@@ -1,0 +1,134 @@
+"""System catalog.
+
+Reference analog: src/backend/catalog (pg_class & friends) plus the pgxc_*
+cluster catalogs (pgxc_node, pgxc_group, pgxc_class, pgxc_shard_map).  The
+coordinator holds only metadata (reference README.md:10-14); here Catalog is
+that metadata: tables, nodes, shard map, sequences.  Persisted as JSON — the
+catalog is tiny and host-side; bulk data lives in the columnar shard stores.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .schema import (ColumnDef, Distribution, DistType, NodeDef, NUM_SHARDS,
+                     SequenceDef, TableDef)
+
+
+class CatalogError(Exception):
+    pass
+
+
+class Catalog:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.tables: dict[str, TableDef] = {}
+        self.nodes: dict[str, NodeDef] = {}
+        self.sequences: dict[str, SequenceDef] = {}
+        # shard map: shard id (0..4095) -> datanode index
+        # (reference: pgxc_shard_map catalog + shmem map, shardmap.c:60-71)
+        self.shard_map: np.ndarray = np.zeros(NUM_SHARDS, dtype=np.int32)
+        self._next_oid = 16384
+
+    # ---- tables ----
+    def create_table(self, td: TableDef, if_not_exists: bool = False) -> TableDef:
+        with self._lock:
+            if td.name in self.tables:
+                if if_not_exists:
+                    return self.tables[td.name]
+                raise CatalogError(f"table {td.name!r} already exists")
+            seen = set()
+            for c in td.columns:
+                if c.name in seen:
+                    raise CatalogError(f"duplicate column {c.name!r}")
+                seen.add(c.name)
+            for dc in td.distribution.dist_cols:
+                if not td.has_column(dc):
+                    raise CatalogError(
+                        f"distribution column {dc!r} not in table {td.name!r}")
+            td.oid = self._next_oid
+            self._next_oid += 1
+            self.tables[td.name] = td
+            return td
+
+    def drop_table(self, name: str, if_exists: bool = False):
+        with self._lock:
+            if name not in self.tables:
+                if if_exists:
+                    return
+                raise CatalogError(f"table {name!r} does not exist")
+            del self.tables[name]
+
+    def table(self, name: str) -> TableDef:
+        td = self.tables.get(name)
+        if td is None:
+            raise CatalogError(f"table {name!r} does not exist")
+        return td
+
+    # ---- nodes / shard map ----
+    def register_node(self, nd: NodeDef):
+        with self._lock:
+            self.nodes[nd.name] = nd
+
+    def datanodes(self) -> list[NodeDef]:
+        return sorted((n for n in self.nodes.values() if n.kind == "datanode"),
+                      key=lambda n: n.index)
+
+    def build_default_shard_map(self, n_datanodes: int):
+        """Round-robin shards over datanodes — the reference populates
+        pgxc_shard_map at CREATE GROUP time similarly (shardmap.c)."""
+        with self._lock:
+            self.shard_map = (np.arange(NUM_SHARDS, dtype=np.int32)
+                              % max(1, n_datanodes))
+
+    def move_shards(self, shard_ids, to_node_index: int):
+        """Online shard move (reference: shard moves + ALTER TABLE ...
+        redistribution, pgxc/locator/redistrib.c)."""
+        with self._lock:
+            self.shard_map[np.asarray(shard_ids, dtype=np.int64)] = to_node_index
+
+    # ---- sequences (global, GTM-served in the reference) ----
+    def create_sequence(self, sd: SequenceDef):
+        with self._lock:
+            if sd.name in self.sequences:
+                raise CatalogError(f"sequence {sd.name!r} already exists")
+            sd.next_value = sd.start
+            self.sequences[sd.name] = sd
+
+    # ---- persistence ----
+    def save(self, path: str):
+        with self._lock:
+            blob = {
+                "tables": [t.to_json() for t in self.tables.values()],
+                "nodes": [n.to_json() for n in self.nodes.values()],
+                "sequences": [s.to_json() for s in self.sequences.values()],
+                "shard_map": self.shard_map.tolist(),
+                "next_oid": self._next_oid,
+            }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(blob, f)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str) -> "Catalog":
+        with open(path) as f:
+            blob = json.load(f)
+        cat = Catalog()
+        for t in blob["tables"]:
+            td = TableDef.from_json(t)
+            cat.tables[td.name] = td
+        for n in blob["nodes"]:
+            nd = NodeDef.from_json(n)
+            cat.nodes[nd.name] = nd
+        for s in blob.get("sequences", []):
+            sd = SequenceDef.from_json(s)
+            cat.sequences[sd.name] = sd
+        cat.shard_map = np.asarray(blob["shard_map"], dtype=np.int32)
+        cat._next_oid = blob.get("next_oid", 16384)
+        return cat

@@ -1,0 +1,114 @@
+"""ColumnBatch — the unit of data flowing through the executor.
+
+The reference executor moves one `TupleTableSlot` at a time through
+`ExecProcNode` (src/backend/executor/execProcnode.c); its vestigial columnar
+hooks (`TupleTableSlot.vector_ptr`, include/executor/tuptable.h:151-156) show
+the direction this rebuild takes natively: operators exchange *columnar
+batches* — a dict of equal-length arrays plus a row-count — because a batch
+of columns is the shape a TPU kernel wants.
+
+A batch's arrays may be numpy (host) or jax (device).  `sel` is an optional
+boolean row mask (the fused qual/visibility output); kernels treat masked-out
+rows as padding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..catalog.types import SqlType
+
+
+@dataclasses.dataclass
+class ColumnSchema:
+    name: str
+    type: SqlType
+
+
+@dataclasses.dataclass
+class ColumnBatch:
+    schema: list[ColumnSchema]
+    columns: dict[str, object]          # name -> np.ndarray | jax.Array
+    nrows: int
+    sel: Optional[object] = None        # bool mask, len == nrows
+    dicts: dict[str, list] = dataclasses.field(default_factory=dict)
+    # dictionary for TEXT columns: name -> list[str], code -> string
+
+    def col(self, name: str):
+        return self.columns[name]
+
+    def col_type(self, name: str) -> SqlType:
+        for cs in self.schema:
+            if cs.name == name:
+                return cs.type
+        raise KeyError(name)
+
+    @property
+    def names(self) -> list[str]:
+        return [c.name for c in self.schema]
+
+    def selected_count(self) -> int:
+        if self.sel is None:
+            return self.nrows
+        return int(np.asarray(self.sel).sum())
+
+    def materialize_host(self) -> "ColumnBatch":
+        """Bring all columns to host numpy and apply `sel` compaction."""
+        cols = {k: np.asarray(v) for k, v in self.columns.items()}
+        n = self.nrows
+        if self.sel is not None:
+            mask = np.asarray(self.sel)[:n]
+            cols = {k: v[:n][mask] for k, v in cols.items()}
+            n = int(mask.sum())
+        else:
+            cols = {k: v[:n] for k, v in cols.items()}
+        return ColumnBatch(self.schema, cols, n, None, dict(self.dicts))
+
+    @staticmethod
+    def concat(batches: list["ColumnBatch"]) -> "ColumnBatch":
+        """Concatenate host batches (dictionaries must already be shared)."""
+        batches = [b.materialize_host() for b in batches]
+        if not batches:
+            raise ValueError("concat of zero batches")
+        first = batches[0]
+        cols = {
+            name: np.concatenate([b.columns[name] for b in batches])
+            for name in first.columns
+        }
+        n = sum(b.nrows for b in batches)
+        return ColumnBatch(first.schema, cols, n, None, dict(first.dicts))
+
+    def to_pylist(self) -> list[tuple]:
+        """Decode to python tuples (tests / client output)."""
+        from ..catalog.types import TypeKind, days_to_date, int_to_decimal
+
+        b = self.materialize_host()
+        out_cols = []
+        for cs in b.schema:
+            arr = b.columns[cs.name]
+            if cs.type.kind == TypeKind.TEXT:
+                d = b.dicts.get(cs.name, [])
+                out_cols.append([d[int(i)] if 0 <= int(i) < len(d) else None
+                                 for i in arr])
+            elif cs.type.kind == TypeKind.DECIMAL:
+                out_cols.append([int_to_decimal(int(v), cs.type.scale)
+                                 for v in arr])
+            elif cs.type.kind == TypeKind.DATE:
+                out_cols.append([days_to_date(int(v)) for v in arr])
+            elif cs.type.kind == TypeKind.FLOAT64:
+                out_cols.append([float(v) for v in arr])
+            else:
+                out_cols.append([int(v) for v in arr])
+        return list(zip(*out_cols)) if out_cols else []
+
+
+def next_pow2(n: int, floor: int = 256) -> int:
+    """Size-class for padded device batches: keeps XLA recompiles bounded
+    (the dynamic-shape strategy from SURVEY.md §7.3)."""
+    p = floor
+    while p < n:
+        p <<= 1
+    return p

@@ -1,0 +1,222 @@
+"""Columnar shard store — the datanode's table storage.
+
+Reference analog: heap storage (src/backend/access/heap) + buffer manager
+(src/backend/storage/buffer).  Re-designed columnar/TPU-first:
+
+- A table on a datanode is a list of fixed-capacity columnar Chunks
+  (column arrays in host RAM; device HBM is a staging cache, never the
+  source of truth — SURVEY.md §7.1).
+- MVCC lives in four per-row int64/int32 columns: xmin_ts / xmax_ts
+  (commit GTS of creator/deleter — the reference embeds exactly these two
+  8-byte GTS fields in every heap tuple header,
+  include/access/htup_details.h:126-144) and xmin_txid / xmax_txid for
+  in-progress/own-transaction checks.  Visibility is a vector compare
+  (reference: per-tuple HeapTupleSatisfiesMVCC, utils/time/tqual.c:1203).
+- Every row stores its shard id (reference: HeapTupleHeader t_shardid,
+  htup_details.h:191; extents are shard-pure, extentmapping.h:129).
+- TEXT columns are dictionary-encoded per store; the dictionary maps
+  code -> str and is node-local (joins are never on raw strings; group-by
+  results are decoded before crossing nodes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..catalog.schema import TableDef
+from ..catalog.types import TypeKind
+
+INF_TS = np.int64(1 << 62)        # "not yet deleted" / "not yet committed"
+ABORTED_TS = np.int64((1 << 62) + 1)  # creator aborted: never visible
+NO_TXID = np.int64(0)
+
+CHUNK_CAP = 1 << 16
+
+
+class WriteConflict(Exception):
+    """Concurrent write-write conflict (first-deleter-wins)."""
+
+
+class StringDict:
+    """Append-only code<->string dictionary for one TEXT column."""
+
+    def __init__(self):
+        self.values: list[str] = []
+        self._index: dict[str, int] = {}
+
+    def encode_one(self, s: str) -> int:
+        code = self._index.get(s)
+        if code is None:
+            code = len(self.values)
+            self.values.append(s)
+            self._index[s] = code
+        return code
+
+    def encode(self, strings) -> np.ndarray:
+        return np.fromiter((self.encode_one(s) for s in strings),
+                           dtype=np.int32, count=len(strings))
+
+    def decode(self, codes: np.ndarray) -> list[str]:
+        return [self.values[int(c)] for c in codes]
+
+    def codes_matching(self, pred) -> np.ndarray:
+        """All codes whose string satisfies `pred` — string predicates are
+        evaluated once against the dictionary, then become device-side code
+        membership masks."""
+        return np.asarray([i for i, v in enumerate(self.values) if pred(v)],
+                          dtype=np.int32)
+
+
+@dataclasses.dataclass
+class Chunk:
+    columns: dict[str, np.ndarray]
+    xmin_ts: np.ndarray
+    xmax_ts: np.ndarray
+    xmin_txid: np.ndarray
+    xmax_txid: np.ndarray
+    shardid: np.ndarray
+    nrows: int
+    cap: int
+
+    @staticmethod
+    def empty(td: TableDef, cap: int = CHUNK_CAP) -> "Chunk":
+        cols = {c.name: np.empty(cap, dtype=c.type.np_dtype)
+                for c in td.columns}
+        return Chunk(
+            columns=cols,
+            xmin_ts=np.empty(cap, dtype=np.int64),
+            xmax_ts=np.empty(cap, dtype=np.int64),
+            xmin_txid=np.empty(cap, dtype=np.int64),
+            xmax_txid=np.empty(cap, dtype=np.int64),
+            shardid=np.empty(cap, dtype=np.int32),
+            nrows=0, cap=cap)
+
+    @property
+    def free(self) -> int:
+        return self.cap - self.nrows
+
+
+class TableStore:
+    """All chunks of one table on one datanode."""
+
+    def __init__(self, td: TableDef):
+        self.td = td
+        self.chunks: list[Chunk] = []
+        self.dicts: dict[str, StringDict] = {
+            c.name: StringDict() for c in td.columns
+            if c.type.kind == TypeKind.TEXT}
+
+    # ------------------------------------------------------------------
+    def row_count(self) -> int:
+        return sum(c.nrows for c in self.chunks)
+
+    def encode_column(self, name: str, values) -> np.ndarray:
+        """Convert python/raw values into the stored array representation."""
+        col = self.td.column(name)
+        k = col.type.kind
+        if k == TypeKind.TEXT:
+            return self.dicts[name].encode([str(v) for v in values])
+        arr = np.asarray(values)
+        if k == TypeKind.DECIMAL:
+            scale = col.type.scale
+            if arr.dtype.kind in "iu":
+                return arr.astype(np.int64) * np.int64(10 ** scale)
+            if arr.dtype.kind == "f":
+                return np.round(arr * 10 ** scale).astype(np.int64)
+            from ..catalog.types import decimal_to_int
+            return np.asarray([decimal_to_int(v, scale)
+                               for v in values], dtype=np.int64)
+        if k == TypeKind.DATE and arr.dtype.kind in "UO":
+            from ..catalog.types import date_to_days
+            return np.asarray([date_to_days(str(v)) for v in values],
+                              dtype=np.int32)
+        return arr.astype(col.type.np_dtype)
+
+    def insert(self, columns: dict[str, np.ndarray], nrows: int,
+               txid: int, shardids: Optional[np.ndarray] = None,
+               commit_ts: Optional[int] = None) -> list[tuple[int, int, int]]:
+        """Append rows (already encoded).  Returns [(chunk_idx, start, end)]
+        spans for the transaction's backfill list.  If commit_ts is given the
+        rows are born committed (bulk load fast path, like the reference's
+        COPY FREEZE)."""
+        spans = []
+        done = 0
+        born_ts = INF_TS if commit_ts is None else np.int64(commit_ts)
+        while done < nrows:
+            if not self.chunks or self.chunks[-1].free == 0:
+                self.chunks.append(Chunk.empty(self.td, CHUNK_CAP))
+            ch = self.chunks[-1]
+            take = min(ch.free, nrows - done)
+            lo, hi = ch.nrows, ch.nrows + take
+            for name, arr in columns.items():
+                ch.columns[name][lo:hi] = arr[done:done + take]
+            ch.xmin_ts[lo:hi] = born_ts
+            ch.xmax_ts[lo:hi] = INF_TS
+            ch.xmin_txid[lo:hi] = txid
+            ch.xmax_txid[lo:hi] = NO_TXID
+            ch.shardid[lo:hi] = (shardids[done:done + take]
+                                 if shardids is not None else -1)
+            ch.nrows = hi
+            spans.append((len(self.chunks) - 1, lo, hi))
+            done += take
+        return spans
+
+    def mark_delete(self, chunk_idx: int, row_mask: np.ndarray,
+                    txid: int) -> tuple[int, np.ndarray]:
+        """Stamp xmax_txid for rows being deleted by txn (pending until
+        commit backfills xmax_ts).  Raises on write-write conflict with
+        another in-progress deleter (the reference blocks on the first
+        updater's xid; we use first-deleter-wins + error, serializable-lite).
+        Returns a (chunk_idx, row_indexes) span for the txn's backfill list.
+        """
+        ch = self.chunks[chunk_idx]
+        idx = np.nonzero(row_mask[:ch.nrows])[0]
+        other = ch.xmax_txid[idx]
+        conflict = (other != NO_TXID) & (other != txid)
+        if conflict.any():
+            raise WriteConflict(
+                f"row already deleted by in-progress txn "
+                f"{int(other[conflict][0])}")
+        ch.xmax_txid[idx] = txid
+        return (chunk_idx, idx)
+
+    # -- commit/abort backfill (the CSN-log analog: we resolve commit
+    #    timestamps into the hint columns eagerly, host-side; reference
+    #    defers via csnlog.c + tqual.c hint-bit stamping).  All backfills
+    #    are span-driven: commit cost is O(rows touched), not O(table). --
+    def backfill_insert(self, spans, ts: np.int64):
+        for ci, lo, hi in spans:
+            self.chunks[ci].xmin_ts[lo:hi] = ts
+
+    def abort_insert(self, spans):
+        for ci, lo, hi in spans:
+            self.chunks[ci].xmin_ts[lo:hi] = ABORTED_TS
+
+    def backfill_delete(self, spans, ts: np.int64):
+        for ci, idx in spans:
+            self.chunks[ci].xmax_ts[idx] = ts
+
+    def revert_delete(self, spans):
+        for ci, idx in spans:
+            self.chunks[ci].xmax_txid[idx] = NO_TXID
+
+    # ------------------------------------------------------------------
+    def scan_chunks(self) -> Iterator[tuple[int, Chunk]]:
+        for i, ch in enumerate(self.chunks):
+            if ch.nrows:
+                yield i, ch
+
+    def visible_mask(self, ch: Chunk, snap_ts: int, my_txid: int) -> np.ndarray:
+        """Host-side reference implementation of the visibility rule; the
+        device kernel in ops/visibility.py computes the same mask fused into
+        scans (reference: HeapTupleSatisfiesMVCC, tqual.c:1203,2133)."""
+        n = ch.nrows
+        xmin_ts = ch.xmin_ts[:n]
+        xmax_ts = ch.xmax_ts[:n]
+        ins_visible = (xmin_ts <= snap_ts) | (
+            (ch.xmin_txid[:n] == my_txid) & (xmin_ts != ABORTED_TS))
+        del_visible = (xmax_ts <= snap_ts) | (ch.xmax_txid[:n] == my_txid)
+        return ins_visible & ~del_visible

@@ -1,0 +1,85 @@
+"""Stable 64-bit hashing, identical on host (numpy) and device (JAX).
+
+The reference routes every tuple through `EvaluateShardId`
+(src/backend/pgxc/shard/shardmap.c:2231) — a per-tuple hash of the
+distribution column(s) modulo the 4096-entry shard map.  Here the same hash
+must be computable both host-side (planner/locator routing of literals,
+COPY routing) and device-side (vectorized redistribution: one hash kernel per
+batch feeding `all_to_all`), and must agree bit-for-bit so that FQS routing
+decisions match where the executor actually put the rows.
+
+splitmix64 is used as the finalizer: cheap, well-distributed, and expressible
+in pure uint64 arithmetic in both numpy and XLA.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_C1 = 0xBF58476D1CE4E5B9
+_C2 = 0x94D049BB133111EB
+_GOLDEN = 0x9E3779B97F4A7C15
+_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 over a uint64/int64 numpy array."""
+    z = x.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        z += np.uint64(_GOLDEN)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(_C1)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(_C2)
+        z = z ^ (z >> np.uint64(31))
+    return z
+
+
+def splitmix64_jax(x):
+    """Same transform under jax tracing (uint64, requires x64 mode)."""
+    import jax.numpy as jnp
+
+    z = x.astype(jnp.uint64)
+    z = z + jnp.uint64(_GOLDEN)
+    z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(_C1)
+    z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(_C2)
+    z = z ^ (z >> jnp.uint64(31))
+    return z
+
+
+def combine_np(h: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """Multi-column hash combiner (host)."""
+    with np.errstate(over="ignore"):
+        return splitmix64_np(h.astype(np.uint64) ^ x.astype(np.uint64))
+
+
+def combine_jax(h, x):
+    import jax.numpy as jnp
+
+    return splitmix64_jax(h.astype(jnp.uint64) ^ x.astype(jnp.uint64))
+
+
+def hash_columns_np(cols: list[np.ndarray]) -> np.ndarray:
+    """Hash one or more integer-representable columns row-wise -> uint64."""
+    h = splitmix64_np(cols[0].astype(np.int64).view(np.uint64)
+                      if cols[0].dtype == np.int64
+                      else cols[0].astype(np.uint64))
+    for c in cols[1:]:
+        h = combine_np(h, c.astype(np.uint64))
+    return h
+
+
+def hash_columns_jax(cols):
+    import jax.numpy as jnp
+
+    h = splitmix64_jax(cols[0].astype(jnp.uint64))
+    for c in cols[1:]:
+        h = combine_jax(h, c)
+    return h
+
+
+def hash_string(s: str) -> int:
+    """Stable scalar hash for string distribution keys (host-side only)."""
+    h = np.uint64(0xCBF29CE484222325)
+    with np.errstate(over="ignore"):
+        for b in s.encode("utf-8"):
+            h = (h ^ np.uint64(b)) * np.uint64(0x100000001B3)
+    return int(splitmix64_np(np.asarray([h]))[0])

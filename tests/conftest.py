@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Distributed tests run on a virtual 8-device CPU mesh (the reference tests
+multi-node behavior with real mini-clusters on one machine,
+src/test/opentenbase_test/ — our analog is N jax CPU devices standing in for
+N datanode chips).  These env vars must be set before jax is imported.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

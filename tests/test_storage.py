@@ -1,0 +1,262 @@
+"""Storage substrate: types, hashing, catalog, locator, chunks, WAL."""
+
+import numpy as np
+import pytest
+
+from opentenbase_tpu.catalog import types as T
+from opentenbase_tpu.catalog.catalog import Catalog, CatalogError
+from opentenbase_tpu.catalog.schema import (ColumnDef, Distribution, DistType,
+                                            NodeDef, NUM_SHARDS, TableDef)
+from opentenbase_tpu.parallel.locator import Locator, shard_ids_for_columns
+from opentenbase_tpu.storage.store import INF_TS, TableStore
+from opentenbase_tpu.storage.wal import (Wal, checkpoint_store, restore_store)
+from opentenbase_tpu.utils import hashing
+
+
+def make_table(name="t", dist=None):
+    return TableDef(name, [
+        ColumnDef("k", T.INT64),
+        ColumnDef("price", T.decimal(15, 2)),
+        ColumnDef("d", T.DATE),
+        ColumnDef("flag", T.TEXT),
+    ], dist or Distribution(DistType.SHARD, ["k"]))
+
+
+def make_catalog(ndn=4):
+    cat = Catalog()
+    for i in range(ndn):
+        cat.register_node(NodeDef(f"dn{i}", "datanode", index=i))
+    cat.build_default_shard_map(ndn)
+    return cat
+
+
+class TestTypes:
+    def test_decimal_roundtrip(self):
+        assert T.decimal_to_int("123.45", 2) == 12345
+        assert T.decimal_to_int("-0.07", 2) == -7
+        assert T.decimal_to_int("5", 2) == 500
+        assert T.decimal_to_int("1.239", 2) == 123  # truncate
+        assert T.int_to_decimal(12345, 2) == 123.45
+
+    def test_decimal_encode_input_kinds(self):
+        st = TableStore(make_table())
+        # int, float and string inputs of the same logical value must agree
+        assert st.encode_column("price", [5]).tolist() == [500]
+        assert st.encode_column("price", [5.0]).tolist() == [500]
+        assert st.encode_column("price", ["5"]).tolist() == [500]
+        assert st.encode_column("price", [0.07]).tolist() == [7]
+
+    def test_date_roundtrip(self):
+        d = T.date_to_days("1995-03-15")
+        assert T.days_to_date(d) == "1995-03-15"
+        assert T.date_to_days("1970-01-01") == 0
+        assert T.date_to_days("1970-01-02") == 1
+
+    def test_type_from_name(self):
+        assert T.type_from_name("bigint") is T.INT64
+        t = T.type_from_name("decimal", (15, 2))
+        assert t.scale == 2 and t.np_dtype == np.int64
+        assert T.type_from_name("varchar", (25,)).kind == T.TypeKind.TEXT
+
+
+class TestHashing:
+    def test_host_device_agree(self):
+        jnp = pytest.importorskip("jax.numpy")
+        x = np.asarray([0, 1, 2, 12345678901234, -5], dtype=np.int64)
+        h_np = hashing.hash_columns_np([x])
+        h_jx = np.asarray(hashing.hash_columns_jax([jnp.asarray(x)]))
+        np.testing.assert_array_equal(h_np, h_jx.astype(np.uint64))
+
+    def test_distribution_uniform(self):
+        x = np.arange(100000, dtype=np.int64)
+        sid = shard_ids_for_columns([x])
+        counts = np.bincount(sid, minlength=NUM_SHARDS)
+        assert counts.min() > 0
+        assert counts.max() < counts.mean() * 2
+
+    def test_multicolumn(self):
+        a = np.arange(1000, dtype=np.int64)
+        b = np.ones(1000, dtype=np.int64)
+        assert not np.array_equal(hashing.hash_columns_np([a]),
+                                  hashing.hash_columns_np([a, b]))
+
+
+class TestCatalog:
+    def test_create_drop(self):
+        cat = make_catalog()
+        cat.create_table(make_table())
+        assert cat.table("t").column("price").type.scale == 2
+        with pytest.raises(CatalogError):
+            cat.create_table(make_table())
+        cat.drop_table("t")
+        with pytest.raises(CatalogError):
+            cat.table("t")
+
+    def test_bad_dist_col(self):
+        cat = make_catalog()
+        with pytest.raises(CatalogError):
+            cat.create_table(make_table(
+                dist=Distribution(DistType.SHARD, ["nope"])))
+
+    def test_persistence(self, tmp_path):
+        cat = make_catalog()
+        cat.create_table(make_table())
+        p = str(tmp_path / "cat.json")
+        cat.save(p)
+        cat2 = Catalog.load(p)
+        assert cat2.table("t").column_names == ["k", "price", "d", "flag"]
+        np.testing.assert_array_equal(cat.shard_map, cat2.shard_map)
+        assert len(cat2.datanodes()) == 4
+
+    def test_shard_move(self):
+        cat = make_catalog(2)
+        cat.move_shards([0, 1, 2], 1)
+        assert all(cat.shard_map[i] == 1 for i in range(3))
+
+
+class TestLocator:
+    def test_shard_routing_agrees_point_vs_batch(self):
+        cat = make_catalog(4)
+        td = cat.create_table(make_table())
+        loc = Locator(cat)
+        keys = np.arange(1000, dtype=np.int64)
+        nodes = loc.route_rows(td, {"k": keys}, 1000)
+        for k in [0, 17, 999]:
+            assert loc.node_for_values(td, [k]) == nodes[k]
+
+    def test_replicated(self):
+        cat = make_catalog(3)
+        td = cat.create_table(make_table(
+            "r", Distribution(DistType.REPLICATED)))
+        loc = Locator(cat)
+        assert loc.nodes_for_table(td) == [0, 1, 2]
+
+    def test_text_dist_key(self):
+        cat = make_catalog(4)
+        td = cat.create_table(TableDef("s", [
+            ColumnDef("name", T.TEXT), ColumnDef("v", T.INT64),
+        ], Distribution(DistType.SHARD, ["name"])))
+        loc = Locator(cat)
+        names = np.asarray(["alpha", "beta", "gamma"], dtype=object)
+        nodes = loc.route_rows(td, {"name": names}, 3)
+        for i, s in enumerate(["alpha", "beta", "gamma"]):
+            assert loc.node_for_values(td, [s]) == nodes[i]
+        # dictionary codes must be rejected (node-local, unroutable)
+        with pytest.raises(ValueError):
+            loc.route_rows(td, {"name": np.asarray([0, 1], np.int32)}, 2)
+
+    def test_roundrobin(self):
+        cat = make_catalog(3)
+        td = cat.create_table(make_table(
+            "rr", Distribution(DistType.ROUNDROBIN)))
+        loc = Locator(cat)
+        nodes = loc.route_rows(td, {}, 7)
+        assert nodes.tolist() == [0, 1, 2, 0, 1, 2, 0]
+        assert loc.route_rows(td, {}, 2).tolist() == [1, 2]
+
+
+class TestStore:
+    def test_insert_and_visibility(self):
+        td = make_table()
+        st = TableStore(td)
+        cols = {
+            "k": st.encode_column("k", [1, 2, 3]),
+            "price": st.encode_column("price", ["1.50", "2.25", "3.00"]),
+            "d": st.encode_column("d", ["1995-01-01"] * 3),
+            "flag": st.encode_column("flag", ["A", "B", "A"]),
+        }
+        spans = st.insert(cols, 3, txid=7)
+        assert st.row_count() == 3
+        ch = st.chunks[0]
+        # uncommitted: invisible to others, visible to self
+        assert st.visible_mask(ch, snap_ts=100, my_txid=8).sum() == 0
+        assert st.visible_mask(ch, snap_ts=100, my_txid=7).sum() == 3
+        st.backfill_insert(spans, np.int64(50))
+        assert st.visible_mask(ch, snap_ts=100, my_txid=8).sum() == 3
+        assert st.visible_mask(ch, snap_ts=40, my_txid=8).sum() == 0
+
+    def test_delete_visibility(self):
+        td = make_table()
+        st = TableStore(td)
+        cols = {n: st.encode_column(n, v) for n, v in
+                [("k", [1, 2]), ("price", ["1", "2"]),
+                 ("d", ["1995-01-01"] * 2), ("flag", ["A", "B"])]}
+        st.insert(cols, 2, txid=1, commit_ts=10)
+        ch = st.chunks[0]
+        span = st.mark_delete(0, np.asarray([True, False]), txid=5)
+        # deleter in progress: still visible to others, gone for deleter
+        assert st.visible_mask(ch, 100, my_txid=9).sum() == 2
+        assert st.visible_mask(ch, 100, my_txid=5).sum() == 1
+        # concurrent delete of same row -> write-write conflict
+        from opentenbase_tpu.storage.store import WriteConflict
+        with pytest.raises(WriteConflict):
+            st.mark_delete(0, np.asarray([True, True]), txid=6)
+        st.backfill_delete([span], np.int64(60))
+        assert st.visible_mask(ch, 100, my_txid=9).sum() == 1
+        assert st.visible_mask(ch, 50, my_txid=9).sum() == 2  # before delete
+
+    def test_abort_paths(self):
+        td = make_table()
+        st = TableStore(td)
+        cols = {n: st.encode_column(n, v) for n, v in
+                [("k", [1]), ("price", ["1"]), ("d", ["1995-01-01"]),
+                 ("flag", ["A"])]}
+        spans = st.insert(cols, 1, txid=3)
+        st.abort_insert(spans)
+        assert st.visible_mask(st.chunks[0], 10**9, my_txid=3).sum() == 0
+        # delete then abort -> row stays visible, lock released
+        st.insert(cols, 1, txid=4, commit_ts=5)
+        span = st.mark_delete(0, np.asarray([False, True]), txid=7)
+        st.revert_delete([span])
+        assert st.visible_mask(st.chunks[0], 100, my_txid=9).sum() == 1
+        st.mark_delete(0, np.asarray([False, True]), txid=8)  # no conflict
+
+    def test_dictionary_encoding(self):
+        td = make_table()
+        st = TableStore(td)
+        codes = st.encode_column("flag", ["N", "R", "N", "A"])
+        assert codes.tolist() == [0, 1, 0, 2]
+        assert st.dicts["flag"].decode(codes) == ["N", "R", "N", "A"]
+        m = st.dicts["flag"].codes_matching(lambda s: s <= "N")
+        assert m.tolist() == [0, 2]
+
+    def test_multi_chunk(self):
+        td = TableDef("big", [ColumnDef("k", T.INT64)],
+                      Distribution(DistType.SHARD, ["k"]))
+        st = TableStore(td)
+        n = (1 << 16) + 100
+        st.insert({"k": np.arange(n, dtype=np.int64)}, n, txid=1, commit_ts=1)
+        assert st.row_count() == n
+        assert len(st.chunks) == 2
+
+
+class TestWal:
+    def test_append_replay_torn_tail(self, tmp_path):
+        p = str(tmp_path / "wal.log")
+        w = Wal(p)
+        w.append({"op": "insert", "n": 1})
+        w.append({"op": "commit", "txid": 1, "ts": 5})
+        w.flush()
+        # simulate torn write
+        with open(p, "ab") as f:
+            f.write(b"\x99\x00\x00\x00garbage")
+        recs = list(Wal.replay(p))
+        assert [r["op"] for r in recs] == ["insert", "commit"]
+        w.close()
+
+    def test_checkpoint_restore(self, tmp_path):
+        td = make_table()
+        st = TableStore(td)
+        cols = {n: st.encode_column(n, v) for n, v in
+                [("k", [1, 2, 3]), ("price", ["1.5", "2", "3"]),
+                 ("d", ["1995-01-01"] * 3), ("flag", ["X", "Y", "X"])]}
+        st.insert(cols, 3, txid=1, commit_ts=9)
+        p = str(tmp_path / "t.ckpt")
+        checkpoint_store(st, p)
+        st2 = TableStore(td)
+        restore_store(st2, p)
+        assert st2.row_count() == 3
+        np.testing.assert_array_equal(
+            st2.chunks[0].columns["k"][:3], [1, 2, 3])
+        assert st2.dicts["flag"].values == ["X", "Y"]
+        assert st2.visible_mask(st2.chunks[0], 100, 2).sum() == 3
