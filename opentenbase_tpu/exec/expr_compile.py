@@ -1,0 +1,268 @@
+"""Expression compiler: typed Expr trees -> jax-traceable closures.
+
+Reference analog: ExecReadyInterpretedExpr building the EEOP_* opcode program
+(src/backend/executor/execExpr.c, execExprInterp.c:120-124) and the LLVM JIT
+tier (src/backend/jit/llvm/llvmjit_expr.c).  Here both tiers are one step:
+`compile_expr` returns a python closure over a dict of column arrays; traced
+under jax.jit it becomes fused XLA ops — the TPU executes the whole
+qual+projection as part of the scan kernel, no per-tuple dispatch.
+
+String predicates (LIKE/=/< over TEXT) are resolved at compile time against
+the store's dictionary into code sets; on device they are integer membership
+tests.  This trades the reference's per-tuple varlena compares for one
+host-side dictionary pass per (query, dictionary version).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..catalog.types import TypeKind
+from ..plan import exprs as E
+
+Arrays = dict  # name -> jnp array
+
+
+def like_to_regex(pattern: str) -> re.Pattern:
+    """SQL LIKE -> anchored python regex (%, _ wildcards)."""
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.S)
+
+
+def _np_dtype(t) -> np.dtype:
+    return t.np_dtype
+
+
+def _rescale(fn, from_scale: int, to_scale: int):
+    if from_scale == to_scale:
+        return fn
+    if to_scale > from_scale:
+        mult = 10 ** (to_scale - from_scale)
+        return lambda cols, _f=fn, _m=mult: _f(cols) * jnp.int64(_m)
+    div = 10 ** (from_scale - to_scale)
+    return lambda cols, _f=fn, _d=div: jnp.floor_divide(_f(cols),
+                                                        jnp.int64(_d))
+
+
+def _codes_for_strpred(pred: E.StrPred, dicts: dict) -> np.ndarray:
+    d = dicts.get(pred.col.name)
+    if d is None:
+        raise E.ExprError(f"no dictionary for TEXT column {pred.col.name!r}")
+    k = pred.kind
+    if k in ("eq", "ne", "in"):
+        wanted = set(pred.patterns)
+        test = lambda s: s in wanted
+    elif k in ("like", "not_like"):
+        rx = like_to_regex(pred.patterns[0])
+        test = lambda s: rx.match(s) is not None
+    elif k in ("lt", "le", "gt", "ge"):
+        p = pred.patterns[0]
+        test = {"lt": lambda s: s < p, "le": lambda s: s <= p,
+                "gt": lambda s: s > p, "ge": lambda s: s >= p}[k]
+    else:
+        raise E.ExprError(f"unknown string predicate {k}")
+    return d.codes_matching(test)
+
+
+def _membership(arr, codes: np.ndarray):
+    """Integer membership test, shaped for TPU: small sets unroll to fused
+    compares; larger sets use a sorted-search.  Comparison values take the
+    array's own dtype (dictionary codes are int32, but InList values may be
+    full int64)."""
+    if len(codes) == 0:
+        return jnp.zeros(arr.shape, dtype=bool)
+    if len(codes) <= 16:
+        m = arr == jnp.asarray(int(codes[0]), dtype=arr.dtype)
+        for c in codes[1:]:
+            m = m | (arr == jnp.asarray(int(c), dtype=arr.dtype))
+        return m
+    sorted_codes = jnp.asarray(np.sort(codes)).astype(arr.dtype)
+    pos = jnp.searchsorted(sorted_codes, arr)
+    pos = jnp.clip(pos, 0, len(codes) - 1)
+    return sorted_codes[pos] == arr
+
+
+# days-since-epoch -> civil date fields (branchless; Howard Hinnant's
+# civil_from_days, public-domain algorithm)
+def _civil(days):
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(doe - doe // 1460 + doe // 36524 - doe // 146096,
+                           365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    day = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    month = mp + jnp.where(mp < 10, 3, -9)
+    year = y + (month <= 2)
+    return year, month, day
+
+
+def compile_expr(e: E.Expr, dicts: dict) -> Callable[[Arrays], object]:
+    """Return fn(columns) -> array.  `dicts` maps TEXT column name ->
+    StringDict for string-predicate resolution."""
+
+    def c(x: E.Expr) -> Callable[[Arrays], object]:
+        if isinstance(x, E.Col):
+            name = x.name
+            return lambda cols: cols[name]
+
+        if isinstance(x, E.Lit):
+            t = x.lit_type
+            val = x.value
+            dt = _np_dtype(t)
+            return lambda cols: jnp.asarray(val, dtype=dt)
+
+        if isinstance(x, E.Arith):
+            lt, rt = x.left.type, x.right.type
+            lf, rf = c(x.left), c(x.right)
+            if x.type.kind == TypeKind.FLOAT64:
+                lf2 = (lambda cols, _f=lf, _s=lt.scale:
+                       _f(cols).astype(jnp.float64) / 10 ** _s) \
+                    if lt.kind == TypeKind.DECIMAL else \
+                    (lambda cols, _f=lf: _f(cols).astype(jnp.float64))
+                rf2 = (lambda cols, _f=rf, _s=rt.scale:
+                       _f(cols).astype(jnp.float64) / 10 ** _s) \
+                    if rt.kind == TypeKind.DECIMAL else \
+                    (lambda cols, _f=rf: _f(cols).astype(jnp.float64))
+                op = x.op
+                return {"+": lambda cols: lf2(cols) + rf2(cols),
+                        "-": lambda cols: lf2(cols) - rf2(cols),
+                        "*": lambda cols: lf2(cols) * rf2(cols),
+                        "/": lambda cols: lf2(cols) / rf2(cols)}[op]
+            if x.type.kind == TypeKind.DECIMAL and x.op in "+-":
+                s = x.type.scale
+                lf = _rescale(lf, lt.scale if lt.kind == TypeKind.DECIMAL
+                              else 0, s) if lt.kind == TypeKind.DECIMAL \
+                    else _rescale(lambda cols, _f=lf: _f(cols).astype(jnp.int64),
+                                  0, s)
+                rf = _rescale(rf, rt.scale if rt.kind == TypeKind.DECIMAL
+                              else 0, s) if rt.kind == TypeKind.DECIMAL \
+                    else _rescale(lambda cols, _f=rf: _f(cols).astype(jnp.int64),
+                                  0, s)
+            if x.op == "+":
+                return lambda cols: lf(cols) + rf(cols)
+            if x.op == "-":
+                return lambda cols: lf(cols) - rf(cols)
+            if x.op == "*":
+                return lambda cols: (lf(cols).astype(jnp.int64)
+                                     * rf(cols).astype(jnp.int64)) \
+                    if x.type.kind == TypeKind.DECIMAL \
+                    else lf(cols) * rf(cols)
+            raise E.ExprError(f"bad arith op {x.op}")
+
+        if isinstance(x, E.Neg):
+            f = c(x.arg)
+            return lambda cols: -f(cols)
+
+        if isinstance(x, E.Cmp):
+            lt, rt = x.left.type, x.right.type
+            lf, rf = c(x.left), c(x.right)
+            # align decimal scales / promote to float if either is float
+            if TypeKind.FLOAT64 in (lt.kind, rt.kind):
+                def mk(f, t):
+                    if t.kind == TypeKind.DECIMAL:
+                        return lambda cols: f(cols).astype(jnp.float64) / 10 ** t.scale
+                    return lambda cols: f(cols).astype(jnp.float64)
+                lf, rf = mk(lf, lt), mk(rf, rt)
+            elif TypeKind.DECIMAL in (lt.kind, rt.kind):
+                s = max(lt.scale, rt.scale)
+                lf = _rescale(lf, lt.scale, s)
+                rf = _rescale(rf, rt.scale, s)
+            op = x.op
+            return {"=": lambda cols: lf(cols) == rf(cols),
+                    "<>": lambda cols: lf(cols) != rf(cols),
+                    "<": lambda cols: lf(cols) < rf(cols),
+                    "<=": lambda cols: lf(cols) <= rf(cols),
+                    ">": lambda cols: lf(cols) > rf(cols),
+                    ">=": lambda cols: lf(cols) >= rf(cols)}[op]
+
+        if isinstance(x, E.BoolOp):
+            fs = [c(a) for a in x.args]
+            if x.op == "and":
+                def andf(cols):
+                    m = fs[0](cols)
+                    for f in fs[1:]:
+                        m = m & f(cols)
+                    return m
+                return andf
+            def orf(cols):
+                m = fs[0](cols)
+                for f in fs[1:]:
+                    m = m | f(cols)
+                return m
+            return orf
+
+        if isinstance(x, E.Not):
+            f = c(x.arg)
+            return lambda cols: ~f(cols)
+
+        if isinstance(x, E.Case):
+            conds = [c(w[0]) for w in x.whens]
+            vals = [c(w[1]) for w in x.whens]
+            elsef = c(x.else_) if x.else_ is not None else None
+            dt = _np_dtype(x.type)
+
+            def casef(cols):
+                out = elsef(cols) if elsef is not None \
+                    else jnp.zeros((), dtype=dt)
+                for cond, val in zip(reversed(conds), reversed(vals)):
+                    out = jnp.where(cond(cols), val(cols), out)
+                return out
+            return casef
+
+        if isinstance(x, E.InList):
+            f = c(x.arg)
+            vals = np.asarray(x.values)
+            return lambda cols: _membership(f(cols), vals)
+
+        if isinstance(x, E.StrPred):
+            codes = _codes_for_strpred(x, dicts)
+            name = x.col.name
+            neg = x.kind in ("ne", "not_like")
+            if neg:
+                return lambda cols: ~_membership(cols[name], codes)
+            return lambda cols: _membership(cols[name], codes)
+
+        if isinstance(x, E.Extract):
+            f = c(x.arg)
+            idx = {"year": 0, "month": 1, "day": 2}[x.field]
+            return lambda cols: _civil(f(cols))[idx].astype(jnp.int32)
+
+        if isinstance(x, E.Cast):
+            f = c(x.arg)
+            src, dst = x.arg.type, x.to
+            if dst.kind == TypeKind.FLOAT64 and src.kind == TypeKind.DECIMAL:
+                return lambda cols: f(cols).astype(jnp.float64) / 10 ** src.scale
+            if dst.kind == TypeKind.DECIMAL and src.kind == TypeKind.DECIMAL:
+                return _rescale(f, src.scale, dst.scale)
+            if dst.kind in (TypeKind.INT32, TypeKind.INT64) \
+                    and src.kind == TypeKind.DECIMAL:
+                dt = _np_dtype(dst)
+                sc = 10 ** src.scale
+                return lambda cols: jnp.floor_divide(
+                    f(cols), jnp.int64(sc)).astype(dt)
+            if dst.kind == TypeKind.DECIMAL and src.kind in (
+                    TypeKind.INT32, TypeKind.INT64):
+                return lambda cols: f(cols).astype(jnp.int64) * 10 ** dst.scale
+            if dst.kind == TypeKind.DECIMAL and src.kind == TypeKind.FLOAT64:
+                return lambda cols: jnp.round(
+                    f(cols) * 10 ** dst.scale).astype(jnp.int64)
+            dt = _np_dtype(dst)
+            return lambda cols: f(cols).astype(dt)
+
+        raise E.ExprError(f"cannot compile {type(x).__name__}")
+
+    return c(e)

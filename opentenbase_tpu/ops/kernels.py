@@ -1,0 +1,267 @@
+"""Device kernel library — the DataNode executor hot loops as XLA programs.
+
+Reference analog (SURVEY.md §7.4): ExecSeqScan + qual/projection
+(execScan.c, execExprInterp.c), ExecAgg's TupleHashTable (nodeAgg.c,
+execGrouping.c), ExecHashJoin's bucketed probe loop (nodeHash.c:570,
+nodeHashjoin.c), tuplesort.  Those are per-tuple, pointer-chasing designs;
+here every operator is a static-shape array program:
+
+- dynamic result sizes are handled by (padded arrays + count) pairs with
+  power-of-two size classes (storage/batch.py:next_pow2), so XLA compiles
+  one program per size class, not per query;
+- group-by is either *dense* (scatter-add over a precomputed bounded group
+  id — the path TPC-H Q1 takes, no sort, pure VPU/MXU work) or *sort-based*
+  (lexicographic sort + segment reduce) for unbounded keys;
+- join is sort+binary-search (build side sorted once; probe via two
+  searchsorted passes, then a static-size pair expansion) — the TPU-friendly
+  replacement for a chained hash table; multi-key joins combine via a 64-bit
+  hash with a residual equality filter added by the planner;
+- all kernels take/return whole batches; invalid rows ride along masked.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT64_MAX = np.int64(2**63 - 1)
+
+
+# ---------------------------------------------------------------------------
+# visibility (reference: HeapTupleSatisfiesMVCC, utils/time/tqual.c:1203 —
+# per-tuple; here one vector compare fused into the scan)
+# ---------------------------------------------------------------------------
+
+def visibility_mask(xmin_ts, xmax_ts, xmin_txid, xmax_txid,
+                    snap_ts, my_txid, aborted_ts):
+    ins = (xmin_ts <= snap_ts) | ((xmin_txid == my_txid)
+                                  & (xmin_ts != aborted_ts))
+    dele = (xmax_ts <= snap_ts) | (xmax_txid == my_txid)
+    return ins & ~dele
+
+
+# ---------------------------------------------------------------------------
+# compaction: gather selected rows to the front of a padded buffer
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("out_size",))
+def compact(mask, cols: tuple, out_size: int):
+    """Returns (count, gathered_cols) where gathered_cols are [out_size]
+    arrays holding the selected rows first (padding rows repeat row 0 and
+    must be masked by count downstream)."""
+    idx = jnp.nonzero(mask, size=out_size, fill_value=0)[0]
+    count = jnp.sum(mask)
+    return count, tuple(c[idx] for c in cols)
+
+
+# ---------------------------------------------------------------------------
+# grouped aggregation
+# ---------------------------------------------------------------------------
+
+_AGG_KINDS = ("sum", "count", "min", "max", "sumf")
+
+
+def _masked_for(kind: str, vals, valid):
+    if kind in ("min", "max"):
+        if jnp.issubdtype(vals.dtype, jnp.integer):
+            info = jnp.iinfo(vals.dtype)
+            fill = info.max if kind == "min" else info.min
+        else:
+            fill = np.inf if kind == "min" else -np.inf
+        return jnp.where(valid, vals, jnp.asarray(fill, vals.dtype))
+    if kind == "sum" and jnp.issubdtype(vals.dtype, jnp.integer):
+        vals = vals.astype(jnp.int64)  # SQL widens sum(int4) -> bigint
+    return jnp.where(valid, vals, jnp.zeros((), vals.dtype))
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "agg_kinds"))
+def grouped_agg_dense(group_id, valid, agg_inputs: tuple,
+                      num_groups: int, agg_kinds: tuple):
+    """Aggregate with a precomputed dense group id in [0, num_groups).
+
+    The planner uses this when the grouping keys have a statically bounded
+    combined domain (dictionary codes, small ints): pure scatter-reduce,
+    no sort — the TPC-H Q1 path.
+    """
+    gid = jnp.where(valid, group_id, num_groups)  # invalid -> overflow slot
+    outs = []
+    for kind, vals in zip(agg_kinds, agg_inputs):
+        if kind == "count":
+            vals = valid.astype(jnp.int64)
+        elif kind == "sumf":
+            vals = _masked_for("sum", vals.astype(jnp.float64), valid)
+        else:
+            vals = _masked_for(kind, vals, valid)
+        if kind == "min":
+            o = jax.ops.segment_min(vals, gid, num_segments=num_groups + 1)
+        elif kind == "max":
+            o = jax.ops.segment_max(vals, gid, num_segments=num_groups + 1)
+        else:
+            o = jax.ops.segment_sum(vals, gid, num_segments=num_groups + 1)
+        outs.append(o[:num_groups])
+    present = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                                  num_segments=num_groups + 1)[:num_groups]
+    return tuple(outs), present
+
+
+@functools.partial(jax.jit, static_argnames=("max_groups", "agg_kinds"))
+def grouped_agg_sort(key_cols: tuple, valid, agg_inputs: tuple,
+                     max_groups: int, agg_kinds: tuple):
+    """General grouped aggregation: lexicographic sort on the key columns
+    (invalid rows last), boundary detection, segment reduce.
+
+    Returns (group_key_cols, agg_outputs, n_groups).  Caller guarantees
+    distinct-group count <= max_groups (host retries at the next size class
+    otherwise — count returned lets it check).
+    """
+    n = valid.shape[0]
+    invalid = ~valid
+    operands = list(key_cols) + [a for a in agg_inputs] + [valid]
+    sorted_all = jax.lax.sort([invalid] + operands, num_keys=1 + len(key_cols))
+    s_keys = sorted_all[1:1 + len(key_cols)]
+    s_aggs = sorted_all[1 + len(key_cols):-1]
+    s_valid = sorted_all[-1]
+    first = jnp.arange(n) == 0
+    differs = jnp.zeros(n, dtype=bool)
+    for k in s_keys:
+        differs = differs | (k != jnp.roll(k, 1))
+    boundary = s_valid & (first | differs)
+    n_groups = jnp.sum(boundary)
+    gid_raw = jnp.cumsum(boundary) - 1
+    gid = jnp.where(s_valid, gid_raw, max_groups)
+    outs = []
+    for kind, vals in zip(agg_kinds, s_aggs):
+        if kind == "count":
+            vals = s_valid.astype(jnp.int64)
+        elif kind == "sumf":
+            vals = _masked_for("sum", vals.astype(jnp.float64), s_valid)
+        else:
+            vals = _masked_for(kind, vals, s_valid)
+        if kind == "min":
+            o = jax.ops.segment_min(vals, gid, num_segments=max_groups + 1)
+        elif kind == "max":
+            o = jax.ops.segment_max(vals, gid, num_segments=max_groups + 1)
+        else:
+            o = jax.ops.segment_sum(vals, gid, num_segments=max_groups + 1)
+        outs.append(o[:max_groups])
+    starts = jnp.nonzero(boundary, size=max_groups, fill_value=0)[0]
+    gkeys = tuple(k[starts] for k in s_keys)
+    return gkeys, tuple(outs), n_groups
+
+
+# ---------------------------------------------------------------------------
+# join: sort build side once, probe with binary search, expand pairs
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def join_build(build_keys, build_valid):
+    """Sort the build side; invalid rows get key INT64_MAX so they sort last
+    and can never match a (clamped) probe key."""
+    keys = jnp.where(build_valid, build_keys, INT64_MAX)
+    perm = jnp.argsort(keys)
+    return keys[perm], perm
+
+
+@jax.jit
+def join_probe_counts(sorted_keys, probe_keys, probe_valid):
+    """Per-probe-row match range in the sorted build side.
+
+    INT64_MAX is a reserved key value (the invalid-build sentinel): a valid
+    probe row carrying it is treated as unmatchable rather than matching
+    masked-out build rows.
+    """
+    pk = jnp.where(probe_valid, probe_keys, INT64_MAX - 1)
+    lo = jnp.searchsorted(sorted_keys, pk, side="left")
+    hi = jnp.searchsorted(sorted_keys, pk, side="right")
+    counts = jnp.where(probe_valid & (probe_keys != INT64_MAX), hi - lo, 0)
+    return lo, counts
+
+
+@functools.partial(jax.jit, static_argnames=("out_size", "left_outer"))
+def join_expand(lo, counts, perm, out_size: int, left_outer: bool = False,
+                probe_valid=None):
+    """Materialize (probe_idx, build_idx) pairs into a static out_size.
+
+    With left_outer, *valid* probe rows with zero matches emit one pair with
+    build_idx == -1 (the null row); pass probe_valid so padding rows don't
+    null-extend.  Returns (probe_idx, build_idx, total).
+    """
+    if left_outer:
+        eff = jnp.maximum(counts, 1)
+        if probe_valid is not None:
+            eff = jnp.where(probe_valid, eff, 0)
+    else:
+        eff = counts
+    csum = jnp.cumsum(eff)
+    total = csum[-1] if eff.shape[0] else jnp.int64(0)
+    j = jnp.arange(out_size, dtype=jnp.int64)
+    p = jnp.searchsorted(csum, j, side="right")
+    p = jnp.clip(p, 0, max(eff.shape[0] - 1, 0))
+    base = csum[p] - eff[p]
+    r = j - base
+    bpos = lo[p] + r
+    bpos = jnp.clip(bpos, 0, max(perm.shape[0] - 1, 0))
+    build_idx = perm[bpos]
+    if left_outer:
+        build_idx = jnp.where(counts[p] == 0, -1, build_idx)
+    valid = j < total
+    probe_idx = jnp.where(valid, p, 0)
+    if not left_outer:
+        build_idx = jnp.where(valid, build_idx, 0)
+    return probe_idx, build_idx, total
+
+
+@jax.jit
+def semi_mask(counts):
+    return counts > 0
+
+
+@jax.jit
+def anti_mask(counts, probe_valid):
+    return probe_valid & (counts == 0)
+
+
+# ---------------------------------------------------------------------------
+# sort / top-k
+# ---------------------------------------------------------------------------
+
+def _order_key(col, desc: bool):
+    """Make an ascending-sortable key implementing DESC by bit tricks."""
+    if col.dtype == jnp.bool_:
+        col = col.astype(jnp.int32)
+    if desc:
+        if col.dtype in (jnp.float64, jnp.float32):
+            return -col
+        return ~col  # bitwise not reverses order for ints
+    return col
+
+
+@functools.partial(jax.jit, static_argnames=("descs", "limit"))
+def sort_rows(key_cols: tuple, valid, payload_cols: tuple,
+              descs: tuple, limit: int | None = None):
+    """Lexicographic multi-key sort; invalid rows last; optional limit slice.
+    TEXT keys must be pre-mapped to order-preserving ranks by the operator
+    (dictionary codes are not ordered)."""
+    keys = [_order_key(k, d) for k, d in zip(key_cols, descs)]
+    operands = [~valid] + keys + list(payload_cols) + [valid]
+    out = jax.lax.sort(operands, num_keys=1 + len(keys))
+    payload = out[1 + len(keys):-1]
+    s_valid = out[-1]
+    if limit is not None:
+        payload = tuple(p[:limit] for p in payload)
+        s_valid = s_valid[:limit]
+    return tuple(payload), s_valid
+
+
+# ---------------------------------------------------------------------------
+# redistribution hashing (feeds all_to_all bucketing — the FN-plane analog)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_buckets",))
+def bucket_ids(key_cols: tuple, num_buckets: int):
+    from ..utils.hashing import hash_columns_jax
+    h = hash_columns_jax(list(key_cols))
+    return (h % jnp.uint64(num_buckets)).astype(jnp.int32)

@@ -1,0 +1,171 @@
+"""Expression compiler vs oracle evaluation."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opentenbase_tpu.catalog import types as T
+from opentenbase_tpu.exec.expr_compile import compile_expr, like_to_regex
+from opentenbase_tpu.plan import exprs as E
+from opentenbase_tpu.storage.store import StringDict
+
+DEC2 = T.decimal(15, 2)
+
+
+def col(name, t):
+    return E.Col(name, t)
+
+
+def lit_dec(v, scale=2):
+    return E.Lit(T.decimal_to_int(str(v), scale), T.decimal(15, scale))
+
+
+class TestArith:
+    def test_q1_style_decimal_chain(self):
+        # l_extendedprice * (1 - l_discount) * (1 + l_tax)
+        price = col("price", DEC2)
+        disc = col("disc", DEC2)
+        tax = col("tax", DEC2)
+        e = E.Arith("*", E.Arith("*", price,
+                                 E.Arith("-", lit_dec(1), disc)),
+                    E.Arith("+", lit_dec(1), tax))
+        assert e.type.kind == T.TypeKind.DECIMAL and e.type.scale == 6
+        f = compile_expr(e, {})
+        cols = {"price": jnp.asarray([10000, 25050]),   # 100.00, 250.50
+                "disc": jnp.asarray([10, 0]),           # 0.10, 0.00
+                "tax": jnp.asarray([5, 8])}             # 0.05, 0.08
+        out = np.asarray(f(cols))
+        # 100.00*0.90*1.05 = 94.50 ; 250.50*1.00*1.08 = 270.54
+        np.testing.assert_array_equal(out, [94_500000, 270_540000])
+
+    def test_division_goes_float(self):
+        e = E.Arith("/", col("a", DEC2), col("b", DEC2))
+        assert e.type.kind == T.TypeKind.FLOAT64
+        f = compile_expr(e, {})
+        out = np.asarray(f({"a": jnp.asarray([300]), "b": jnp.asarray([200])}))
+        assert out[0] == pytest.approx(1.5)
+
+    def test_int_decimal_add(self):
+        e = E.Arith("+", col("i", T.INT64), col("d", DEC2))
+        f = compile_expr(e, {})
+        out = np.asarray(f({"i": jnp.asarray([3]), "d": jnp.asarray([150])}))
+        assert out[0] == 450  # 3.00 + 1.50 = 4.50 at scale 2
+
+
+class TestCmp:
+    def test_decimal_scale_alignment(self):
+        # disc between 0.05 and 0.07 with literal scale 2
+        disc = col("disc", DEC2)
+        e = E.BoolOp("and", (E.Cmp(">=", disc, lit_dec("0.05")),
+                             E.Cmp("<=", disc, lit_dec("0.07"))))
+        f = compile_expr(e, {})
+        out = np.asarray(f({"disc": jnp.asarray([4, 5, 6, 7, 8])}))
+        assert out.tolist() == [False, True, True, True, False]
+
+    def test_date_cmp(self):
+        d = col("d", T.DATE)
+        cutoff = E.Lit(T.date_to_days("1998-09-02"), T.DATE)
+        f = compile_expr(E.Cmp("<=", d, cutoff), {})
+        days = [T.date_to_days(x) for x in
+                ("1998-09-01", "1998-09-02", "1998-09-03")]
+        out = np.asarray(f({"d": jnp.asarray(days, jnp.int32)}))
+        assert out.tolist() == [True, True, False]
+
+
+class TestCase:
+    def test_case_when(self):
+        # case when flag = code(1) then price else 0 end
+        e = E.Case(
+            whens=((E.Cmp("=", col("f", T.INT32),
+                          E.Lit(1, T.INT32)), col("p", DEC2)),),
+            else_=E.Lit(0, DEC2), case_type=DEC2)
+        f = compile_expr(e, {})
+        out = np.asarray(f({"f": jnp.asarray([0, 1, 1], jnp.int32),
+                            "p": jnp.asarray([100, 200, 300])}))
+        assert out.tolist() == [0, 200, 300]
+
+
+class TestStrPred:
+    def make_dict(self, values):
+        d = StringDict()
+        for v in values:
+            d.encode_one(v)
+        return d
+
+    def test_eq_and_like(self):
+        d = self.make_dict(["AIR", "TRUCK", "MAIL", "AIR REG", "SHIP"])
+        dicts = {"mode": d}
+        codes = jnp.asarray([0, 1, 3, 4], jnp.int32)
+        f = compile_expr(E.StrPred(col("mode", T.TEXT), "in",
+                                   ("AIR", "AIR REG")), dicts)
+        assert np.asarray(f({"mode": codes})).tolist() == [True, False, True, False]
+        f2 = compile_expr(E.StrPred(col("mode", T.TEXT), "like", ("%AI%",)),
+                          dicts)
+        assert np.asarray(f2({"mode": codes})).tolist() == [True, False, True, False]
+        f3 = compile_expr(E.StrPred(col("mode", T.TEXT), "not_like", ("A%",)),
+                          dicts)
+        assert np.asarray(f3({"mode": codes})).tolist() == [False, True, False, True]
+
+    def test_large_dict_membership(self):
+        d = self.make_dict([f"v{i:04d}" for i in range(100)])
+        f = compile_expr(E.StrPred(col("s", T.TEXT), "like", ("v000%",)),
+                         {"s": d})
+        codes = jnp.asarray([0, 9, 10, 99], jnp.int32)
+        assert np.asarray(f({"s": codes})).tolist() == [True, True, False, False]
+
+    def test_range_cmp(self):
+        d = self.make_dict(["b", "a", "c"])
+        f = compile_expr(E.StrPred(col("s", T.TEXT), "le", ("b",)), {"s": d})
+        codes = jnp.asarray([0, 1, 2], jnp.int32)
+        assert np.asarray(f({"s": codes})).tolist() == [True, True, False]
+
+
+class TestExtract:
+    def test_year_month_day(self):
+        days = [T.date_to_days(x) for x in
+                ("1970-01-01", "1995-03-15", "2000-02-29", "1998-12-31")]
+        cols = {"d": jnp.asarray(days, jnp.int32)}
+        for field, expect in [("year", [1970, 1995, 2000, 1998]),
+                              ("month", [1, 3, 2, 12]),
+                              ("day", [1, 15, 29, 31])]:
+            f = compile_expr(E.Extract(field, col("d", T.DATE)), {})
+            assert np.asarray(f(cols)).tolist() == expect
+
+
+class TestMisc:
+    def test_inlist(self):
+        f = compile_expr(E.InList(col("x", T.INT64), (1, 5, 9)), {})
+        out = np.asarray(f({"x": jnp.asarray([1, 2, 5, 8, 9])}))
+        assert out.tolist() == [True, False, True, False, True]
+
+    def test_cast_decimal_to_float(self):
+        f = compile_expr(E.Cast(col("d", DEC2), T.FLOAT64), {})
+        assert np.asarray(f({"d": jnp.asarray([150])}))[0] == pytest.approx(1.5)
+
+    def test_cast_decimal_to_int(self):
+        f = compile_expr(E.Cast(col("d", DEC2), T.INT64), {})
+        assert np.asarray(f({"d": jnp.asarray([150])}))[0] == 1
+
+    def test_cast_decimal_downscale(self):
+        f = compile_expr(E.Cast(col("d", T.decimal(15, 4)),
+                                T.decimal(15, 2)), {})
+        assert np.asarray(f({"d": jnp.asarray([12345])}))[0] == 123
+
+    def test_inlist_int64_beyond_int32(self):
+        f = compile_expr(E.InList(col("x", T.INT64), (3_000_000_000,)), {})
+        out = np.asarray(f({"x": jnp.asarray([3_000_000_000, 5])}))
+        assert out.tolist() == [True, False]
+
+    def test_like_regex(self):
+        rx = like_to_regex("%special%requests%")
+        assert rx.match("the special deposit requests")
+        assert not rx.match("special")
+        assert like_to_regex("a_c").match("abc")
+        assert not like_to_regex("a_c").match("abbc")
+
+    def test_neg_and_not(self):
+        f = compile_expr(E.Neg(col("x", T.INT64)), {})
+        assert np.asarray(f({"x": jnp.asarray([3, -4])})).tolist() == [-3, 4]
+        f2 = compile_expr(E.Not(E.Cmp("=", col("x", T.INT64),
+                                      E.Lit(3, T.INT64))), {})
+        assert np.asarray(f2({"x": jnp.asarray([3, 4])})).tolist() == [False, True]

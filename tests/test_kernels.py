@@ -1,0 +1,218 @@
+"""Device kernels vs numpy oracles (runs on CPU backend; same code path
+runs on TPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opentenbase_tpu.ops import kernels as K
+
+rng = np.random.default_rng(42)
+
+
+class TestCompact:
+    def test_basic(self):
+        x = np.arange(100, dtype=np.int64)
+        mask = (x % 3) == 0
+        cnt, (out,) = K.compact(jnp.asarray(mask), (jnp.asarray(x),), 128)
+        cnt = int(cnt)
+        np.testing.assert_array_equal(np.asarray(out)[:cnt], x[mask])
+
+
+class TestGroupedAggDense:
+    def test_sum_count_min_max(self):
+        n = 1000
+        gid = rng.integers(0, 4, n)
+        vals = rng.integers(-50, 50, n).astype(np.int64)
+        valid = rng.random(n) > 0.3
+        (s, c, mn, mx), present = K.grouped_agg_dense(
+            jnp.asarray(gid), jnp.asarray(valid),
+            (jnp.asarray(vals),) * 4, 4, ("sum", "count", "min", "max"))
+        for g in range(4):
+            m = (gid == g) & valid
+            assert int(s[g]) == vals[m].sum()
+            assert int(c[g]) == m.sum()
+            assert int(mn[g]) == vals[m].min()
+            assert int(mx[g]) == vals[m].max()
+            assert int(present[g]) == m.sum()
+
+    def test_min_max_int32_date(self):
+        gid = np.zeros(5, dtype=np.int64)
+        dates = np.asarray([100, 50, 200, 5, 75], dtype=np.int32)
+        (mn, mx), _ = K.grouped_agg_dense(
+            jnp.asarray(gid), jnp.ones(5, bool),
+            (jnp.asarray(dates),) * 2, 1, ("min", "max"))
+        assert int(mn[0]) == 5 and int(mx[0]) == 200
+
+    def test_sum_int32_widens(self):
+        gid = np.zeros(3, dtype=np.int64)
+        vals = np.full(3, 2**30, dtype=np.int32)
+        (s,), _ = K.grouped_agg_dense(
+            jnp.asarray(gid), jnp.ones(3, bool), (jnp.asarray(vals),),
+            1, ("sum",))
+        assert int(s[0]) == 3 * 2**30  # would wrap in int32
+
+    def test_sumf_float_accum(self):
+        gid = np.zeros(10, dtype=np.int64)
+        vals = np.full(10, 1.5)
+        (s,), _ = K.grouped_agg_dense(
+            jnp.asarray(gid), jnp.ones(10, bool), (jnp.asarray(vals),),
+            1, ("sumf",))
+        assert float(s[0]) == pytest.approx(15.0)
+
+
+class TestGroupedAggSort:
+    def test_vs_oracle(self):
+        n = 2048
+        k1 = rng.integers(0, 50, n).astype(np.int64)
+        k2 = rng.integers(0, 3, n).astype(np.int64)
+        vals = rng.integers(0, 1000, n).astype(np.int64)
+        valid = rng.random(n) > 0.2
+        gkeys, (s, c), ng = K.grouped_agg_sort(
+            (jnp.asarray(k1), jnp.asarray(k2)), jnp.asarray(valid),
+            (jnp.asarray(vals),) * 2, 256, ("sum", "count"))
+        ng = int(ng)
+        # oracle via python dict
+        oracle = {}
+        for i in range(n):
+            if valid[i]:
+                key = (k1[i], k2[i])
+                acc = oracle.setdefault(key, [0, 0])
+                acc[0] += vals[i]
+                acc[1] += 1
+        assert ng == len(oracle)
+        got = {(int(gkeys[0][i]), int(gkeys[1][i])): (int(s[i]), int(c[i]))
+               for i in range(ng)}
+        assert got == {k: tuple(v) for k, v in oracle.items()}
+
+    def test_empty_input(self):
+        gkeys, (s,), ng = K.grouped_agg_sort(
+            (jnp.zeros(16, jnp.int64),), jnp.zeros(16, bool),
+            (jnp.ones(16, jnp.int64),), 8, ("sum",))
+        assert int(ng) == 0
+
+
+class TestJoin:
+    def _oracle_pairs(self, probe, build, pvalid, bvalid):
+        out = []
+        for i, (pk, pv) in enumerate(zip(probe, pvalid)):
+            if not pv:
+                continue
+            for j, (bk, bv) in enumerate(zip(build, bvalid)):
+                if bv and pk == bk:
+                    out.append((i, j))
+        return set(out)
+
+    def test_inner_with_dups(self):
+        probe = rng.integers(0, 20, 64).astype(np.int64)
+        build = rng.integers(0, 20, 48).astype(np.int64)
+        pvalid = rng.random(64) > 0.1
+        bvalid = rng.random(48) > 0.1
+        skeys, perm = K.join_build(jnp.asarray(build), jnp.asarray(bvalid))
+        lo, counts = K.join_probe_counts(skeys, jnp.asarray(probe),
+                                         jnp.asarray(pvalid))
+        total = int(np.asarray(counts).sum())
+        out_size = max(256, total)
+        pi, bi, tot = K.join_expand(lo, counts, perm, out_size)
+        assert int(tot) == total
+        got = {(int(pi[i]), int(bi[i])) for i in range(total)}
+        assert got == self._oracle_pairs(probe, build, pvalid, bvalid)
+
+    def test_left_outer(self):
+        probe = np.asarray([1, 2, 3, 99], dtype=np.int64)
+        build = np.asarray([2, 2, 3], dtype=np.int64)
+        skeys, perm = K.join_build(jnp.asarray(build), jnp.ones(3, bool))
+        lo, counts = K.join_probe_counts(skeys, jnp.asarray(probe),
+                                         jnp.ones(4, bool))
+        pi, bi, tot = K.join_expand(lo, counts, perm, 16, left_outer=True,
+                                    probe_valid=jnp.ones(4, bool))
+        tot = int(tot)
+        pairs = sorted((int(pi[i]), int(bi[i])) for i in range(tot))
+        # row0 (k=1): null match; row3 (k=99): null match
+        assert tot == 5
+        assert (0, -1) in pairs and (3, -1) in pairs
+        assert (2, 2) in pairs
+        assert {p for p, b in pairs if b in (0, 1)} == {1}
+
+    def test_semi_anti(self):
+        probe = np.asarray([1, 2, 3], dtype=np.int64)
+        build = np.asarray([2], dtype=np.int64)
+        skeys, perm = K.join_build(jnp.asarray(build), jnp.ones(1, bool))
+        lo, counts = K.join_probe_counts(skeys, jnp.asarray(probe),
+                                         jnp.ones(3, bool))
+        assert np.asarray(K.semi_mask(counts)).tolist() == [False, True, False]
+        assert np.asarray(K.anti_mask(counts, jnp.ones(3, bool))).tolist() \
+            == [True, False, True]
+
+    def test_invalid_build_never_matches(self):
+        build = np.asarray([5, 5], dtype=np.int64)
+        skeys, perm = K.join_build(jnp.asarray(build),
+                                   jnp.asarray([True, False]))
+        lo, counts = K.join_probe_counts(skeys, jnp.asarray([5], np.int64),
+                                         jnp.ones(1, bool))
+        assert int(counts[0]) == 1
+
+    def test_left_outer_padding_rows_do_not_null_extend(self):
+        probe = np.asarray([1, 2, 7], dtype=np.int64)
+        pvalid = np.asarray([True, True, False])
+        build = np.asarray([2, 3], dtype=np.int64)
+        skeys, perm = K.join_build(jnp.asarray(build), jnp.ones(2, bool))
+        lo, counts = K.join_probe_counts(skeys, jnp.asarray(probe),
+                                         jnp.asarray(pvalid))
+        pi, bi, tot = K.join_expand(lo, counts, perm, 16, left_outer=True,
+                                    probe_valid=jnp.asarray(pvalid))
+        tot = int(tot)
+        pairs = sorted((int(pi[i]), int(bi[i])) for i in range(tot))
+        assert pairs == [(0, -1), (1, 0)]
+
+    def test_sentinel_probe_key_unmatchable(self):
+        build = np.asarray([7, 7], dtype=np.int64)
+        skeys, perm = K.join_build(jnp.asarray(build),
+                                   jnp.asarray([False, False]))
+        probe = np.asarray([2**63 - 1], dtype=np.int64)
+        lo, counts = K.join_probe_counts(skeys, jnp.asarray(probe),
+                                         jnp.ones(1, bool))
+        assert int(counts[0]) == 0
+
+
+class TestSort:
+    def test_multikey_desc_limit(self):
+        n = 500
+        a = rng.integers(0, 10, n).astype(np.int64)
+        b = rng.integers(0, 1000, n).astype(np.int64)
+        valid = rng.random(n) > 0.2
+        (sa, sb), svalid = K.sort_rows(
+            (jnp.asarray(a), jnp.asarray(b)), jnp.asarray(valid),
+            (jnp.asarray(a), jnp.asarray(b)), (False, True), limit=50)
+        order = np.lexsort((-b[valid], a[valid]))
+        oa = a[valid][order][:50]
+        ob = b[valid][order][:50]
+        np.testing.assert_array_equal(np.asarray(sa)[:len(oa)], oa)
+        np.testing.assert_array_equal(np.asarray(sb)[:len(ob)], ob)
+
+    def test_float_desc(self):
+        x = np.asarray([1.5, -2.0, 3.25], dtype=np.float64)
+        (sx,), sv = K.sort_rows((jnp.asarray(x),), jnp.ones(3, bool),
+                                (jnp.asarray(x),), (True,))
+        np.testing.assert_array_equal(np.asarray(sx), [3.25, 1.5, -2.0])
+
+
+class TestVisibility:
+    def test_mask(self):
+        xmin_ts = jnp.asarray([10, 10**18 + 1, 1 << 62], dtype=jnp.int64)
+        xmax_ts = jnp.asarray([1 << 62, 1 << 62, 1 << 62], dtype=jnp.int64)
+        xmin_txid = jnp.asarray([1, 2, 3], dtype=jnp.int64)
+        xmax_txid = jnp.zeros(3, dtype=jnp.int64)
+        m = K.visibility_mask(xmin_ts, xmax_ts, xmin_txid, xmax_txid,
+                              snap_ts=100, my_txid=3,
+                              aborted_ts=(1 << 62) + 1)
+        assert np.asarray(m).tolist() == [True, False, True]
+
+
+class TestBuckets:
+    def test_matches_host_locator(self):
+        from opentenbase_tpu.parallel.locator import shard_ids_for_columns
+        keys = np.arange(1000, dtype=np.int64)
+        host = shard_ids_for_columns([keys])
+        dev = np.asarray(K.bucket_ids((jnp.asarray(keys),), 4096))
+        np.testing.assert_array_equal(host, dev)
