@@ -55,21 +55,30 @@ def _rescale(fn, from_scale: int, to_scale: int):
                                                         jnp.int64(_d))
 
 
+def _strpred_colname(pred: E.StrPred) -> str:
+    c = pred.col
+    return c.col.name if isinstance(c, E.TextExpr) else c.name
+
+
 def _codes_for_strpred(pred: E.StrPred, dicts: dict) -> np.ndarray:
-    d = dicts.get(pred.col.name)
+    name = _strpred_colname(pred)
+    d = dicts.get(name)
     if d is None:
-        raise E.ExprError(f"no dictionary for TEXT column {pred.col.name!r}")
+        raise E.ExprError(f"no dictionary for TEXT column {name!r}")
+    transform = (pred.col.apply if isinstance(pred.col, E.TextExpr)
+                 else (lambda s: s))
     k = pred.kind
-    if k in ("eq", "ne", "in"):
+    if k in ("eq", "ne", "in", "not_in"):
         wanted = set(pred.patterns)
-        test = lambda s: s in wanted
+        test = lambda s: transform(s) in wanted
     elif k in ("like", "not_like"):
         rx = like_to_regex(pred.patterns[0])
-        test = lambda s: rx.match(s) is not None
+        test = lambda s: rx.match(transform(s)) is not None
     elif k in ("lt", "le", "gt", "ge"):
         p = pred.patterns[0]
-        test = {"lt": lambda s: s < p, "le": lambda s: s <= p,
+        base = {"lt": lambda s: s < p, "le": lambda s: s <= p,
                 "gt": lambda s: s > p, "ge": lambda s: s >= p}[k]
+        test = lambda s: base(transform(s))
     else:
         raise E.ExprError(f"unknown string predicate {k}")
     return d.codes_matching(test)
@@ -230,11 +239,16 @@ def compile_expr(e: E.Expr, dicts: dict) -> Callable[[Arrays], object]:
 
         if isinstance(x, E.StrPred):
             codes = _codes_for_strpred(x, dicts)
-            name = x.col.name
-            neg = x.kind in ("ne", "not_like")
+            name = _strpred_colname(x)
+            neg = x.kind in ("ne", "not_like", "not_in")
             if neg:
                 return lambda cols: ~_membership(cols[name], codes)
             return lambda cols: _membership(cols[name], codes)
+
+        if isinstance(x, E.TextExpr):
+            # codes pass through; only the decode dictionary changes
+            name = x.col.name
+            return lambda cols: cols[name]
 
         if isinstance(x, E.Extract):
             f = c(x.arg)

@@ -177,12 +177,44 @@ class InList(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
-class StrPred(Expr):
-    """A predicate over a TEXT column, described abstractly; the compiler
-    resolves it against the store's dictionary into a device code-set mask.
-    kind: 'eq' | 'ne' | 'like' | 'not_like' | 'in' | 'lt' | 'le' | 'gt' | 'ge'
-    """
+class TextExpr(Expr):
+    """A TEXT-valued expression: an underlying dictionary-coded column with
+    pure string->string transforms (e.g. substring) applied *to the
+    dictionary*, not the rows — codes pass through unchanged, the decode
+    table changes.  This is how substring(c_phone from 1 for 2) (TPC-H Q22)
+    stays an integer column on device."""
     col: Col
+    transforms: tuple = ()   # (("substring", start, length|None), ...)
+
+    def __post_init__(self):
+        object.__setattr__(self, "type", self.col.col_type)
+
+    def children(self):
+        return (self.col,)
+
+    def apply(self, s: str) -> str:
+        for t in self.transforms:
+            if t[0] == "substring":
+                start, length = t[1], t[2]
+                lo = start - 1          # SQL positions are 1-based;
+                if length is None:      # clip at the string start like PG
+                    s = s[max(lo, 0):]
+                else:
+                    s = s[max(lo, 0):max(lo + length, 0)]
+            else:
+                raise ExprError(f"unknown text transform {t[0]}")
+        return s
+
+
+@dataclasses.dataclass(frozen=True)
+class StrPred(Expr):
+    """A predicate over a TEXT column (possibly transformed), described
+    abstractly; the compiler resolves it against the store's dictionary into
+    a device code-set mask.
+    kind: 'eq' | 'ne' | 'like' | 'not_like' | 'in' | 'not_in' | 'lt' | 'le' |
+    'gt' | 'ge'
+    """
+    col: Expr                 # Col or TextExpr over a TEXT column
     kind: str
     patterns: tuple[str, ...]
 

@@ -1,0 +1,488 @@
+"""Parse analysis: raw AST -> typed BoundQuery against the catalog.
+
+Reference analog: src/backend/parser/analyze.c + parse_expr.c/parse_relation.c
+(transformStmt and friends).  Responsibilities: range-table construction,
+name/scope resolution (incl. correlated references into outer queries),
+type checking with decimal-scale discipline, string-predicate rewriting onto
+dictionary-coded columns, constant folding of date/interval arithmetic,
+aggregate detection, and star expansion.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..catalog.catalog import Catalog, CatalogError
+from ..catalog import types as T
+from ..catalog.types import SqlType, TypeKind
+from ..plan import exprs as E
+from ..plan.query import BoundQuery, JoinStep, RTE, SubLink
+from . import ast as A
+
+
+class BindError(Exception):
+    pass
+
+
+class Scope:
+    def __init__(self, rtable: list[RTE]):
+        self.rtable = rtable
+
+    def lookup(self, parts: tuple[str, ...]) -> Optional[tuple[str, SqlType]]:
+        if len(parts) == 2:
+            tbl, col = parts
+            for rte in self.rtable:
+                if rte.alias == tbl and col in rte.columns:
+                    return rte.columns[col]
+            return None
+        (col,) = parts
+        hits = [rte.columns[col] for rte in self.rtable if col in rte.columns]
+        if len(hits) > 1:
+            raise BindError(f"ambiguous column {col!r}")
+        return hits[0] if hits else None
+
+
+class Binder:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+
+    # ------------------------------------------------------------------
+    def bind_select(self, stmt: A.SelectStmt,
+                    outer: list[Scope] = ()) -> BoundQuery:
+        if stmt.setop is not None:
+            raise BindError("set operations not supported yet")
+        rtable: list[RTE] = []
+        join_order: list[JoinStep] = []
+        where: list[E.Expr] = []
+        correlated: list[str] = []
+        scope = Scope(rtable)
+        scopes = [scope, *outer]
+
+        def add_rte(item, kind_for_step="cross", on_ast=None):
+            if isinstance(item, A.TableRef):
+                td = self._table(item.name)
+                alias = item.alias or item.name
+                self._check_dup_alias(rtable, alias)
+                cols = {c.name: (f"{alias}.{c.name}", c.type)
+                        for c in td.columns}
+                rtable.append(RTE(alias, "table", table=td, columns=cols))
+            elif isinstance(item, A.SubqueryRef):
+                sub = self.bind_select(item.subquery, outer=scopes)
+                alias = item.alias
+                self._check_dup_alias(rtable, alias)
+                cols = {name: (f"{alias}.{name}", expr.type)
+                        for name, expr in sub.targets}
+                rtable.append(RTE(alias, "subquery", subquery=sub,
+                                  columns=cols))
+            else:
+                raise BindError(f"unsupported FROM item {type(item).__name__}")
+            idx = len(rtable) - 1
+            step = JoinStep(idx, kind_for_step)
+            join_order.append(step)
+            return step
+
+        def walk_from(item):
+            if isinstance(item, A.JoinRef):
+                walk_from(item.left)
+                if isinstance(item.right, A.JoinRef):
+                    raise BindError("parenthesized right-side joins "
+                                    "not supported")
+                if item.kind in ("right", "full"):
+                    raise BindError(f"{item.kind} join not supported yet")
+                step = add_rte(item.right,
+                               "inner" if item.kind == "cross"
+                               else item.kind)
+                if item.on is not None:
+                    bound = self.bind_expr(item.on, scopes, correlated)
+                    if item.kind == "inner":
+                        where.extend(split_conjuncts(bound))
+                        step.kind = "inner"
+                    else:
+                        step.on = bound
+            else:
+                add_rte(item)
+
+        for item in stmt.from_:
+            walk_from(item)
+
+        if stmt.where is not None:
+            where.extend(split_conjuncts(
+                self.bind_expr(stmt.where, scopes, correlated)))
+
+        # targets (with star expansion)
+        targets: list[tuple[str, E.Expr]] = []
+        for it in stmt.items:
+            if isinstance(it.expr, A.Star):
+                for rte in rtable:
+                    if it.expr.table and rte.alias != it.expr.table:
+                        continue
+                    for plain, (qname, t) in rte.columns.items():
+                        targets.append((plain, E.Col(qname, t)))
+                continue
+            bound = self.bind_expr(it.expr, scopes, correlated)
+            name = it.alias or self._default_name(it.expr, len(targets))
+            targets.append((name, bound))
+
+        group_by = [self._bind_groupref(g, scopes, correlated, targets)
+                    for g in stmt.group_by]
+        having = split_conjuncts(self.bind_expr(
+            stmt.having, scopes, correlated)) if stmt.having else []
+
+        order_by = []
+        for si in stmt.order_by:
+            order_by.append((self._bind_orderref(si.expr, scopes, correlated,
+                                                 targets), si.desc))
+
+        limit = self._const_int(stmt.limit) if stmt.limit else None
+        offset = self._const_int(stmt.offset) if stmt.offset else None
+
+        return BoundQuery(rtable=rtable, join_order=join_order, where=where,
+                          targets=targets, group_by=group_by, having=having,
+                          order_by=order_by, limit=limit, offset=offset,
+                          distinct=stmt.distinct, correlated_cols=correlated)
+
+    # ------------------------------------------------------------------
+    def _table(self, name):
+        try:
+            return self.catalog.table(name)
+        except CatalogError as e:
+            raise BindError(str(e)) from None
+
+    @staticmethod
+    def _check_dup_alias(rtable, alias):
+        if any(r.alias == alias for r in rtable):
+            raise BindError(f"duplicate table alias {alias!r}")
+
+    @staticmethod
+    def _default_name(expr: A.Node, i: int) -> str:
+        if isinstance(expr, A.ColRef):
+            return expr.parts[-1]
+        if isinstance(expr, A.FuncCall):
+            return expr.name
+        return f"?column?{i}"
+
+    def _const_int(self, node) -> int:
+        if isinstance(node, A.Const) and node.kind == "int":
+            return int(node.value)
+        raise BindError("LIMIT/OFFSET must be integer literals")
+
+    def _bind_groupref(self, g, scopes, correlated, targets):
+        if isinstance(g, A.Const) and g.kind == "int":
+            return targets[int(g.value) - 1][1]
+        # allow referencing a target alias (common in practice)
+        if isinstance(g, A.ColRef) and len(g.parts) == 1:
+            try:
+                return self.bind_expr(g, scopes, correlated)
+            except BindError:
+                for name, e in targets:
+                    if name == g.parts[0]:
+                        return e
+                raise
+        return self.bind_expr(g, scopes, correlated)
+
+    def _bind_orderref(self, o, scopes, correlated, targets):
+        if isinstance(o, A.Const) and o.kind == "int":
+            return targets[int(o.value) - 1][1]
+        if isinstance(o, A.ColRef) and len(o.parts) == 1:
+            for name, e in targets:
+                if name == o.parts[0]:
+                    return e
+        return self.bind_expr(o, scopes, correlated)
+
+    # ------------------------------------------------------------------
+    # expressions
+    # ------------------------------------------------------------------
+    def bind_expr(self, node: A.Node, scopes: list[Scope],
+                  correlated: list[str]) -> E.Expr:
+        b = lambda n: self.bind_expr(n, scopes, correlated)
+
+        if isinstance(node, A.ColRef):
+            hit = scopes[0].lookup(node.parts)
+            if hit is not None:
+                return E.Col(*hit)
+            for sc in scopes[1:]:
+                hit = sc.lookup(node.parts)
+                if hit is not None:
+                    correlated.append(hit[0])
+                    return E.Col(*hit)
+            raise BindError(f"column {'.'.join(node.parts)!r} does not exist")
+
+        if isinstance(node, A.Const):
+            return self._bind_const(node)
+
+        if isinstance(node, A.TypedConst):
+            if node.type_name == "date":
+                return E.Lit(T.date_to_days(node.value), T.DATE)
+            raise BindError("interval literal outside date arithmetic")
+
+        if isinstance(node, A.BinOp):
+            return self._bind_binop(node, b)
+
+        if isinstance(node, A.UnaryOp):
+            if node.op == "-":
+                arg = b(node.arg)
+                if isinstance(arg, E.Lit):
+                    return E.Lit(-arg.value, arg.lit_type)
+                return E.Neg(arg)
+            return self._negate(b(node.arg))
+
+        if isinstance(node, A.BoolExpr):
+            return E.BoolOp(node.op, tuple(b(a) for a in node.args))
+
+        if isinstance(node, A.BetweenExpr):
+            lo = A.BinOp(">=", node.arg, node.low)
+            hi = A.BinOp("<=", node.arg, node.high)
+            e = E.BoolOp("and", (b(lo), b(hi)))
+            return self._negate(e) if node.negated else e
+
+        if isinstance(node, A.LikeExpr):
+            arg = b(node.arg)
+            if not isinstance(arg, (E.Col, E.TextExpr)) or \
+                    arg.type.kind != TypeKind.TEXT:
+                raise BindError("LIKE requires a text column")
+            if not (isinstance(node.pattern, A.Const)
+                    and node.pattern.kind == "str"):
+                raise BindError("LIKE pattern must be a string literal")
+            return E.StrPred(arg, "not_like" if node.negated else "like",
+                             (node.pattern.value,))
+
+        if isinstance(node, A.InExpr):
+            arg = b(node.arg)
+            if node.subquery is not None:
+                sub = self.bind_select(node.subquery, outer=scopes)
+                return SubLink("in", sub, test_expr=arg,
+                               negated=node.negated)
+            if arg.type.kind == TypeKind.TEXT:
+                vals = []
+                for it in node.items:
+                    if not (isinstance(it, A.Const) and it.kind == "str"):
+                        raise BindError("text IN list must be string literals")
+                    vals.append(it.value)
+                return E.StrPred(arg, "not_in" if node.negated else "in",
+                                 tuple(vals))
+            vals = []
+            for it in node.items:
+                lit = b(it)
+                if not isinstance(lit, E.Lit):
+                    raise BindError("IN list must be literals")
+                vals.append(self._to_storage(lit, arg.type))
+            e = E.InList(arg, tuple(vals))
+            return self._negate(e) if node.negated else e
+
+        if isinstance(node, A.NullTest):
+            # No NULL storage yet (TPC-H base data is NOT NULL); outer-join
+            # null flags are handled by the planner's join machinery.
+            return E.Lit(not node.is_null, T.BOOL)
+
+        if isinstance(node, A.ExistsExpr):
+            sub = self.bind_select(node.subquery, outer=scopes)
+            return SubLink("exists", sub, negated=node.negated)
+
+        if isinstance(node, A.ScalarSubquery):
+            sub = self.bind_select(node.subquery, outer=scopes)
+            if len(sub.targets) != 1:
+                raise BindError("scalar subquery must return one column")
+            return SubLink("scalar", sub)
+
+        if isinstance(node, A.QuantifiedCmp):
+            sub = self.bind_select(node.subquery, outer=scopes)
+            return SubLink(node.quantifier, sub, test_expr=b(node.arg),
+                           cmp_op=node.op)
+
+        if isinstance(node, A.CaseExpr):
+            whens = tuple((b(c), b(v)) for c, v in node.whens)
+            else_ = b(node.else_) if node.else_ is not None else None
+            t = self._common_case_type([v.type for _, v in whens]
+                                       + ([else_.type] if else_ else []))
+            whens, else_ = self._coerce_case(whens, else_, t)
+            return E.Case(whens, else_, t)
+
+        if isinstance(node, A.FuncCall):
+            return self._bind_func(node, b)
+
+        if isinstance(node, A.CastExpr):
+            to = T.type_from_name(node.type_name, node.type_args)
+            return E.Cast(b(node.arg), to)
+
+        if isinstance(node, A.ExtractExpr):
+            arg = b(node.arg)
+            if arg.type.kind != TypeKind.DATE:
+                raise BindError("EXTRACT requires a date argument")
+            if node.field not in ("year", "month", "day"):
+                raise BindError(f"EXTRACT field {node.field!r} unsupported")
+            return E.Extract(node.field, arg)
+
+        if isinstance(node, A.SubstringExpr):
+            arg = b(node.arg)
+            if not isinstance(arg, (E.Col, E.TextExpr)) \
+                    or arg.type.kind != TypeKind.TEXT:
+                raise BindError("substring requires a text column")
+            start = self._const_int(node.start)
+            length = self._const_int(node.length) \
+                if node.length is not None else None
+            base = arg if isinstance(arg, E.Col) else arg.col
+            prior = arg.transforms if isinstance(arg, E.TextExpr) else ()
+            return E.TextExpr(base, prior + (("substring", start, length),))
+
+        if isinstance(node, A.Param):
+            raise BindError("parameters require a bound portal")
+
+        raise BindError(f"cannot bind {type(node).__name__}")
+
+    # ---- helpers ----
+    def _bind_const(self, node: A.Const) -> E.Expr:
+        if node.kind == "int":
+            return E.Lit(int(node.value), T.INT64)
+        if node.kind == "num":
+            s = str(node.value)
+            frac = len(s.split(".")[1]) if "." in s else 0
+            if "e" in s.lower():
+                return E.Lit(float(s), T.FLOAT64)
+            return E.Lit(T.decimal_to_int(s, frac), T.decimal(30, frac))
+        if node.kind == "bool":
+            return E.Lit(bool(node.value), T.BOOL)
+        if node.kind == "str":
+            # untyped string literal: type decided by coercion context;
+            # default TEXT marker
+            return E.Lit(node.value, T.TEXT)
+        if node.kind == "null":
+            raise BindError("NULL literal unsupported (no null storage yet)")
+        raise BindError(f"bad const kind {node.kind}")
+
+    def _negate(self, e: E.Expr) -> E.Expr:
+        if isinstance(e, E.StrPred):
+            flip = {"in": "not_in", "not_in": "in", "like": "not_like",
+                    "not_like": "like", "eq": "ne", "ne": "eq"}
+            if e.kind in flip:
+                return E.StrPred(e.col, flip[e.kind], e.patterns)
+        return E.Not(e)
+
+    def _bind_binop(self, node: A.BinOp, b) -> E.Expr:
+        # date +/- interval constant folding (TPC-H uses literal arithmetic)
+        if node.op in ("+", "-"):
+            folded = self._try_fold_date(node, b)
+            if folded is not None:
+                return folded
+        left = b(node.left)
+        right = b(node.right)
+        if node.op in ("=", "<>", "<", "<=", ">", ">="):
+            return self._bind_cmp(node.op, left, right)
+        if node.op in ("+", "-", "*", "/"):
+            left, right = self._coerce_pair(left, right)
+            return E.Arith(node.op, left, right)
+        if node.op == "||":
+            raise BindError("string concatenation unsupported on device "
+                            "columns")
+        raise BindError(f"operator {node.op!r} unsupported")
+
+    def _try_fold_date(self, node: A.BinOp, b) -> Optional[E.Expr]:
+        rl = node.right
+        if not (isinstance(rl, A.TypedConst) and rl.type_name == "interval"):
+            return None
+        left = b(node.left)
+        if not (isinstance(left, E.Lit) and left.type.kind == TypeKind.DATE):
+            raise BindError("interval arithmetic only on date literals")
+        import numpy as np
+        base = np.datetime64(T.days_to_date(left.value), "D")
+        qty = rl.qty if node.op == "+" else -rl.qty
+        if rl.unit == "day":
+            out = base + np.timedelta64(qty, "D")
+        elif rl.unit == "month":
+            m = (base.astype("datetime64[M]") + np.timedelta64(qty, "M"))
+            out = m.astype("datetime64[D]") + (base
+                                               - base.astype("datetime64[M]"))
+        elif rl.unit == "year":
+            m = (base.astype("datetime64[M]") + np.timedelta64(12 * qty, "M"))
+            out = m.astype("datetime64[D]") + (base
+                                               - base.astype("datetime64[M]"))
+        else:
+            raise BindError(f"interval unit {rl.unit!r} unsupported")
+        return E.Lit(T.date_to_days(str(out)), T.DATE)
+
+    def _bind_cmp(self, op: str, left: E.Expr, right: E.Expr) -> E.Expr:
+        lt, rt = left.type, right.type
+        # text predicates -> dictionary-resolved
+        if lt.kind == TypeKind.TEXT or rt.kind == TypeKind.TEXT:
+            if isinstance(right, E.Lit) and rt.kind == TypeKind.TEXT \
+                    and isinstance(left, (E.Col, E.TextExpr)) \
+                    and lt.kind == TypeKind.TEXT:
+                kind = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le",
+                        ">": "gt", ">=": "ge"}[op]
+                return E.StrPred(left, kind, (right.value,))
+            if isinstance(left, E.Lit) and lt.kind == TypeKind.TEXT \
+                    and isinstance(right, (E.Col, E.TextExpr)) \
+                    and rt.kind == TypeKind.TEXT:
+                swap = {"=": "=", "<>": "<>", "<": ">", "<=": ">=",
+                        ">": "<", ">=": "<="}[op]
+                return self._bind_cmp(swap, right, left)
+            if lt.kind == TypeKind.TEXT and rt.kind == TypeKind.TEXT:
+                raise BindError("text-to-text column comparison requires "
+                                "shared dictionary (unsupported)")
+        left, right = self._coerce_pair(left, right)
+        return E.Cmp(op, left, right)
+
+    def _coerce_pair(self, left: E.Expr, right: E.Expr):
+        """Insert coercions for str-lit vs date, etc."""
+        lt, rt = left.type, right.type
+        if lt.kind == TypeKind.DATE and rt.kind == TypeKind.TEXT \
+                and isinstance(right, E.Lit):
+            right = E.Lit(T.date_to_days(right.value), T.DATE)
+        elif rt.kind == TypeKind.DATE and lt.kind == TypeKind.TEXT \
+                and isinstance(left, E.Lit):
+            left = E.Lit(T.date_to_days(left.value), T.DATE)
+        return left, right
+
+    def _to_storage(self, lit: E.Lit, target: SqlType):
+        v = lit.value
+        if target.kind == TypeKind.DECIMAL:
+            if lit.type.kind == TypeKind.DECIMAL:
+                return v * 10 ** max(0, target.scale - lit.type.scale)
+            return int(v) * 10 ** target.scale
+        if target.kind == TypeKind.DATE and isinstance(v, str):
+            return T.date_to_days(v)
+        return int(v)
+
+    def _common_case_type(self, types: list[SqlType]) -> SqlType:
+        t = types[0]
+        for u in types[1:]:
+            if u.kind == t.kind and u.scale == t.scale:
+                continue
+            if t.is_numeric and u.is_numeric:
+                if TypeKind.FLOAT64 in (t.kind, u.kind):
+                    t = T.FLOAT64
+                elif TypeKind.DECIMAL in (t.kind, u.kind):
+                    t = T.decimal(30, max(t.scale, u.scale))
+                else:
+                    t = T.INT64
+            else:
+                raise BindError("CASE branches have incompatible types")
+        return t
+
+    def _coerce_case(self, whens, else_, t: SqlType):
+        def fix(e: E.Expr) -> E.Expr:
+            if e.type.kind == t.kind and e.type.scale == t.scale:
+                return e
+            return E.Cast(e, t)
+        whens = tuple((c, fix(v)) for c, v in whens)
+        return whens, (fix(else_) if else_ is not None else None)
+
+    def _bind_func(self, node: A.FuncCall, b) -> E.Expr:
+        name = node.name
+        if name in E.AGG_FUNCS:
+            if node.star:
+                return E.AggCall("count", None)
+            if len(node.args) != 1:
+                raise BindError(f"{name} takes one argument")
+            return E.AggCall(name, b(node.args[0]), distinct=node.distinct)
+        raise BindError(f"function {name!r} unsupported")
+
+
+def split_conjuncts(e: Optional[E.Expr]) -> list[E.Expr]:
+    if e is None:
+        return []
+    if isinstance(e, E.BoolOp) and e.op == "and":
+        out = []
+        for a in e.args:
+            out.extend(split_conjuncts(a))
+        return out
+    return [e]

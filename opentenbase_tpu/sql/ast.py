@@ -1,0 +1,313 @@
+"""Raw (untyped) SQL AST.
+
+Reference analog: the parse-tree nodes of src/include/nodes/parsenodes.h
+produced by gram.y.  The analyzer (sql/analyze.py) binds these against the
+catalog into typed query trees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Node:
+    pass
+
+
+# ---- expressions ----------------------------------------------------------
+
+@dataclasses.dataclass
+class ColRef(Node):
+    parts: tuple[str, ...]            # (col) or (tbl, col)
+
+
+@dataclasses.dataclass
+class Star(Node):
+    table: Optional[str] = None       # t.* or *
+
+
+@dataclasses.dataclass
+class Const(Node):
+    value: object                     # int | float-str | str | bool | None
+    kind: str                         # 'int' | 'num' | 'str' | 'bool' | 'null'
+
+
+@dataclasses.dataclass
+class Param(Node):
+    index: int                        # $n
+
+
+@dataclasses.dataclass
+class TypedConst(Node):
+    """DATE 'x', INTERVAL 'n' unit."""
+    type_name: str
+    value: str
+    unit: str = ""
+    qty: int = 0
+
+
+@dataclasses.dataclass
+class BinOp(Node):
+    op: str
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass
+class UnaryOp(Node):
+    op: str                           # '-' | 'not'
+    arg: Node
+
+
+@dataclasses.dataclass
+class BoolExpr(Node):
+    op: str                           # 'and' | 'or'
+    args: list[Node]
+
+
+@dataclasses.dataclass
+class FuncCall(Node):
+    name: str
+    args: list[Node]
+    distinct: bool = False
+    star: bool = False                # count(*)
+
+
+@dataclasses.dataclass
+class CaseExpr(Node):
+    whens: list[tuple[Node, Node]]
+    else_: Optional[Node]
+
+
+@dataclasses.dataclass
+class InExpr(Node):
+    arg: Node
+    items: Optional[list[Node]]       # literal list
+    subquery: Optional["SelectStmt"]  # or IN (select ...)
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class BetweenExpr(Node):
+    arg: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class LikeExpr(Node):
+    arg: Node
+    pattern: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class NullTest(Node):
+    arg: Node
+    is_null: bool
+
+
+@dataclasses.dataclass
+class ExistsExpr(Node):
+    subquery: "SelectStmt"
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class ScalarSubquery(Node):
+    subquery: "SelectStmt"
+
+
+@dataclasses.dataclass
+class QuantifiedCmp(Node):
+    """expr op ANY/ALL (subquery)."""
+    op: str
+    arg: Node
+    quantifier: str                   # 'any' | 'all'
+    subquery: "SelectStmt"
+
+
+@dataclasses.dataclass
+class CastExpr(Node):
+    arg: Node
+    type_name: str
+    type_args: tuple[int, ...] = ()
+
+
+@dataclasses.dataclass
+class ExtractExpr(Node):
+    field: str
+    arg: Node
+
+
+@dataclasses.dataclass
+class SubstringExpr(Node):
+    arg: Node
+    start: Node
+    length: Optional[Node]
+
+
+# ---- select ---------------------------------------------------------------
+
+@dataclasses.dataclass
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TableRef(Node):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SubqueryRef(Node):
+    subquery: "SelectStmt"
+    alias: str
+
+
+@dataclasses.dataclass
+class JoinRef(Node):
+    kind: str                         # inner|left|right|full|cross
+    left: Node
+    right: Node
+    on: Optional[Node]
+
+
+@dataclasses.dataclass
+class SortItem(Node):
+    expr: Node
+    desc: bool = False
+    nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class SelectStmt(Node):
+    items: list[SelectItem]
+    from_: list[Node]                 # TableRef | SubqueryRef | JoinRef
+    where: Optional[Node] = None
+    group_by: list[Node] = dataclasses.field(default_factory=list)
+    having: Optional[Node] = None
+    order_by: list[SortItem] = dataclasses.field(default_factory=list)
+    limit: Optional[Node] = None
+    offset: Optional[Node] = None
+    distinct: bool = False
+    setop: Optional[tuple[str, bool, "SelectStmt"]] = None  # (op, all, rhs)
+
+
+# ---- DML ------------------------------------------------------------------
+
+@dataclasses.dataclass
+class InsertStmt(Node):
+    table: str
+    columns: list[str]
+    values: Optional[list[list[Node]]]    # VALUES rows
+    select: Optional[SelectStmt] = None
+
+
+@dataclasses.dataclass
+class UpdateStmt(Node):
+    table: str
+    assignments: list[tuple[str, Node]]
+    where: Optional[Node] = None
+
+
+@dataclasses.dataclass
+class DeleteStmt(Node):
+    table: str
+    where: Optional[Node] = None
+
+
+@dataclasses.dataclass
+class CopyStmt(Node):
+    table: str
+    columns: list[str]
+    direction: str                    # 'from' | 'to'
+    filename: str                     # '' => STDIN/STDOUT
+    options: dict
+
+
+# ---- DDL / utility --------------------------------------------------------
+
+@dataclasses.dataclass
+class ColumnDefAst(Node):
+    name: str
+    type_name: str
+    type_args: tuple[int, ...]
+    not_null: bool = False
+    primary_key: bool = False
+
+
+@dataclasses.dataclass
+class CreateTableStmt(Node):
+    name: str
+    columns: list[ColumnDefAst]
+    primary_key: list[str]
+    dist_type: str = "shard"          # shard|replication|hash|modulo|roundrobin
+    dist_cols: list[str] = dataclasses.field(default_factory=list)
+    group: Optional[str] = None
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropTableStmt(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class CreateSequenceStmt(Node):
+    name: str
+    start: int = 1
+    increment: int = 1
+
+
+@dataclasses.dataclass
+class CreateIndexStmt(Node):
+    name: str
+    table: str
+    columns: list[str]
+    unique: bool = False
+
+
+@dataclasses.dataclass
+class TxnStmt(Node):
+    op: str                           # begin|commit|rollback
+
+
+@dataclasses.dataclass
+class ExplainStmt(Node):
+    stmt: Node
+    analyze: bool = False
+    verbose: bool = False
+
+
+@dataclasses.dataclass
+class SetStmt(Node):
+    name: str
+    value: object
+
+
+@dataclasses.dataclass
+class ShowStmt(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class VacuumStmt(Node):
+    table: Optional[str]
+
+
+@dataclasses.dataclass
+class BarrierStmt(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class ExecuteDirectStmt(Node):
+    node: str
+    sql: str

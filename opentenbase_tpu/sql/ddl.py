@@ -1,0 +1,40 @@
+"""DDL AST -> catalog objects.
+
+Reference analog: DefineRelation + pgxc distribution handling in
+src/backend/commands/tablecmds.c and pgxc/locator (CREATE TABLE ...
+DISTRIBUTE BY is the XC grammar addition).
+"""
+
+from __future__ import annotations
+
+from ..catalog import types as T
+from ..catalog.schema import (ColumnDef, Distribution, DistType, SequenceDef,
+                              TableDef)
+from . import ast as A
+
+_DIST_MAP = {
+    "shard": DistType.SHARD,
+    "hash": DistType.HASH,
+    "modulo": DistType.MODULO,
+    "roundrobin": DistType.ROUNDROBIN,
+    "replicated": DistType.REPLICATED,
+    "replication": DistType.REPLICATED,
+}
+
+
+def table_def_from_ast(stmt: A.CreateTableStmt) -> TableDef:
+    cols = []
+    pk = list(stmt.primary_key)
+    for c in stmt.columns:
+        cols.append(ColumnDef(c.name, T.type_from_name(c.type_name,
+                                                       c.type_args),
+                              nullable=not (c.not_null or c.primary_key)))
+        if c.primary_key:
+            pk.append(c.name)
+    dist = Distribution(_DIST_MAP[stmt.dist_type], list(stmt.dist_cols),
+                        stmt.group or "default_group")
+    return TableDef(stmt.name, cols, dist)
+
+
+def sequence_def_from_ast(stmt: A.CreateSequenceStmt) -> SequenceDef:
+    return SequenceDef(stmt.name, stmt.start, stmt.increment)

@@ -113,6 +113,20 @@ class TestStrPred:
         codes = jnp.asarray([0, 9, 10, 99], jnp.int32)
         assert np.asarray(f({"s": codes})).tolist() == [True, True, False, False]
 
+    def test_strpred_over_textexpr(self):
+        # the TPC-H Q22 shape: substring(c_phone from 1 for 2) in ('13','31')
+        d = self.make_dict(["13-245-abc", "31-555-xyz", "99-111-qqq"])
+        te = E.TextExpr(col("phone", T.TEXT), (("substring", 1, 2),))
+        f = compile_expr(E.StrPred(te, "in", ("13", "31")), {"phone": d})
+        codes = jnp.asarray([0, 1, 2], jnp.int32)
+        assert np.asarray(f({"phone": codes})).tolist() == [True, True, False]
+
+    def test_substring_clip_semantics(self):
+        te = E.TextExpr(col("s", T.TEXT), (("substring", 0, 2),))
+        assert te.apply("abc") == "a"   # PG clips at position 1
+        te2 = E.TextExpr(col("s", T.TEXT), (("substring", 2, None),))
+        assert te2.apply("abc") == "bc"
+
     def test_range_cmp(self):
         d = self.make_dict(["b", "a", "c"])
         f = compile_expr(E.StrPred(col("s", T.TEXT), "le", ("b",)), {"s": d})

@@ -1,0 +1,178 @@
+"""SQL front door: lexer, parser, analyzer."""
+
+import pytest
+
+from opentenbase_tpu.catalog import types as T
+from opentenbase_tpu.catalog.catalog import Catalog
+from opentenbase_tpu.catalog.schema import DistType, NodeDef
+from opentenbase_tpu.plan import exprs as E
+from opentenbase_tpu.plan.query import SubLink
+from opentenbase_tpu.sql import ast as A
+from opentenbase_tpu.sql.analyze import Binder, BindError
+from opentenbase_tpu.sql.ddl import table_def_from_ast
+from opentenbase_tpu.sql.lexer import SqlSyntaxError, lex
+from opentenbase_tpu.sql.parser import parse_one, parse_sql
+from opentenbase_tpu.tpch.queries import Q
+from opentenbase_tpu.tpch.schema import SCHEMA
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    cat = Catalog()
+    for i in range(4):
+        cat.register_node(NodeDef(f"dn{i}", "datanode", index=i))
+    cat.build_default_shard_map(4)
+    for stmt in parse_sql(SCHEMA):
+        cat.create_table(table_def_from_ast(stmt))
+    return cat
+
+
+@pytest.fixture(scope="module")
+def binder(catalog):
+    return Binder(catalog)
+
+
+class TestLexer:
+    def test_basic(self):
+        toks = lex("select a1, 'it''s' from t -- c\nwhere x >= 1.5e3")
+        vals = [t.value for t in toks]
+        assert "it's" in vals and ">=" in vals and "1.5e3" in vals
+
+    def test_errors(self):
+        with pytest.raises(SqlSyntaxError):
+            lex("select 'unterminated")
+        with pytest.raises(SqlSyntaxError):
+            lex("select /* no end")
+
+
+class TestParser:
+    def test_all_tpch_parse(self):
+        for i in sorted(Q):
+            parse_one(Q[i])
+
+    def test_create_table_distribute(self):
+        s = parse_one("create table t (a bigint, b varchar(10)) "
+                      "distribute by shard(a) to group g")
+        assert isinstance(s, A.CreateTableStmt)
+        assert s.dist_type == "shard" and s.dist_cols == ["a"]
+        assert s.group == "g"
+        td = table_def_from_ast(s)
+        assert td.distribution.dist_type == DistType.SHARD
+
+    def test_default_dist_col_from_pk(self):
+        s = parse_one("create table t (a int, b bigint primary key)")
+        assert s.dist_cols == ["b"]
+
+    def test_operator_precedence(self):
+        s = parse_one("select 1 + 2 * 3 from t")
+        e = s.items[0].expr
+        assert isinstance(e, A.BinOp) and e.op == "+"
+        assert isinstance(e.right, A.BinOp) and e.right.op == "*"
+
+    def test_not_like_and_between(self):
+        s = parse_one("select * from t where a not like 'x%' "
+                      "and b not between 1 and 2 and c not in (1, 2)")
+        w = s.where
+        assert isinstance(w, A.BoolExpr)
+        assert isinstance(w.args[0], A.LikeExpr) and w.args[0].negated
+        assert isinstance(w.args[1], A.BetweenExpr) and w.args[1].negated
+        assert isinstance(w.args[2], A.InExpr) and w.args[2].negated
+
+    def test_case_with_operand(self):
+        s = parse_one("select case x when 1 then 'a' else 'b' end from t")
+        c = s.items[0].expr
+        assert isinstance(c, A.CaseExpr)
+        assert isinstance(c.whens[0][0], A.BinOp)  # rewritten to x = 1
+
+    def test_interval_styles(self):
+        s1 = parse_one("select date '1998-12-01' - interval '90' day from t")
+        s2 = parse_one("select date '1998-12-01' + interval '3 month' from t")
+        assert s1.items[0].expr.right.qty == 90
+        assert s2.items[0].expr.right.unit == "month"
+
+    def test_execute_direct(self):
+        s = parse_one("execute direct on (dn1) 'select 1'")
+        assert s.node == "dn1" and s.sql == "select 1"
+
+    def test_error_position(self):
+        with pytest.raises(SqlSyntaxError, match="line 2"):
+            parse_one("select a\nfrom from t")
+
+    def test_union(self):
+        s = parse_one("select a from t union all select b from u order by 1")
+        assert s.setop is not None and s.setop[0] == "union"
+
+
+class TestBinder:
+    def test_all_tpch_bind(self, binder):
+        for i in sorted(Q):
+            binder.bind_select(parse_one(Q[i]))
+
+    def test_q1_types(self, binder):
+        bq = binder.bind_select(parse_one(Q[1]))
+        names = [n for n, _ in bq.targets]
+        assert names[:4] == ["l_returnflag", "l_linestatus", "sum_qty",
+                             "sum_base_price"]
+        # sum_disc_price: decimal scale 4 (price*disc)
+        assert bq.targets[4][1].type.scale == 4
+        # sum_charge: scale 6
+        assert bq.targets[5][1].type.scale == 6
+        # avg -> float64
+        assert bq.targets[6][1].type.kind == T.TypeKind.FLOAT64
+        assert bq.group_by[0] == E.Col("lineitem.l_returnflag", T.SqlType(
+            T.TypeKind.TEXT, max_len=1))
+        # where folded: shipdate <= 1998-09-02
+        cutoff = bq.where[0].right
+        assert isinstance(cutoff, E.Lit)
+        assert T.days_to_date(cutoff.value) == "1998-09-02"
+
+    def test_correlation_detection(self, binder):
+        bq = binder.bind_select(parse_one(Q[4]))
+        sub = next(e for e in bq.where if isinstance(e, SubLink))
+        assert sub.link_kind == "exists"
+        assert "orders.o_orderkey" in sub.query.correlated_cols
+
+    def test_text_predicates(self, binder):
+        bq = binder.bind_select(parse_one(
+            "select * from orders where o_orderpriority <> '1-URGENT'"))
+        p = bq.where[0]
+        assert isinstance(p, E.StrPred) and p.kind == "ne"
+
+    def test_substring_textexpr(self, binder):
+        bq = binder.bind_select(parse_one(
+            "select substring(c_phone from 1 for 2) from customer"))
+        te = bq.targets[0][1]
+        assert isinstance(te, E.TextExpr)
+        assert te.apply("13-245") == "13"
+
+    def test_ambiguous_column(self, binder):
+        with pytest.raises(BindError, match="ambiguous"):
+            binder.bind_select(parse_one(
+                "select n_nationkey from nation n1, nation n2"))
+
+    def test_unknown_column(self, binder):
+        with pytest.raises(BindError, match="does not exist"):
+            binder.bind_select(parse_one("select nope from nation"))
+
+    def test_unknown_table(self, binder):
+        with pytest.raises(BindError, match="does not exist"):
+            binder.bind_select(parse_one("select 1 from nonesuch"))
+
+    def test_alias_in_order_and_group(self, binder):
+        bq = binder.bind_select(parse_one(
+            "select n_regionkey as rk, count(*) as c from nation "
+            "group by rk order by c desc"))
+        assert bq.group_by[0] == E.Col("nation.n_regionkey", T.INT32)
+        assert isinstance(bq.order_by[0][0], E.AggCall)
+
+    def test_left_join_kept_structured(self, binder):
+        bq = binder.bind_select(parse_one(
+            "select c_custkey from customer left join orders "
+            "on c_custkey = o_custkey"))
+        assert bq.join_order[1].kind == "left"
+        assert bq.join_order[1].on is not None
+
+    def test_star_expansion(self, binder):
+        bq = binder.bind_select(parse_one("select * from region"))
+        assert [n for n, _ in bq.targets] == ["r_regionkey", "r_name",
+                                              "r_comment"]
