@@ -1,0 +1,683 @@
+"""Fragment executor: runs a physical plan over one datanode's stores.
+
+Reference analog: src/backend/executor (ExecutorStart/Run, ExecProcNode
+Volcano loop).  Architectural differences (SURVEY.md §7.1):
+
+- Whole-batch execution: each operator consumes/produces a DBatch — padded
+  device arrays + a validity mask — instead of pulling tuples.  Padding is
+  power-of-two size classes so XLA compiles one program per class.
+- The scan stages table chunks into a device cache once per table version
+  (the device is the buffer cache; host RAM is the source of truth) and
+  fuses MVCC visibility + quals + projection in one jitted kernel.
+- NULLs exist only where the engine creates them (outer-join null-extended
+  columns), tracked as per-column null masks consumed by aggregates —
+  matching TPC-H/NOT NULL base data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..catalog import types as T
+from ..catalog.types import SqlType, TypeKind
+from ..ops import kernels as K
+from ..plan import exprs as E
+from ..plan import physical as P
+from ..plan.planner import PlannedStmt, rewrite
+from ..storage.batch import next_pow2
+from ..storage.store import ABORTED_TS, TableStore
+from ..utils.hashing import hash_columns_jax
+
+
+class ExecError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class DBatch:
+    cols: dict[str, object]            # name -> jnp array [P]
+    valid: object                      # jnp bool [P]
+    types: dict[str, SqlType]
+    dicts: dict[str, list]             # TEXT col name -> code->str list
+    nulls: dict[str, object] = dataclasses.field(default_factory=dict)
+
+    @property
+    def padded(self) -> int:
+        return int(self.valid.shape[0])
+
+    def count(self) -> int:
+        return int(jnp.sum(self.valid))
+
+
+def _empty_batch(types: dict[str, SqlType], dicts: dict) -> DBatch:
+    cols = {n: jnp.zeros(256, dtype=t.np_dtype) for n, t in types.items()}
+    return DBatch(cols, jnp.zeros(256, dtype=bool), dict(types), dict(dicts))
+
+
+class DeviceTableCache:
+    """Staged (padded, concatenated) device columns per table version —
+    the bufmgr analog: device HBM caches host chunks."""
+
+    def __init__(self):
+        self._cache: dict[tuple, tuple] = {}
+
+    def get(self, store: TableStore, colnames: list[str]):
+        key = (id(store),)
+        ver = store.version
+        hit = self._cache.get(key)
+        if hit is not None and hit[0] == ver and \
+                set(colnames) <= set(hit[1]):
+            return hit[1], hit[2]
+        n = store.row_count()
+        padded = next_pow2(max(n, 1))
+        arrs = {}
+        want = set(colnames) | {"__xmin_ts", "__xmax_ts", "__xmin_txid",
+                                "__xmax_txid"}
+        for name in want:
+            if name == "__xmin_ts":
+                parts = [ch.xmin_ts[:ch.nrows] for _, ch in
+                         store.scan_chunks()]
+                dt = np.int64
+            elif name == "__xmax_ts":
+                parts = [ch.xmax_ts[:ch.nrows] for _, ch in
+                         store.scan_chunks()]
+                dt = np.int64
+            elif name == "__xmin_txid":
+                parts = [ch.xmin_txid[:ch.nrows] for _, ch in
+                         store.scan_chunks()]
+                dt = np.int64
+            elif name == "__xmax_txid":
+                parts = [ch.xmax_txid[:ch.nrows] for _, ch in
+                         store.scan_chunks()]
+                dt = np.int64
+            else:
+                parts = [ch.columns[name][:ch.nrows] for _, ch in
+                         store.scan_chunks()]
+                dt = store.td.column(name).type.np_dtype
+            host = np.concatenate(parts) if parts else np.empty(0, dt)
+            buf = np.zeros(padded, dtype=host.dtype)
+            buf[:n] = host
+            arrs[name] = jax.device_put(buf)
+        self._cache[key] = (ver, arrs, n)
+        return arrs, n
+
+    def invalidate(self, store: TableStore):
+        self._cache.pop((id(store),), None)
+
+
+@dataclasses.dataclass
+class ExecContext:
+    stores: dict[str, TableStore]
+    snapshot_ts: int
+    txid: int
+    cache: DeviceTableCache
+    params: dict[str, tuple] = dataclasses.field(default_factory=dict)
+    # init-plan results: name -> (value, SqlType)
+
+
+class Executor:
+    def __init__(self, ctx: ExecContext):
+        self.ctx = ctx
+
+    # ------------------------------------------------------------------
+    def run(self, planned: PlannedStmt):
+        for ip in planned.init_plans:
+            batch = self.exec_node(ip.plan)
+            val = self._scalar_from_batch(batch, ip.type)
+            self.ctx.params[ip.name] = (val, ip.type)
+        out = self.exec_node(planned.plan)
+        return out
+
+    def _scalar_from_batch(self, b: DBatch, t: SqlType):
+        name = next(iter(b.cols))
+        arr = np.asarray(b.cols[name])
+        valid = np.asarray(b.valid)
+        vals = arr[valid]
+        if len(vals) == 0:
+            return 0
+        if len(vals) > 1:
+            raise ExecError("scalar subquery returned more than one row")
+        return vals[0].item()
+
+    # ------------------------------------------------------------------
+    def _prep(self, e: E.Expr) -> E.Expr:
+        """Substitute init-plan results before compiling."""
+        params = self.ctx.params
+
+        def sub(x: E.Expr):
+            if isinstance(x, E.Col) and x.name in params:
+                v, t = params[x.name]
+                return E.Lit(v, t)
+            return None
+        return rewrite(e, sub)
+
+    def _compile(self, e: E.Expr, batch: DBatch):
+        from .expr_compile import compile_expr
+
+        class _DictView:
+            def __init__(self, values):
+                self.values = values
+
+            def codes_matching(self, pred):
+                return np.asarray([i for i, v in enumerate(self.values)
+                                   if pred(v)], dtype=np.int32)
+
+        dicts = {n: _DictView(v) for n, v in batch.dicts.items()}
+        return compile_expr(self._prep(e), dicts)
+
+    def _eval(self, e: E.Expr, batch: DBatch):
+        return self._compile(e, batch)(batch.cols)
+
+    # ------------------------------------------------------------------
+    def exec_node(self, node: P.PhysNode) -> DBatch:
+        m = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
+        if m is None:
+            raise ExecError(f"no executor for {type(node).__name__}")
+        return m(node)
+
+    # ---- scan ----
+    def _exec_seqscan(self, node: P.SeqScan) -> DBatch:
+        store = self.ctx.stores.get(node.table.name)
+        if store is None:
+            raise ExecError(f"no store for table {node.table.name}")
+        # substitute init-plan results first: a '__initplanN' Col is a
+        # parameter, not a table column
+        filters = [self._prep(f) for f in node.filters]
+        outputs = [(n, self._prep(e)) for n, e in (node.outputs or [])]
+        needed = set()
+        for f in filters:
+            needed |= {c.split(".", 1)[1] if "." in c else c
+                       for c in _cols_of(f)}
+        for _, oe in outputs:
+            needed |= {c.split(".", 1)[1] if "." in c else c
+                       for c in _cols_of(oe)}
+        arrs, n = self.ctx.cache.get(store, sorted(needed))
+
+        # build an eval namespace with *qualified* names
+        qcols = {}
+        types = {}
+        dicts = {}
+        for c in store.td.columns:
+            qname = f"{node.alias}.{c.name}"
+            if c.name in arrs:
+                qcols[qname] = arrs[c.name]
+            types[qname] = c.type
+            if c.type.kind == TypeKind.TEXT and c.name in store.dicts:
+                dicts[qname] = store.dicts[c.name].values
+
+        padded = next_pow2(max(n, 1))
+        base = DBatch(qcols, None, types, dicts)
+        vis = K.visibility_mask(
+            arrs["__xmin_ts"], arrs["__xmax_ts"], arrs["__xmin_txid"],
+            arrs["__xmax_txid"], jnp.int64(self.ctx.snapshot_ts),
+            jnp.int64(self.ctx.txid), jnp.int64(ABORTED_TS))
+        vis = vis & (jnp.arange(padded) < n)
+        for f in filters:
+            vis = vis & self._eval(f, base)
+
+        out_cols, out_types, out_dicts = {}, {}, {}
+        for name, oe in outputs:
+            out_cols[name] = self._eval(oe, base)
+            out_types[name] = oe.type
+            d = _dict_for_expr(oe, dicts)
+            if d is not None:
+                out_dicts[name] = d
+        return DBatch(out_cols, vis, out_types, out_dicts)
+
+    # ---- filter / project ----
+    def _exec_filter(self, node: P.Filter) -> DBatch:
+        b = self.exec_node(node.child)
+        valid = b.valid
+        for q in node.quals:
+            valid = valid & self._eval(q, b)
+        return DBatch(b.cols, valid, b.types, b.dicts, b.nulls)
+
+    def _exec_project(self, node: P.Project) -> DBatch:
+        b = self.exec_node(node.child)
+        cols, types, dicts, nulls = {}, {}, {}, {}
+        for name, oe in node.outputs:
+            cols[name] = self._eval(oe, b)
+            types[name] = oe.type
+            d = _dict_for_expr(oe, b.dicts)
+            if d is not None:
+                dicts[name] = d
+            if isinstance(oe, E.Col) and oe.name in b.nulls:
+                nulls[name] = b.nulls[oe.name]
+        return DBatch(cols, b.valid, types, dicts, nulls)
+
+    # ---- join ----
+    def _join_key(self, keys: list[E.Expr], b: DBatch):
+        """Combine join key exprs into one int64 key column."""
+        arrs = [self._eval(k, b) for k in keys]
+        if len(arrs) == 1:
+            a = arrs[0]
+            if a.dtype == jnp.bool_:
+                a = a.astype(jnp.int64)
+            return a.astype(jnp.int64), False
+        h = hash_columns_jax([a.astype(jnp.int64) for a in arrs])
+        return h.astype(jnp.int64), True   # hashed: residual recheck needed
+
+    def _exec_hashjoin(self, node: P.HashJoin) -> DBatch:
+        left = self.exec_node(node.left)
+        right = self.exec_node(node.right)
+
+        if node.kind == "cross":
+            return self._cross_join(left, right)
+
+        lkey, lhashed = self._join_key(node.left_keys, left)
+        rkey, rhashed = self._join_key(node.right_keys, right)
+        skeys, perm = K.join_build(rkey, right.valid)
+        lo, counts = K.join_probe_counts(skeys, lkey, left.valid)
+
+        hash_recheck = []
+        if lhashed or rhashed:
+            hash_recheck = [(lk, rk) for lk, rk in
+                            zip(node.left_keys, node.right_keys)]
+
+        if node.kind in ("semi", "anti") and not node.residual \
+                and not hash_recheck:
+            mask = K.semi_mask(counts) if node.kind == "semi" \
+                else K.anti_mask(counts, left.valid)
+            return DBatch(left.cols, left.valid & mask, left.types,
+                          left.dicts, left.nulls)
+
+        total = int(jnp.sum(counts))
+        left_outer = node.kind == "left"
+        if left_outer:
+            total = int(jnp.sum(jnp.where(left.valid,
+                                          jnp.maximum(counts, 1), 0)))
+        out_size = next_pow2(max(total, 1))
+        pi, bi, tot = K.join_expand(lo, counts, perm, out_size,
+                                    left_outer=left_outer,
+                                    probe_valid=left.valid)
+        tot = int(tot)
+        valid = jnp.arange(out_size) < tot
+        null_right = (bi < 0) if left_outer else None
+        bi_safe = jnp.where(bi < 0, 0, bi) if left_outer else bi
+
+        cols, types, dicts, nulls = {}, {}, {}, {}
+        for n_, a in left.cols.items():
+            cols[n_] = a[pi]
+            types[n_] = left.types[n_]
+            if n_ in left.dicts:
+                dicts[n_] = left.dicts[n_]
+            if n_ in left.nulls:
+                nulls[n_] = left.nulls[n_][pi]
+        for n_, a in right.cols.items():
+            cols[n_] = a[bi_safe]
+            types[n_] = right.types[n_]
+            if n_ in right.dicts:
+                dicts[n_] = right.dicts[n_]
+            rn = right.nulls[n_][bi_safe] if n_ in right.nulls else None
+            if left_outer:
+                rn = null_right if rn is None else (rn | null_right)
+            if rn is not None:
+                nulls[n_] = rn
+        out = DBatch(cols, valid, types, dicts, nulls)
+
+        # residual quals (incl. hash recheck for multi-key joins)
+        res_valid = out.valid
+        for lk, rk in hash_recheck:
+            res_valid = res_valid & (self._eval(lk, out) ==
+                                     self._eval(rk, out))
+        for q in node.residual:
+            res_valid = res_valid & self._eval(q, out)
+
+        if node.kind in ("semi", "anti"):
+            # per-probe-row any(): scatter surviving pairs back to probe rows
+            hits = jax.ops.segment_sum(
+                res_valid.astype(jnp.int32), pi,
+                num_segments=left.valid.shape[0])
+            mask = hits > 0 if node.kind == "semi" else \
+                (left.valid & (hits == 0))
+            return DBatch(left.cols, left.valid & mask, left.types,
+                          left.dicts, left.nulls)
+        if left_outer:
+            # pairs killed by residual revert to null-extension... keep
+            # simple: residuals on outer joins were folded into `on` keys
+            out.valid = res_valid
+            return out
+        out.valid = res_valid
+        return out
+
+    def _cross_join(self, left: DBatch, right: DBatch) -> DBatch:
+        ln, rn = left.count(), right.count()
+        if ln * rn > 1 << 22:
+            raise ExecError("cross join too large")
+        lidx = jnp.repeat(jnp.arange(left.padded), right.padded)
+        ridx = jnp.tile(jnp.arange(right.padded), left.padded)
+        valid = left.valid[lidx] & right.valid[ridx]
+        cols = {n: a[lidx] for n, a in left.cols.items()}
+        cols.update({n: a[ridx] for n, a in right.cols.items()})
+        return DBatch(cols, valid, {**left.types, **right.types},
+                      {**left.dicts, **right.dicts})
+
+    # ---- aggregate ----
+    def _exec_agg(self, node: P.Agg) -> DBatch:
+        b = self.exec_node(node.child)
+        key_arrs, key_types, key_dicts, text_transformed = [], [], [], False
+        for name, ke in node.group_keys:
+            key_arrs.append(self._eval(ke, b).astype(jnp.int64))
+            key_types.append(ke.type)
+            d = _dict_for_expr(ke, b.dicts)
+            key_dicts.append(d)
+            # a transformed dictionary (substring etc.) can map several
+            # codes to one string: groups on codes over-split and must be
+            # re-merged after decode
+            if d is not None and len(set(d)) < len(d):
+                text_transformed = True
+
+        if any(ac.distinct for _, ac in node.aggs):
+            return self._exec_distinct_agg(node, b, key_arrs, key_types,
+                                           key_dicts)
+
+        # expand aggregate inputs
+        kinds, inputs, out_specs = [], [], []
+        for name, ac in node.aggs:
+            arg_arr = None
+            null_mask = None
+            if ac.arg is not None:
+                arg_arr = self._eval(ac.arg, b)
+                if isinstance(ac.arg, E.Col) and ac.arg.name in b.nulls:
+                    null_mask = b.nulls[ac.arg.name]
+            if ac.func == "count":
+                base = b.valid if null_mask is None else \
+                    (b.valid & ~null_mask)
+                kinds.append("sum")
+                inputs.append(base.astype(jnp.int64))
+                out_specs.append((name, T.INT64, None))
+            elif ac.func == "avg":
+                scale = ac.arg.type.scale \
+                    if ac.arg.type.kind == TypeKind.DECIMAL else 0
+                kinds.append("sumf")
+                inputs.append(arg_arr)
+                kinds.append("count")
+                inputs.append(b.valid.astype(jnp.int64))
+                out_specs.append((name, T.FLOAT64, ("avg", scale)))
+            elif ac.func == "sum":
+                if ac.arg.type.kind == TypeKind.FLOAT64:
+                    kinds.append("sumf")
+                    out_specs.append((name, T.FLOAT64, None))
+                else:
+                    kinds.append("sum")
+                    t = ac.arg.type if ac.arg.type.kind == TypeKind.DECIMAL \
+                        else T.INT64
+                    out_specs.append((name, t, None))
+                inputs.append(arg_arr)
+            elif ac.func in ("min", "max"):
+                kinds.append(ac.func)
+                inputs.append(arg_arr)
+                out_specs.append((name, ac.arg.type, None))
+            else:
+                raise ExecError(f"aggregate {ac.func} unsupported")
+
+        n = b.padded
+        if not key_arrs:
+            gid = jnp.zeros(n, dtype=jnp.int64)
+            (outs, present) = K.grouped_agg_dense(
+                gid, b.valid, tuple(inputs), 1, tuple(kinds))
+            out_valid = jnp.ones(1, dtype=bool)
+            gkey_out = []
+            padded_groups = 1
+        else:
+            dense_bound = _dense_bound(key_types, key_dicts)
+            if dense_bound is not None and dense_bound <= 4096:
+                gid = jnp.zeros(n, dtype=jnp.int64)
+                mult = 1
+                for arr, t, d in zip(key_arrs, key_types, key_dicts):
+                    dom = len(d) if d is not None else 2
+                    gid = gid * dom + jnp.clip(arr, 0, dom - 1)
+                    mult *= dom
+                (outs, present) = K.grouped_agg_dense(
+                    gid, b.valid, tuple(inputs), mult, tuple(kinds))
+                padded_groups = mult
+                out_valid = present > 0
+                # decode group keys from gid
+                gidx = jnp.arange(mult)
+                gkey_out = []
+                rem = gidx
+                doms = [len(d) if d is not None else 2 for d in key_dicts]
+                for i in reversed(range(len(key_arrs))):
+                    gkey_out.insert(0, (rem % doms[i]).astype(jnp.int64))
+                    rem = rem // doms[i]
+            else:
+                max_groups = next_pow2(max(b.count(), 1))
+                gkeys, outs, ng = K.grouped_agg_sort(
+                    tuple(key_arrs), b.valid, tuple(inputs),
+                    max_groups, tuple(kinds))
+                ng = int(ng)
+                padded_groups = max_groups
+                out_valid = jnp.arange(max_groups) < ng
+                gkey_out = list(gkeys)
+
+        # assemble output batch
+        cols, types, dicts = {}, {}, {}
+        for (kname, _), karr, kt, kd in zip(node.group_keys, gkey_out,
+                                            key_types, key_dicts):
+            cols[kname] = karr.astype(kt.np_dtype)
+            types[kname] = kt
+            if kd is not None:
+                dicts[kname] = kd
+        oi = 0
+        for name, t, special in out_specs:
+            if special is not None and special[0] == "avg":
+                s = outs[oi]
+                c = outs[oi + 1]
+                oi += 2
+                scale = special[1]
+                cols[name] = jnp.where(c > 0, s / jnp.maximum(c, 1)
+                                       / (10 ** scale), 0.0)
+            else:
+                cols[name] = outs[oi]
+                oi += 1
+            types[name] = t
+        out = DBatch(cols, out_valid, types, dicts)
+        if text_transformed:
+            out = self._remerge_text_groups(node, out)
+        return out
+
+    def _exec_distinct_agg(self, node: P.Agg, b: DBatch, key_arrs,
+                           key_types, key_dicts) -> DBatch:
+        """count(DISTINCT x): dedupe on (group keys, x) then count per
+        group — the reference handles this via sorted Agg transition
+        (nodeAgg.c DISTINCT path); here two sort-based passes."""
+        if len(node.aggs) != 1 or node.aggs[0][1].func != "count":
+            raise ExecError("only a single count(DISTINCT x) aggregate "
+                            "is supported")
+        name, ac = node.aggs[0]
+        arg_arr = self._eval(ac.arg, b).astype(jnp.int64)
+        n = b.padded
+        max_g1 = next_pow2(max(b.count(), 1))
+        gkeys1, _, ng1 = K.grouped_agg_sort(
+            tuple(key_arrs) + (arg_arr,), b.valid,
+            (b.valid.astype(jnp.int64),), max_g1, ("count",))
+        ng1 = int(ng1)
+        valid1 = jnp.arange(max_g1) < ng1
+        max_g2 = next_pow2(max(ng1, 1))
+        gkeys2, (cnt,), ng2 = K.grouped_agg_sort(
+            tuple(g for g in gkeys1[:-1]) if key_arrs else
+            (jnp.zeros(max_g1, jnp.int64),),
+            valid1, (valid1.astype(jnp.int64),), max_g2, ("count",))
+        ng2 = int(ng2)
+        cols, types, dicts = {}, {}, {}
+        for (kname, _), karr, kt, kd in zip(node.group_keys, gkeys2,
+                                            key_types, key_dicts):
+            cols[kname] = karr[:max_g2].astype(kt.np_dtype)
+            types[kname] = kt
+            if kd is not None:
+                dicts[kname] = kd
+        cols[name] = cnt
+        types[name] = T.INT64
+        out_valid = jnp.arange(max_g2) < (ng2 if key_arrs else 1)
+        return DBatch(cols, out_valid, types, dicts)
+
+    def _remerge_text_groups(self, node: P.Agg, b: DBatch) -> DBatch:
+        """Group keys built from transformed dictionaries (substring) may
+        map many codes to one string: decode and re-aggregate host-side
+        (cheap: operates on groups, not rows)."""
+        valid = np.asarray(b.valid)
+        merged: dict[tuple, list] = {}
+        key_names = [n for n, _ in node.group_keys]
+        agg_names = [n for n, _ in node.aggs]
+        host = {n: np.asarray(a) for n, a in b.cols.items()}
+        for i in np.nonzero(valid)[0]:
+            key = tuple(
+                b.dicts[kn][int(host[kn][i])] if kn in b.dicts
+                else host[kn][i].item() for kn in key_names)
+            acc = merged.get(key)
+            if acc is None:
+                merged[key] = [host[an][i].item() for an in agg_names]
+            else:
+                for j, (an, (_, ac)) in enumerate(
+                        zip(agg_names, node.aggs)):
+                    v = host[an][i].item()
+                    if ac.func in ("sum", "count"):
+                        acc[j] += v
+                    elif ac.func == "min":
+                        acc[j] = min(acc[j], v)
+                    elif ac.func == "max":
+                        acc[j] = max(acc[j], v)
+                    else:
+                        raise ExecError("avg through text re-merge "
+                                        "unsupported; decompose first")
+        # rebuild
+        ng = len(merged)
+        padded = next_pow2(max(ng, 1))
+        cols = {}
+        new_dicts = {}
+        keys_list = list(merged.keys())
+        for ki, kn in enumerate(key_names):
+            if kn in b.dicts:
+                vals = [k[ki] for k in keys_list]
+                uniq = sorted(set(vals))
+                lut = {v: i for i, v in enumerate(uniq)}
+                arr = np.zeros(padded, np.int32)
+                arr[:ng] = [lut[v] for v in vals]
+                cols[kn] = jnp.asarray(arr)
+                new_dicts[kn] = uniq
+            else:
+                arr = np.zeros(padded, b.types[kn].np_dtype)
+                arr[:ng] = [k[ki] for k in keys_list]
+                cols[kn] = jnp.asarray(arr)
+        for j, an in enumerate(agg_names):
+            arr = np.zeros(padded, b.types[an].np_dtype)
+            arr[:ng] = [merged[k][j] for k in keys_list]
+            cols[an] = jnp.asarray(arr)
+        valid = jnp.asarray(np.arange(padded) < ng)
+        return DBatch(cols, valid, b.types, new_dicts)
+
+    # ---- sort / limit ----
+    def _exec_sort(self, node: P.Sort) -> DBatch:
+        b = self.exec_node(node.child)
+        key_arrs, descs = [], []
+        for ke, desc in node.keys:
+            arr = self._eval(ke, b)
+            d = _dict_for_expr(ke, b.dicts)
+            if d is not None:
+                # dictionary codes are unordered: map code -> rank
+                order = np.argsort(np.asarray(d, dtype=object))
+                rank = np.empty(max(len(d), 1), dtype=np.int32)
+                rank[order] = np.arange(len(d), dtype=np.int32)
+                arr = jnp.asarray(rank)[jnp.clip(arr, 0, len(d) - 1)]
+            key_arrs.append(arr)
+            descs.append(bool(desc))
+        names = list(b.cols.keys())
+        payload = tuple(b.cols[n] for n in names)
+        limit = node.limit
+        sorted_payload, s_valid = K.sort_rows(
+            tuple(key_arrs), b.valid, payload, tuple(descs),
+            limit=limit)
+        cols = dict(zip(names, sorted_payload))
+        return DBatch(cols, s_valid, b.types, b.dicts)
+
+    def _exec_limit(self, node: P.Limit) -> DBatch:
+        b = self.exec_node(node.child)
+        # valid rows are in order (post-sort); mask beyond count+offset
+        idx = jnp.cumsum(b.valid.astype(jnp.int32))
+        keep = b.valid
+        if node.offset:
+            keep = keep & (idx > node.offset)
+        if node.count is not None:
+            keep = keep & (idx <= (node.count + node.offset))
+        return DBatch(b.cols, keep, b.types, b.dicts, b.nulls)
+
+    def _exec_result(self, node: P.Result) -> DBatch:
+        cols, types = {}, {}
+        base = DBatch({}, jnp.ones(1, dtype=bool), {}, {})
+        for name, oe in node.outputs:
+            arr = self._eval(oe, base)
+            cols[name] = jnp.broadcast_to(arr, (1,)) \
+                if getattr(arr, "ndim", 0) == 0 else arr
+            types[name] = oe.type
+        return DBatch(cols, jnp.ones(1, dtype=bool), types, {})
+
+    def _exec_gather(self, node: P.Gather) -> DBatch:
+        return self.exec_node(node.child)
+
+
+# ---------------------------------------------------------------------------
+
+def _cols_of(e: E.Expr) -> set[str]:
+    return {x.name for x in E.walk(e) if isinstance(x, E.Col)}
+
+
+def _dict_for_expr(e: E.Expr, dicts: dict):
+    """Decode dictionary for a TEXT-valued expr output (transformed for
+    TextExpr — many codes may map to one string downstream)."""
+    if isinstance(e, E.Col) and e.name in dicts:
+        return dicts[e.name]
+    if isinstance(e, E.TextExpr):
+        base = dicts.get(e.col.name)
+        if base is None:
+            return None
+        return [e.apply(v) for v in base]
+    return None
+
+
+def materialize(b: DBatch, names: Optional[list[str]] = None):
+    """DBatch -> (column_names, list of python row tuples), decoded."""
+    if names is None:
+        names = list(b.cols.keys())
+    valid = np.asarray(b.valid)
+    rows_idx = np.nonzero(valid)[0]
+    out_cols = []
+    for n in names:
+        arr = np.asarray(b.cols[n])[rows_idx]
+        t = b.types[n]
+        nullm = np.asarray(b.nulls[n])[rows_idx] if n in b.nulls else None
+        if t.kind == TypeKind.TEXT:
+            d = b.dicts.get(n, [])
+            vals = [d[int(c)] if 0 <= int(c) < len(d) else None for c in arr]
+        elif t.kind == TypeKind.DECIMAL:
+            vals = [v.item() / 10 ** t.scale for v in arr]
+        elif t.kind == TypeKind.DATE:
+            vals = [T.days_to_date(int(v)) for v in arr]
+        elif t.kind == TypeKind.BOOL:
+            vals = [bool(v) for v in arr]
+        elif t.kind == TypeKind.FLOAT64:
+            vals = [float(v) for v in arr]
+        else:
+            vals = [int(v) for v in arr]
+        if nullm is not None:
+            vals = [None if m else v for v, m in zip(vals, nullm)]
+        out_cols.append(vals)
+    rows = list(zip(*out_cols)) if out_cols else []
+    return names, rows
+
+
+def _dense_bound(key_types: list[SqlType], key_dicts: list) -> Optional[int]:
+    """Combined group-domain bound if all keys have small known domains."""
+    bound = 1
+    for t, d in zip(key_types, key_dicts):
+        if t.kind == TypeKind.TEXT and d is not None:
+            bound *= max(len(d), 1)
+        elif t.kind == TypeKind.BOOL:
+            bound *= 2
+        else:
+            return None
+    return bound

@@ -1,0 +1,466 @@
+"""Single-node engine + session: the "centralized mode" of the reference
+(IS_CENTRALIZED_MODE, src/include/pgxc/pgxc.h:111-117 — one node acting as
+access node and datanode at once).  The distributed CN/DN split layers on
+top of this engine in net/ and parallel/.
+
+A LocalNode owns: catalog, table stores, WAL, a device cache, and a local
+timestamp source (stand-in for the GTM; the gtm/ service replaces it in
+cluster mode).  Session wraps it with the SQL statement loop
+(reference: exec_simple_query, tcop/postgres.c:1370).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..catalog.catalog import Catalog, CatalogError
+from ..catalog.schema import DistType, NodeDef, TableDef
+from ..catalog.types import TypeKind
+from ..parallel.locator import Locator
+from ..plan import physical as P
+from ..plan.planner import PlannedStmt, Planner
+from ..sql import ast as A
+from ..sql.analyze import Binder, split_conjuncts
+from ..sql.ddl import sequence_def_from_ast, table_def_from_ast
+from ..sql.parser import parse_sql
+from ..storage.store import TableStore
+from ..storage.wal import Wal, checkpoint_store, restore_store
+from .executor import (DBatch, DeviceTableCache, ExecContext, ExecError,
+                       Executor, materialize)
+
+
+@dataclasses.dataclass
+class Result:
+    """One statement's result."""
+    command: str
+    names: list[str] = dataclasses.field(default_factory=list)
+    rows: list[tuple] = dataclasses.field(default_factory=list)
+    rowcount: int = 0
+    text: str = ""                      # EXPLAIN etc.
+
+
+class TxnState:
+    def __init__(self, txid: int, snapshot_ts: int):
+        self.txid = txid
+        self.snapshot_ts = snapshot_ts
+        # per-store write sets for commit/abort backfill
+        self.insert_spans: list[tuple[TableStore, list]] = []
+        self.delete_spans: list[tuple[TableStore, tuple]] = []
+        self.explicit = False
+
+
+class LocalGts:
+    """Monotonic local timestamp source — the in-process stand-in for the
+    GTM (reference: GetGlobalTimestampGTM, access/transam/gtm.c:1962).
+    Cluster mode swaps in gtm/client.py with the same interface."""
+
+    def __init__(self, start: int = 100):
+        self._ts = start
+        self._txid = 1
+
+    def next_gts(self) -> int:
+        self._ts += 1
+        return self._ts
+
+    def next_txid(self) -> int:
+        self._txid += 1
+        return self._txid
+
+
+class LocalNode:
+    def __init__(self, datadir: Optional[str] = None, node_name: str = "dn0"):
+        self.catalog = Catalog()
+        self.catalog.register_node(NodeDef(node_name, "datanode", index=0))
+        self.catalog.build_default_shard_map(1)
+        self.stores: dict[str, TableStore] = {}
+        self.gts = LocalGts()
+        self.cache = DeviceTableCache()
+        self.datadir = datadir
+        self.wal: Optional[Wal] = None
+        self.gucs: dict[str, str] = {
+            "enable_fast_query_shipping": "on",
+            "enable_datanode_push": "on",
+        }
+        if datadir:
+            os.makedirs(datadir, exist_ok=True)
+            self._recover()
+            self.wal = Wal(os.path.join(datadir, "wal.log"))
+
+    # ---- persistence ----
+    def _recover(self):
+        # clock state first: recovered rows carry commit GTS that must be
+        # in this node's past (reference: pg_control checkpoint record +
+        # GTM's persistent store gtm_store.c)
+        metapath = os.path.join(self.datadir, "meta.json")
+        if os.path.exists(metapath):
+            import json
+            with open(metapath) as f:
+                meta = json.load(f)
+            self.gts._ts = max(self.gts._ts, meta["gts"])
+            self.gts._txid = max(self.gts._txid, meta["txid"])
+        catpath = os.path.join(self.datadir, "catalog.json")
+        if os.path.exists(catpath):
+            self.catalog = Catalog.load(catpath)
+            for name, td in self.catalog.tables.items():
+                st = TableStore(td)
+                ckpt = os.path.join(self.datadir, f"{name}.ckpt")
+                if os.path.exists(ckpt):
+                    restore_store(st, ckpt)
+                self.stores[name] = st
+        walpath = os.path.join(self.datadir, "wal.log")
+        replayed: dict[int, list] = {}
+        for rec in Wal.replay(walpath):
+            self._replay_record(rec, replayed)
+
+    def _replay_record(self, rec: dict, pending: dict):
+        op = rec.get("op")
+        # never reuse any txid seen in the log: a crashed (uncommitted) txn's
+        # rows would become visible to a new txn that drew the same id
+        if "txid" in rec:
+            self.gts._txid = max(self.gts._txid, rec["txid"])
+        if op == "create_table":
+            td = TableDef.from_json(rec["table"])
+            if td.name not in self.catalog.tables:
+                self.catalog.create_table(td)
+            self.stores.setdefault(td.name, TableStore(td))
+        elif op == "drop_table":
+            self.catalog.drop_table(rec["name"], if_exists=True)
+            self.stores.pop(rec["name"], None)
+        elif op == "insert":
+            st = self.stores[rec["table"]]
+            cols = {k: np.asarray(v) for k, v in rec["columns"].items()}
+            # dictionary codes were logged as raw strings for TEXT cols
+            enc = {}
+            for cname, arr in cols.items():
+                enc[cname] = st.encode_column(
+                    cname, arr if arr.dtype.kind not in "UO" else list(arr))
+            spans = st.insert(enc, rec["n"], rec["txid"])
+            pending.setdefault(rec["txid"], []).append(("ins", st, spans))
+        elif op == "delete":
+            st = self.stores[rec["table"]]
+            span = st.mark_delete(rec["chunk"],
+                                  np.asarray(rec["mask"]), rec["txid"])
+            pending.setdefault(rec["txid"], []).append(("del", st, span))
+        elif op == "commit":
+            ts = np.int64(rec["ts"])
+            for kind, st, sp in pending.pop(rec["txid"], []):
+                if kind == "ins":
+                    st.backfill_insert(sp, ts)
+                else:
+                    st.backfill_delete([sp], ts)
+            self.gts._ts = max(self.gts._ts, int(rec["ts"]))
+            self.gts._txid = max(self.gts._txid, rec["txid"])
+        elif op == "abort":
+            for kind, st, sp in pending.pop(rec["txid"], []):
+                if kind == "ins":
+                    st.abort_insert(sp)
+                else:
+                    st.revert_delete([sp])
+
+    def checkpoint(self):
+        if not self.datadir:
+            return
+        import json
+        self.catalog.save(os.path.join(self.datadir, "catalog.json"))
+        for name, st in self.stores.items():
+            checkpoint_store(st, os.path.join(self.datadir, f"{name}.ckpt"))
+        tmp = os.path.join(self.datadir, "meta.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"gts": self.gts._ts, "txid": self.gts._txid}, f)
+        os.replace(tmp, os.path.join(self.datadir, "meta.json"))
+        if self.wal:
+            self.wal.truncate()
+
+    def _log(self, rec: dict, sync: bool = False):
+        if self.wal:
+            self.wal.append(rec, sync=sync)
+
+
+class Session:
+    def __init__(self, node: LocalNode):
+        self.node = node
+        self.txn: Optional[TxnState] = None
+
+    # ------------------------------------------------------------------
+    def execute(self, sql: str) -> list[Result]:
+        return [self._exec_stmt(s) for s in parse_sql(sql)]
+
+    def query(self, sql: str) -> list[tuple]:
+        """Convenience: single SELECT -> rows."""
+        res = self.execute(sql)
+        return res[-1].rows
+
+    # ------------------------------------------------------------------
+    def _begin_implicit(self) -> tuple[TxnState, bool]:
+        if self.txn is not None:
+            return self.txn, False
+        t = TxnState(self.node.gts.next_txid(), self.node.gts.next_gts())
+        return t, True
+
+    def _commit(self, t: TxnState):
+        ts = np.int64(self.node.gts.next_gts())
+        self.node._log({"op": "commit", "txid": t.txid, "ts": int(ts)},
+                       sync=True)
+        for st, spans in t.insert_spans:
+            st.backfill_insert(spans, ts)
+        for st, span in t.delete_spans:
+            st.backfill_delete([span], ts)
+
+    def _abort(self, t: TxnState):
+        self.node._log({"op": "abort", "txid": t.txid})
+        for st, spans in t.insert_spans:
+            st.abort_insert(spans)
+        for st, span in t.delete_spans:
+            st.revert_delete([span])
+
+    # ------------------------------------------------------------------
+    def _exec_stmt(self, stmt: A.Node) -> Result:
+        if isinstance(stmt, A.SelectStmt):
+            return self._exec_select(stmt)
+        if isinstance(stmt, A.CreateTableStmt):
+            td = table_def_from_ast(stmt)
+            self.node.catalog.create_table(td, stmt.if_not_exists)
+            self.node.stores.setdefault(td.name, TableStore(td))
+            self.node._log({"op": "create_table", "table": td.to_json()})
+            return Result("CREATE TABLE")
+        if isinstance(stmt, A.DropTableStmt):
+            self.node.catalog.drop_table(stmt.name, stmt.if_exists)
+            st = self.node.stores.pop(stmt.name, None)
+            if st is not None:
+                self.node.cache.invalidate(st)
+            self.node._log({"op": "drop_table", "name": stmt.name})
+            return Result("DROP TABLE")
+        if isinstance(stmt, A.CreateSequenceStmt):
+            self.node.catalog.create_sequence(sequence_def_from_ast(stmt))
+            return Result("CREATE SEQUENCE")
+        if isinstance(stmt, A.CreateIndexStmt):
+            return Result("CREATE INDEX")   # metadata-only (no index AM yet)
+        if isinstance(stmt, A.InsertStmt):
+            return self._exec_insert(stmt)
+        if isinstance(stmt, A.DeleteStmt):
+            return self._exec_delete(stmt)
+        if isinstance(stmt, A.UpdateStmt):
+            return self._exec_update(stmt)
+        if isinstance(stmt, A.CopyStmt):
+            return self._exec_copy(stmt)
+        if isinstance(stmt, A.TxnStmt):
+            return self._exec_txn(stmt)
+        if isinstance(stmt, A.ExplainStmt):
+            return self._exec_explain(stmt)
+        if isinstance(stmt, A.SetStmt):
+            self.node.gucs[stmt.name] = str(stmt.value)
+            return Result("SET")
+        if isinstance(stmt, A.ShowStmt):
+            v = self.node.gucs.get(stmt.name, "")
+            return Result("SHOW", names=[stmt.name], rows=[(v,)])
+        if isinstance(stmt, A.VacuumStmt):
+            self.node.checkpoint()
+            return Result("VACUUM")
+        if isinstance(stmt, A.BarrierStmt):
+            self.node.checkpoint()
+            return Result("BARRIER")
+        raise ExecError(f"unsupported statement {type(stmt).__name__}")
+
+    # ---- SELECT ----
+    def _plan_select(self, stmt: A.SelectStmt) -> PlannedStmt:
+        binder = Binder(self.node.catalog)
+        bq = binder.bind_select(stmt)
+        return Planner(self.node.catalog).plan(bq)
+
+    def _exec_select(self, stmt: A.SelectStmt) -> Result:
+        planned = self._plan_select(stmt)
+        t, implicit = self._begin_implicit()
+        ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
+                          self.node.cache)
+        batch = Executor(ctx).run(planned)
+        names, rows = materialize(batch, planned.output_names)
+        return Result("SELECT", names=names, rows=rows, rowcount=len(rows))
+
+    # ---- DML ----
+    def _exec_insert(self, stmt: A.InsertStmt) -> Result:
+        td = self.node.catalog.table(stmt.table)
+        st = self.node.stores[stmt.table]
+        cols = stmt.columns or td.column_names
+        if stmt.select is not None:
+            planned = self._plan_select(stmt.select)
+            t0, _ = self._begin_implicit()
+            ctx = ExecContext(self.node.stores, t0.snapshot_ts, t0.txid,
+                              self.node.cache)
+            batch = Executor(ctx).run(planned)
+            _, rows = materialize(batch, planned.output_names)
+        else:
+            rows = []
+            for vr in stmt.values:
+                row = []
+                for v in vr:
+                    if isinstance(v, A.Const):
+                        row.append(v.value)
+                    elif isinstance(v, A.TypedConst) and v.type_name == "date":
+                        row.append(v.value)
+                    elif isinstance(v, A.UnaryOp) and v.op == "-" \
+                            and isinstance(v.arg, A.Const):
+                        row.append(-float(v.arg.value)
+                                   if "." in str(v.arg.value)
+                                   else -int(v.arg.value))
+                    else:
+                        raise ExecError("INSERT values must be literals")
+                rows.append(row)
+        if len(cols) != len(rows[0]):
+            raise ExecError("INSERT column count mismatch")
+        coldata = {c: [r[i] for r in rows] for i, c in enumerate(cols)}
+        missing = [c for c in td.column_names if c not in coldata]
+        if missing:
+            raise ExecError(f"INSERT missing columns {missing} "
+                            "(defaults unsupported)")
+        return Result("INSERT",
+                      rowcount=self._insert_rows(td, st, coldata, len(rows)))
+
+    def _insert_rows(self, td: TableDef, st: TableStore,
+                     coldata: dict, n: int) -> int:
+        t, implicit = self._begin_implicit()
+        enc = {c: st.encode_column(c, vals) for c, vals in coldata.items()}
+        loc = Locator(self.node.catalog)
+        raw_for_route = {c: np.asarray(coldata[c])
+                         for c in td.distribution.dist_cols} \
+            if td.distribution.dist_type == DistType.SHARD else {}
+        sid = loc.shard_ids_for_rows(td, raw_for_route) \
+            if raw_for_route else None
+        self.node._log({"op": "insert", "table": td.name, "n": n,
+                        "txid": t.txid,
+                        "columns": {c: (list(map(str, v))
+                                        if td.column(c).type.kind
+                                        == TypeKind.TEXT else
+                                        np.asarray(enc[c]))
+                                    for c, v in coldata.items()}})
+        spans = st.insert(enc, n, t.txid, shardids=sid)
+        t.insert_spans.append((st, spans))
+        if implicit:
+            self._commit(t)
+        return n
+
+    def _exec_delete(self, stmt: A.DeleteStmt) -> Result:
+        td = self.node.catalog.table(stmt.table)
+        st = self.node.stores[stmt.table]
+        t, implicit = self._begin_implicit()
+        binder = Binder(self.node.catalog)
+        quals = []
+        if stmt.where is not None:
+            sel = A.SelectStmt(items=[A.SelectItem(A.Star())],
+                               from_=[A.TableRef(stmt.table)],
+                               where=stmt.where)
+            bq = binder.bind_select(sel)
+            quals = bq.where
+        from .expr_compile import compile_expr
+        n_deleted = 0
+        try:
+            for ci, ch in st.scan_chunks():
+                vis = st.visible_mask(ch, t.snapshot_ts, t.txid)
+                mask = vis
+                if quals:
+                    cols = {f"{stmt.table}.{c.name}":
+                            ch.columns[c.name][:ch.nrows]
+                            for c in td.columns}
+                    dicts = {f"{stmt.table}.{k}": d
+                             for k, d in st.dicts.items()}
+                    for q in quals:
+                        mask = mask & np.asarray(
+                            compile_expr(q, dicts)(cols))
+                if mask.any():
+                    span = st.mark_delete(ci, mask, t.txid)
+                    t.delete_spans.append((st, span))
+                    self.node._log({"op": "delete", "table": td.name,
+                                    "chunk": ci, "mask": mask,
+                                    "txid": t.txid})
+                    n_deleted += int(mask.sum())
+        except Exception:
+            if implicit:
+                self._abort(t)
+            raise
+        if implicit:
+            self._commit(t)
+        return Result("DELETE", rowcount=n_deleted)
+
+    def _exec_update(self, stmt: A.UpdateStmt) -> Result:
+        # MVCC update = delete + insert of new row versions (the reference
+        # heap does the same at tuple level)
+        td = self.node.catalog.table(stmt.table)
+        sel_items = []
+        assigned = {c: e for c, e in stmt.assignments}
+        for c in td.columns:
+            src = assigned.get(c.name, A.ColRef((c.name,)))
+            sel_items.append(A.SelectItem(src, alias=c.name))
+        sel = A.SelectStmt(items=sel_items, from_=[A.TableRef(stmt.table)],
+                           where=stmt.where)
+        t, implicit = self._begin_implicit()
+        try:
+            planned = self._plan_select(sel)
+            ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
+                              self.node.cache)
+            batch = Executor(ctx).run(planned)
+            names, rows = materialize(batch, planned.output_names)
+            del_res = self._exec_delete(A.DeleteStmt(stmt.table, stmt.where))
+            if rows:
+                coldata = {c: [r[i] for r in rows]
+                           for i, c in enumerate(names)}
+                self._insert_rows(td, self.node.stores[stmt.table],
+                                  coldata, len(rows))
+        except Exception:
+            if implicit and self.txn is None:
+                self._abort(t)
+            raise
+        if implicit:
+            self._commit(t)
+        return Result("UPDATE", rowcount=len(rows))
+
+    # ---- COPY ----
+    def _exec_copy(self, stmt: A.CopyStmt) -> Result:
+        import pandas as pd
+        td = self.node.catalog.table(stmt.table)
+        st = self.node.stores[stmt.table]
+        if stmt.direction != "from":
+            raise ExecError("COPY TO unsupported yet")
+        delim = str(stmt.options.get("delimiter", "|"))
+        cols = stmt.columns or td.column_names
+        df = pd.read_csv(stmt.filename, sep=delim, header=None,
+                         names=cols + ["__trail"], index_col=False,
+                         engine="c")
+        if df["__trail"].isna().all():
+            df = df.drop(columns="__trail")
+        coldata = {c: df[c].tolist() for c in cols}
+        n = len(df)
+        return Result("COPY", rowcount=self._insert_rows(td, st, coldata, n))
+
+    # ---- txn / explain ----
+    def _exec_txn(self, stmt: A.TxnStmt) -> Result:
+        if stmt.op == "begin":
+            if self.txn is None:
+                self.txn = TxnState(self.node.gts.next_txid(),
+                                    self.node.gts.next_gts())
+                self.txn.explicit = True
+            return Result("BEGIN")
+        if stmt.op == "commit":
+            if self.txn is not None:
+                self._commit(self.txn)
+                self.txn = None
+            return Result("COMMIT")
+        if self.txn is not None:
+            self._abort(self.txn)
+            self.txn = None
+        return Result("ROLLBACK")
+
+    def _exec_explain(self, stmt: A.ExplainStmt) -> Result:
+        if not isinstance(stmt.stmt, A.SelectStmt):
+            raise ExecError("EXPLAIN supports SELECT only")
+        planned = self._plan_select(stmt.stmt)
+        text = P.explain(planned.plan)
+        if stmt.analyze:
+            t0 = time.perf_counter()
+            self._exec_select(stmt.stmt)
+            text += f"\nExecution Time: {(time.perf_counter()-t0)*1e3:.2f} ms"
+        return Result("EXPLAIN", names=["QUERY PLAN"],
+                      rows=[(line,) for line in text.split("\n")], text=text)

@@ -1,0 +1,179 @@
+"""Physical plan nodes.
+
+Reference analog: the Plan node tree of include/nodes/plannodes.h (SeqScan,
+HashJoin, Agg, Sort, Limit ...) plus the XC additions RemoteSubplan /
+RemoteQuery (include/pgxc/planner.h).  Differences by design:
+
+- Operators consume/produce whole columnar batches, not tuples.
+- There is no separate Hash node: the join's build side is its right child.
+- Exchange operators (Redistribute/Broadcast/Gather) are the RemoteSubplan
+  analog: they mark fragment boundaries for the distributed executor and map
+  onto XLA collectives (all_to_all / all_gather / device->host).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..catalog.schema import TableDef
+from . import exprs as E
+
+
+@dataclasses.dataclass
+class PhysNode:
+    def children(self) -> list["PhysNode"]:
+        return []
+
+    def title(self) -> str:
+        return type(self).__name__
+
+
+@dataclasses.dataclass
+class SeqScan(PhysNode):
+    """Fused scan+visibility+filter+project over a table's chunks.
+    Reference: ExecSeqScan + ExecQual/ExecProject (execScan.c) — one kernel
+    here."""
+    table: TableDef
+    alias: str
+    filters: list[E.Expr]
+    # output qualified-name -> expr over the table's columns; None = all cols
+    outputs: Optional[list[tuple[str, E.Expr]]] = None
+
+    def title(self):
+        f = f" filter={len(self.filters)}" if self.filters else ""
+        return f"SeqScan {self.table.name} as {self.alias}{f}"
+
+
+@dataclasses.dataclass
+class Filter(PhysNode):
+    child: PhysNode = None
+    quals: list[E.Expr] = dataclasses.field(default_factory=list)
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class Project(PhysNode):
+    child: PhysNode = None
+    outputs: list[tuple[str, E.Expr]] = dataclasses.field(default_factory=list)
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class HashJoin(PhysNode):
+    """Equi-join; right child is the build side.  kind:
+    inner|left|semi|anti.  Multi-key joins hash-combine with a residual
+    equality recheck (reference nodeHashjoin.c keeps hashes + recheck too).
+    Reference: ExecHashJoin (nodeHashjoin.c) over a chained hash table;
+    here sort+searchsorted (ops/kernels.py join_*)."""
+    left: PhysNode = None
+    right: PhysNode = None
+    left_keys: list[E.Expr] = dataclasses.field(default_factory=list)
+    right_keys: list[E.Expr] = dataclasses.field(default_factory=list)
+    kind: str = "inner"
+    residual: list[E.Expr] = dataclasses.field(default_factory=list)
+
+    def children(self):
+        return [self.left, self.right]
+
+    def title(self):
+        return f"HashJoin {self.kind} on {len(self.left_keys)} key(s)"
+
+
+@dataclasses.dataclass
+class Agg(PhysNode):
+    """Grouped aggregation.  mode: 'single' | 'partial' | 'final' —
+    partial/final split mirrors RemoteQuery.rq_finalise_aggs
+    (include/pgxc/planner.h:135)."""
+    child: PhysNode = None
+    group_keys: list[tuple[str, E.Expr]] = dataclasses.field(
+        default_factory=list)
+    aggs: list[tuple[str, E.AggCall]] = dataclasses.field(default_factory=list)
+    mode: str = "single"
+
+    def children(self):
+        return [self.child]
+
+    def title(self):
+        return (f"Agg {self.mode} keys={len(self.group_keys)} "
+                f"aggs={len(self.aggs)}")
+
+
+@dataclasses.dataclass
+class Sort(PhysNode):
+    child: PhysNode = None
+    keys: list[tuple[E.Expr, bool]] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None      # top-k fusion
+
+    def children(self):
+        return [self.child]
+
+    def title(self):
+        lim = f" limit={self.limit}" if self.limit is not None else ""
+        return f"Sort keys={len(self.keys)}{lim}"
+
+
+@dataclasses.dataclass
+class Limit(PhysNode):
+    child: PhysNode = None
+    count: Optional[int] = None
+    offset: int = 0
+
+    def children(self):
+        return [self.child]
+
+
+# ---- exchange operators (fragment boundaries; reference RemoteSubplan) ----
+
+@dataclasses.dataclass
+class Redistribute(PhysNode):
+    """Hash-redistribute rows across datanodes by key — the reference's
+    RemoteSubplan with distributionType=HASH streaming FnPages
+    (execFragment.c FragmentRedistributeData); on TPU one all_to_all."""
+    child: PhysNode = None
+    keys: list[E.Expr] = dataclasses.field(default_factory=list)
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class Broadcast(PhysNode):
+    """Replicate child output to all datanodes (FragmentSendTupleBroadcast
+    analog; all_gather on TPU)."""
+    child: PhysNode = None
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class Gather(PhysNode):
+    """Collect child output on the coordinator (device->host stream)."""
+    child: PhysNode = None
+    sort_keys: list[tuple[E.Expr, bool]] = dataclasses.field(
+        default_factory=list)   # merge-sorted gather (SimpleSort analog)
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class Result(PhysNode):
+    """Constant/empty-input result (SELECT without FROM)."""
+    outputs: list[tuple[str, E.Expr]] = dataclasses.field(default_factory=list)
+
+
+def explain(node: PhysNode, indent: int = 0, out: Optional[list] = None) -> str:
+    top = out is None
+    if out is None:
+        out = []
+    out.append("  " * indent + ("-> " if indent else "") + node.title())
+    for c in node.children():
+        if c is not None:
+            explain(c, indent + 1, out)
+    return "\n".join(out) if top else ""

@@ -1,0 +1,560 @@
+"""Planner: BoundQuery -> physical plan.
+
+Reference analog: src/backend/optimizer (standard_planner path) plus the XC
+distributed planning in src/backend/pgxc/plan/planner.c and
+optimizer/util/pgxcship.c.  This module covers the single-fragment (local)
+plan shape; distribution decisions (FQS vs fragments with exchanges) are
+layered on in plan/distribute.py.
+
+Subquery strategy (the reference's v2.2 headline feature was exactly this
+rewrite family — "subquery -> correlated query rewrite + DN pushdown"):
+- EXISTS / IN (subquery)           -> semi / anti HashJoin
+- uncorrelated scalar subquery     -> init plan (executed once, substituted)
+- correlated scalar aggregate      -> decorrelation: grouped derived table
+                                      joined on the correlation keys
+Join order: greedy connection-aware ordering over the equi-join conjunct
+graph (no cross joins unless forced), left-deep, new table as build side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional
+
+from ..catalog.catalog import Catalog
+from ..catalog import types as T
+from ..catalog.types import TypeKind
+from . import exprs as E
+from . import physical as P
+from .query import BoundQuery, JoinStep, RTE, SubLink
+
+
+class PlanError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class InitPlan:
+    name: str
+    plan: P.PhysNode
+    type: T.SqlType
+
+
+@dataclasses.dataclass
+class PlannedStmt:
+    plan: P.PhysNode
+    init_plans: list[InitPlan]
+    output_names: list[str]
+
+
+# ---------------------------------------------------------------------------
+# expression utilities
+# ---------------------------------------------------------------------------
+
+def expr_cols(e: E.Expr) -> set[str]:
+    out = set()
+    for x in E.walk(e):
+        if isinstance(x, E.Col):
+            out.add(x.name)
+    return out
+
+
+def rewrite(e: E.Expr, fn) -> E.Expr:
+    """Bottom-up rewrite; fn(node) returns replacement or None."""
+    def rec(x: E.Expr) -> E.Expr:
+        r = fn(x)
+        if r is not None:
+            return r
+        if isinstance(x, E.Arith):
+            return E.Arith(x.op, rec(x.left), rec(x.right))
+        if isinstance(x, E.Neg):
+            return E.Neg(rec(x.arg))
+        if isinstance(x, E.Cmp):
+            return E.Cmp(x.op, rec(x.left), rec(x.right))
+        if isinstance(x, E.BoolOp):
+            return E.BoolOp(x.op, tuple(rec(a) for a in x.args))
+        if isinstance(x, E.Not):
+            return E.Not(rec(x.arg))
+        if isinstance(x, E.Case):
+            return E.Case(tuple((rec(c), rec(v)) for c, v in x.whens),
+                          rec(x.else_) if x.else_ is not None else None,
+                          x.case_type)
+        if isinstance(x, E.InList):
+            return E.InList(rec(x.arg), x.values)
+        if isinstance(x, E.Extract):
+            return E.Extract(x.field, rec(x.arg))
+        if isinstance(x, E.Cast):
+            return E.Cast(rec(x.arg), x.to)
+        if isinstance(x, E.AggCall):
+            return E.AggCall(x.func, rec(x.arg) if x.arg is not None
+                             else None, x.distinct)
+        return x
+    return rec(e)
+
+
+def _hoist_or_common(q: E.Expr) -> list[E.Expr]:
+    """(a AND x AND ...) OR (a AND y AND ...) -> [a, (x... OR y...)]."""
+    if not (isinstance(q, E.BoolOp) and q.op == "or" and len(q.args) > 1):
+        return [q]
+    from ..sql.analyze import split_conjuncts
+    branch_sets = [split_conjuncts(a) for a in q.args]
+    common = [c for c in branch_sets[0]
+              if all(any(c == d for d in bs) for bs in branch_sets[1:])]
+    if not common:
+        return [q]
+    rest_branches = []
+    for bs in branch_sets:
+        rest = [d for d in bs if not any(d == c for c in common)]
+        if not rest:
+            return common  # one branch fully covered: OR is implied true
+        rest_branches.append(rest[0] if len(rest) == 1
+                             else E.BoolOp("and", tuple(rest)))
+    return common + [E.BoolOp("or", tuple(rest_branches))]
+
+
+def _is_equi_pair(e: E.Expr):
+    """conjunct of form Col = Col -> (left_col, right_col) exprs."""
+    if isinstance(e, E.Cmp) and e.op == "=" \
+            and isinstance(e.left, E.Col) and isinstance(e.right, E.Col):
+        return e.left, e.right
+    return None
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+class Planner:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+        self._ip_counter = itertools.count()
+
+    # -- public ------------------------------------------------------------
+    def plan(self, bq: BoundQuery) -> PlannedStmt:
+        init_plans: list[InitPlan] = []
+        plan = self._plan_query(bq, init_plans)
+        return PlannedStmt(plan, init_plans, [n for n, _ in bq.targets])
+
+    # -- query planning ----------------------------------------------------
+    def _plan_query(self, bq: BoundQuery,
+                    init_plans: list[InitPlan]) -> P.PhysNode:
+        bq = self._rewrite_sublinks(bq, init_plans)
+
+        # classify conjuncts
+        rte_cols = {}
+        for rte in bq.rtable:
+            rte_cols[rte.alias] = {q for q, _ in rte.columns.values()}
+        semijoins = getattr(bq, "_semijoins", [])
+
+        scan_filters: dict[str, list[E.Expr]] = {r.alias: [] for r in bq.rtable}
+        join_edges: list[tuple[str, str, E.Expr, E.Expr]] = []
+        residual: list[E.Expr] = []
+
+        def owner_of(cols: set[str]) -> Optional[str]:
+            owners = {a for a, cs in rte_cols.items() if cols & cs}
+            if len(owners) == 1:
+                return owners.pop()
+            return None
+
+        # factor conjuncts common to every OR branch (TPC-H Q19: the join
+        # key equality lives inside each bracket) — the reference optimizer
+        # does the same via extract_restriction_or_clauses
+        where = []
+        for q in bq.where:
+            where.extend(_hoist_or_common(q))
+
+        for q in where:
+            cols = expr_cols(q)
+            own = owner_of(cols)
+            if own is not None:
+                scan_filters[own].append(q)
+                continue
+            pair = _is_equi_pair(q)
+            if pair is not None:
+                lo = owner_of({pair[0].name})
+                ro = owner_of({pair[1].name})
+                if lo and ro and lo != ro:
+                    join_edges.append((lo, ro, pair[0], pair[1]))
+                    continue
+            residual.append(q)
+
+        # build scans
+        scans: dict[str, P.PhysNode] = {}
+        for rte in bq.rtable:
+            scans[rte.alias] = self._plan_rte(rte, scan_filters[rte.alias],
+                                              init_plans)
+
+        plan, avail = self._join_tables(bq, scans, rte_cols, join_edges,
+                                        residual, semijoins, init_plans)
+
+        # leftover residual quals
+        still = [q for q in residual if not expr_cols(q) <= avail]
+        if still:
+            raise PlanError(f"unplaceable predicates: {still}")
+
+        # aggregation / projection
+        plan, out_names = self._plan_agg_project(bq, plan)
+        return plan
+
+    # -- RTE scan ----------------------------------------------------------
+    def _plan_rte(self, rte: RTE, filters, init_plans) -> P.PhysNode:
+        if rte.kind == "table":
+            # scan emits qualified names
+            outputs = [(q, E.Col(q, t)) for _, (q, t) in rte.columns.items()]
+            return P.SeqScan(rte.table, rte.alias, filters, outputs)
+        sub = self._plan_query(rte.subquery, init_plans)
+        return _RenameHelper.wrap(sub, rte, filters)
+
+    # -- join ordering -----------------------------------------------------
+    def _join_tables(self, bq, scans, rte_cols, join_edges, residual,
+                     semijoins, init_plans):
+        order = [s.rte_index for s in bq.join_order]
+        aliases = [bq.rtable[i].alias for i in order]
+        outer_steps = {bq.rtable[s.rte_index].alias: s
+                       for s in bq.join_order if s.kind == "left"}
+
+        joined: list[str] = []
+        plan: Optional[P.PhysNode] = None
+        avail: set[str] = set()
+        remaining = list(aliases)
+
+        def edges_between(cand: str):
+            out = []
+            for lo, ro, le, re_ in join_edges:
+                if ro == cand and lo in joined:
+                    out.append((le, re_))
+                elif lo == cand and ro in joined:
+                    out.append((re_, le))
+            return out
+
+        while remaining:
+            # pick next connected table (FROM order preference)
+            cand = None
+            for a in remaining:
+                if plan is None or edges_between(a) or a in outer_steps:
+                    cand = a
+                    break
+            if cand is None:
+                cand = remaining[0]      # forced cross join
+            remaining.remove(cand)
+            right = scans[cand]
+            if plan is None:
+                plan = right
+            else:
+                step = outer_steps.get(cand)
+                if step is not None:
+                    lk, rk, res = self._outer_keys(step.on, avail,
+                                                   rte_cols[cand])
+                    plan = P.HashJoin(plan, right, lk, rk, "left", res)
+                else:
+                    edges = edges_between(cand)
+                    if edges:
+                        lk = [le for le, _ in edges]
+                        rk = [re_ for _, re_ in edges]
+                        plan = P.HashJoin(plan, right, lk, rk, "inner", [])
+                    else:
+                        plan = P.HashJoin(plan, right, [], [], "cross", [])
+            joined.append(cand)
+            avail |= rte_cols[cand]
+            # attach residual quals that just became evaluable
+            now = [q for q in residual if expr_cols(q) <= avail]
+            for q in now:
+                residual.remove(q)
+                plan = P.Filter(plan, [q])
+            # attach semi/anti joins whose outer cols are now available
+            for sj in list(semijoins):
+                if sj["outer_cols"] <= avail:
+                    semijoins.remove(sj)
+                    plan = P.HashJoin(plan, sj["plan"], sj["outer_keys"],
+                                      sj["inner_keys"], sj["kind"],
+                                      sj["residual"])
+        if plan is None:
+            plan = P.Result(outputs=[])
+        return plan, avail
+
+    def _outer_keys(self, on: E.Expr, avail: set[str], right_cols: set[str]):
+        from ..sql.analyze import split_conjuncts
+        lk, rk, res = [], [], []
+        for q in split_conjuncts(on):
+            pair = _is_equi_pair(q)
+            if pair is not None:
+                a, b = pair
+                if a.name in avail and b.name in right_cols:
+                    lk.append(a)
+                    rk.append(b)
+                    continue
+                if b.name in avail and a.name in right_cols:
+                    lk.append(b)
+                    rk.append(a)
+                    continue
+            res.append(q)
+        if not lk:
+            raise PlanError("outer join requires at least one equi-key")
+        return lk, rk, res
+
+    # -- sublink rewrites --------------------------------------------------
+    def _rewrite_sublinks(self, bq: BoundQuery,
+                          init_plans: list[InitPlan]) -> BoundQuery:
+        semijoins = []
+        new_where = []
+
+        def scalar_replacement(sl: SubLink) -> E.Expr:
+            if sl.query.correlated_cols:
+                return self._decorrelate_scalar(sl, bq, init_plans)
+            name = f"__initplan{next(self._ip_counter)}"
+            sub = self._plan_query(sl.query, init_plans)
+            t = sl.query.targets[0][1].type
+            init_plans.append(InitPlan(name, sub, t))
+            return E.Col(name, t)
+
+        def rewrite_scalars(e: E.Expr) -> E.Expr:
+            return rewrite(e, lambda x: scalar_replacement(x)
+                           if isinstance(x, SubLink)
+                           and x.link_kind == "scalar" else None)
+
+        for q in bq.where:
+            if isinstance(q, SubLink) and q.link_kind in ("exists", "in"):
+                semijoins.append(self._sublink_to_semijoin(q, init_plans))
+                continue
+            if isinstance(q, E.Not) and isinstance(q.arg, SubLink) \
+                    and q.arg.link_kind in ("exists", "in"):
+                sl = SubLink(q.arg.link_kind, q.arg.query, q.arg.test_expr,
+                             q.arg.cmp_op, not q.arg.negated)
+                semijoins.append(self._sublink_to_semijoin(sl, init_plans))
+                continue
+            new_where.append(rewrite_scalars(q))
+
+        bq = dataclasses.replace(bq, where=new_where)
+        bq.targets = [(n, rewrite_scalars(e)) for n, e in bq.targets]
+        bq.having = [rewrite_scalars(e) for e in bq.having]
+        bq._semijoins = semijoins
+        return bq
+
+    def _sublink_to_semijoin(self, sl: SubLink, init_plans) -> dict:
+        sub = sl.query
+        kind = "anti" if sl.negated else "semi"
+        outer_keys: list[E.Expr] = []
+        inner_keys: list[E.Expr] = []
+        residual: list[E.Expr] = []
+
+        if sl.link_kind == "in":
+            if sub.correlated_cols:
+                raise PlanError("correlated IN subquery unsupported")
+            if len(sub.targets) != 1:
+                raise PlanError("IN subquery must return one column")
+            tname, texpr = sub.targets[0]
+            outer_keys.append(sl.test_expr)
+            inner_keys.append(E.Col(f"__sub.{tname}", texpr.type))
+            inner_plan = self._plan_query(sub, init_plans)
+            inner_plan = _rename_outputs(inner_plan, sub, "__sub")
+        else:  # exists
+            corr = set(sub.correlated_cols)
+            if not corr:
+                raise PlanError("uncorrelated EXISTS unsupported (use limit)")
+            inner_where = []
+            for q in sub.where:
+                pair = _is_equi_pair(q)
+                if pair is not None:
+                    a, b = pair
+                    if a.name in corr and b.name not in corr:
+                        outer_keys.append(a)
+                        inner_keys.append(b)
+                        continue
+                    if b.name in corr and a.name not in corr:
+                        outer_keys.append(b)
+                        inner_keys.append(a)
+                        continue
+                cols = expr_cols(q)
+                if cols & corr:
+                    residual.append(q)   # evaluated over joined pairs
+                    continue
+                inner_where.append(q)
+            if not outer_keys:
+                raise PlanError("EXISTS without equality correlation "
+                                "unsupported")
+            sub2 = dataclasses.replace(sub, where=inner_where,
+                                       targets=self._exists_targets(
+                                           sub, inner_keys, residual))
+            inner_plan = self._plan_query(sub2, init_plans)
+
+        return {"kind": kind, "plan": inner_plan,
+                "outer_keys": outer_keys, "inner_keys": inner_keys,
+                "residual": residual,
+                "outer_cols": set().union(*(expr_cols(k)
+                                            for k in outer_keys))}
+
+    def _exists_targets(self, sub: BoundQuery, inner_keys, residual):
+        """EXISTS subquery: project the join keys + any inner columns the
+        residual quals need."""
+        needed = {}
+        for k in inner_keys:
+            for c in expr_cols(k):
+                needed[c] = k.type if isinstance(k, E.Col) else T.INT64
+        for q in residual:
+            for x in E.walk(q):
+                if isinstance(x, E.Col):
+                    needed.setdefault(x.name, x.col_type)
+        corr = set(sub.correlated_cols)
+        return [(qname, E.Col(qname, t)) for qname, t in needed.items()
+                if qname not in corr]
+
+    def _decorrelate_scalar(self, sl: SubLink, outer_bq: BoundQuery,
+                            init_plans) -> E.Expr:
+        """Correlated scalar aggregate -> grouped derived table + join.
+
+        select ... where expr OP (select AGG(x) from T where T.k = outer.k
+        and quals)  becomes  derived = select T.k, AGG(x) from T where quals
+        group by T.k, joined on derived.k = outer.k; OP compares against
+        the agg column.  (The reference implements this family of rewrites
+        in its optimizer; v2.2 release note lines 3-4.)
+        """
+        sub = sl.query
+        corr = set(sub.correlated_cols)
+        inner_where, outer_keys, inner_keys = [], [], []
+        for q in sub.where:
+            pair = _is_equi_pair(q)
+            if pair is not None:
+                a, b = pair
+                if a.name in corr and b.name not in corr:
+                    outer_keys.append(a)
+                    inner_keys.append(b)
+                    continue
+                if b.name in corr and a.name not in corr:
+                    outer_keys.append(b)
+                    inner_keys.append(a)
+                    continue
+            if expr_cols(q) & corr:
+                raise PlanError("non-equality correlation in scalar "
+                                "subquery unsupported")
+            inner_where.append(q)
+        if not outer_keys:
+            raise PlanError("correlated scalar subquery without equality "
+                            "correlation")
+        val_name, val_expr = sub.targets[0]
+        targets = [("__val", val_expr)] + \
+            [(f"__k{i}", k) for i, k in enumerate(inner_keys)]
+        derived = dataclasses.replace(
+            sub, where=inner_where, targets=targets,
+            group_by=list(inner_keys), having=[], order_by=[],
+            limit=None, offset=None, correlated_cols=[])
+        alias = f"__dsq{next(self._ip_counter)}"
+        rte = RTE(alias, "subquery", subquery=derived,
+                  columns={"__val": (f"{alias}.__val", val_expr.type),
+                           **{f"__k{i}": (f"{alias}.__k{i}", k.type)
+                              for i, k in enumerate(inner_keys)}})
+        outer_bq.rtable.append(rte)
+        outer_bq.join_order.append(JoinStep(len(outer_bq.rtable) - 1,
+                                            "inner"))
+        for i, ok in enumerate(outer_keys):
+            outer_bq.where.append(E.Cmp("=", ok,
+                                        E.Col(f"{alias}.__k{i}",
+                                              inner_keys[i].type)))
+        return E.Col(f"{alias}.__val", val_expr.type)
+
+    # -- aggregation & projection ------------------------------------------
+    def _plan_agg_project(self, bq: BoundQuery, plan: P.PhysNode):
+        targets = bq.targets
+        out_names = [n for n, _ in targets]
+
+        if bq.has_aggs:
+            plan, repl = self._plan_aggregate(bq, plan)
+            proj = [(n, rewrite(e, repl)) for n, e in targets]
+            having = [rewrite(h, repl) for h in bq.having]
+            if having:
+                plan = P.Filter(plan, having)
+            order = [(rewrite(o, repl), d) for o, d in bq.order_by]
+        else:
+            proj = list(targets)
+            order = list(bq.order_by)
+
+        proj_node = P.Project(plan, proj)
+        plan = proj_node
+
+        if bq.distinct:
+            plan = P.Agg(plan, [(n, E.Col(n, e.type)) for n, e in proj], [],
+                         "single")
+
+        if order:
+            # sort keys over projected outputs; add hidden columns if needed
+            keys = []
+            extra = []
+            for oe, desc in order:
+                hit = None
+                for n, e in proj:
+                    if e == oe:
+                        hit = (E.Col(n, e.type), desc)
+                        break
+                if hit is None:
+                    hname = f"__sort{len(extra)}"
+                    extra.append((hname, oe))
+                    hit = (E.Col(hname, oe.type), desc)
+                keys.append(hit)
+            if extra:
+                if bq.distinct:
+                    raise PlanError("ORDER BY expression not in DISTINCT "
+                                    "select list")
+                proj_node.outputs = proj + extra
+            plan = P.Sort(plan, keys,
+                          limit=(bq.limit + (bq.offset or 0))
+                          if bq.limit is not None else None)
+        if bq.limit is not None or bq.offset:
+            plan = P.Limit(plan, bq.limit, bq.offset or 0)
+        return plan, out_names
+
+    def _plan_aggregate(self, bq: BoundQuery, plan: P.PhysNode):
+        group_keys = [(f"__gk{i}", g) for i, g in enumerate(bq.group_by)]
+        aggs: list[tuple[str, E.AggCall]] = []
+        # dedupe structurally: the same aggregate referenced from targets
+        # and ORDER BY/HAVING may be distinct (but equal) objects
+        agg_names: list[tuple[E.AggCall, str]] = []
+
+        def find(x):
+            for a, nm in agg_names:
+                if a == x:
+                    return nm
+            return None
+
+        def collect(e: E.Expr):
+            for x in E.walk(e):
+                if isinstance(x, E.AggCall) and find(x) is None:
+                    name = f"__agg{len(aggs)}"
+                    aggs.append((name, x))
+                    agg_names.append((x, name))
+
+        for _, e in bq.targets:
+            collect(e)
+        for h in bq.having:
+            collect(h)
+        for o, _ in bq.order_by:
+            collect(o)
+
+        plan = P.Agg(plan, group_keys, aggs, "single")
+
+        def repl(x: E.Expr):
+            if isinstance(x, E.AggCall):
+                return E.Col(find(x), x.type)
+            for name, g in group_keys:
+                if x == g:
+                    return E.Col(name, g.type)
+            return None
+        return plan, repl
+
+
+class _RenameHelper:
+    """Wrap a subquery plan so its outputs carry alias-qualified names."""
+    @staticmethod
+    def wrap(sub_plan: P.PhysNode, rte: RTE, filters) -> P.PhysNode:
+        outs = []
+        for plain, (qname, t) in rte.columns.items():
+            outs.append((qname, E.Col(plain, t)))
+        p = P.Project(sub_plan, outs)
+        if filters:
+            return P.Filter(p, filters)
+        return p
+
+
+def _rename_outputs(plan: P.PhysNode, sub: BoundQuery,
+                    alias: str) -> P.PhysNode:
+    outs = [(f"{alias}.{n}", E.Col(n, e.type)) for n, e in sub.targets]
+    return P.Project(plan, outs)

@@ -40,6 +40,14 @@ class WriteConflict(Exception):
     """Concurrent write-write conflict (first-deleter-wins)."""
 
 
+import itertools as _itertools
+
+# process-global version source: values never repeat across stores, so a
+# device-cache entry keyed by a recycled id(store) can never alias a new
+# store's version
+_VERSION_COUNTER = _itertools.count(1)
+
+
 class StringDict:
     """Append-only code<->string dictionary for one TEXT column."""
 
@@ -105,6 +113,7 @@ class TableStore:
     def __init__(self, td: TableDef):
         self.td = td
         self.chunks: list[Chunk] = []
+        self.version = next(_VERSION_COUNTER)  # bumped on any mutation
         self.dicts: dict[str, StringDict] = {
             c.name: StringDict() for c in td.columns
             if c.type.kind == TypeKind.TEXT}
@@ -142,6 +151,9 @@ class TableStore:
         spans for the transaction's backfill list.  If commit_ts is given the
         rows are born committed (bulk load fast path, like the reference's
         COPY FREEZE)."""
+        if nrows == 0:
+            return []
+        self.version = next(_VERSION_COUNTER)
         spans = []
         done = 0
         born_ts = INF_TS if commit_ts is None else np.int64(commit_ts)
@@ -181,6 +193,7 @@ class TableStore:
                 f"row already deleted by in-progress txn "
                 f"{int(other[conflict][0])}")
         ch.xmax_txid[idx] = txid
+        self.version = next(_VERSION_COUNTER)
         return (chunk_idx, idx)
 
     # -- commit/abort backfill (the CSN-log analog: we resolve commit
@@ -188,18 +201,22 @@ class TableStore:
     #    defers via csnlog.c + tqual.c hint-bit stamping).  All backfills
     #    are span-driven: commit cost is O(rows touched), not O(table). --
     def backfill_insert(self, spans, ts: np.int64):
+        self.version = next(_VERSION_COUNTER)
         for ci, lo, hi in spans:
             self.chunks[ci].xmin_ts[lo:hi] = ts
 
     def abort_insert(self, spans):
+        self.version = next(_VERSION_COUNTER)
         for ci, lo, hi in spans:
             self.chunks[ci].xmin_ts[lo:hi] = ABORTED_TS
 
     def backfill_delete(self, spans, ts: np.int64):
+        self.version = next(_VERSION_COUNTER)
         for ci, idx in spans:
             self.chunks[ci].xmax_ts[idx] = ts
 
     def revert_delete(self, spans):
+        self.version = next(_VERSION_COUNTER)
         for ci, idx in spans:
             self.chunks[ci].xmax_txid[idx] = NO_TXID
 

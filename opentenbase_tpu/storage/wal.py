@@ -123,6 +123,8 @@ def restore_store(store, path: str):
         for v in values:
             d.encode_one(v)
         store.dicts[name] = d
+    from .store import _VERSION_COUNTER
+    store.version = next(_VERSION_COUNTER)
 
 
 def _grow(arr: np.ndarray) -> np.ndarray:

@@ -9,10 +9,26 @@ N datanode chips).  These env vars must be set before jax is imported.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment pre-sets JAX_PLATFORMS=axon
+# (real TPU via tunnel); tests must be hermetic on the CPU backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon (TPU-tunnel) PJRT plugin registers itself in every interpreter
+# via sitecustomize and is initialized by backends() even under
+# JAX_PLATFORMS=cpu; if the tunnel is down this blocks forever.  Tests
+# never want the real chip: unregister the factory before first use.
+import jax
+from jax._src import xla_bridge as _xb
+
+# Fail loudly if the private API moves — silently keeping the axon factory
+# registered restores the indefinite hang this block exists to prevent.
+_xb._backend_factories.pop("axon", None)
+# jax may have been imported (by a pytest plugin) before this file ran,
+# in which case it captured JAX_PLATFORMS=axon at import time.
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

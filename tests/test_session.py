@@ -1,0 +1,127 @@
+"""Session/engine behavior: DML, transactions, recovery, conflicts.
+The analog of the reference's isolation + recovery test tiers
+(SURVEY.md §4.4)."""
+
+import pytest
+
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.storage.store import WriteConflict
+
+
+@pytest.fixture()
+def sess(tmp_path):
+    node = LocalNode(datadir=str(tmp_path / "data"))
+    s = Session(node)
+    s.execute("create table emp (id bigint primary key, name varchar(20), "
+              "sal decimal(10,2), hired date) distribute by shard(id)")
+    s.execute("insert into emp values "
+              "(1, 'ada', 100.50, date '2020-01-05'),"
+              "(2, 'bob', 90.25, date '2021-07-01'),"
+              "(3, 'eve', 120, date '2019-03-11')")
+    return s
+
+
+class TestDml:
+    def test_select_filter_order(self, sess):
+        assert sess.query("select name, sal from emp where sal > 95 "
+                          "order by sal desc") == \
+            [("eve", 120.0), ("ada", 100.5)]
+
+    def test_update(self, sess):
+        sess.execute("update emp set sal = sal * 2 where name = 'bob'")
+        assert sess.query("select sal from emp where id = 2") == [(180.5,)]
+
+    def test_delete(self, sess):
+        r = sess.execute("delete from emp where sal < 100")[0]
+        assert r.rowcount == 1
+        assert sess.query("select count(*) from emp") == [(2,)]
+
+    def test_insert_select(self, sess):
+        sess.execute("create table emp2 (id bigint, name varchar(20), "
+                     "sal decimal(10,2), hired date)")
+        sess.execute("insert into emp2 select * from emp")
+        assert sess.query("select count(*) from emp2") == [(3,)]
+
+
+class TestTxn:
+    def test_rollback(self, sess):
+        sess.execute("begin")
+        sess.execute("insert into emp values (9, 'zed', 1, "
+                     "date '2024-01-01')")
+        assert sess.query("select count(*) from emp") == [(4,)]
+        sess.execute("rollback")
+        assert sess.query("select count(*) from emp") == [(3,)]
+
+    def test_isolation_between_sessions(self, sess):
+        other = Session(sess.node)
+        sess.execute("begin")
+        sess.execute("insert into emp values (7, 'gil', 2, "
+                     "date '2024-01-01')")
+        assert other.query("select count(*) from emp") == [(3,)]
+        sess.execute("commit")
+        assert other.query("select count(*) from emp") == [(4,)]
+
+    def test_write_write_conflict(self, sess):
+        other = Session(sess.node)
+        sess.execute("begin")
+        sess.execute("delete from emp where id = 1")
+        with pytest.raises(WriteConflict):
+            other.execute("delete from emp where id = 1")
+        sess.execute("rollback")
+        # lock released: other session may now delete
+        assert other.execute("delete from emp where id = 1")[0].rowcount == 1
+
+
+class TestRecovery:
+    def test_wal_replay(self, sess, tmp_path):
+        node2 = LocalNode(datadir=str(tmp_path / "data"))
+        s2 = Session(node2)
+        assert s2.query("select id, name from emp order by id") == \
+            [(1, "ada"), (2, "bob"), (3, "eve")]
+
+    def test_checkpoint_then_recover(self, sess, tmp_path):
+        sess.node.checkpoint()
+        sess.execute("insert into emp values (4, 'dan', 10, "
+                     "date '2023-01-01')")
+        node2 = LocalNode(datadir=str(tmp_path / "data"))
+        s2 = Session(node2)
+        # checkpointed rows AND the post-checkpoint WAL tail
+        assert s2.query("select count(*) from emp") == [(4,)]
+        # clock advanced past recovered commit timestamps
+        s2.execute("insert into emp values (5, 'fay', 11, "
+                   "date '2023-01-01')")
+        assert s2.query("select count(*) from emp") == [(5,)]
+
+    def test_aborted_txn_not_recovered(self, sess, tmp_path):
+        sess.execute("begin")
+        sess.execute("insert into emp values (9, 'zed', 1, "
+                     "date '2024-01-01')")
+        sess.execute("rollback")
+        s2 = Session(LocalNode(datadir=str(tmp_path / "data")))
+        assert s2.query("select count(*) from emp") == [(3,)]
+
+    def test_uncommitted_tail_not_recovered(self, sess, tmp_path):
+        # txn left open (simulated crash before commit record)
+        sess.execute("begin")
+        sess.execute("insert into emp values (9, 'zed', 1, "
+                     "date '2024-01-01')")
+        sess.node.wal.flush(fsync=True)
+        s2 = Session(LocalNode(datadir=str(tmp_path / "data")))
+        assert s2.query("select count(*) from emp") == [(3,)]
+
+
+class TestUtility:
+    def test_explain(self, sess):
+        r = sess.execute("explain select count(*) from emp")[0]
+        assert "SeqScan" in r.text and "Agg" in r.text
+
+    def test_set_show(self, sess):
+        sess.execute("set enable_fast_query_shipping = off")
+        assert sess.query("show enable_fast_query_shipping") == [("off",)]
+
+    def test_copy_roundtrip(self, sess, tmp_path):
+        p = tmp_path / "x.tbl"
+        p.write_text("10|joe|55.5|2022-02-02|\n11|kim|66.6|2022-03-03|\n")
+        r = sess.execute(f"copy emp from '{p}' with (delimiter '|')")[0]
+        assert r.rowcount == 2
+        assert sess.query("select name from emp where id = 11") == [("kim",)]

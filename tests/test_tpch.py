@@ -1,0 +1,183 @@
+"""End-to-end TPC-H correctness: all 22 queries vs pandas oracles on the
+same generated data (SF 0.01, single node).  The analog of the reference's
+pg_regress golden-SQL suite (SURVEY.md §4.1)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tpch_oracle as O
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.tpch import datagen
+from opentenbase_tpu.tpch.queries import Q
+from opentenbase_tpu.tpch.schema import SCHEMA
+
+
+@pytest.fixture(scope="module")
+def env():
+    node = LocalNode()
+    s = Session(node)
+    s.execute(SCHEMA)
+    data = datagen.generate(sf=0.01)
+    datagen.load_into(s, data)
+    dfs = datagen.as_dataframes(data)
+    return s, dfs
+
+
+def _iso(days):
+    return str(np.datetime64("1970-01-01", "D")
+               + np.timedelta64(int(days), "D"))
+
+
+def rows_close(got, want, float_tol=1e-2):
+    assert len(got) == len(want), f"{len(got)} rows != {len(want)}"
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert len(g) == len(w), f"row {i}: arity {len(g)} != {len(w)}"
+        for a, b in zip(g, w):
+            if isinstance(b, float) or isinstance(a, float):
+                assert a == pytest.approx(b, abs=float_tol, rel=1e-6), \
+                    f"row {i}: {a} != {b} (got={g}, want={w})"
+            else:
+                assert a == b, f"row {i}: {a!r} != {b!r}"
+
+
+class TestTpch:
+    def test_q1(self, env):
+        s, dfs = env
+        got = s.query(Q[1])
+        o = O.q1(dfs)
+        want = [(r.l_returnflag, r.l_linestatus, r.sum_qty,
+                 r.sum_base_price, r.sum_disc_price, r.sum_charge,
+                 r.avg_qty, r.avg_price, r.avg_disc, r.count_order)
+                for r in o.itertuples()]
+        rows_close(got, want)
+
+    def test_q2(self, env):
+        s, dfs = env
+        got = [r[:4] for r in s.query(Q[2])]
+        o, _ = O.q2(dfs), None
+        want = [(r.s_acctbal, r.s_name, r.n_name, r.p_partkey)
+                for r in O.q2(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q3(self, env):
+        s, dfs = env
+        got = s.query(Q[3])
+        want = [(r.l_orderkey, r.rev, _iso(r.o_orderdate), r.o_shippriority)
+                for r in O.q3(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q4(self, env):
+        s, dfs = env
+        got = s.query(Q[4])
+        want = [(r.o_orderpriority, r.n) for r in O.q4(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q5(self, env):
+        s, dfs = env
+        got = s.query(Q[5])
+        want = [(r.n_name, r.rev) for r in O.q5(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q6(self, env):
+        s, dfs = env
+        assert s.query(Q[6])[0][0] == pytest.approx(O.q6(dfs), abs=1e-2)
+
+    def test_q7(self, env):
+        s, dfs = env
+        got = s.query(Q[7])
+        want = [(r.s_n_n_name, r.c_n_n_name, r.l_year, r.vol)
+                for r in O.q7(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q8(self, env):
+        s, dfs = env
+        got = s.query(Q[8])
+        want = [(r.o_year, r.share) for r in O.q8(dfs).itertuples()]
+        rows_close(got, want, float_tol=1e-6)
+
+    def test_q9(self, env):
+        s, dfs = env
+        got = s.query(Q[9])
+        want = [(r.n_name, r.o_year, r.amount)
+                for r in O.q9(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q10(self, env):
+        s, dfs = env
+        got = [(r[0], r[1], round(r[2], 2)) for r in s.query(Q[10])]
+        want = [(r.c_custkey, r.c_name, round(r.rev, 2))
+                for r in O.q10(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q11(self, env):
+        s, dfs = env
+        got = s.query(Q[11])
+        want = [(r.ps_partkey, r.v) for r in O.q11(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q12(self, env):
+        s, dfs = env
+        got = s.query(Q[12])
+        want = [(r.l_shipmode, r.high, r.low)
+                for r in O.q12(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q13(self, env):
+        s, dfs = env
+        got = s.query(Q[13])
+        want = [(r.c_count, r.custdist) for r in O.q13(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q14(self, env):
+        s, dfs = env
+        assert s.query(Q[14])[0][0] == pytest.approx(O.q14(dfs), rel=1e-9)
+
+    def test_q15(self, env):
+        s, dfs = env
+        got = s.query(Q[15])
+        want_df, mx = O.q15(dfs)
+        assert len(got) == len(want_df)
+        assert got[0][0] == want_df.iloc[0].s_suppkey
+        assert got[0][4] == pytest.approx(mx, abs=1e-2)
+
+    def test_q16(self, env):
+        s, dfs = env
+        got = s.query(Q[16])
+        want = [(r.p_brand, r.p_type, r.p_size, r.supplier_cnt)
+                for r in O.q16(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q17(self, env):
+        s, dfs = env
+        assert s.query(Q[17])[0][0] == pytest.approx(O.q17(dfs), rel=1e-9)
+
+    def test_q18(self, env):
+        s, dfs = env
+        got = s.query(Q[18])
+        want = [(r.c_name, r.c_custkey, r.o_orderkey, _iso(r.o_orderdate),
+                 r.o_totalprice, r.l_quantity)
+                for r in O.q18(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q19(self, env):
+        s, dfs = env
+        assert s.query(Q[19])[0][0] == pytest.approx(O.q19(dfs), abs=1e-2)
+
+    def test_q20(self, env):
+        s, dfs = env
+        got = [r[0] for r in s.query(Q[20])]
+        want = [r.s_name for r in O.q20(dfs).itertuples()]
+        assert got == want
+
+    def test_q21(self, env):
+        s, dfs = env
+        got = s.query(Q[21])
+        want = [(r.s_name, r.numwait) for r in O.q21(dfs).itertuples()]
+        rows_close(got, want)
+
+    def test_q22(self, env):
+        s, dfs = env
+        got = s.query(Q[22])
+        want = [(r.cn, r.numcust, r.tot) for r in O.q22(dfs).itertuples()]
+        rows_close(got, want)

@@ -1,0 +1,350 @@
+"""pandas oracle implementations of TPC-H queries (validation-parameter
+versions from opentenbase_tpu/tpch/queries.py) over datagen dataframes.
+Dates are int days since epoch."""
+
+import numpy as np
+import pandas as pd
+
+
+def _d(iso):
+    return int((np.datetime64(iso, "D") - np.datetime64("1970-01-01", "D"))
+               .astype(np.int64))
+
+
+def q1(t):
+    li = t["lineitem"]
+    df = li[li.l_shipdate <= _d("1998-09-02")].copy()
+    df["disc_price"] = df.l_extendedprice * (1 - df.l_discount)
+    df["charge"] = df.disc_price * (1 + df.l_tax)
+    g = df.groupby(["l_returnflag", "l_linestatus"]).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "count"),
+    ).reset_index().sort_values(["l_returnflag", "l_linestatus"])
+    return g
+
+
+def q3(t):
+    c, o, li = t["customer"], t["orders"], t["lineitem"]
+    df = c[c.c_mktsegment == "BUILDING"].merge(
+        o, left_on="c_custkey", right_on="o_custkey")
+    df = df[df.o_orderdate < _d("1995-03-15")]
+    df = df.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    df = df[df.l_shipdate > _d("1995-03-15")]
+    df["rev"] = df.l_extendedprice * (1 - df.l_discount)
+    g = df.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])["rev"] \
+        .sum().reset_index()
+    g = g.sort_values(["rev", "o_orderdate"],
+                      ascending=[False, True]).head(10)
+    return g[["l_orderkey", "rev", "o_orderdate", "o_shippriority"]]
+
+
+def q5(t):
+    df = t["customer"].merge(t["orders"], left_on="c_custkey",
+                             right_on="o_custkey")
+    df = df.merge(t["lineitem"], left_on="o_orderkey", right_on="l_orderkey")
+    df = df.merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+    df = df[df.c_nationkey == df.s_nationkey]
+    df = df.merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    df = df.merge(t["region"], left_on="n_regionkey", right_on="r_regionkey")
+    df = df[(df.r_name == "ASIA") & (df.o_orderdate >= _d("1994-01-01"))
+            & (df.o_orderdate < _d("1995-01-01"))]
+    df["rev"] = df.l_extendedprice * (1 - df.l_discount)
+    g = df.groupby("n_name")["rev"].sum().reset_index() \
+        .sort_values("rev", ascending=False)
+    return g
+
+
+def q6(t):
+    li = t["lineitem"]
+    df = li[(li.l_shipdate >= _d("1994-01-01"))
+            & (li.l_shipdate < _d("1995-01-01"))
+            & (li.l_discount >= 0.05 - 1e-9) & (li.l_discount <= 0.07 + 1e-9)
+            & (li.l_quantity < 24)]
+    return float((df.l_extendedprice * df.l_discount).sum())
+
+
+def q2(t):
+    ps = t["partsupp"].merge(t["supplier"], left_on="ps_suppkey",
+                             right_on="s_suppkey")
+    ps = ps.merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    ps = ps.merge(t["region"], left_on="n_regionkey", right_on="r_regionkey")
+    ps = ps[ps.r_name == "EUROPE"]
+    minc = ps.groupby("ps_partkey")["ps_supplycost"].min().rename("minc")
+    df = ps.merge(minc, left_on="ps_partkey", right_index=True)
+    df = df[df.ps_supplycost == df.minc]
+    df = df.merge(t["part"], left_on="ps_partkey", right_on="p_partkey")
+    df = df[(df.p_size == 15) & df.p_type.str.endswith("BRASS")]
+    df = df.sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                        ascending=[False, True, True, True]).head(100)
+    return df[["s_acctbal", "s_name", "n_name", "p_partkey"]]
+
+
+def q4(t):
+    li = t["lineitem"]
+    ok = li[li.l_commitdate < li.l_receiptdate].l_orderkey.unique()
+    o = t["orders"]
+    df = o[(o.o_orderdate >= _d("1993-07-01"))
+           & (o.o_orderdate < _d("1993-10-01"))
+           & o.o_orderkey.isin(ok)]
+    return df.groupby("o_orderpriority").size().reset_index(name="n") \
+        .sort_values("o_orderpriority")
+
+
+def q7(t):
+    df = t["supplier"].merge(t["lineitem"], left_on="s_suppkey",
+                             right_on="l_suppkey")
+    df = df.merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+    df = df.merge(t["customer"], left_on="o_custkey", right_on="c_custkey")
+    n = t["nation"]
+    df = df.merge(n.add_prefix("s_n_"), left_on="s_nationkey",
+                  right_on="s_n_n_nationkey")
+    df = df.merge(n.add_prefix("c_n_"), left_on="c_nationkey",
+                  right_on="c_n_n_nationkey")
+    m = (((df.s_n_n_name == "FRANCE") & (df.c_n_n_name == "GERMANY"))
+         | ((df.s_n_n_name == "GERMANY") & (df.c_n_n_name == "FRANCE")))
+    df = df[m & (df.l_shipdate >= _d("1995-01-01"))
+            & (df.l_shipdate <= _d("1996-12-31"))]
+    df["l_year"] = (1970 + pd.to_datetime(
+        df.l_shipdate, unit="D", origin="unix").dt.year - 1970)
+    df["vol"] = df.l_extendedprice * (1 - df.l_discount)
+    return df.groupby(["s_n_n_name", "c_n_n_name", "l_year"])["vol"] \
+        .sum().reset_index().sort_values(["s_n_n_name", "c_n_n_name",
+                                          "l_year"])
+
+
+def q8(t):
+    df = t["part"].merge(t["lineitem"], left_on="p_partkey",
+                         right_on="l_partkey")
+    df = df.merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+    df = df.merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+    df = df.merge(t["customer"], left_on="o_custkey", right_on="c_custkey")
+    n = t["nation"]
+    df = df.merge(n.add_prefix("c_n_"), left_on="c_nationkey",
+                  right_on="c_n_n_nationkey")
+    df = df.merge(t["region"], left_on="c_n_n_regionkey",
+                  right_on="r_regionkey")
+    df = df.merge(n.add_prefix("s_n_"), left_on="s_nationkey",
+                  right_on="s_n_n_nationkey")
+    df = df[(df.r_name == "AMERICA") & (df.p_type == "ECONOMY ANODIZED STEEL")
+            & (df.o_orderdate >= _d("1995-01-01"))
+            & (df.o_orderdate <= _d("1996-12-31"))]
+    df["o_year"] = pd.to_datetime(df.o_orderdate, unit="D",
+                                  origin="unix").dt.year
+    df["vol"] = df.l_extendedprice * (1 - df.l_discount)
+    df["brvol"] = df.vol.where(df.s_n_n_name == "BRAZIL", 0.0)
+    g = df.groupby("o_year").agg(num=("brvol", "sum"), den=("vol", "sum"))
+    g["share"] = g.num / g.den
+    return g.reset_index().sort_values("o_year")[["o_year", "share"]]
+
+
+def q9(t):
+    df = t["part"][t["part"].p_name.str.contains("green")]
+    df = df.merge(t["lineitem"], left_on="p_partkey", right_on="l_partkey")
+    df = df.merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+    df = df.merge(t["partsupp"],
+                  left_on=["l_partkey", "l_suppkey"],
+                  right_on=["ps_partkey", "ps_suppkey"])
+    df = df.merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+    df = df.merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    df["o_year"] = pd.to_datetime(df.o_orderdate, unit="D",
+                                  origin="unix").dt.year
+    df["amount"] = df.l_extendedprice * (1 - df.l_discount) \
+        - df.ps_supplycost * df.l_quantity
+    return df.groupby(["n_name", "o_year"])["amount"].sum().reset_index() \
+        .sort_values(["n_name", "o_year"], ascending=[True, False])
+
+
+def q11(t):
+    df = t["partsupp"].merge(t["supplier"], left_on="ps_suppkey",
+                             right_on="s_suppkey")
+    df = df.merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    df = df[df.n_name == "GERMANY"]
+    df["v"] = df.ps_supplycost * df.ps_availqty
+    total = df.v.sum() * 0.0001
+    g = df.groupby("ps_partkey")["v"].sum().reset_index()
+    g = g[g.v > total].sort_values("v", ascending=False)
+    return g
+
+
+def q13(t):
+    o = t["orders"][~t["orders"].o_comment.str.contains(
+        "special.*requests", regex=True)]
+    cnt = t["customer"].merge(o, left_on="c_custkey", right_on="o_custkey",
+                              how="left")
+    g = cnt.groupby("c_custkey")["o_orderkey"].count().reset_index(
+        name="c_count")
+    g2 = g.groupby("c_count").size().reset_index(name="custdist")
+    return g2.sort_values(["custdist", "c_count"],
+                          ascending=[False, False])
+
+
+def q15(t):
+    li = t["lineitem"]
+    df = li[(li.l_shipdate >= _d("1996-01-01"))
+            & (li.l_shipdate < _d("1996-04-01"))]
+    rev = (df.l_extendedprice * (1 - df.l_discount)).groupby(
+        df.l_suppkey).sum()
+    mx = rev.max()
+    top = rev[np.isclose(rev, mx)].reset_index()
+    out = top.merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+    return out.sort_values("s_suppkey")[["s_suppkey", "s_name"]], mx
+
+
+def q16(t):
+    bad = t["supplier"][t["supplier"].s_comment.str.contains(
+        "Customer.*Complaints", regex=True)].s_suppkey
+    df = t["partsupp"].merge(t["part"], left_on="ps_partkey",
+                             right_on="p_partkey")
+    df = df[(df.p_brand != "Brand#45")
+            & ~df.p_type.str.startswith("MEDIUM POLISHED")
+            & df.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])
+            & ~df.ps_suppkey.isin(bad)]
+    g = df.groupby(["p_brand", "p_type", "p_size"])["ps_suppkey"] \
+        .nunique().reset_index(name="supplier_cnt")
+    return g.sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                         ascending=[False, True, True, True])
+
+
+def q17(t):
+    li = t["lineitem"]
+    p = t["part"][(t["part"].p_brand == "Brand#23")
+                  & (t["part"].p_container == "MED BOX")]
+    df = li.merge(p, left_on="l_partkey", right_on="p_partkey")
+    avg = li.groupby("l_partkey")["l_quantity"].mean().rename("avgq")
+    df = df.merge(avg, left_on="l_partkey", right_index=True)
+    sel = df[df.l_quantity < 0.2 * df.avgq]
+    return float(sel.l_extendedprice.sum() / 7.0)
+
+
+def q18(t):
+    li = t["lineitem"]
+    big = li.groupby("l_orderkey")["l_quantity"].sum()
+    big = big[big > 300].index
+    df = t["customer"].merge(t["orders"], left_on="c_custkey",
+                             right_on="o_custkey")
+    df = df[df.o_orderkey.isin(big)]
+    df = df.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    g = df.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                    "o_totalprice"])["l_quantity"].sum().reset_index()
+    return g.sort_values(["o_totalprice", "o_orderdate"],
+                         ascending=[False, True]).head(100)
+
+
+def q20(t):
+    parts = t["part"][t["part"].p_name.str.startswith("forest")].p_partkey
+    li = t["lineitem"]
+    li94 = li[(li.l_shipdate >= _d("1994-01-01"))
+              & (li.l_shipdate < _d("1995-01-01"))]
+    qsum = li94.groupby(["l_partkey", "l_suppkey"])["l_quantity"].sum() \
+        .rename("qs").reset_index()
+    ps = t["partsupp"][t["partsupp"].ps_partkey.isin(parts)]
+    ps = ps.merge(qsum, how="left",
+                  left_on=["ps_partkey", "ps_suppkey"],
+                  right_on=["l_partkey", "l_suppkey"])
+    ps = ps[ps.ps_availqty > 0.5 * ps.qs.fillna(np.inf)]
+    sup = t["supplier"][t["supplier"].s_suppkey.isin(ps.ps_suppkey)]
+    sup = sup.merge(t["nation"], left_on="s_nationkey",
+                    right_on="n_nationkey")
+    sup = sup[sup.n_name == "CANADA"]
+    return sup.sort_values("s_name")[["s_name", "s_address"]]
+
+
+def q21(t):
+    li = t["lineitem"]
+    df = t["supplier"].merge(li, left_on="s_suppkey", right_on="l_suppkey")
+    df = df.merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+    df = df.merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    df = df[(df.o_orderstatus == "F") & (df.l_receiptdate > df.l_commitdate)
+            & (df.n_name == "SAUDI ARABIA")]
+    # exists: another supplier on same order
+    per_order = li.groupby("l_orderkey")["l_suppkey"].nunique()
+    multi = per_order[per_order > 1].index
+    # not exists: another supplier late on same order
+    late = li[li.l_receiptdate > li.l_commitdate]
+    late_n = late.groupby("l_orderkey")["l_suppkey"].nunique().rename("ln")
+    df = df[df.l_orderkey.isin(multi)]
+    df = df.merge(late_n, left_on="l_orderkey", right_index=True,
+                  how="left")
+    # the only late supplier on the order must be this one
+    df = df[df.ln.fillna(0) == 1]
+    g = df.groupby("s_name").size().reset_index(name="numwait")
+    return g.sort_values(["numwait", "s_name"],
+                         ascending=[False, True]).head(100)
+
+
+def q22(t):
+    c = t["customer"]
+    cc = c.c_phone.str[:2]
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    avg = c[(c.c_acctbal > 0) & cc.isin(codes)].c_acctbal.mean()
+    cand = c[cc.isin(codes) & (c.c_acctbal > avg)]
+    cand = cand[~cand.c_custkey.isin(t["orders"].o_custkey)]
+    g = cand.assign(cn=cand.c_phone.str[:2]).groupby("cn").agg(
+        numcust=("c_custkey", "count"),
+        tot=("c_acctbal", "sum")).reset_index().sort_values("cn")
+    return g
+
+
+def q10(t):
+    df = t["customer"].merge(t["orders"], left_on="c_custkey",
+                             right_on="o_custkey")
+    df = df[(df.o_orderdate >= _d("1993-10-01"))
+            & (df.o_orderdate < _d("1994-01-01"))]
+    df = df.merge(t["lineitem"], left_on="o_orderkey", right_on="l_orderkey")
+    df = df[df.l_returnflag == "R"]
+    df = df.merge(t["nation"], left_on="c_nationkey", right_on="n_nationkey")
+    df["rev"] = df.l_extendedprice * (1 - df.l_discount)
+    g = df.groupby(["c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+                    "c_address", "c_comment"])["rev"].sum().reset_index()
+    g = g.sort_values("rev", ascending=False).head(20)
+    return g
+
+
+def q12(t):
+    df = t["orders"].merge(t["lineitem"], left_on="o_orderkey",
+                           right_on="l_orderkey")
+    df = df[df.l_shipmode.isin(["MAIL", "SHIP"])
+            & (df.l_commitdate < df.l_receiptdate)
+            & (df.l_shipdate < df.l_commitdate)
+            & (df.l_receiptdate >= _d("1994-01-01"))
+            & (df.l_receiptdate < _d("1995-01-01"))]
+    hi = df.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    g = df.assign(high=hi.astype(int), low=(~hi).astype(int)) \
+        .groupby("l_shipmode")[["high", "low"]].sum().reset_index() \
+        .sort_values("l_shipmode")
+    return g
+
+
+def q14(t):
+    df = t["lineitem"].merge(t["part"], left_on="l_partkey",
+                             right_on="p_partkey")
+    df = df[(df.l_shipdate >= _d("1995-09-01"))
+            & (df.l_shipdate < _d("1995-10-01"))]
+    rev = df.l_extendedprice * (1 - df.l_discount)
+    promo = rev.where(df.p_type.str.startswith("PROMO"), 0.0)
+    return float(100.0 * promo.sum() / rev.sum())
+
+
+def q19(t):
+    df = t["lineitem"].merge(t["part"], left_on="l_partkey",
+                             right_on="p_partkey")
+    def bracket(brand, conts, qlo, qhi, slo, shi):
+        return ((df.p_brand == brand) & df.p_container.isin(conts)
+                & (df.l_quantity >= qlo) & (df.l_quantity <= qhi)
+                & (df.p_size >= slo) & (df.p_size <= shi)
+                & df.l_shipmode.isin(["AIR", "AIR REG"])
+                & (df.l_shipinstruct == "DELIVER IN PERSON"))
+    m = bracket("Brand#12", ["SM CASE", "SM BOX", "SM PACK", "SM PKG"],
+                1, 11, 1, 5) | \
+        bracket("Brand#23", ["MED BAG", "MED BOX", "MED PKG", "MED PACK"],
+                10, 20, 1, 10) | \
+        bracket("Brand#34", ["LG CASE", "LG BOX", "LG PACK", "LG PKG"],
+                20, 30, 1, 15)
+    sel = df[m]
+    return float((sel.l_extendedprice * (1 - sel.l_discount)).sum())
