@@ -1,0 +1,92 @@
+"""Benchmark driver: TPC-H Q1 through the full SQL engine on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- value: Q1 throughput in Mrows/s of lineitem scanned (engine, device path)
+- vs_baseline: speedup over the CPU control arm (pandas, BASELINE.md's
+  "CPU DataNode" stand-in) on the same machine & data
+
+Scale via env: BENCH_SF (default 1.0), BENCH_REPEAT (default 5).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from opentenbase_tpu.utils.backend import ensure_alive_backend  # noqa: E402
+
+platform = ensure_alive_backend(timeout_s=90)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
+    repeat = int(os.environ.get("BENCH_REPEAT", "5"))
+
+    from opentenbase_tpu.exec.session import LocalNode, Session
+    from opentenbase_tpu.tpch import datagen
+    from opentenbase_tpu.tpch.queries import Q
+    from opentenbase_tpu.tpch.schema import SCHEMA
+
+    t0 = time.time()
+    data = datagen.generate(sf=sf)
+    node = LocalNode()
+    s = Session(node)
+    s.execute(SCHEMA)
+    # bench loads only what Q1 needs (lineitem)
+    td = node.catalog.table("lineitem")
+    st = node.stores["lineitem"]
+    tbl = data["lineitem"]
+    n_rows = len(tbl["l_orderkey"])
+    s._insert_rows(td, st, tbl, n_rows)
+    gen_s = time.time() - t0
+
+    # warm (compile + device staging)
+    s.query(Q[1])
+    times = []
+    for _ in range(repeat):
+        t1 = time.perf_counter()
+        s.query(Q[1])
+        times.append(time.perf_counter() - t1)
+    engine_s = min(times)
+
+    # CPU control arm: pandas (the classic CPU DataNode stand-in)
+    import pandas as pd
+    li = pd.DataFrame({k: tbl[k] for k in
+                       ("l_returnflag", "l_linestatus", "l_quantity",
+                        "l_extendedprice", "l_discount", "l_tax",
+                        "l_shipdate")})
+    cutoff = 10471  # 1998-09-02
+    ptimes = []
+    for _ in range(max(2, repeat // 2)):
+        t2 = time.perf_counter()
+        df = li[li.l_shipdate <= cutoff]
+        dp = df.l_extendedprice * (1 - df.l_discount)
+        ch = dp * (1 + df.l_tax)
+        df.assign(dp=dp, ch=ch).groupby(
+            ["l_returnflag", "l_linestatus"]).agg(
+            sq=("l_quantity", "sum"), sp=("l_extendedprice", "sum"),
+            sdp=("dp", "sum"), sch=("ch", "sum"),
+            aq=("l_quantity", "mean"), ap=("l_extendedprice", "mean"),
+            ad=("l_discount", "mean"), n=("l_quantity", "count"))
+        ptimes.append(time.perf_counter() - t2)
+    pandas_s = min(ptimes)
+
+    mrows = n_rows / engine_s / 1e6
+    print(json.dumps({
+        "metric": f"TPC-H Q1 SF{sf:g} throughput ({platform})",
+        "value": round(mrows, 3),
+        "unit": "Mrows/s",
+        "vs_baseline": round(pandas_s / engine_s, 3),
+    }))
+    print(f"# rows={n_rows} engine={engine_s*1e3:.1f}ms "
+          f"pandas={pandas_s*1e3:.1f}ms datagen={gen_s:.1f}s "
+          f"platform={platform}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -78,6 +78,11 @@ class DeviceTableCache:
         arrs = {}
         want = set(colnames) | {"__xmin_ts", "__xmax_ts", "__xmin_txid",
                                 "__xmax_txid"}
+        if hit is not None and hit[0] == ver:
+            # same version, new columns: merge — keep already-staged
+            # device buffers, stage only what's missing
+            arrs.update(hit[1])
+            want -= set(arrs)
         for name in want:
             if name == "__xmin_ts":
                 parts = [ch.xmin_ts[:ch.nrows] for _, ch in
@@ -354,8 +359,10 @@ class Executor:
         valid = left.valid[lidx] & right.valid[ridx]
         cols = {n: a[lidx] for n, a in left.cols.items()}
         cols.update({n: a[ridx] for n, a in right.cols.items()})
+        nulls = {n: a[lidx] for n, a in left.nulls.items()}
+        nulls.update({n: a[ridx] for n, a in right.nulls.items()})
         return DBatch(cols, valid, {**left.types, **right.types},
-                      {**left.dicts, **right.dicts})
+                      {**left.dicts, **right.dicts}, nulls)
 
     # ---- aggregate ----
     def _exec_agg(self, node: P.Agg) -> DBatch:
@@ -385,6 +392,13 @@ class Executor:
                 arg_arr = self._eval(ac.arg, b)
                 if isinstance(ac.arg, E.Col) and ac.arg.name in b.nulls:
                     null_mask = b.nulls[ac.arg.name]
+            # SQL aggregates skip NULLs (outer-join null-extended rows):
+            # pre-mask inputs with the aggregate's neutral element
+            def non_null(v, neutral):
+                if null_mask is None:
+                    return v
+                return jnp.where(null_mask, jnp.asarray(neutral, v.dtype), v)
+
             if ac.func == "count":
                 base = b.valid if null_mask is None else \
                     (b.valid & ~null_mask)
@@ -395,9 +409,11 @@ class Executor:
                 scale = ac.arg.type.scale \
                     if ac.arg.type.kind == TypeKind.DECIMAL else 0
                 kinds.append("sumf")
-                inputs.append(arg_arr)
-                kinds.append("count")
-                inputs.append(b.valid.astype(jnp.int64))
+                inputs.append(non_null(arg_arr, 0))
+                base = b.valid if null_mask is None else \
+                    (b.valid & ~null_mask)
+                kinds.append("sum")
+                inputs.append(base.astype(jnp.int64))
                 out_specs.append((name, T.FLOAT64, ("avg", scale)))
             elif ac.func == "sum":
                 if ac.arg.type.kind == TypeKind.FLOAT64:
@@ -408,9 +424,16 @@ class Executor:
                     t = ac.arg.type if ac.arg.type.kind == TypeKind.DECIMAL \
                         else T.INT64
                     out_specs.append((name, t, None))
-                inputs.append(arg_arr)
+                inputs.append(non_null(arg_arr, 0))
             elif ac.func in ("min", "max"):
                 kinds.append(ac.func)
+                if null_mask is not None:
+                    if jnp.issubdtype(arg_arr.dtype, jnp.integer):
+                        info = jnp.iinfo(arg_arr.dtype)
+                        neutral = info.max if ac.func == "min" else info.min
+                    else:
+                        neutral = np.inf if ac.func == "min" else -np.inf
+                    arg_arr = non_null(arg_arr, neutral)
                 inputs.append(arg_arr)
                 out_specs.append((name, ac.arg.type, None))
             else:
@@ -587,13 +610,16 @@ class Executor:
             key_arrs.append(arr)
             descs.append(bool(desc))
         names = list(b.cols.keys())
-        payload = tuple(b.cols[n] for n in names)
+        null_names = list(b.nulls.keys())
+        payload = tuple(b.cols[n] for n in names) + \
+            tuple(b.nulls[n] for n in null_names)
         limit = node.limit
         sorted_payload, s_valid = K.sort_rows(
             tuple(key_arrs), b.valid, payload, tuple(descs),
             limit=limit)
-        cols = dict(zip(names, sorted_payload))
-        return DBatch(cols, s_valid, b.types, b.dicts)
+        cols = dict(zip(names, sorted_payload[:len(names)]))
+        nulls = dict(zip(null_names, sorted_payload[len(names):]))
+        return DBatch(cols, s_valid, b.types, b.dicts, nulls)
 
     def _exec_limit(self, node: P.Limit) -> DBatch:
         b = self.exec_node(node.child)

@@ -78,6 +78,7 @@ class LocalNode:
         self.catalog.register_node(NodeDef(node_name, "datanode", index=0))
         self.catalog.build_default_shard_map(1)
         self.stores: dict[str, TableStore] = {}
+        self.active_txns: set[int] = set()
         self.gts = LocalGts()
         self.cache = DeviceTableCache()
         self.datadir = datadir
@@ -133,12 +134,19 @@ class LocalNode:
             self.stores.pop(rec["name"], None)
         elif op == "insert":
             st = self.stores[rec["table"]]
-            cols = {k: np.asarray(v) for k, v in rec["columns"].items()}
-            # dictionary codes were logged as raw strings for TEXT cols
             enc = {}
-            for cname, arr in cols.items():
-                enc[cname] = st.encode_column(
-                    cname, arr if arr.dtype.kind not in "UO" else list(arr))
+            for cname, v in rec["columns"].items():
+                arr = np.asarray(v)
+                if arr.dtype.kind in "UO":
+                    # TEXT columns are logged as raw strings (dictionary
+                    # codes are not stable across restarts)
+                    enc[cname] = st.encode_column(cname, list(arr))
+                else:
+                    # all other columns were logged in storage
+                    # representation — re-encoding would double-scale
+                    # decimals
+                    enc[cname] = arr.astype(
+                        st.td.column(cname).type.np_dtype)
             spans = st.insert(enc, rec["n"], rec["txid"])
             pending.setdefault(rec["txid"], []).append(("ins", st, spans))
         elif op == "delete":
@@ -162,9 +170,14 @@ class LocalNode:
                 else:
                     st.revert_delete([sp])
 
-    def checkpoint(self):
+    def checkpoint(self) -> bool:
         if not self.datadir:
-            return
+            return False
+        if self.active_txns:
+            # truncating the WAL would orphan in-flight txns' records: a
+            # later COMMIT would replay against nothing (the reference's
+            # checkpointer coordinates with open xacts via the proc array)
+            return False
         import json
         self.catalog.save(os.path.join(self.datadir, "catalog.json"))
         for name, st in self.stores.items():
@@ -175,6 +188,7 @@ class LocalNode:
         os.replace(tmp, os.path.join(self.datadir, "meta.json"))
         if self.wal:
             self.wal.truncate()
+        return True
 
     def _log(self, rec: dict, sync: bool = False):
         if self.wal:
@@ -202,6 +216,11 @@ class Session:
         t = TxnState(self.node.gts.next_txid(), self.node.gts.next_gts())
         return t, True
 
+    def _track_write(self, t: TxnState):
+        """Register a txn as having in-flight WAL records (blocks
+        checkpoint truncation until commit/abort)."""
+        self.node.active_txns.add(t.txid)
+
     def _commit(self, t: TxnState):
         ts = np.int64(self.node.gts.next_gts())
         self.node._log({"op": "commit", "txid": t.txid, "ts": int(ts)},
@@ -210,6 +229,7 @@ class Session:
             st.backfill_insert(spans, ts)
         for st, span in t.delete_spans:
             st.backfill_delete([span], ts)
+        self.node.active_txns.discard(t.txid)
 
     def _abort(self, t: TxnState):
         self.node._log({"op": "abort", "txid": t.txid})
@@ -217,6 +237,7 @@ class Session:
             st.abort_insert(spans)
         for st, span in t.delete_spans:
             st.revert_delete([span])
+        self.node.active_txns.discard(t.txid)
 
     # ------------------------------------------------------------------
     def _exec_stmt(self, stmt: A.Node) -> Result:
@@ -310,6 +331,8 @@ class Session:
                     else:
                         raise ExecError("INSERT values must be literals")
                 rows.append(row)
+        if not rows:
+            return Result("INSERT", rowcount=0)
         if len(cols) != len(rows[0]):
             raise ExecError("INSERT column count mismatch")
         coldata = {c: [r[i] for r in rows] for i, c in enumerate(cols)}
@@ -323,6 +346,7 @@ class Session:
     def _insert_rows(self, td: TableDef, st: TableStore,
                      coldata: dict, n: int) -> int:
         t, implicit = self._begin_implicit()
+        self._track_write(t)
         enc = {c: st.encode_column(c, vals) for c, vals in coldata.items()}
         loc = Locator(self.node.catalog)
         raw_for_route = {c: np.asarray(coldata[c])
@@ -347,6 +371,7 @@ class Session:
         td = self.node.catalog.table(stmt.table)
         st = self.node.stores[stmt.table]
         t, implicit = self._begin_implicit()
+        self._track_write(t)
         binder = Binder(self.node.catalog)
         quals = []
         if stmt.where is not None:
@@ -396,24 +421,31 @@ class Session:
             sel_items.append(A.SelectItem(src, alias=c.name))
         sel = A.SelectStmt(items=sel_items, from_=[A.TableRef(stmt.table)],
                            where=stmt.where)
+        # UPDATE composes a delete + insert and must be ONE transaction:
+        # install the implicit txn as the session txn so the nested
+        # statements join it instead of drawing (and committing) their own
         t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
         try:
             planned = self._plan_select(sel)
             ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
                               self.node.cache)
             batch = Executor(ctx).run(planned)
             names, rows = materialize(batch, planned.output_names)
-            del_res = self._exec_delete(A.DeleteStmt(stmt.table, stmt.where))
+            self._exec_delete(A.DeleteStmt(stmt.table, stmt.where))
             if rows:
                 coldata = {c: [r[i] for r in rows]
                            for i, c in enumerate(names)}
                 self._insert_rows(td, self.node.stores[stmt.table],
                                   coldata, len(rows))
         except Exception:
-            if implicit and self.txn is None:
+            if implicit:
+                self.txn = None
                 self._abort(t)
             raise
         if implicit:
+            self.txn = None
             self._commit(t)
         return Result("UPDATE", rowcount=len(rows))
 

@@ -1,0 +1,50 @@
+"""Backend selection with tunnel-health probing.
+
+The real TPU chip is reached through the axon PJRT plugin over a local
+relay; when that tunnel is wedged, *any* jax backend init blocks forever
+(even under JAX_PLATFORMS=cpu, because the plugin is force-registered by
+sitecustomize).  Probing in a subprocess with a timeout keeps the engine's
+own process safe, then either keeps the TPU or falls back to CPU.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_PROBE = ("import jax; d = jax.devices(); "
+          "print(d[0].platform if d else 'none')")
+
+
+def probe_tpu(timeout_s: float = 60.0) -> bool:
+    """True if the default (axon/TPU) backend initializes in time."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            capture_output=True, timeout=timeout_s, text=True,
+            cwd="/", env=os.environ.copy())
+        return out.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def force_cpu():
+    """Make this process use the CPU backend and never touch the tunnel."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    from jax._src import xla_bridge as xb
+    xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_alive_backend(timeout_s: float = 60.0) -> str:
+    """Probe the TPU; fall back to CPU if the tunnel is down.  Returns the
+    selected platform name.  Must be called before any jax computation."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        force_cpu()
+        return "cpu"
+    if probe_tpu(timeout_s):
+        return "tpu"
+    force_cpu()
+    return "cpu"

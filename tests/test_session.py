@@ -110,6 +110,59 @@ class TestRecovery:
         assert s2.query("select count(*) from emp") == [(3,)]
 
 
+class TestReviewRegressions:
+    def test_wal_replay_preserves_decimals(self, sess, tmp_path):
+        # decimals were double-scaled through replay re-encoding
+        s2 = Session(LocalNode(datadir=str(tmp_path / "data")))
+        assert s2.query("select sal from emp where id = 1") == [(100.5,)]
+
+    def test_checkpoint_blocked_during_open_txn(self, sess, tmp_path):
+        sess.execute("begin")
+        sess.execute("insert into emp values (8, 'hal', 7, "
+                     "date '2024-01-01')")
+        assert sess.node.checkpoint() is False
+        sess.execute("commit")
+        assert sess.node.checkpoint() is True
+        s2 = Session(LocalNode(datadir=str(tmp_path / "data")))
+        assert s2.query("select count(*) from emp") == [(4,)]
+
+    def test_insert_select_zero_rows(self, sess):
+        sess.execute("create table emp2 (id bigint, name varchar(20), "
+                     "sal decimal(10,2), hired date)")
+        r = sess.execute("insert into emp2 select * from emp "
+                         "where id = 999")[0]
+        assert r.rowcount == 0
+
+    def test_update_is_atomic_one_txn(self, sess):
+        wal_before = [r for r in __import__(
+            "opentenbase_tpu.storage.wal", fromlist=["Wal"]).Wal.replay(
+            sess.node.wal.path)]
+        sess.execute("update emp set sal = sal + 1 where id = 1")
+        recs = [r for r in __import__(
+            "opentenbase_tpu.storage.wal", fromlist=["Wal"]).Wal.replay(
+            sess.node.wal.path)][len(wal_before):]
+        commits = [r for r in recs if r["op"] == "commit"]
+        assert len(commits) == 1  # delete+insert under ONE commit
+        txids = {r["txid"] for r in recs}
+        assert len(txids) == 1
+
+    def test_left_join_null_aggregates(self, sess):
+        sess.execute("create table r (k bigint, v decimal(10,2))")
+        sess.execute("insert into r values (1, 100)")
+        got = sess.query(
+            "select sum(v), count(v), min(v), avg(v) from emp "
+            "left join r on id = k")
+        # only id=1 matched: nulls from ids 2,3 must not contribute
+        assert got == [(100.0, 1, 100.0, 100.0)]
+
+    def test_left_join_nulls_survive_order_by(self, sess):
+        sess.execute("create table r (k bigint, v decimal(10,2))")
+        sess.execute("insert into r values (1, 100)")
+        got = sess.query("select id, v from emp left join r on id = k "
+                         "order by id")
+        assert got == [(1, 100.0), (2, None), (3, None)]
+
+
 class TestUtility:
     def test_explain(self, sess):
         r = sess.execute("explain select count(*) from emp")[0]
