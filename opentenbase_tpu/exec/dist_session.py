@@ -1,0 +1,380 @@
+"""ClusterSession — the coordinator-side SQL session.
+
+Reference analog: a CN backend (tcop/postgres.c session loop) planning into
+fragments (pgxc_planner) and driving remote execution (execRemote.c /
+execDispatchFragment.c), with implicit 2PC on multi-node writes
+(xact.c:3234 + pgxc_node_remote_prepare/commit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..catalog.schema import DistType, TableDef
+from ..catalog.types import TypeKind
+from ..parallel.cluster import Cluster
+from ..plan import physical as P
+from ..plan.distribute import DistPlan, Distributor
+from ..plan.planner import PlannedStmt, Planner
+from ..sql import ast as A
+from ..sql.analyze import Binder
+from ..sql.ddl import sequence_def_from_ast, table_def_from_ast
+from ..sql.parser import parse_sql
+from .dist import DistExecutor
+from .executor import ExecContext, ExecError, Executor, materialize
+from .session import Result
+
+
+class ClusterTxn:
+    def __init__(self, txid: int, snapshot_ts: int):
+        self.txid = txid
+        self.snapshot_ts = snapshot_ts
+        self.written: dict[int, list] = {}   # dn index -> [(kind, st, span)]
+        self.explicit = False
+
+    def track(self, dn_idx: int, kind: str, st, span):
+        self.written.setdefault(dn_idx, []).append((kind, st, span))
+
+
+class ClusterSession:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.txn: Optional[ClusterTxn] = None
+
+    # ------------------------------------------------------------------
+    def execute(self, sql: str) -> list[Result]:
+        return [self._exec_stmt(s) for s in parse_sql(sql)]
+
+    def query(self, sql: str) -> list[tuple]:
+        return self.execute(sql)[-1].rows
+
+    # ---- txn helpers ----
+    def _begin_implicit(self) -> tuple[ClusterTxn, bool]:
+        if self.txn is not None:
+            return self.txn, False
+        t = ClusterTxn(self.cluster.gtm.next_txid(),
+                       self.cluster.gtm.next_gts())
+        return t, True
+
+    def _commit(self, t: ClusterTxn):
+        self.cluster.commit_txn(t.txid, t.written, {})
+
+    def _abort(self, t: ClusterTxn):
+        self.cluster.abort_txn(t.txid, t.written)
+
+    # ------------------------------------------------------------------
+    def _exec_stmt(self, stmt: A.Node) -> Result:
+        c = self.cluster
+        if isinstance(stmt, A.SelectStmt):
+            return self._exec_select(stmt)
+        if isinstance(stmt, A.CreateTableStmt):
+            c.create_table(table_def_from_ast(stmt), stmt.if_not_exists)
+            return Result("CREATE TABLE")
+        if isinstance(stmt, A.DropTableStmt):
+            c.drop_table(stmt.name, stmt.if_exists)
+            return Result("DROP TABLE")
+        if isinstance(stmt, A.CreateSequenceStmt):
+            sd = sequence_def_from_ast(stmt)
+            c.gtm.seq_create(sd.name, sd.start, sd.increment)
+            return Result("CREATE SEQUENCE")
+        if isinstance(stmt, A.CreateIndexStmt):
+            return Result("CREATE INDEX")
+        if isinstance(stmt, A.InsertStmt):
+            return self._exec_insert(stmt)
+        if isinstance(stmt, A.DeleteStmt):
+            return self._exec_delete(stmt)
+        if isinstance(stmt, A.UpdateStmt):
+            return self._exec_update(stmt)
+        if isinstance(stmt, A.CopyStmt):
+            return self._exec_copy(stmt)
+        if isinstance(stmt, A.TxnStmt):
+            return self._exec_txn(stmt)
+        if isinstance(stmt, A.ExplainStmt):
+            return self._exec_explain(stmt)
+        if isinstance(stmt, A.SetStmt):
+            c.gucs[stmt.name] = str(stmt.value)
+            return Result("SET")
+        if isinstance(stmt, A.ShowStmt):
+            return Result("SHOW", names=[stmt.name],
+                          rows=[(c.gucs.get(stmt.name, ""),)])
+        if isinstance(stmt, A.VacuumStmt):
+            c.checkpoint()
+            return Result("VACUUM")
+        if isinstance(stmt, A.BarrierStmt):
+            # 2-phase cluster-wide consistency point (reference:
+            # pgxc/barrier/barrier.c): block new txns implicitly by
+            # checkpointing every node at one GTS
+            c.checkpoint()
+            return Result("BARRIER")
+        if isinstance(stmt, A.ExecuteDirectStmt):
+            return self._exec_direct(stmt)
+        raise ExecError(f"unsupported statement {type(stmt).__name__}")
+
+    # ---- SELECT ----
+    def _plan_distributed(self, stmt: A.SelectStmt) -> DistPlan:
+        binder = Binder(self.cluster.catalog)
+        bq = binder.bind_select(stmt)
+        planned = Planner(self.cluster.catalog).plan(bq)
+        fqs_enabled = self.cluster.gucs.get(
+            "enable_fast_query_shipping", "on") != "off"
+        d = Distributor(self.cluster.catalog, self.cluster.ndn)
+        return d.distribute(planned, bq if fqs_enabled else None)
+
+    def _exec_select(self, stmt: A.SelectStmt) -> Result:
+        dp = self._plan_distributed(stmt)
+        t, implicit = self._begin_implicit()
+        ex = DistExecutor(self.cluster, t.snapshot_ts, t.txid)
+        batch = ex.run(dp)
+        names, rows = materialize(batch, dp.output_names)
+        return Result("SELECT", names=names, rows=rows, rowcount=len(rows))
+
+    # ---- writes ----
+    def _exec_insert(self, stmt: A.InsertStmt) -> Result:
+        td = self.cluster.catalog.table(stmt.table)
+        cols = stmt.columns or td.column_names
+        if stmt.select is not None:
+            dp = self._plan_distributed(stmt.select)
+            t0, _ = self._begin_implicit()
+            batch = DistExecutor(self.cluster, t0.snapshot_ts,
+                                 t0.txid).run(dp)
+            _, rows = materialize(batch, dp.output_names)
+        else:
+            rows = []
+            for vr in stmt.values:
+                row = []
+                for v in vr:
+                    if isinstance(v, A.Const):
+                        row.append(v.value)
+                    elif isinstance(v, A.TypedConst) and \
+                            v.type_name == "date":
+                        row.append(v.value)
+                    elif isinstance(v, A.UnaryOp) and v.op == "-" \
+                            and isinstance(v.arg, A.Const):
+                        row.append(-float(v.arg.value)
+                                   if "." in str(v.arg.value)
+                                   else -int(v.arg.value))
+                    else:
+                        raise ExecError("INSERT values must be literals")
+                rows.append(row)
+        if not rows:
+            return Result("INSERT", rowcount=0)
+        if len(cols) != len(rows[0]):
+            raise ExecError("INSERT column count mismatch")
+        coldata = {cname: [r[i] for r in rows]
+                   for i, cname in enumerate(cols)}
+        missing = [cn for cn in td.column_names if cn not in coldata]
+        if missing:
+            raise ExecError(f"INSERT missing columns {missing}")
+        n = self._insert_rows(td, coldata, len(rows))
+        return Result("INSERT", rowcount=n)
+
+    def _insert_rows(self, td: TableDef, coldata: dict, n: int) -> int:
+        c = self.cluster
+        t, implicit = self._begin_implicit()
+        c.active_txns.add(t.txid)
+        try:
+            if td.distribution.dist_type == DistType.REPLICATED:
+                dests = {i: np.arange(n)
+                         for i in range(c.ndn)}          # write everywhere
+                sid = None
+            else:
+                route_cols = {cn: np.asarray(coldata[cn])
+                              for cn in td.distribution.dist_cols}
+                nodes = c.locator.route_rows(td, route_cols, n)
+                sid = c.locator.shard_ids_for_rows(td, route_cols)
+                dests = {i: np.nonzero(nodes == i)[0]
+                         for i in set(nodes.tolist())}
+            for dn_idx, idx in dests.items():
+                if len(idx) == 0:
+                    continue
+                dn = c.datanodes[dn_idx]
+                st = dn.stores[td.name]
+                sub = {cn: [coldata[cn][j] for j in idx]
+                       for cn in coldata}
+                enc = {cn: st.encode_column(cn, vals)
+                       for cn, vals in sub.items()}
+                sub_sid = sid[idx] if sid is not None else None
+                dn.log({"op": "insert", "table": td.name, "n": len(idx),
+                        "txid": t.txid,
+                        "shardids": sub_sid,
+                        "columns": {cn: (np.asarray(v, dtype=object)
+                                         if td.column(cn).type.kind
+                                         == TypeKind.TEXT
+                                         else np.asarray(enc[cn]))
+                                    for cn, v in sub.items()}})
+                spans = st.insert(enc, len(idx), t.txid, shardids=sub_sid)
+                t.track(dn_idx, "ins", st, spans)
+        except Exception:
+            if implicit:
+                self._abort(t)
+            raise
+        if implicit:
+            self._commit(t)
+        return n
+
+    def _exec_delete(self, stmt: A.DeleteStmt) -> Result:
+        c = self.cluster
+        td = c.catalog.table(stmt.table)
+        t, implicit = self._begin_implicit()
+        c.active_txns.add(t.txid)
+        binder = Binder(c.catalog)
+        quals = []
+        if stmt.where is not None:
+            sel = A.SelectStmt(items=[A.SelectItem(A.Star())],
+                               from_=[A.TableRef(stmt.table)],
+                               where=stmt.where)
+            quals = binder.bind_select(sel).where
+        from .expr_compile import compile_expr
+        n_deleted = 0
+        try:
+            for dn in c.datanodes:
+                st = dn.stores[td.name]
+                for ci, ch in st.scan_chunks():
+                    vis = st.visible_mask(ch, t.snapshot_ts, t.txid)
+                    mask = vis
+                    if quals:
+                        colmap = {f"{stmt.table}.{col.name}":
+                                  ch.columns[col.name][:ch.nrows]
+                                  for col in td.columns}
+                        dicts = {f"{stmt.table}.{k}": d
+                                 for k, d in st.dicts.items()}
+                        for q in quals:
+                            mask = mask & np.asarray(
+                                compile_expr(q, dicts)(colmap))
+                    if mask.any():
+                        span = st.mark_delete(ci, mask, t.txid)
+                        t.track(dn.index, "del", st, span)
+                        dn.log({"op": "delete", "table": td.name,
+                                "chunk": ci, "mask": mask, "txid": t.txid})
+                        n_deleted += int(mask.sum())
+        except Exception:
+            if implicit:
+                self._abort(t)
+            raise
+        if implicit:
+            self._commit(t)
+        # replicated deletes count each copy once
+        if td.distribution.dist_type == DistType.REPLICATED and c.ndn:
+            n_deleted //= c.ndn
+        return Result("DELETE", rowcount=n_deleted)
+
+    def _exec_update(self, stmt: A.UpdateStmt) -> Result:
+        td = self.cluster.catalog.table(stmt.table)
+        assigned = {cn: e for cn, e in stmt.assignments}
+        sel_items = [A.SelectItem(assigned.get(col.name,
+                                               A.ColRef((col.name,))),
+                                  alias=col.name)
+                     for col in td.columns]
+        sel = A.SelectStmt(items=sel_items,
+                           from_=[A.TableRef(stmt.table)],
+                           where=stmt.where)
+        t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
+        try:
+            dp = self._plan_distributed(sel)
+            batch = DistExecutor(self.cluster, t.snapshot_ts,
+                                 t.txid).run(dp)
+            names, rows = materialize(batch, dp.output_names)
+            self._exec_delete(A.DeleteStmt(stmt.table, stmt.where))
+            if rows:
+                coldata = {cn: [r[i] for r in rows]
+                           for i, cn in enumerate(names)}
+                self._insert_rows(td, coldata, len(rows))
+        except Exception:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit(t)
+        return Result("UPDATE", rowcount=len(rows))
+
+    def _exec_copy(self, stmt: A.CopyStmt) -> Result:
+        import pandas as pd
+        td = self.cluster.catalog.table(stmt.table)
+        if stmt.direction != "from":
+            raise ExecError("COPY TO unsupported yet")
+        delim = str(stmt.options.get("delimiter", "|"))
+        cols = stmt.columns or td.column_names
+        df = pd.read_csv(stmt.filename, sep=delim, header=None,
+                         names=cols + ["__trail"], index_col=False,
+                         engine="c")
+        if df["__trail"].isna().all():
+            df = df.drop(columns="__trail")
+        coldata = {cn: df[cn].tolist() for cn in cols}
+        n = self._insert_rows(td, coldata, len(df))
+        return Result("COPY", rowcount=n)
+
+    # ---- txn / utility ----
+    def _exec_txn(self, stmt: A.TxnStmt) -> Result:
+        if stmt.op == "begin":
+            if self.txn is None:
+                self.txn = ClusterTxn(self.cluster.gtm.next_txid(),
+                                      self.cluster.gtm.next_gts())
+                self.txn.explicit = True
+                self.cluster.active_txns.add(self.txn.txid)
+            return Result("BEGIN")
+        if stmt.op == "commit":
+            if self.txn is not None:
+                self._commit(self.txn)
+                self.txn = None
+            return Result("COMMIT")
+        if self.txn is not None:
+            self._abort(self.txn)
+            self.txn = None
+        return Result("ROLLBACK")
+
+    def _exec_explain(self, stmt: A.ExplainStmt) -> Result:
+        if not isinstance(stmt.stmt, A.SelectStmt):
+            raise ExecError("EXPLAIN supports SELECT only")
+        dp = self._plan_distributed(stmt.stmt)
+        lines = []
+        if dp.fqs_node is not None:
+            lines.append(f"Fast Query Shipping -> dn{dp.fqs_node}")
+        for frag in reversed(dp.fragments):
+            loc = "CN" if frag.index == dp.top_fragment \
+                and dp.fqs_node is None else \
+                (f"dn{dp.fqs_node}" if dp.fqs_node is not None
+                 else "all DNs")
+            lines.append(f"Fragment {frag.index} [{loc}]:")
+            lines.append(P.explain(frag.plan))
+        for ex in dp.exchanges:
+            lines.append(f"Exchange {ex.index}: {ex.kind} "
+                         f"(from fragment {ex.source_fragment})")
+        text = "\n".join(lines)
+        if stmt.analyze:
+            t0 = time.perf_counter()
+            self._exec_select(stmt.stmt)
+            text += (f"\nExecution Time: "
+                     f"{(time.perf_counter()-t0)*1e3:.2f} ms")
+        return Result("EXPLAIN", names=["QUERY PLAN"],
+                      rows=[(ln,) for ln in text.split("\n")], text=text)
+
+    def _exec_direct(self, stmt: A.ExecuteDirectStmt) -> Result:
+        """EXECUTE DIRECT ON (node) 'sql' — run a statement on one
+        datanode (reference: ExecDirectType, pgxc/planner.h:65-75)."""
+        name = stmt.node
+        dn = None
+        for dnode in self.cluster.datanodes:
+            if f"dn{dnode.index}" == name:
+                dn = dnode
+                break
+        if dn is None:
+            raise ExecError(f"unknown node {name!r}")
+        inner = parse_sql(stmt.sql)
+        if len(inner) != 1 or not isinstance(inner[0], A.SelectStmt):
+            raise ExecError("EXECUTE DIRECT supports a single SELECT")
+        binder = Binder(self.cluster.catalog)
+        bq = binder.bind_select(inner[0])
+        planned = Planner(self.cluster.catalog).plan(bq)
+        t, _ = self._begin_implicit()
+        ctx = ExecContext(dn.stores, t.snapshot_ts, t.txid, dn.cache)
+        batch = Executor(ctx).run(planned)
+        names, rows = materialize(batch, planned.output_names)
+        return Result("SELECT", names=names, rows=rows, rowcount=len(rows))

@@ -364,10 +364,12 @@ class Executor:
         return DBatch(cols, valid, {**left.types, **right.types},
                       {**left.dicts, **right.dicts}, nulls)
 
+    def _exec_batchsource(self, node) -> DBatch:
+        return node.batch
+
     # ---- aggregate ----
-    def _exec_agg(self, node: P.Agg) -> DBatch:
-        b = self.exec_node(node.child)
-        key_arrs, key_types, key_dicts, text_transformed = [], [], [], False
+    def _eval_group_keys(self, node: P.Agg, b: DBatch):
+        key_arrs, key_types, key_dicts, dup_dicts = [], [], [], False
         for name, ke in node.group_keys:
             key_arrs.append(self._eval(ke, b).astype(jnp.int64))
             key_types.append(ke.type)
@@ -377,7 +379,37 @@ class Executor:
             # codes to one string: groups on codes over-split and must be
             # re-merged after decode
             if d is not None and len(set(d)) < len(d):
-                text_transformed = True
+                dup_dicts = True
+        return key_arrs, key_types, key_dicts, dup_dicts
+
+    def _assemble_agg_output(self, node: P.Agg, gkey_out, key_types,
+                             key_dicts, outs, out_specs, out_valid):
+        cols, types, dicts = {}, {}, {}
+        for (kname, _), karr, kt, kd in zip(node.group_keys, gkey_out,
+                                            key_types, key_dicts):
+            cols[kname] = karr.astype(kt.np_dtype)
+            types[kname] = kt
+            if kd is not None:
+                dicts[kname] = kd
+        oi = 0
+        for name, t, special in out_specs:
+            if special is not None and special[0] == "avg":
+                s, c = outs[oi], outs[oi + 1]
+                oi += 2
+                cols[name] = jnp.where(c > 0, s / jnp.maximum(c, 1)
+                                       / (10 ** special[1]), 0.0)
+            else:
+                cols[name] = outs[oi]
+                oi += 1
+            types[name] = t
+        return DBatch(cols, out_valid, types, dicts)
+
+    def _exec_agg(self, node: P.Agg) -> DBatch:
+        b = self.exec_node(node.child)
+        if node.mode == "final":
+            return self._exec_agg_final(node, b)
+        key_arrs, key_types, key_dicts, text_transformed = \
+            self._eval_group_keys(node, b)
 
         if any(ac.distinct for _, ac in node.aggs):
             return self._exec_distinct_agg(node, b, key_arrs, key_types,
@@ -414,7 +446,12 @@ class Executor:
                     (b.valid & ~null_mask)
                 kinds.append("sum")
                 inputs.append(base.astype(jnp.int64))
-                out_specs.append((name, T.FLOAT64, ("avg", scale)))
+                if node.mode == "partial":
+                    # components travel separately to the final agg
+                    out_specs.append((name + "__s", T.FLOAT64, None))
+                    out_specs.append((name + "__c", T.INT64, None))
+                else:
+                    out_specs.append((name, T.FLOAT64, ("avg", scale)))
             elif ac.func == "sum":
                 if ac.arg.type.kind == TypeKind.FLOAT64:
                     kinds.append("sumf")
@@ -478,31 +515,74 @@ class Executor:
                 out_valid = jnp.arange(max_groups) < ng
                 gkey_out = list(gkeys)
 
-        # assemble output batch
-        cols, types, dicts = {}, {}, {}
-        for (kname, _), karr, kt, kd in zip(node.group_keys, gkey_out,
-                                            key_types, key_dicts):
-            cols[kname] = karr.astype(kt.np_dtype)
-            types[kname] = kt
-            if kd is not None:
-                dicts[kname] = kd
-        oi = 0
-        for name, t, special in out_specs:
-            if special is not None and special[0] == "avg":
-                s = outs[oi]
-                c = outs[oi + 1]
-                oi += 2
-                scale = special[1]
-                cols[name] = jnp.where(c > 0, s / jnp.maximum(c, 1)
-                                       / (10 ** scale), 0.0)
-            else:
-                cols[name] = outs[oi]
-                oi += 1
-            types[name] = t
-        out = DBatch(cols, out_valid, types, dicts)
-        if text_transformed:
+        out = self._assemble_agg_output(node, gkey_out, key_types,
+                                        key_dicts, outs, out_specs,
+                                        out_valid)
+        # partial mode skips the re-merge: the exchange decodes transformed
+        # dictionaries to strings and re-encodes uniquely, so the final agg
+        # merges over-split groups by itself
+        if text_transformed and node.mode == "single":
             out = self._remerge_text_groups(node, out)
         return out
+
+    def _exec_agg_final(self, node: P.Agg, b: DBatch) -> DBatch:
+        """Finalise partial aggregates (reference: rq_finalise_aggs —
+        the CN-side combine of DN partials).  Input columns follow the
+        partial naming convention; group keys are passthrough columns.
+        Exchange re-encoding guarantees unique dictionary values here, so
+        no post-decode re-merge is needed."""
+        key_arrs, key_types, key_dicts, _ = self._eval_group_keys(node, b)
+
+        kinds, inputs, out_specs = [], [], []
+        for name, ac in node.aggs:
+            if ac.func == "avg":
+                scale = ac.arg.type.scale \
+                    if ac.arg.type.kind == TypeKind.DECIMAL else 0
+                kinds.append("sumf")
+                inputs.append(b.cols[name + "__s"])
+                kinds.append("sum")
+                inputs.append(b.cols[name + "__c"])
+                out_specs.append((name, T.FLOAT64, ("avg", scale)))
+            elif ac.func in ("count", "sum"):
+                arr = b.cols[name]
+                if ac.func == "sum" and ac.arg.type.kind == TypeKind.FLOAT64:
+                    kinds.append("sumf")
+                    out_specs.append((name, T.FLOAT64, None))
+                elif ac.func == "count":
+                    kinds.append("sum")
+                    out_specs.append((name, T.INT64, None))
+                else:
+                    kinds.append("sum")
+                    t = ac.arg.type if ac.arg.type.kind == TypeKind.DECIMAL \
+                        else T.INT64
+                    out_specs.append((name, t, None))
+                inputs.append(arr)
+            elif ac.func in ("min", "max"):
+                kinds.append(ac.func)
+                inputs.append(b.cols[name])
+                out_specs.append((name, ac.arg.type, None))
+            else:
+                raise ExecError(f"cannot finalise aggregate {ac.func}")
+
+        n = b.padded
+        if not key_arrs:
+            gid = jnp.zeros(n, dtype=jnp.int64)
+            outs, present = K.grouped_agg_dense(
+                gid, b.valid, tuple(inputs), 1, tuple(kinds))
+            out_valid = jnp.ones(1, dtype=bool)
+            gkey_out = []
+            max_groups = 1
+        else:
+            max_groups = next_pow2(max(b.count(), 1))
+            gkeys, outs, ng = K.grouped_agg_sort(
+                tuple(key_arrs), b.valid, tuple(inputs), max_groups,
+                tuple(kinds))
+            out_valid = jnp.arange(max_groups) < int(ng)
+            gkey_out = list(gkeys)
+
+        return self._assemble_agg_output(node, gkey_out, key_types,
+                                         key_dicts, outs, out_specs,
+                                         out_valid)
 
     def _exec_distinct_agg(self, node: P.Agg, b: DBatch, key_arrs,
                            key_types, key_dicts) -> DBatch:

@@ -170,6 +170,10 @@ def compile_expr(e: E.Expr, dicts: dict) -> Callable[[Arrays], object]:
                                      * rf(cols).astype(jnp.int64)) \
                     if x.type.kind == TypeKind.DECIMAL \
                     else lf(cols) * rf(cols)
+            if x.op == "%":
+                # SQL modulo truncates toward zero (sign of the dividend);
+                # python/numpy % floors (sign of the divisor)
+                return lambda cols: jnp.fmod(lf(cols), rf(cols))
             raise E.ExprError(f"bad arith op {x.op}")
 
         if isinstance(x, E.Neg):

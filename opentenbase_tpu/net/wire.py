@@ -1,0 +1,53 @@
+"""Wire protocol: length-prefixed pickled messages with CRC.
+
+Reference analog: the pooler's unix-socket protocol (poolcomm.c) and the
+extended libpq vocabulary between nodes (pgxcnode.c).  Numpy arrays pickle
+efficiently (buffer protocol), which covers plan fragments, column batches,
+and control messages with one frame format.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import zlib
+
+_HDR = struct.Struct("<II")  # length, crc32
+MAX_MSG = 1 << 31
+
+
+class WireError(ConnectionError):
+    pass
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    blob = pickle.dumps(obj, protocol=4)
+    sock.sendall(_HDR.pack(len(blob), zlib.crc32(blob)) + blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if buf:
+                raise WireError("connection closed mid-message")
+            return b""
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, _HDR.size)
+    if not hdr:
+        return None
+    length, crc = _HDR.unpack(hdr)
+    if length > MAX_MSG:
+        raise WireError(f"message too large: {length}")
+    blob = _recv_exact(sock, length)
+    if len(blob) != length:
+        raise WireError("short read")
+    if zlib.crc32(blob) != crc:
+        raise WireError("message checksum mismatch")
+    return pickle.loads(blob)

@@ -1,0 +1,368 @@
+"""Distributed planning: annotate a physical plan with row distributions,
+insert exchange operators where they mismatch, split into fragments.
+
+Reference analog: every Path carries a Distribution
+(include/nodes/relation.h:33-46); joins pick colocated/redistributed/
+replicated strategies (optimizer/util/pathnode.c:4575
+set_joinpath_distribution); redistribute_path/create_remotesubplan_path
+insert exchanges (pathnode.c:2449,1851); aggregates split partial/final
+(RemoteQuery.rq_finalise_aggs, include/pgxc/planner.h:135); the executor
+cuts the tree at exchange boundaries into fragments
+(execFragment.c:558 ExecInitFragmentTree).
+
+FQS (fast query shipping) lives in fqs_target_node(): whole-query
+single-node shipping when dist-key equality pins every sharded table to one
+datanode (pgxc_FQS_planner, pgxc/plan/planner.c:390 +
+pgxc_is_query_shippable, pgxcship.c:2431).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..catalog.catalog import Catalog
+from ..catalog.schema import DistType
+from ..parallel.locator import Locator
+from . import exprs as E
+from . import physical as P
+from .planner import PlannedStmt, expr_cols
+from .query import BoundQuery, SubLink
+
+
+@dataclasses.dataclass
+class Dist:
+    kind: str                    # 'sharded' | 'replicated' | 'cn'
+    keys: tuple[str, ...] = ()   # qualified cols rows are hash-placed by
+    # () with kind='sharded' = partitioned by unknown key
+
+
+@dataclasses.dataclass
+class ExchangeRef(P.PhysNode):
+    """Fragment-input leaf: the output of exchange `index` for this node."""
+    index: int = 0
+    types: dict = dataclasses.field(default_factory=dict)
+
+    def title(self):
+        return f"ExchangeRef #{self.index}"
+
+
+@dataclasses.dataclass
+class BatchSource(P.PhysNode):
+    """Executor-injected leaf holding a ready batch."""
+    batch: object = None
+
+    def title(self):
+        return "BatchSource"
+
+
+@dataclasses.dataclass
+class Fragment:
+    index: int
+    plan: P.PhysNode
+    location: str                 # 'dn' | 'cn'
+    # exchange feeding this fragment's parent: set on edges below
+
+
+@dataclasses.dataclass
+class Exchange:
+    index: int
+    kind: str                     # 'redistribute' | 'broadcast' | 'gather'
+    keys: list[E.Expr]
+    source_fragment: int
+    sort_keys: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class DistPlan:
+    fragments: list[Fragment]
+    exchanges: list[Exchange]
+    top_fragment: int
+    init_plans: list
+    output_names: list[str]
+    fqs_node: Optional[int] = None     # set => whole plan runs on one DN
+
+
+# ---------------------------------------------------------------------------
+# FQS analysis
+# ---------------------------------------------------------------------------
+
+def fqs_target_node(bq: BoundQuery, catalog: Catalog) -> Optional[int]:
+    """Single datanode that can answer the whole query, or None.
+
+    Shippable when every sharded table is pinned by a dist-key = literal
+    conjunct to the same node and replicated tables fill the rest.  Any
+    subquery/sublink disables FQS here (the reference walks deeper;
+    pgxcship.c handles many more cases — future widening).
+    """
+    loc = Locator(catalog)
+    target: Optional[int] = None
+    for _, e in bq.targets:
+        if any(isinstance(x, SubLink) for x in E.walk(e)):
+            return None
+    for q in bq.where:
+        if any(isinstance(x, SubLink) for x in E.walk(q)):
+            return None
+    for rte in bq.rtable:
+        if rte.kind != "table":
+            return None
+        dt = rte.table.distribution.dist_type
+        if dt == DistType.REPLICATED:
+            continue
+        if dt not in (DistType.SHARD, DistType.HASH, DistType.MODULO):
+            return None
+        dist_cols = [f"{rte.alias}.{c}"
+                     for c in rte.table.distribution.dist_cols]
+        values = {}
+        for q in bq.where:
+            if isinstance(q, E.Cmp) and q.op == "=" \
+                    and isinstance(q.left, E.Col) \
+                    and isinstance(q.right, E.Lit) \
+                    and q.left.name in dist_cols:
+                values[q.left.name] = q.right.value
+        if set(values) != set(dist_cols):
+            return None
+        node = loc.node_for_values(
+            rte.table, [values[c] for c in dist_cols])
+        if node is None:
+            return None
+        if target is None:
+            target = node
+        elif target != node:
+            return None
+    return target
+
+
+# ---------------------------------------------------------------------------
+# distribution annotation + exchange insertion
+# ---------------------------------------------------------------------------
+
+class Distributor:
+    def __init__(self, catalog: Catalog, n_datanodes: int):
+        self.catalog = catalog
+        self.ndn = n_datanodes
+        self.exchanges: list[Exchange] = []
+        self.fragments: list[Fragment] = []
+
+    # -- main entry --
+    def distribute(self, planned: PlannedStmt,
+                   bq: BoundQuery) -> DistPlan:
+        fqs = fqs_target_node(bq, self.catalog) if bq is not None else None
+        if fqs is not None:
+            frag = Fragment(0, planned.plan, "dn")
+            return DistPlan([frag], [], 0, planned.init_plans,
+                            planned.output_names, fqs_node=fqs)
+
+        # distribute init plans too (each becomes its own DistPlan run by
+        # the executor before the main plan)
+        plan, dist = self._walk(planned.plan)
+        if dist.kind != "cn":
+            plan = self._add_gather(plan, one=(dist.kind == "replicated"))
+        top = self._fragmentize(plan, "cn")
+        return DistPlan(self.fragments, self.exchanges, top,
+                        planned.init_plans, planned.output_names)
+
+    # -- annotation walk: returns (new_plan, Dist) --
+    def _walk(self, node: P.PhysNode):
+        if isinstance(node, P.SeqScan):
+            dt = node.table.distribution
+            if dt.dist_type == DistType.REPLICATED:
+                return node, Dist("replicated")
+            keys = tuple(f"{node.alias}.{c}" for c in dt.dist_cols) \
+                if dt.dist_type == DistType.SHARD else ()
+            return node, Dist("sharded", keys)
+
+        if isinstance(node, P.Filter):
+            node.child, d = self._walk(node.child)
+            return node, d
+
+        if isinstance(node, P.Project):
+            node.child, d = self._walk(node.child)
+            # track dist keys through renames
+            if d.kind == "sharded" and d.keys:
+                out = []
+                for k in d.keys:
+                    hit = [n for n, e in node.outputs
+                           if isinstance(e, E.Col) and e.name == k]
+                    if not hit:
+                        return node, Dist("sharded", ())
+                    out.append(hit[0])
+                return node, Dist("sharded", tuple(out))
+            return node, d
+
+        if isinstance(node, P.HashJoin):
+            return self._walk_join(node)
+
+        if isinstance(node, P.Agg):
+            return self._walk_agg(node)
+
+        if isinstance(node, P.Sort):
+            node.child, d = self._walk(node.child)
+            if d.kind == "sharded":
+                # per-DN top-k, merge at CN, re-limit there
+                gathered = self._add_gather(node.child,
+                                            sort_keys=node.keys)
+                cn_sort = P.Sort(gathered, node.keys, node.limit)
+                return cn_sort, Dist("cn")
+            return node, d
+
+        if isinstance(node, P.Limit):
+            node.child, d = self._walk(node.child)
+            if d.kind == "sharded":
+                node.child = self._add_gather(node.child)
+                d = Dist("cn")
+            return node, d
+
+        if isinstance(node, P.Result):
+            return node, Dist("cn")
+
+        raise ValueError(f"cannot distribute {type(node).__name__}")
+
+    # -- joins --
+    def _join_pairs(self, node: P.HashJoin):
+        return list(zip(node.left_keys, node.right_keys))
+
+    def _walk_join(self, node: P.HashJoin):
+        node.left, ld = self._walk(node.left)
+        node.right, rd = self._walk(node.right)
+        pairs = self._join_pairs(node)
+
+        def sharded_on_join_key(d: Dist, side: int) -> Optional[int]:
+            """index of the join pair whose key == d.keys (single-key)."""
+            if d.kind != "sharded" or len(d.keys) != 1:
+                return None
+            for i, pr in enumerate(pairs):
+                k = pr[side]
+                if isinstance(k, E.Col) and k.name == d.keys[0]:
+                    return i
+            return None
+
+        li = sharded_on_join_key(ld, 0)
+        ri = sharded_on_join_key(rd, 1)
+
+        if node.kind == "cross":
+            if rd.kind != "replicated":
+                node.right = self._add_broadcast(node.right)
+            return node, (ld if ld.kind != "replicated"
+                          else Dist("replicated"))
+
+        # colocated: both sharded on the same join pair
+        if li is not None and ri is not None and li == ri:
+            return node, ld
+        if ld.kind == "replicated" and rd.kind == "replicated":
+            return node, Dist("replicated")
+        if rd.kind == "replicated" and ld.kind == "sharded":
+            return node, ld
+        if ld.kind == "replicated" and rd.kind == "sharded":
+            if node.kind == "inner":
+                return node, rd
+            # left/semi/anti with replicated probe side: broadcast build
+            node.right = self._add_broadcast(node.right)
+            return node, ld
+
+        # need movement.  Prefer keeping the already-aligned side.
+        if li is not None:
+            node.right = self._add_redistribute(node.right,
+                                                [pairs[li][1]])
+            return node, ld
+        if ri is not None:
+            node.left = self._add_redistribute(node.left, [pairs[ri][0]])
+            return node, rd
+        if not pairs:
+            # no equi keys (pure residual join): broadcast build side
+            node.right = self._add_broadcast(node.right)
+            return node, ld
+        # redistribute both by the full key set
+        node.left = self._add_redistribute(node.left,
+                                           [p[0] for p in pairs])
+        node.right = self._add_redistribute(node.right,
+                                            [p[1] for p in pairs])
+        lk = pairs[0][0]
+        return node, Dist("sharded",
+                          (lk.name,) if isinstance(lk, E.Col) and
+                          len(pairs) == 1 else ())
+
+    # -- aggregation --
+    def _walk_agg(self, node: P.Agg):
+        node.child, d = self._walk(node.child)
+        if d.kind in ("replicated", "cn"):
+            return node, d
+        key_names = set()
+        for _, ke in node.group_keys:
+            if isinstance(ke, E.Col):
+                key_names.add(ke.name)
+        if d.kind == "sharded" and d.keys and set(d.keys) <= key_names:
+            return node, d          # groups are node-local
+
+        distinct = any(ac.distinct for _, ac in node.aggs)
+        if node.group_keys and not distinct:
+            # partial per DN -> redistribute by group keys -> final
+            partial = P.Agg(node.child, node.group_keys, node.aggs,
+                            "partial")
+            red = self._add_redistribute(
+                partial, [E.Col(n, ke.type)
+                          for (n, ke) in node.group_keys])
+            final = P.Agg(red, [(n, E.Col(n, ke.type))
+                                for (n, ke) in node.group_keys],
+                          node.aggs, "final")
+            return final, Dist("sharded",
+                               (node.group_keys[0][0],)
+                               if len(node.group_keys) == 1 else ())
+        if node.group_keys:
+            # distinct aggs: move whole groups to their owner node first
+            red = self._add_redistribute(
+                node.child, [ke for (_, ke) in node.group_keys])
+            node.child = red
+            return node, Dist("sharded", ())
+        if distinct:
+            # global count(DISTINCT): per-DN distinct counts cannot be
+            # summed (values straddle nodes) — gather the rows, dedupe at CN
+            node.child = self._add_gather(node.child)
+            return node, Dist("cn")
+        # global aggregate: partial per DN -> gather -> final at CN
+        partial = P.Agg(node.child, [], node.aggs, "partial")
+        gathered = self._add_gather(partial)
+        final = P.Agg(gathered, [], node.aggs, "final")
+        return final, Dist("cn")
+
+    # -- exchange insertion --
+    def _add_redistribute(self, child: P.PhysNode,
+                          keys: list[E.Expr]) -> P.PhysNode:
+        return P.Redistribute(child, keys)
+
+    def _add_broadcast(self, child: P.PhysNode) -> P.PhysNode:
+        return P.Broadcast(child)
+
+    def _add_gather(self, child: P.PhysNode, sort_keys=None,
+                    one: bool = False) -> P.PhysNode:
+        return P.Gather(child, sort_keys or [], one)
+
+    # -- fragmentation at exchange boundaries --
+    def _fragmentize(self, plan: P.PhysNode, location: str) -> int:
+        """Cut at exchange nodes; returns the index of the fragment whose
+        plan is `plan` with exchange children replaced by ExchangeRef."""
+
+        def cut(node: P.PhysNode) -> P.PhysNode:
+            if isinstance(node, (P.Redistribute, P.Broadcast, P.Gather)):
+                child_loc = "dn"
+                src = self._fragmentize(node.child, child_loc)
+                kind = {"Redistribute": "redistribute",
+                        "Broadcast": "broadcast",
+                        "Gather": "gather"}[type(node).__name__]
+                if kind == "gather" and getattr(node, "one", False):
+                    kind = "gather_one"
+                ex = Exchange(len(self.exchanges), kind,
+                              getattr(node, "keys", []), src,
+                              sort_keys=getattr(node, "sort_keys", []))
+                self.exchanges.append(ex)
+                return ExchangeRef(ex.index)
+            for attr in ("child", "left", "right"):
+                c = getattr(node, attr, None)
+                if isinstance(c, P.PhysNode):
+                    setattr(node, attr, cut(c))
+            return node
+
+        body = cut(plan)
+        frag = Fragment(len(self.fragments), body, location)
+        self.fragments.append(frag)
+        return frag.index

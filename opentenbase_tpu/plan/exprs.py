@@ -87,6 +87,11 @@ class Arith(Expr):
         a, b = self.left.type, self.right.type
         if self.op == "/":
             t = FLOAT64
+        elif self.op == "%":
+            if TypeKind.DECIMAL in (a.kind, b.kind) or \
+                    TypeKind.FLOAT64 in (a.kind, b.kind):
+                raise ExprError("modulo requires integer operands")
+            t = _common_numeric(a, b)
         elif self.op == "*" and TypeKind.DECIMAL in (a.kind, b.kind) \
                 and TypeKind.FLOAT64 not in (a.kind, b.kind):
             t = decimal_t(30, a.scale + b.scale)

@@ -157,6 +157,7 @@ class Gather(PhysNode):
     child: PhysNode = None
     sort_keys: list[tuple[E.Expr, bool]] = dataclasses.field(
         default_factory=list)   # merge-sorted gather (SimpleSort analog)
+    one: bool = False           # replicated child: read a single node
 
     def children(self):
         return [self.child]

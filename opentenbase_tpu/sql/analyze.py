@@ -367,7 +367,7 @@ class Binder:
         right = b(node.right)
         if node.op in ("=", "<>", "<", "<=", ">", ">="):
             return self._bind_cmp(node.op, left, right)
-        if node.op in ("+", "-", "*", "/"):
+        if node.op in ("+", "-", "*", "/", "%"):
             left, right = self._coerce_pair(left, right)
             return E.Arith(node.op, left, right)
         if node.op == "||":

@@ -1,0 +1,54 @@
+"""Fault injection — named crash/error points in distributed-txn windows.
+
+Reference analog: src/backend/utils/xact_whitebox — named stub points
+covering every 2PC failure mode (xact_whitebox_stubnames.c:
+REMOTE_PREPARE_SEND_ALL_FAILED, REMOTE_COMMIT_SEND_ALL_FAILED, ...),
+toggled by config.  Tests arm a point; the code path calls
+`fault_point(name)` which raises InjectedFault when armed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_armed: dict[str, int] = {}
+_lock = threading.Lock()
+
+# the 2PC windows (named after the reference's stub points)
+POINTS = (
+    "REMOTE_PREPARE_BEFORE_SEND",
+    "REMOTE_PREPARE_AFTER_SEND",       # prepared on DNs, GTM not told
+    "AFTER_GTM_PREPARE",               # GTM knows, no commit ts yet
+    "AFTER_GTM_COMMIT_BEFORE_DN",      # decided commit, DNs not told
+    "REMOTE_COMMIT_PARTIAL",           # some DNs committed, then crash
+    "BEFORE_GTM_FORGET",
+)
+
+
+class InjectedFault(Exception):
+    def __init__(self, point: str):
+        super().__init__(f"injected fault at {point}")
+        self.point = point
+
+
+def arm(point: str, times: int = 1):
+    with _lock:
+        _armed[point] = times
+
+
+def disarm(point: str = None):
+    with _lock:
+        if point is None:
+            _armed.clear()
+        else:
+            _armed.pop(point, None)
+
+
+def fault_point(point: str):
+    with _lock:
+        n = _armed.get(point, 0)
+        if n > 0:
+            _armed[point] = n - 1
+            if _armed[point] == 0:
+                del _armed[point]
+            raise InjectedFault(point)
