@@ -1,0 +1,167 @@
+"""Cluster lifecycle tool — the pgxc_ctl / opentenbase_ctl analog
+(reference: contrib/pgxc_ctl README.md:96-123, contrib/opentenbase_ctl).
+
+Subcommands:
+  init     <dir> --datanodes N        lay out a cluster directory
+  start    <dir>                      start gtm + datanode servers
+                                      (in this process, threaded; prints
+                                      addresses and serves until ^C)
+  shell    <dir> [--connect host:port,...]   interactive SQL shell
+  status   <dir>                      node liveness (health-map analog)
+
+Python -m entry: python -m opentenbase_tpu.cli.ctl <cmd> ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def cmd_init(args):
+    os.makedirs(args.dir, exist_ok=True)
+    cfg = {"datanodes": args.datanodes, "gtm_port": args.gtm_port,
+           "dn_base_port": args.dn_base_port}
+    with open(os.path.join(args.dir, "cluster.json"), "w") as f:
+        json.dump(cfg, f, indent=2)
+    # build the initial catalog (node registry + shard map)
+    from ..parallel.cluster import Cluster
+    Cluster(n_datanodes=args.datanodes, datadir=args.dir).checkpoint()
+    print(f"initialized cluster dir {args.dir} "
+          f"({args.datanodes} datanodes)")
+
+
+def _load_cfg(d):
+    with open(os.path.join(d, "cluster.json")) as f:
+        return json.load(f)
+
+
+def cmd_start(args):
+    cfg = _load_cfg(args.dir)
+    from ..gtm.server import GtmCore, GtmServer
+    from ..net.dn_server import DnServer
+    gtm_core = GtmCore(os.path.join(args.dir, "gtm.json"))
+    gtm = GtmServer(gtm_core, port=cfg["gtm_port"]).start()
+    print(f"gtm listening on {gtm.host}:{gtm.port}")
+    catalog_path = os.path.join(args.dir, "catalog.json")
+    servers = []
+    for i in range(cfg["datanodes"]):
+        srv = DnServer(i, os.path.join(args.dir, f"dn{i}"), catalog_path,
+                       gtm_addr=(gtm.host, gtm.port),
+                       port=cfg["dn_base_port"] + i).start()
+        servers.append(srv)
+        print(f"dn{i} listening on {srv.host}:{srv.port}")
+    addrs = {"gtm": [gtm.host, gtm.port],
+             "datanodes": [[s.host, s.port] for s in servers]}
+    with open(os.path.join(args.dir, "addresses.json"), "w") as f:
+        json.dump(addrs, f)
+    print("cluster up; ^C to stop")
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        for s in servers:
+            s.stop()
+        gtm.stop()
+
+
+def _connect(args):
+    from ..exec.dist_session import ClusterSession
+    from ..parallel.cluster import Cluster
+    addrpath = os.path.join(args.dir, "addresses.json")
+    if os.path.exists(addrpath):
+        with open(addrpath) as f:
+            addrs = json.load(f)
+        cluster = Cluster.connect(
+            os.path.join(args.dir, "catalog.json"),
+            [tuple(a) for a in addrs["datanodes"]],
+            tuple(addrs["gtm"]))
+    else:
+        cluster = Cluster(datadir=args.dir)   # embedded (centralized) mode
+    return ClusterSession(cluster)
+
+
+def cmd_shell(args):
+    s = _connect(args)
+    print("opentenbase_tpu shell — \\q to quit")
+    buf = []
+    while True:
+        try:
+            line = input("otb=# " if not buf else "otb-# ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return
+        if line.strip() in ("\\q", "exit", "quit"):
+            return
+        buf.append(line)
+        if not line.rstrip().endswith(";"):
+            continue
+        sql = "\n".join(buf)
+        buf = []
+        try:
+            for r in s.execute(sql):
+                if r.names:
+                    print(" | ".join(r.names))
+                    print("-+-".join("-" * len(n) for n in r.names))
+                    for row in r.rows:
+                        print(" | ".join(str(v) for v in row))
+                    print(f"({len(r.rows)} row"
+                          f"{'s' if len(r.rows) != 1 else ''})")
+                else:
+                    print(r.command
+                          + (f" {r.rowcount}" if r.rowcount else ""))
+        except Exception as e:
+            print(f"ERROR: {type(e).__name__}: {e}")
+
+
+def cmd_status(args):
+    addrpath = os.path.join(args.dir, "addresses.json")
+    if not os.path.exists(addrpath):
+        print("cluster not started (no addresses.json)")
+        return
+    with open(addrpath) as f:
+        addrs = json.load(f)
+    from ..gtm.server import GtmClient
+    from ..net.dn_server import RemoteDataNode
+    try:
+        GtmClient(*addrs["gtm"]).call(op="ping")
+        print(f"gtm {addrs['gtm'][0]}:{addrs['gtm'][1]}: up")
+    except Exception:
+        print(f"gtm {addrs['gtm'][0]}:{addrs['gtm'][1]}: DOWN")
+    for i, (h, p) in enumerate(addrs["datanodes"]):
+        ok = RemoteDataNode(i, h, p).ping()
+        print(f"dn{i} {h}:{p}: {'up' if ok else 'DOWN'}")
+
+
+def main(argv=None):
+    # select a live jax backend up front (falls back to CPU when the TPU
+    # tunnel is unreachable) so sessions never block in backend init
+    from ..utils.backend import ensure_alive_backend
+    ensure_alive_backend(timeout_s=45)
+
+    ap = argparse.ArgumentParser(prog="opentenbase_tpu_ctl")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("init")
+    p.add_argument("dir")
+    p.add_argument("--datanodes", type=int, default=2)
+    p.add_argument("--gtm-port", type=int, default=7777)
+    p.add_argument("--dn-base-port", type=int, default=7800)
+    p.set_defaults(fn=cmd_init)
+    p = sub.add_parser("start")
+    p.add_argument("dir")
+    p.set_defaults(fn=cmd_start)
+    p = sub.add_parser("shell")
+    p.add_argument("dir")
+    p.set_defaults(fn=cmd_shell)
+    p = sub.add_parser("status")
+    p.add_argument("dir")
+    p.set_defaults(fn=cmd_status)
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

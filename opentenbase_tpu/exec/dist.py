@@ -41,16 +41,20 @@ from .executor import DBatch, ExecContext, ExecError, Executor, materialize
 
 @dataclasses.dataclass
 class HostBatch:
-    """Exchange wire format: host numpy columns, TEXT as decoded values."""
+    """Exchange wire format: host numpy columns, TEXT as decoded values,
+    NULL masks carried alongside (outer-join null extension survives
+    exchange boundaries)."""
     cols: dict[str, np.ndarray]       # TEXT columns: object arrays of str
     types: dict[str, SqlType]
     nrows: int
+    nulls: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
 
 
 def _to_host(b: DBatch) -> HostBatch:
     valid = np.asarray(b.valid)
     idx = np.nonzero(valid)[0]
     cols = {}
+    nulls = {}
     for n, arr in b.cols.items():
         a = np.asarray(arr)[idx]
         t = b.types[n]
@@ -59,12 +63,11 @@ def _to_host(b: DBatch) -> HostBatch:
             a = np.asarray([d[int(c)] if 0 <= int(c) < len(d) else ""
                             for c in a], dtype=object)
         if n in b.nulls:
-            # exchanges carry no null masks yet: outer-join nulls above an
-            # exchange boundary are not supported in this tier
-            raise ExecError("NULL-bearing columns cannot cross an "
-                            "exchange yet")
+            m = np.asarray(b.nulls[n])[idx]
+            if m.any():
+                nulls[n] = m
         cols[n] = a
-    return HostBatch(cols, dict(b.types), len(idx))
+    return HostBatch(cols, dict(b.types), len(idx), nulls)
 
 
 def _concat_host(parts: list[HostBatch]) -> HostBatch:
@@ -72,12 +75,19 @@ def _concat_host(parts: list[HostBatch]) -> HostBatch:
     first = parts[0]
     cols = {n: np.concatenate([p.cols[n] for p in parts])
             for n in first.cols}
-    return HostBatch(cols, first.types, sum(p.nrows for p in parts))
+    nulls = {}
+    null_names = set()
+    for p in parts:
+        null_names |= set(p.nulls)
+    for n in null_names:
+        nulls[n] = np.concatenate(
+            [p.nulls.get(n, np.zeros(p.nrows, dtype=bool)) for p in parts])
+    return HostBatch(cols, first.types, sum(p.nrows for p in parts), nulls)
 
 
 def _to_device(hb: HostBatch) -> DBatch:
     padded = next_pow2(max(hb.nrows, 1))
-    cols, dicts = {}, {}
+    cols, dicts, nulls = {}, {}, {}
     for n, arr in hb.cols.items():
         t = hb.types[n]
         if t.kind == TypeKind.TEXT:
@@ -100,8 +110,12 @@ def _to_device(hb: HostBatch) -> DBatch:
             buf = np.zeros(padded, dtype=arr.dtype)
             buf[:len(arr)] = arr
             cols[n] = jnp.asarray(buf)
+    for n, m in hb.nulls.items():
+        buf = np.zeros(padded, dtype=bool)
+        buf[:len(m)] = m
+        nulls[n] = jnp.asarray(buf)
     valid = jnp.asarray(np.arange(padded) < hb.nrows)
-    return DBatch(cols, valid, dict(hb.types), dicts)
+    return DBatch(cols, valid, dict(hb.types), dicts, nulls)
 
 
 class DistExecutor:
@@ -135,9 +149,16 @@ class DistExecutor:
 
     def _run_distplan(self, dp: DistPlan) -> DBatch:
         if dp.fqs_node is not None:
-            # whole-query shipped to one datanode (FQS)
-            return self._exec_fragment_on(dp.fragments[dp.top_fragment],
-                                          dp, dp.fqs_node, {})
+            # whole-query shipped to one datanode (FQS).  An in-process
+            # datanode returns the device batch directly (no host
+            # round-trip on the OLTP fast path).
+            dn = self.cluster.datanodes[dp.fqs_node]
+            frag = dp.fragments[dp.top_fragment]
+            if hasattr(dn, "exec_plan_device"):
+                return dn.exec_plan_device(frag.plan, self.snapshot_ts,
+                                           self.txid, self.params, {})
+            return _to_device(dn.exec_plan(frag.plan, self.snapshot_ts,
+                                           self.txid, self.params, {}))
         # exchange outputs, keyed (exchange_index, dest) where dest is a
         # dn index or 'cn'
         ex_out: dict = {}
@@ -158,10 +179,9 @@ class DistExecutor:
         only_one = consumers and all(ex.kind == "gather_one"
                                      for ex in consumers)
         dn_range = [0] if only_one else list(range(self.cluster.ndn))
-        per_dn: list[HostBatch] = []
-        for dn_idx in dn_range:
-            batch = self._exec_fragment_on(frag, dp, dn_idx, ex_out)
-            per_dn.append(_to_host(batch))
+        per_dn: list[HostBatch] = [
+            self._exec_fragment_on(frag, dp, dn_idx, ex_out)
+            for dn_idx in dn_range]
         for ex in consumers:
             if ex.kind == "gather_one":
                 ex_out[(ex.index, "cn")] = per_dn[0]
@@ -205,7 +225,8 @@ class DistExecutor:
                 if m.any():
                     outs[d].append(HostBatch(
                         {n: a[m] for n, a in hb.cols.items()},
-                        hb.types, int(m.sum())))
+                        hb.types, int(m.sum()),
+                        {n: a[m] for n, a in hb.nulls.items()}))
         return [
             _concat_host(o) if o else
             HostBatch({n: np.empty(0, dtype=(object
@@ -233,33 +254,35 @@ class DistExecutor:
 
     # ------------------------------------------------------------------
     def _exec_fragment_on(self, frag: Fragment, dp: DistPlan, where,
-                          ex_out: dict) -> DBatch:
-        """Run one fragment at `where` ('cn' or dn index)."""
-        plan = _bind_sources(frag.plan, ex_out, where)
+                          ex_out: dict):
+        """Run one fragment at `where` ('cn' or dn index).  Returns a
+        DBatch for 'cn', a HostBatch from a datanode (the datanode may be
+        remote — its exec_plan is the RPC surface)."""
+        sources = {ex_idx: hb for (ex_idx, dest), hb in ex_out.items()
+                   if dest == where}
         if where == "cn":
-            stores = {}
-            cache = self.cluster.datanodes[0].cache
-        else:
-            dn = self.cluster.datanodes[where]
-            stores = dn.stores
-            cache = dn.cache
-        ctx = ExecContext(stores, self.snapshot_ts, self.txid, cache,
-                          params=dict(self.params))
-        return Executor(ctx).exec_node(plan)
+            from .executor import DeviceTableCache
+            plan = _bind_sources_host(frag.plan, sources)
+            ctx = ExecContext({}, self.snapshot_ts, self.txid,
+                              DeviceTableCache(),
+                              params=dict(self.params))
+            return Executor(ctx).exec_node(plan)
+        dn = self.cluster.datanodes[where]
+        return dn.exec_plan(frag.plan, self.snapshot_ts, self.txid,
+                            self.params, sources)
 
 
-def _bind_sources(node: P.PhysNode, ex_out: dict, where):
+def _bind_sources_host(node: P.PhysNode, sources: dict):
     """Copy the fragment plan with ExchangeRef leaves replaced by
-    BatchSource(batch-for-this-destination)."""
+    BatchSource over the staged exchange input."""
     if isinstance(node, ExchangeRef):
-        hb = ex_out.get((node.index, where))
+        hb = sources.get(node.index)
         if hb is None:
-            raise ExecError(f"exchange {node.index} has no output for "
-                            f"{where}")
+            raise ExecError(f"exchange {node.index} has no input here")
         return BatchSource(_to_device(hb))
     clone = dataclasses.replace(node)
     for attr in ("child", "left", "right"):
         c = getattr(clone, attr, None)
         if isinstance(c, P.PhysNode):
-            setattr(clone, attr, _bind_sources(c, ex_out, where))
+            setattr(clone, attr, _bind_sources_host(c, sources))
     return clone

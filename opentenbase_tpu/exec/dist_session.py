@@ -33,11 +33,8 @@ class ClusterTxn:
     def __init__(self, txid: int, snapshot_ts: int):
         self.txid = txid
         self.snapshot_ts = snapshot_ts
-        self.written: dict[int, list] = {}   # dn index -> [(kind, st, span)]
+        self.written_dns: set[int] = set()   # 2PC participant tracking
         self.explicit = False
-
-    def track(self, dn_idx: int, kind: str, st, span):
-        self.written.setdefault(dn_idx, []).append((kind, st, span))
 
 
 class ClusterSession:
@@ -61,10 +58,10 @@ class ClusterSession:
         return t, True
 
     def _commit(self, t: ClusterTxn):
-        self.cluster.commit_txn(t.txid, t.written, {})
+        self.cluster.commit_txn(t.txid, sorted(t.written_dns))
 
     def _abort(self, t: ClusterTxn):
-        self.cluster.abort_txn(t.txid, t.written)
+        self.cluster.abort_txn(t.txid, t.written_dns)
 
     # ------------------------------------------------------------------
     def _exec_stmt(self, stmt: A.Node) -> Result:
@@ -191,23 +188,12 @@ class ClusterSession:
             for dn_idx, idx in dests.items():
                 if len(idx) == 0:
                     continue
-                dn = c.datanodes[dn_idx]
-                st = dn.stores[td.name]
                 sub = {cn: [coldata[cn][j] for j in idx]
                        for cn in coldata}
-                enc = {cn: st.encode_column(cn, vals)
-                       for cn, vals in sub.items()}
                 sub_sid = sid[idx] if sid is not None else None
-                dn.log({"op": "insert", "table": td.name, "n": len(idx),
-                        "txid": t.txid,
-                        "shardids": sub_sid,
-                        "columns": {cn: (np.asarray(v, dtype=object)
-                                         if td.column(cn).type.kind
-                                         == TypeKind.TEXT
-                                         else np.asarray(enc[cn]))
-                                    for cn, v in sub.items()}})
-                spans = st.insert(enc, len(idx), t.txid, shardids=sub_sid)
-                t.track(dn_idx, "ins", st, spans)
+                c.datanodes[dn_idx].insert_raw(td.name, sub, len(idx),
+                                               t.txid, sub_sid)
+                t.written_dns.add(dn_idx)
         except Exception:
             if implicit:
                 self._abort(t)
@@ -228,29 +214,13 @@ class ClusterSession:
                                from_=[A.TableRef(stmt.table)],
                                where=stmt.where)
             quals = binder.bind_select(sel).where
-        from .expr_compile import compile_expr
         n_deleted = 0
         try:
             for dn in c.datanodes:
-                st = dn.stores[td.name]
-                for ci, ch in st.scan_chunks():
-                    vis = st.visible_mask(ch, t.snapshot_ts, t.txid)
-                    mask = vis
-                    if quals:
-                        colmap = {f"{stmt.table}.{col.name}":
-                                  ch.columns[col.name][:ch.nrows]
-                                  for col in td.columns}
-                        dicts = {f"{stmt.table}.{k}": d
-                                 for k, d in st.dicts.items()}
-                        for q in quals:
-                            mask = mask & np.asarray(
-                                compile_expr(q, dicts)(colmap))
-                    if mask.any():
-                        span = st.mark_delete(ci, mask, t.txid)
-                        t.track(dn.index, "del", st, span)
-                        dn.log({"op": "delete", "table": td.name,
-                                "chunk": ci, "mask": mask, "txid": t.txid})
-                        n_deleted += int(mask.sum())
+                nd = dn.delete_where(td.name, quals, t.snapshot_ts, t.txid)
+                if nd:
+                    t.written_dns.add(dn.index)
+                n_deleted += nd
         except Exception:
             if implicit:
                 self._abort(t)
@@ -373,8 +343,10 @@ class ClusterSession:
         binder = Binder(self.cluster.catalog)
         bq = binder.bind_select(inner[0])
         planned = Planner(self.cluster.catalog).plan(bq)
+        if planned.init_plans:
+            raise ExecError("EXECUTE DIRECT does not support subqueries")
         t, _ = self._begin_implicit()
-        ctx = ExecContext(dn.stores, t.snapshot_ts, t.txid, dn.cache)
-        batch = Executor(ctx).run(planned)
-        names, rows = materialize(batch, planned.output_names)
+        from .dist import _to_device
+        hb = dn.exec_plan(planned.plan, t.snapshot_ts, t.txid, {}, {})
+        names, rows = materialize(_to_device(hb), planned.output_names)
         return Result("SELECT", names=names, rows=rows, rowcount=len(rows))

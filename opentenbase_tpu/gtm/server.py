@@ -267,7 +267,7 @@ class GtmClient:
             finally:
                 self._sock = None
 
-    # typed helpers
+    # typed helpers (mirror GtmCore's surface so Cluster can use either)
     def next_gts(self) -> int:
         return self.call(op="gts")["ts"]
 
@@ -277,3 +277,29 @@ class GtmClient:
     def begin(self) -> tuple[int, int]:
         r = self.call(op="begin")
         return r["txid"], r["ts"]
+
+    def seq_create(self, name, start=1, increment=1):
+        self.call(op="seq_create", name=name, start=start,
+                  increment=increment)
+
+    def seq_next(self, name, cache=1) -> int:
+        return self.call(op="seq_next", name=name, cache=cache)["v"]
+
+    def prepare_txn(self, gid, participants, txid):
+        self.call(op="prepare", gid=gid, participants=participants,
+                  txid=txid)
+
+    def commit_txn(self, gid, ts):
+        self.call(op="commit", gid=gid, ts=ts)
+
+    def abort_txn(self, gid):
+        self.call(op="abort", gid=gid)
+
+    def forget_txn(self, gid):
+        self.call(op="forget", gid=gid)
+
+    def txn_verdict(self, gid) -> str:
+        return self.call(op="verdict", gid=gid)["state"]
+
+    def prepared_list(self) -> dict:
+        return self.call(op="prepared_list")["prepared"]

@@ -26,8 +26,13 @@ from ..utils.faultinject import fault_point
 
 
 class DataNode:
-    """One datanode: table stores + WAL + device cache.
-    (reference: a DN postgres instance; here the storage+exec state)"""
+    """One datanode: table stores + WAL + device cache + executor service.
+
+    (reference: a DN postgres instance.)  The public service surface —
+    ddl_create/ddl_drop, insert_raw, delete_where, exec_plan,
+    prepare/commit/abort, checkpoint_node — is everything the coordinator
+    uses; net/dn_server.py exposes exactly these methods over TCP so the
+    in-process and multi-process deployments share one code path."""
 
     def __init__(self, index: int, datadir: Optional[str] = None):
         self.index = index
@@ -35,9 +40,116 @@ class DataNode:
         self.cache = DeviceTableCache()
         self.datadir = datadir
         self.wal: Optional[Wal] = None
-        self.prepared: dict[str, list] = {}   # gid -> replay ops (in-doubt)
+        self.txn_spans: dict[int, list] = {}  # txid -> [(kind, table, span)]
         if datadir:
             os.makedirs(datadir, exist_ok=True)
+
+    # ---- service surface -------------------------------------------------
+    def ddl_create(self, td: TableDef):
+        if td.name not in self.stores:
+            self.stores[td.name] = TableStore(td)
+            self.log({"op": "create_table", "table": td.to_json()})
+
+    def ddl_drop(self, name: str):
+        st = self.stores.pop(name, None)
+        if st is not None:
+            self.cache.invalidate(st)
+        self.log({"op": "drop_table", "name": name})
+
+    def insert_raw(self, table: str, coldata: dict, n: int, txid: int,
+                   shardids=None) -> int:
+        """Insert raw (unencoded) values; encoding happens node-side where
+        the dictionaries live."""
+        st = self.stores[table]
+        td = st.td
+        enc = {cn: st.encode_column(cn, vals)
+               for cn, vals in coldata.items()}
+        self.log({"op": "insert", "table": table, "n": n, "txid": txid,
+                  "shardids": shardids,
+                  "columns": {cn: (np.asarray(v, dtype=object)
+                                   if td.column(cn).type.kind
+                                   == TypeKind.TEXT
+                                   else np.asarray(enc[cn]))
+                              for cn, v in coldata.items()}})
+        spans = st.insert(enc, n, txid, shardids=shardids)
+        self.txn_spans.setdefault(txid, []).append(("ins", table, spans))
+        return n
+
+    def delete_where(self, table: str, quals: list, snapshot_ts: int,
+                     txid: int) -> int:
+        from ..exec.expr_compile import compile_expr
+        st = self.stores[table]
+        td = st.td
+        n_deleted = 0
+        for ci, ch in st.scan_chunks():
+            mask = st.visible_mask(ch, snapshot_ts, txid)
+            if quals:
+                colmap = {f"{table}.{col.name}":
+                          ch.columns[col.name][:ch.nrows]
+                          for col in td.columns}
+                dicts = {f"{table}.{k}": d for k, d in st.dicts.items()}
+                for q in quals:
+                    mask = mask & np.asarray(compile_expr(q, dicts)(colmap))
+            if mask.any():
+                span = st.mark_delete(ci, mask, txid)
+                self.txn_spans.setdefault(txid, []).append(
+                    ("del", table, span))
+                self.log({"op": "delete", "table": table, "chunk": ci,
+                          "mask": mask, "txid": txid})
+                n_deleted += int(mask.sum())
+        return n_deleted
+
+    def exec_plan_device(self, plan, snapshot_ts: int, txid: int,
+                         params: dict, sources: dict):
+        """In-process fast path: run a fragment and return the device
+        batch directly (no host materialization) — used for FQS where the
+        coordinator and datanode share the process."""
+        from ..exec.dist import _bind_sources_host
+        from ..exec.executor import ExecContext, Executor
+        bound = _bind_sources_host(plan, sources)
+        ctx = ExecContext(self.stores, snapshot_ts, txid, self.cache,
+                          params=dict(params))
+        return Executor(ctx).exec_node(bound)
+
+    def exec_plan(self, plan, snapshot_ts: int, txid: int,
+                  params: dict, sources: dict):
+        """Run a plan fragment against this node's stores; exchange inputs
+        arrive as HostBatches keyed by exchange index."""
+        from ..exec.dist import _to_host
+        return _to_host(self.exec_plan_device(plan, snapshot_ts, txid,
+                                              params, sources))
+
+    def prepare(self, gid: str, txid: int):
+        self.log({"op": "prepare", "gid": gid, "txid": txid}, sync=True)
+
+    def commit(self, txid: int, ts: int):
+        self.log({"op": "commit", "txid": txid, "ts": int(ts)}, sync=True)
+        for kind, table, sp in self.txn_spans.pop(txid, []):
+            st = self.stores.get(table)
+            if st is None:
+                continue
+            if kind == "ins":
+                st.backfill_insert(sp, np.int64(ts))
+            else:
+                st.backfill_delete([sp], np.int64(ts))
+
+    def abort(self, txid: int):
+        ops = self.txn_spans.pop(txid, [])
+        if ops:
+            self.log({"op": "abort", "txid": txid})
+        for kind, table, sp in ops:
+            st = self.stores.get(table)
+            if st is None:
+                continue
+            if kind == "ins":
+                st.abort_insert(sp)
+            else:
+                st.revert_delete([sp])
+
+    def wrote_in(self, txid: int) -> bool:
+        return bool(self.txn_spans.get(txid))
+
+    # ---- infrastructure --------------------------------------------------
 
     def open_wal(self):
         if self.datadir:
@@ -178,6 +290,30 @@ class Cluster:
                     dn.stores[td.name] = TableStore(td)
             dn.open_wal()
 
+    @classmethod
+    def connect(cls, catalog_path: str, dn_addrs: list[tuple],
+                gtm_addr: tuple) -> "Cluster":
+        """Multi-process mode: attach to running DN servers and GTM
+        (reference: a CN joining the cluster via pgxc_node + pooler)."""
+        from ..gtm.server import GtmClient
+        from ..net.dn_server import RemoteDataNode
+        self = object.__new__(cls)
+        self.datadir = os.path.dirname(catalog_path) or "."
+        self.catalog = Catalog.load(catalog_path) \
+            if os.path.exists(catalog_path) else Catalog()
+        if not self.catalog.datanodes():
+            for i, (h, p) in enumerate(dn_addrs):
+                self.catalog.register_node(
+                    NodeDef(f"dn{i}", "datanode", host=h, port=p, index=i))
+            self.catalog.build_default_shard_map(len(dn_addrs))
+        self.gtm = GtmClient(*gtm_addr)
+        self.datanodes = [RemoteDataNode(i, h, p)
+                          for i, (h, p) in enumerate(dn_addrs)]
+        self.locator = Locator(self.catalog)
+        self.active_txns = set()
+        self.gucs = {"enable_fast_query_shipping": "on"}
+        return self
+
     @property
     def ndn(self) -> int:
         return len(self.datanodes)
@@ -190,19 +326,14 @@ class Cluster:
     def create_table(self, td: TableDef, if_not_exists: bool = False):
         td = self.catalog.create_table(td, if_not_exists)
         for dn in self.datanodes:
-            if td.name not in dn.stores:
-                dn.stores[td.name] = TableStore(td)
-                dn.log({"op": "create_table", "table": td.to_json()})
+            dn.ddl_create(td)
         self._save_catalog()
         return td
 
     def drop_table(self, name: str, if_exists: bool = False):
         self.catalog.drop_table(name, if_exists)
         for dn in self.datanodes:
-            st = dn.stores.pop(name, None)
-            if st is not None:
-                dn.cache.invalidate(st)
-            dn.log({"op": "drop_table", "name": name})
+            dn.ddl_drop(name)
         self._save_catalog()
 
     def checkpoint(self) -> bool:
@@ -216,59 +347,44 @@ class Cluster:
 
     # ---- distributed commit (reference: execRemote.c
     # pgxc_node_remote_prepare :3944 / pgxc_node_remote_commit :4883) ----
-    def commit_txn(self, txid: int, written: dict[int, list],
-                   logs_per_dn: dict[int, bool]) -> int:
-        """written: dn_index -> [(kind, store, span)].  Returns commit ts."""
-        dns = [i for i, ops in written.items() if ops]
+    def commit_txn(self, txid: int, dns: Optional[list[int]] = None) -> int:
+        """Commit on every datanode the txn wrote to; implicit 2PC when
+        more than one.  The coordinator passes the participant list it
+        tracked (one RPC per participant); falls back to polling wrote_in.
+        Returns commit ts."""
+        if dns is None:
+            dns = [dn.index for dn in self.datanodes if dn.wrote_in(txid)]
         if len(dns) <= 1:
-            ts = np.int64(self.gtm.next_gts())
+            ts = int(self.gtm.next_gts())
             for i in dns:
-                self.datanodes[i].log({"op": "commit", "txid": txid,
-                                       "ts": int(ts)}, sync=True)
-            self._apply_commit(written, ts)
+                self.datanodes[i].commit(txid, ts)
             self.active_txns.discard(txid)
-            return int(ts)
+            return ts
 
         # implicit 2PC
         gid = f"gxid_{txid}"
         fault_point("REMOTE_PREPARE_BEFORE_SEND")
         for i in dns:
-            self.datanodes[i].log({"op": "prepare", "gid": gid,
-                                   "txid": txid}, sync=True)
+            self.datanodes[i].prepare(gid, txid)
         fault_point("REMOTE_PREPARE_AFTER_SEND")
         self.gtm.prepare_txn(gid, [f"dn{i}" for i in dns], txid)
         fault_point("AFTER_GTM_PREPARE")
-        ts = np.int64(self.gtm.next_gts())
-        self.gtm.commit_txn(gid, int(ts))
+        ts = int(self.gtm.next_gts())
+        self.gtm.commit_txn(gid, ts)
         fault_point("AFTER_GTM_COMMIT_BEFORE_DN")
         for k, i in enumerate(dns):
             if k == 1:
                 fault_point("REMOTE_COMMIT_PARTIAL")
-            self.datanodes[i].log({"op": "commit", "txid": txid,
-                                   "ts": int(ts), "gid": gid}, sync=True)
-            self._apply_commit({i: written[i]}, ts)
+            self.datanodes[i].commit(txid, ts)
         fault_point("BEFORE_GTM_FORGET")
         self.gtm.forget_txn(gid)
         self.active_txns.discard(txid)
-        return int(ts)
+        return ts
 
-    def _apply_commit(self, written: dict[int, list], ts):
-        for ops in written.values():
-            for kind, st, sp in ops:
-                if kind == "ins":
-                    st.backfill_insert(sp, ts)
-                else:
-                    st.backfill_delete([sp], ts)
-
-    def abort_txn(self, txid: int, written: dict[int, list]):
-        for i, ops in written.items():
-            if ops:
-                self.datanodes[i].log({"op": "abort", "txid": txid})
-            for kind, st, sp in ops:
-                if kind == "ins":
-                    st.abort_insert(sp)
-                else:
-                    st.revert_delete([sp])
+    def abort_txn(self, txid: int, dns: Optional[set] = None):
+        for dn in self.datanodes:
+            if dns is None or dn.index in dns:
+                dn.abort(txid)
         self.active_txns.discard(txid)
 
     # ---- in-doubt resolver (reference: clean2pc launcher/workers) ----
@@ -281,5 +397,5 @@ class Cluster:
                 self.gtm.forget_txn(gid)
             elif info["state"] in ("prepared", "aborted"):
                 for dn in self.datanodes:
-                    dn.log({"op": "abort", "txid": info["txid"]})
+                    dn.abort(info["txid"])
                 self.gtm.forget_txn(gid)

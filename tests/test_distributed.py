@@ -182,6 +182,24 @@ class TestAggRegressions:
                                           / 10)
 
 
+class TestNullsAcrossExchanges:
+    def test_left_join_nulls_cross_gather(self, cs):
+        cs.execute("create table r2 (k2 bigint primary key, "
+                   "v2 decimal(10,2)) distribute by shard(k2)")
+        cs.execute("insert into r2 values (1, 100)")
+        got = cs.query("select k, v2 from t left join r2 on k = k2 "
+                       "where k < 4 order by k")
+        assert got == [(0, None), (1, 100.0), (2, None), (3, None)]
+
+    def test_left_join_null_agg_distributed(self, cs):
+        cs.execute("create table r3 (k3 bigint primary key, "
+                   "v3 decimal(10,2)) distribute by shard(k3)")
+        cs.execute("insert into r3 values (1, 100), (2, 50)")
+        got = cs.query("select count(v3), sum(v3) from t "
+                       "left join r3 on k = k3")
+        assert got == [(2, 150.0)]
+
+
 class TestSequences:
     def test_global_sequence(self, cs):
         cs.execute("create sequence sq start with 5 increment by 2")

@@ -1,0 +1,104 @@
+"""Multi-process(-style) deployment: CN talking to DN servers + GTM over
+real TCP sockets (servers run as threads here; the protocol and process
+separation are identical to subprocess deployment — the reference tests
+multi-node the same way, all on localhost: opentenbase_test.py:45-48)."""
+
+import os
+
+import pytest
+
+from opentenbase_tpu.exec.dist_session import ClusterSession
+from opentenbase_tpu.gtm.server import GtmClient, GtmCore, GtmServer
+from opentenbase_tpu.net.dn_server import DnServer, RemoteDataNode
+from opentenbase_tpu.parallel.cluster import Cluster
+
+
+@pytest.fixture()
+def tcp_cluster(tmp_path):
+    d = str(tmp_path)
+    # init catalog via an embedded cluster, then serve it over TCP
+    Cluster(n_datanodes=2, datadir=d).checkpoint()
+    gtm = GtmServer(GtmCore(os.path.join(d, "gtm.json"))).start()
+    catalog_path = os.path.join(d, "catalog.json")
+    servers = [DnServer(i, os.path.join(d, f"dn{i}"), catalog_path,
+                        gtm_addr=(gtm.host, gtm.port)).start()
+               for i in range(2)]
+    cluster = Cluster.connect(catalog_path,
+                              [(s.host, s.port) for s in servers],
+                              (gtm.host, gtm.port))
+    yield ClusterSession(cluster), servers, gtm, d
+    for s in servers:
+        s.stop()
+    gtm.stop()
+
+
+class TestTcpCluster:
+    def test_end_to_end_sql(self, tcp_cluster):
+        s, servers, gtm, d = tcp_cluster
+        s.execute("create table t (k bigint primary key, v decimal(10,2)) "
+                  "distribute by shard(k)")
+        rows = ", ".join(f"({i}, {i}.25)" for i in range(20))
+        s.execute(f"insert into t values {rows}")
+        # rows actually live in the server processes
+        counts = [srv.node.stores["t"].row_count() for srv in servers]
+        assert sum(counts) == 20 and all(c > 0 for c in counts)
+        assert s.query("select count(*), sum(v) from t") == \
+            [(20, 20 * 19 / 2 + 20 * 0.25)]
+        assert s.query("select v from t where k = 7") == [(7.25,)]
+
+    def test_distributed_join_over_tcp(self, tcp_cluster):
+        s, *_ = tcp_cluster
+        s.execute("create table a (x bigint primary key) "
+                  "distribute by shard(x)")
+        s.execute("create table b (y bigint primary key, x2 bigint) "
+                  "distribute by shard(y)")
+        s.execute("insert into a values (1), (2), (3)")
+        s.execute("insert into b values (10, 1), (20, 2), (30, 9)")
+        assert s.query("select count(*) from a, b where x = x2") == [(2,)]
+
+    def test_2pc_over_tcp_and_gtm(self, tcp_cluster):
+        s, servers, gtm, d = tcp_cluster
+        s.execute("create table t2 (k bigint primary key) "
+                  "distribute by shard(k)")
+        s.execute("begin")
+        rows = ", ".join(f"({i})" for i in range(30))
+        s.execute(f"insert into t2 values {rows}")
+        s.execute("commit")
+        assert s.query("select count(*) from t2") == [(30,)]
+
+    def test_gtm_client_monotonic(self, tcp_cluster):
+        s, servers, gtm, d = tcp_cluster
+        c = GtmClient(gtm.host, gtm.port)
+        ts = [c.next_gts() for _ in range(10)]
+        assert ts == sorted(ts) and len(set(ts)) == 10
+
+    def test_dn_restart_recovers_over_tcp(self, tcp_cluster, tmp_path):
+        s, servers, gtm, d = tcp_cluster
+        s.execute("create table t3 (k bigint primary key, "
+                  "name varchar(10)) distribute by shard(k)")
+        s.execute("insert into t3 values (1, 'a'), (2, 'b'), (3, 'c')")
+        # stop dn servers, restart from their datadirs
+        for srv in servers:
+            srv.stop()
+        catalog_path = os.path.join(d, "catalog.json")
+        new_servers = [DnServer(i, os.path.join(d, f"dn{i}"), catalog_path,
+                                gtm_addr=(gtm.host, gtm.port)).start()
+                       for i in range(2)]
+        try:
+            cluster2 = Cluster.connect(
+                catalog_path, [(x.host, x.port) for x in new_servers],
+                (gtm.host, gtm.port))
+            s2 = ClusterSession(cluster2)
+            assert s2.query("select count(*) from t3") == [(3,)]
+            assert s2.query("select name from t3 where k = 2") == [("b",)]
+        finally:
+            for srv in new_servers:
+                srv.stop()
+
+    def test_node_health(self, tcp_cluster):
+        s, servers, gtm, d = tcp_cluster
+        proxy = RemoteDataNode(0, servers[0].host, servers[0].port)
+        assert proxy.ping() is True
+        servers[0].stop()
+        proxy.close()
+        assert proxy.ping() is False
