@@ -30,7 +30,8 @@ class TypeKind(enum.Enum):
     FLOAT64 = "float64"
     DECIMAL = "decimal"
     DATE = "date"
-    TEXT = "text"  # dictionary-encoded
+    TEXT = "text"    # dictionary-encoded
+    VECTOR = "vector"  # fixed-dim float32 (pgvector analog)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,7 +39,7 @@ class SqlType:
     kind: TypeKind
     precision: int = 0  # DECIMAL only
     scale: int = 0      # DECIMAL only: value = int64 * 10**-scale
-    max_len: int = 0    # CHAR/VARCHAR declared length (metadata only)
+    max_len: int = 0    # CHAR/VARCHAR declared length; VECTOR dimension
 
     # ---- storage dtype of the physical column array ----
     @property
@@ -50,8 +51,18 @@ class SqlType:
             TypeKind.FLOAT64: np.dtype(np.float64),
             TypeKind.DECIMAL: np.dtype(np.int64),
             TypeKind.DATE: np.dtype(np.int32),
-            TypeKind.TEXT: np.dtype(np.int32),  # dictionary code
+            TypeKind.TEXT: np.dtype(np.int32),   # dictionary code
+            TypeKind.VECTOR: np.dtype(np.float32),
         }[self.kind]
+
+    @property
+    def dim(self) -> int:
+        """Column array trailing dimension: VECTOR columns are 2D."""
+        return self.max_len if self.kind == TypeKind.VECTOR else 0
+
+    @property
+    def shape_suffix(self) -> tuple:
+        return (self.max_len,) if self.kind == TypeKind.VECTOR else ()
 
     @property
     def is_numeric(self) -> bool:
@@ -99,6 +110,10 @@ def type_from_name(name: str, args: tuple[int, ...] = ()) -> SqlType:
         return decimal(p, s)
     if name in ("char", "varchar", "character"):
         return SqlType(TypeKind.TEXT, max_len=args[0] if args else 0)
+    if name == "vector":
+        if not args:
+            raise ValueError("vector type requires a dimension")
+        return SqlType(TypeKind.VECTOR, max_len=args[0])
     if name == "double precision":
         return FLOAT64
     if name in _NAME_MAP:

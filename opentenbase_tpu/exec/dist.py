@@ -107,7 +107,7 @@ def _to_device(hb: HostBatch) -> DBatch:
             cols[n] = jnp.asarray(buf)
             dicts[n] = values
         else:
-            buf = np.zeros(padded, dtype=arr.dtype)
+            buf = np.zeros((padded, *np.shape(arr)[1:]), dtype=arr.dtype)
             buf[:len(arr)] = arr
             cols[n] = jnp.asarray(buf)
     for n, m in hb.nulls.items():
@@ -119,11 +119,17 @@ def _to_device(hb: HostBatch) -> DBatch:
 
 
 class DistExecutor:
-    def __init__(self, cluster: Cluster, snapshot_ts: int, txid: int):
+    def __init__(self, cluster: Cluster, snapshot_ts: int, txid: int,
+                 instrument: bool = False):
         self.cluster = cluster
         self.snapshot_ts = snapshot_ts
         self.txid = txid
         self.params: dict[str, tuple] = {}
+        self.instrument = instrument
+        # (fragment, where) -> {"ms": float, "rows": int} — the
+        # distributed-EXPLAIN instrumentation the reference ships DN->CN
+        # (commands/explain_dist.c)
+        self.stats: dict = {}
 
     # ------------------------------------------------------------------
     def run(self, dp: DistPlan) -> DBatch:
@@ -260,16 +266,28 @@ class DistExecutor:
         remote — its exec_plan is the RPC surface)."""
         sources = {ex_idx: hb for (ex_idx, dest), hb in ex_out.items()
                    if dest == where}
+        import time as _time
+        t0 = _time.perf_counter() if self.instrument else 0
         if where == "cn":
             from .executor import DeviceTableCache
             plan = _bind_sources_host(frag.plan, sources)
             ctx = ExecContext({}, self.snapshot_ts, self.txid,
                               DeviceTableCache(),
                               params=dict(self.params))
-            return Executor(ctx).exec_node(plan)
+            out = Executor(ctx).exec_node(plan)
+            if self.instrument:
+                self.stats[(frag.index, where)] = {
+                    "ms": (_time.perf_counter() - t0) * 1e3,
+                    "rows": out.count()}
+            return out
         dn = self.cluster.datanodes[where]
-        return dn.exec_plan(frag.plan, self.snapshot_ts, self.txid,
-                            self.params, sources)
+        out = dn.exec_plan(frag.plan, self.snapshot_ts, self.txid,
+                           self.params, sources)
+        if self.instrument:
+            self.stats[(frag.index, where)] = {
+                "ms": (_time.perf_counter() - t0) * 1e3,
+                "rows": out.nrows}
+        return out
 
 
 def _bind_sources_host(node: P.PhysNode, sources: dict):

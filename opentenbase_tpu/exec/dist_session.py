@@ -79,6 +79,16 @@ class ClusterSession:
             c.gtm.seq_create(sd.name, sd.start, sd.increment)
             return Result("CREATE SEQUENCE")
         if isinstance(stmt, A.CreateIndexStmt):
+            if stmt.method == "ivfflat":
+                td = c.catalog.table(stmt.table)
+                col = stmt.columns[0]
+                from ..catalog.types import TypeKind as TK
+                if td.column(col).type.kind != TK.VECTOR:
+                    raise ExecError("ivfflat requires a vector column")
+                lists = int(stmt.options.get("lists", 0))
+                metric = str(stmt.options.get("metric", "l2"))
+                for dn in c.datanodes:
+                    dn.build_ann_index(stmt.table, col, lists, metric)
             return Result("CREATE INDEX")
         if isinstance(stmt, A.InsertStmt):
             return self._exec_insert(stmt)
@@ -121,13 +131,41 @@ class ClusterSession:
         d = Distributor(self.cluster.catalog, self.cluster.ndn)
         return d.distribute(planned, bq if fqs_enabled else None)
 
-    def _exec_select(self, stmt: A.SelectStmt) -> Result:
+    def _refresh_stat_views(self, stmt: A.SelectStmt):
+        from ..parallel import statviews
+
+        # collect every table name anywhere in the statement, including
+        # WHERE/target-list subqueries
+        names = []
+
+        def walk(obj):
+            if isinstance(obj, A.TableRef):
+                names.append(obj.name)
+            if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+                for f in dataclasses.fields(obj):
+                    walk(getattr(obj, f.name))
+            elif isinstance(obj, (list, tuple)):
+                for x in obj:
+                    walk(x)
+
+        walk(stmt)
+        wanted = statviews.referenced_stat_tables(names)
+        if wanted:
+            statviews.refresh(self.cluster, self, wanted)
+
+    def _exec_select(self, stmt: A.SelectStmt,
+                     instrument: bool = False) -> tuple:
+        self._refresh_stat_views(stmt)
         dp = self._plan_distributed(stmt)
         t, implicit = self._begin_implicit()
-        ex = DistExecutor(self.cluster, t.snapshot_ts, t.txid)
+        ex = DistExecutor(self.cluster, t.snapshot_ts, t.txid,
+                          instrument=instrument)
         batch = ex.run(dp)
         names, rows = materialize(batch, dp.output_names)
-        return Result("SELECT", names=names, rows=rows, rowcount=len(rows))
+        res = Result("SELECT", names=names, rows=rows, rowcount=len(rows))
+        if instrument:
+            return res, ex.stats, dp
+        return res
 
     # ---- writes ----
     def _exec_insert(self, stmt: A.InsertStmt) -> Result:
@@ -320,9 +358,16 @@ class ClusterSession:
         text = "\n".join(lines)
         if stmt.analyze:
             t0 = time.perf_counter()
-            self._exec_select(stmt.stmt)
-            text += (f"\nExecution Time: "
-                     f"{(time.perf_counter()-t0)*1e3:.2f} ms")
+            _, stats, dp2 = self._exec_select(stmt.stmt, instrument=True)
+            total = (time.perf_counter() - t0) * 1e3
+            # per-fragment DN instrumentation shipped back to the CN
+            # (reference: commands/explain_dist.c)
+            for (fidx, where), st in sorted(stats.items(),
+                                            key=lambda kv: kv[0][0]):
+                loc = "CN" if where == "cn" else f"dn{where}"
+                text += (f"\n  Fragment {fidx} @ {loc}: "
+                         f"rows={st['rows']} time={st['ms']:.2f} ms")
+            text += f"\nExecution Time: {total:.2f} ms"
         return Result("EXPLAIN", names=["QUERY PLAN"],
                       rows=[(ln,) for ln in text.split("\n")], text=text)
 

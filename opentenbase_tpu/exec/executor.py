@@ -103,9 +103,14 @@ class DeviceTableCache:
             else:
                 parts = [ch.columns[name][:ch.nrows] for _, ch in
                          store.scan_chunks()]
-                dt = store.td.column(name).type.np_dtype
-            host = np.concatenate(parts) if parts else np.empty(0, dt)
-            buf = np.zeros(padded, dtype=host.dtype)
+                ct = store.td.column(name).type
+                dt = ct.np_dtype
+                if not parts:
+                    parts = [np.empty((0, *ct.shape_suffix), dt)]
+            if not parts:
+                parts = [np.empty(0, dt)]
+            host = np.concatenate(parts)
+            buf = np.zeros((padded, *host.shape[1:]), dtype=host.dtype)
             buf[:n] = host
             arrs[name] = jax.device_put(buf)
         self._cache[key] = (ver, arrs, n)
@@ -186,15 +191,19 @@ class Executor:
         return m(node)
 
     # ---- scan ----
-    def _exec_seqscan(self, node: P.SeqScan) -> DBatch:
-        store = self.ctx.stores.get(node.table.name)
+    def _scan_base(self, table, alias: str, filters, outputs,
+                   extra_needed: set = frozenset()):
+        """Shared scan scaffolding (SeqScan + AnnSearch): stage needed
+        columns via the device cache, build the qualified-name eval
+        namespace, fuse MVCC visibility + filter quals into one mask."""
+        store = self.ctx.stores.get(table.name)
         if store is None:
-            raise ExecError(f"no store for table {node.table.name}")
+            raise ExecError(f"no store for table {table.name}")
         # substitute init-plan results first: a '__initplanN' Col is a
         # parameter, not a table column
-        filters = [self._prep(f) for f in node.filters]
-        outputs = [(n, self._prep(e)) for n, e in (node.outputs or [])]
-        needed = set()
+        filters = [self._prep(f) for f in filters]
+        outputs = [(n, self._prep(e)) for n, e in (outputs or [])]
+        needed = set(extra_needed)
         for f in filters:
             needed |= {c.split(".", 1)[1] if "." in c else c
                        for c in _cols_of(f)}
@@ -203,12 +212,9 @@ class Executor:
                        for c in _cols_of(oe)}
         arrs, n = self.ctx.cache.get(store, sorted(needed))
 
-        # build an eval namespace with *qualified* names
-        qcols = {}
-        types = {}
-        dicts = {}
+        qcols, types, dicts = {}, {}, {}
         for c in store.td.columns:
-            qname = f"{node.alias}.{c.name}"
+            qname = f"{alias}.{c.name}"
             if c.name in arrs:
                 qcols[qname] = arrs[c.name]
             types[qname] = c.type
@@ -224,7 +230,12 @@ class Executor:
         vis = vis & (jnp.arange(padded) < n)
         for f in filters:
             vis = vis & self._eval(f, base)
+        return store, base, vis, arrs, n, padded, outputs, dicts
 
+    def _exec_seqscan(self, node: P.SeqScan) -> DBatch:
+        (_store, base, vis, _arrs, _n, _padded, outputs,
+         dicts) = self._scan_base(node.table, node.alias, node.filters,
+                                  node.outputs)
         out_cols, out_types, out_dicts = {}, {}, {}
         for name, oe in outputs:
             out_cols[name] = self._eval(oe, base)
@@ -233,6 +244,42 @@ class Executor:
             if d is not None:
                 out_dicts[name] = d
         return DBatch(out_cols, vis, out_types, out_dicts)
+
+    def _exec_annsearch(self, node) -> DBatch:
+        """Top-k vector search: visibility+filters mask, IVF probe when an
+        index exists, exact distances otherwise, lax.top_k, gather."""
+        from ..ops import ann as ANN
+        plain_vec = node.vec_col.split(".", 1)[1] if "." in node.vec_col \
+            else node.vec_col
+        (store, base, valid, arrs, n, padded, outputs,
+         dicts) = self._scan_base(node.table, node.alias, node.filters,
+                                  node.outputs, {plain_vec})
+        vecs = arrs[plain_vec]
+        q = jnp.asarray(np.asarray(node.query, dtype=np.float32))
+        k = min(node.k, padded)
+        idx_info = store.ann_indexes.get(plain_vec)
+        if idx_info is not None and idx_info["metric"] == node.metric:
+            assign, centroids = _ann_assignments(store, plain_vec, vecs, n)
+            nprobe = min(idx_info["nprobe"], centroids.shape[0])
+            idx, dist = ANN.ivf_search(vecs, assign, centroids, q, valid,
+                                       nprobe, k, node.metric)
+        else:
+            d = ANN.distances(vecs, q, node.metric)
+            idx, dist = ANN.topk_nearest(d, valid, k)
+        found = int(jnp.sum(jnp.isfinite(dist)))
+
+        out_cols, out_types, out_dicts = {}, {}, {}
+        for name, oe in outputs:
+            if isinstance(oe, E.DistExpr):
+                out_cols[name] = dist.astype(jnp.float64)
+            else:
+                out_cols[name] = self._eval(oe, base)[idx]
+            out_types[name] = oe.type
+            dd = _dict_for_expr(oe, dicts)
+            if dd is not None:
+                out_dicts[name] = dd
+        out_valid = jnp.arange(k) < found
+        return DBatch(out_cols, out_valid, out_types, out_dicts)
 
     # ---- filter / project ----
     def _exec_filter(self, node: P.Filter) -> DBatch:
@@ -732,6 +779,23 @@ def _cols_of(e: E.Expr) -> set[str]:
     return {x.name for x in E.walk(e) if isinstance(x, E.Col)}
 
 
+def _ann_assignments(store, col: str, vecs, n: int):
+    """Cluster assignments for the IVF index, recomputed lazily when rows
+    were added since the build (pgvector re-lists on insert; we re-assign
+    on demand — one matmul)."""
+    import jax.numpy as _jnp
+
+    from ..ops import ann as ANN
+    info = store.ann_indexes[col]
+    centroids = _jnp.asarray(info["centroids"])
+    cached = info.get("_assign_cache")
+    if cached is not None and cached[0] == store.version:
+        return cached[1], centroids
+    assign = ANN.assign_clusters(vecs, centroids, info["metric"])
+    info["_assign_cache"] = (store.version, assign)
+    return assign, centroids
+
+
 def _dict_for_expr(e: E.Expr, dicts: dict):
     """Decode dictionary for a TEXT-valued expr output (transformed for
     TextExpr — many codes may map to one string downstream)."""
@@ -767,6 +831,8 @@ def materialize(b: DBatch, names: Optional[list[str]] = None):
             vals = [bool(v) for v in arr]
         elif t.kind == TypeKind.FLOAT64:
             vals = [float(v) for v in arr]
+        elif t.kind == TypeKind.VECTOR:
+            vals = [tuple(float(x) for x in v) for v in arr]
         else:
             vals = [int(v) for v in arr]
         if nullm is not None:

@@ -254,6 +254,14 @@ def compile_expr(e: E.Expr, dicts: dict) -> Callable[[Arrays], object]:
             name = x.col.name
             return lambda cols: cols[name]
 
+        if isinstance(x, E.DistExpr):
+            from ..ops.ann import distances
+            name = x.col.name
+            q = np.asarray(x.query, dtype=np.float32)
+            metric = x.metric
+            return lambda cols: distances(cols[name], jnp.asarray(q),
+                                          metric).astype(jnp.float64)
+
         if isinstance(x, E.Extract):
             f = c(x.arg)
             idx = {"year": 0, "month": 1, "day": 2}[x.field]

@@ -260,7 +260,15 @@ class Session:
             self.node.catalog.create_sequence(sequence_def_from_ast(stmt))
             return Result("CREATE SEQUENCE")
         if isinstance(stmt, A.CreateIndexStmt):
-            return Result("CREATE INDEX")   # metadata-only (no index AM yet)
+            if stmt.method == "ivfflat":
+                try:
+                    self.node.stores[stmt.table].build_ann_index(
+                        stmt.columns[0],
+                        int(stmt.options.get("lists", 0)),
+                        str(stmt.options.get("metric", "l2")))
+                except ValueError as e:
+                    raise ExecError(str(e)) from None
+            return Result("CREATE INDEX")
         if isinstance(stmt, A.InsertStmt):
             return self._exec_insert(stmt)
         if isinstance(stmt, A.DeleteStmt):

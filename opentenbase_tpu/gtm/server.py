@@ -142,6 +142,12 @@ class GtmCore:
         with self._lock:
             return dict(self._prepared)
 
+    def stats(self) -> dict:
+        """Read-only observability snapshot (no timestamp allocation)."""
+        with self._lock:
+            return {"ts": self._ts, "txid": self._txid,
+                    "prepared": len(self._prepared)}
+
 
 class GtmServer:
     """Threaded TCP front end for GtmCore (the reference's thread-pool +
@@ -202,6 +208,8 @@ class GtmServer:
                                 msg["gid"])}
                         elif op == "prepared_list":
                             resp = {"prepared": core_ref.prepared_list()}
+                        elif op == "stats":
+                            resp = {"stats": core_ref.stats()}
                         elif op == "ping":
                             resp = {"pong": True}
                         else:
@@ -303,3 +311,6 @@ class GtmClient:
 
     def prepared_list(self) -> dict:
         return self.call(op="prepared_list")["prepared"]
+
+    def stats(self) -> dict:
+        return self.call(op="stats")["stats"]

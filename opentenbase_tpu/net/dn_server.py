@@ -98,6 +98,11 @@ def _dispatch(node: DataNode, msg: dict):
         return node.exec_plan(msg["plan"], msg["snapshot_ts"],
                               msg["txid"], msg.get("params", {}),
                               msg.get("sources", {}))
+    if op == "build_ann_index":
+        return node.build_ann_index(msg["table"], msg["col"],
+                                    msg.get("lists", 0),
+                                    msg.get("metric", "l2"),
+                                    msg.get("nprobe", 0))
     if op == "prepare":
         return node.prepare(msg["gid"], msg["txid"])
     if op == "commit":
@@ -181,6 +186,10 @@ class RemoteDataNode:
         return self._call(op="exec_plan", plan=plan,
                           snapshot_ts=snapshot_ts, txid=txid,
                           params=params, sources=sources)
+
+    def build_ann_index(self, table, col, lists=0, metric="l2", nprobe=0):
+        return self._call(op="build_ann_index", table=table, col=col,
+                          lists=lists, metric=metric, nprobe=nprobe)
 
     def prepare(self, gid, txid):
         return self._call(op="prepare", gid=gid, txid=txid)

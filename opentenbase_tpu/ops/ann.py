@@ -1,0 +1,114 @@
+"""Vector ANN kernels — the pgvector analog (reference:
+contrib/pgvector — vector type + IVFFlat/HNSW; named in BASELINE.json
+config 4).  TPU-first design: distance evaluation is a single (n,d)x(d,)
+matmul riding the MXU (pgvector's per-tuple SIMD loops collapse into one
+GEMV); IVFFlat assignment/probing are the same matmuls against the
+centroid matrix; k-means build is Lloyd iterations of matmul + masked
+reductions."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+METRICS = ("l2", "cosine", "ip")
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def distances(vecs, q, metric: str = "l2"):
+    """vecs: (n, d) f32, q: (d,) f32 -> (n,) f32 distances."""
+    vecs = vecs.astype(jnp.float32)
+    q = q.astype(jnp.float32)
+    dots = vecs @ q                              # MXU GEMV
+    if metric == "ip":
+        return -dots
+    if metric == "cosine":
+        vn = jnp.sqrt(jnp.sum(vecs * vecs, axis=1))
+        qn = jnp.sqrt(jnp.sum(q * q))
+        return 1.0 - dots / jnp.maximum(vn * qn, 1e-30)
+    # l2 (squared -> sqrt at the end, monotone either way)
+    vn2 = jnp.sum(vecs * vecs, axis=1)
+    qn2 = jnp.sum(q * q)
+    return jnp.sqrt(jnp.maximum(vn2 - 2.0 * dots + qn2, 0.0))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk_nearest(dists, valid, k: int):
+    """Smallest-k by distance among valid rows -> (indexes, dists)."""
+    masked = jnp.where(valid, dists, jnp.inf)
+    neg_top, idx = jax.lax.top_k(-masked, k)
+    return idx, -neg_top
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def assign_clusters(vecs, centroids, metric: str = "l2"):
+    """(n, d), (nlist, d) -> (n,) nearest-centroid id (one matmul)."""
+    vecs = vecs.astype(jnp.float32)
+    c = centroids.astype(jnp.float32)
+    dots = vecs @ c.T                            # (n, nlist) on the MXU
+    if metric == "ip":
+        scores = dots
+    elif metric == "cosine":
+        vn = jnp.sqrt(jnp.sum(vecs * vecs, axis=1, keepdims=True))
+        cn = jnp.sqrt(jnp.sum(c * c, axis=1))
+        scores = dots / jnp.maximum(vn * cn[None, :], 1e-30)
+    else:
+        cn2 = jnp.sum(c * c, axis=1)
+        scores = 2.0 * dots - cn2[None, :]       # argmin l2 == argmax this
+    return jnp.argmax(scores, axis=1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("nlist",))
+def _lloyd_step(vecs, valid, centroids, nlist: int):
+    assign = assign_clusters(vecs, centroids)
+    assign = jnp.where(valid, assign, nlist)
+    ones = valid.astype(jnp.float32)
+    counts = jax.ops.segment_sum(ones, assign, num_segments=nlist + 1)
+    sums = jax.ops.segment_sum(
+        vecs * ones[:, None], assign, num_segments=nlist + 1)
+    new = sums[:nlist] / jnp.maximum(counts[:nlist, None], 1.0)
+    # empty clusters keep their previous centroid
+    new = jnp.where(counts[:nlist, None] > 0, new, centroids)
+    return new
+
+
+def kmeans(vecs: np.ndarray, nlist: int, iters: int = 8,
+           seed: int = 17) -> np.ndarray:
+    """Lloyd k-means for the IVF coarse quantizer (host-driven loop,
+    device steps)."""
+    n = len(vecs)
+    rng = np.random.default_rng(seed)
+    init = vecs[rng.choice(n, size=min(nlist, n), replace=False)]
+    if len(init) < nlist:   # fewer rows than lists
+        init = np.concatenate(
+            [init, rng.normal(size=(nlist - len(init), vecs.shape[1]))
+             .astype(np.float32)])
+    c = jnp.asarray(init, dtype=jnp.float32)
+    v = jnp.asarray(vecs, dtype=jnp.float32)
+    valid = jnp.ones(n, dtype=bool)
+    for _ in range(iters):
+        c = _lloyd_step(v, valid, c, nlist)
+    return np.asarray(c)
+
+
+@functools.partial(jax.jit, static_argnames=("nprobe", "k", "metric"))
+def ivf_search(vecs, assign, centroids, q, valid,
+               nprobe: int, k: int, metric: str = "l2"):
+    """Probe the nprobe nearest lists, exact-rank candidates, top-k.
+
+    Static-shape trick: instead of gathering candidate rows (dynamic), we
+    mask rows whose list is not probed to +inf distance — the distance
+    matmul runs over all rows (still one GEMV; HBM-bound either way at
+    these sizes) and the *selectivity* win is in skipping nothing but
+    ranking correctness: identical results to pgvector's probe semantics.
+    """
+    cd = distances(centroids, q, metric)
+    _, probe = jax.lax.top_k(-cd, nprobe)
+    probed = jnp.zeros(centroids.shape[0] + 1, dtype=bool) \
+        .at[probe].set(True)
+    in_probe = probed[jnp.clip(assign, 0, centroids.shape[0])]
+    d = distances(vecs, q, metric)
+    return topk_nearest(d, valid & in_probe, k)

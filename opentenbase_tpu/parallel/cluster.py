@@ -119,6 +119,12 @@ class DataNode:
         return _to_host(self.exec_plan_device(plan, snapshot_ts, txid,
                                               params, sources))
 
+    def build_ann_index(self, table: str, col: str, lists: int = 0,
+                        metric: str = "l2", nprobe: int = 0) -> int:
+        """Build an IVFFlat index over a VECTOR column on this node."""
+        return self.stores[table].build_ann_index(col, lists, metric,
+                                                  nprobe)
+
     def prepare(self, gid: str, txid: int):
         self.log({"op": "prepare", "gid": gid, "txid": txid}, sync=True)
 
@@ -289,6 +295,8 @@ class Cluster:
                 for td in self.catalog.tables.values():
                     dn.stores[td.name] = TableStore(td)
             dn.open_wal()
+        from . import statviews
+        statviews.register(self)
 
     @classmethod
     def connect(cls, catalog_path: str, dn_addrs: list[tuple],
@@ -312,6 +320,8 @@ class Cluster:
         self.locator = Locator(self.catalog)
         self.active_txns = set()
         self.gucs = {"enable_fast_query_shipping": "on"}
+        from . import statviews
+        statviews.register(self)
         return self
 
     @property

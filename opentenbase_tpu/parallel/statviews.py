@@ -1,0 +1,113 @@
+"""Cluster system views — observability surfaces queryable in SQL.
+
+Reference analog: pg_stat_cluster_activity + fn page stats + pg_prepared_
+xacts (catalog/system_views.sql:726,758,1598) and the pgstat collector.
+Implemented as virtual tables materialized on read: the coordinator
+refreshes the backing rows (on datanode 0, SINGLE distribution) right
+before a query that references them.
+
+Views:
+- otb_stat_tables(table_name, datanode, rows, version)
+- otb_stat_gtm(current_gts, next_txid, active_txns, prepared_txns)
+- otb_prepared_xacts(gid, state, txid, commit_ts)
+- otb_nodes(name, kind, host, port, healthy)
+"""
+
+from __future__ import annotations
+
+from ..catalog.schema import ColumnDef, Distribution, DistType, TableDef
+from ..catalog import types as T
+
+STAT_TABLES = {
+    "otb_stat_tables": [
+        ColumnDef("table_name", T.TEXT), ColumnDef("datanode", T.INT32),
+        ColumnDef("rows", T.INT64), ColumnDef("version", T.INT64)],
+    "otb_stat_gtm": [
+        ColumnDef("current_gts", T.INT64), ColumnDef("next_txid", T.INT64),
+        ColumnDef("active_txns", T.INT64),
+        ColumnDef("prepared_txns", T.INT64)],
+    "otb_prepared_xacts": [
+        ColumnDef("gid", T.TEXT), ColumnDef("state", T.TEXT),
+        ColumnDef("txid", T.INT64), ColumnDef("commit_ts", T.INT64)],
+    "otb_nodes": [
+        ColumnDef("name", T.TEXT), ColumnDef("kind", T.TEXT),
+        ColumnDef("host", T.TEXT), ColumnDef("port", T.INT32),
+        ColumnDef("healthy", T.BOOL)],
+}
+
+
+def register(cluster):
+    """Create the view tables in the catalog (idempotent)."""
+    for name, cols in STAT_TABLES.items():
+        if name not in cluster.catalog.tables:
+            td = TableDef(name, list(cols), Distribution(DistType.SINGLE))
+            cluster.catalog.create_table(td, if_not_exists=True)
+            for dn in cluster.datanodes:
+                dn.ddl_create(td)
+
+
+def referenced_stat_tables(sql_tables) -> list[str]:
+    return [t for t in sql_tables if t in STAT_TABLES]
+
+
+def refresh(cluster, session, names: list[str]):
+    """Re-materialize the requested views (rows live on datanode 0)."""
+    gtm = cluster.gtm
+    for name in names:
+        rows = []
+        if name == "otb_stat_tables":
+            for dn in cluster.datanodes:
+                for tname in cluster.catalog.tables:
+                    if tname in STAT_TABLES:
+                        continue
+                    if hasattr(dn, "stores"):
+                        st = dn.stores.get(tname)
+                        if st is not None:
+                            rows.append((tname, dn.index, st.row_count(),
+                                         st.version))
+                    else:
+                        rows.append((tname, dn.index,
+                                     dn.row_count(tname), -1))
+        elif name == "otb_stat_gtm":
+            st = gtm.stats()   # read-only: never allocates a timestamp
+            rows.append((st["ts"], st["txid"],
+                         len(cluster.active_txns), st["prepared"]))
+        elif name == "otb_prepared_xacts":
+            for gid, info in gtm.prepared_list().items():
+                rows.append((gid, info["state"], info["txid"],
+                             info.get("commit_ts", 0)))
+        elif name == "otb_nodes":
+            for nd in cluster.catalog.nodes.values():
+                if nd.kind == "datanode" and nd.index < cluster.ndn:
+                    dn = cluster.datanodes[nd.index]
+                    healthy = dn.ping() if hasattr(dn, "ping") else True
+                else:
+                    healthy = True
+                rows.append((nd.name, nd.kind, nd.host, nd.port, healthy))
+        _replace_rows(cluster, name, rows)
+
+
+def _replace_rows(cluster, name: str, rows: list[tuple]):
+    from ..storage.store import TableStore
+    td = cluster.catalog.table(name)
+    dn0 = cluster.datanodes[0]
+    if hasattr(dn0, "stores"):
+        old = dn0.stores.get(name)
+        if old is not None:
+            dn0.cache.invalidate(old)   # evict the replaced store's buffers
+        st = TableStore(td)
+        if rows:
+            cols = {c.name: [r[i] for r in rows]
+                    for i, c in enumerate(td.columns)}
+            enc = {cn: st.encode_column(cn, v) for cn, v in cols.items()}
+            st.insert(enc, len(rows), txid=1, commit_ts=1)
+        dn0.stores[name] = st
+    else:
+        # remote datanode: rebuild over RPC
+        dn0.ddl_drop(name)
+        dn0.ddl_create(td)
+        if rows:
+            cols = {c.name: [r[i] for r in rows]
+                    for i, c in enumerate(td.columns)}
+            dn0.insert_raw(name, cols, len(rows), txid=1)
+            dn0.commit(1, 1)

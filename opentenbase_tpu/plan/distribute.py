@@ -172,6 +172,19 @@ class Distributor:
                 if dt.dist_type == DistType.SHARD else ()
             return node, Dist("sharded", keys)
 
+        if isinstance(node, P.AnnSearch):
+            dt = node.table.distribution
+            if dt.dist_type == DistType.REPLICATED:
+                return node, Dist("replicated")
+            # per-DN top-k, merge by distance at CN (pgvector on XC does
+            # exactly this shape: DN IVFFlat scans under a CN merge)
+            from ..catalog import types as T
+            gathered = self._add_gather(node)
+            cn_sort = P.Sort(gathered,
+                             [(E.Col(node.dist_name, T.FLOAT64), False)],
+                             node.k)
+            return cn_sort, Dist("cn")
+
         if isinstance(node, P.Filter):
             node.child, d = self._walk(node.child)
             return node, d

@@ -231,6 +231,21 @@ class StrPred(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class DistExpr(Expr):
+    """Vector distance: col <metric> constant-query (pgvector's
+    <-> / <=> / <#> operators).  type FLOAT64."""
+    metric: str              # 'l2' | 'cosine' | 'ip'
+    col: Col                 # VECTOR column
+    query: tuple             # query vector as a tuple of floats
+
+    def __post_init__(self):
+        object.__setattr__(self, "type", FLOAT64)
+
+    def children(self):
+        return (self.col,)
+
+
+@dataclasses.dataclass(frozen=True)
 class Extract(Expr):
     """EXTRACT(field FROM date) -> INT32.  field: year|month|day."""
     field: str

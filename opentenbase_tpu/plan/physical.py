@@ -164,6 +164,26 @@ class Gather(PhysNode):
 
 
 @dataclasses.dataclass
+class AnnSearch(PhysNode):
+    """Top-k nearest-neighbor scan over a VECTOR column (pgvector's
+    `ORDER BY vec <-> q LIMIT k` IVFFlat/seq path as one fused node)."""
+    table: TableDef = None
+    alias: str = ""
+    filters: list[E.Expr] = dataclasses.field(default_factory=list)
+    outputs: list[tuple[str, E.Expr]] = dataclasses.field(
+        default_factory=list)
+    vec_col: str = ""            # qualified column name
+    metric: str = "l2"
+    query: tuple = ()
+    k: int = 10
+    dist_name: str = "__dist"    # emitted distance column
+
+    def title(self):
+        return (f"AnnSearch {self.table.name} {self.metric} "
+                f"k={self.k}")
+
+
+@dataclasses.dataclass
 class Result(PhysNode):
     """Constant/empty-input result (SELECT without FROM)."""
     outputs: list[tuple[str, E.Expr]] = dataclasses.field(default_factory=list)

@@ -468,6 +468,12 @@ class Planner:
             proj = list(targets)
             order = list(bq.order_by)
 
+        # pgvector pattern: ORDER BY vec <metric> 'q' LIMIT k over a plain
+        # scan -> one fused AnnSearch node (top-k on device)
+        ann = self._try_ann_search(bq, plan, proj, order)
+        if ann is not None:
+            return ann, out_names
+
         proj_node = P.Project(plan, proj)
         plan = proj_node
 
@@ -501,6 +507,33 @@ class Planner:
         if bq.limit is not None or bq.offset:
             plan = P.Limit(plan, bq.limit, bq.offset or 0)
         return plan, out_names
+
+    def _try_ann_search(self, bq, plan, proj, order):
+        if (bq.has_aggs or bq.distinct or bq.limit is None or bq.offset
+                or len(order) != 1 or order[0][1]):
+            return None
+        oe = order[0][0]
+        if not isinstance(oe, E.DistExpr):
+            return None
+        # peel Filter wrappers down to a bare SeqScan
+        filters = []
+        node = plan
+        while isinstance(node, P.Filter):
+            filters = node.quals + filters
+            node = node.child
+        if not isinstance(node, P.SeqScan):
+            return None
+        filters = list(node.filters) + filters
+        outputs = list(proj)
+        dist_name = next((n for n, e in outputs if e == oe), None)
+        if dist_name is None:
+            dist_name = "__dist"
+            outputs = outputs + [(dist_name, oe)]
+        return P.AnnSearch(table=node.table, alias=node.alias,
+                           filters=filters, outputs=outputs,
+                           vec_col=oe.col.name, metric=oe.metric,
+                           query=oe.query, k=bq.limit,
+                           dist_name=dist_name)
 
     def _plan_aggregate(self, bq: BoundQuery, plan: P.PhysNode):
         group_keys = [(f"__gk{i}", g) for i, g in enumerate(bq.group_by)]

@@ -358,6 +358,8 @@ class Binder:
         return E.Not(e)
 
     def _bind_binop(self, node: A.BinOp, b) -> E.Expr:
+        if node.op in ("<->", "<=>", "<#>"):
+            return self._bind_distance(node, b)
         # date +/- interval constant folding (TPC-H uses literal arithmetic)
         if node.op in ("+", "-"):
             folded = self._try_fold_date(node, b)
@@ -374,6 +376,31 @@ class Binder:
             raise BindError("string concatenation unsupported on device "
                             "columns")
         raise BindError(f"operator {node.op!r} unsupported")
+
+    def _bind_distance(self, node: A.BinOp, b) -> E.Expr:
+        metric = {"<->": "l2", "<=>": "cosine", "<#>": "ip"}[node.op]
+        left, right = b(node.left), b(node.right)
+        # one side must be a VECTOR column, the other a '[...]' literal
+        if isinstance(right, E.Col) and right.type.kind == TypeKind.VECTOR:
+            left, right = right, left
+        if not (isinstance(left, E.Col)
+                and left.type.kind == TypeKind.VECTOR):
+            raise BindError(f"{node.op} requires a vector column operand")
+        if not (isinstance(right, E.Lit) and isinstance(right.value, str)):
+            raise BindError(f"{node.op} requires a vector literal "
+                            "('[1,2,...]')")
+        s = right.value.strip()
+        if not (s.startswith("[") and s.endswith("]")):
+            raise BindError(f"malformed vector literal {right.value!r} "
+                            "(expected '[x,y,...]')")
+        try:
+            q = tuple(float(x) for x in s[1:-1].split(","))
+        except ValueError:
+            raise BindError(f"malformed vector literal {right.value!r}")
+        if len(q) != left.type.dim:
+            raise BindError(f"vector literal dim {len(q)} != column dim "
+                            f"{left.type.dim}")
+        return E.DistExpr(metric, left, q)
 
     def _try_fold_date(self, node: A.BinOp, b) -> Optional[E.Expr]:
         rl = node.right

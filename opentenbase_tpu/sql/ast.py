@@ -272,6 +272,8 @@ class CreateIndexStmt(Node):
     table: str
     columns: list[str]
     unique: bool = False
+    method: str = ""                      # 'ivfflat' etc.
+    options: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -50,6 +50,7 @@ left right full outer cross union except intersect values insert into update
 set delete create drop table with asc desc
 """.split())
 
+_THREE_CHAR_OPS = {"<->", "<=>", "<#>"}   # pgvector distance operators
 _TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||", "::"}
 
 
@@ -140,6 +141,11 @@ def lex(sql: str) -> list[Token]:
             word = sql[i:j].lower()
             toks.append(Token(Tok.IDENT, word, i, is_keyword=word in KEYWORDS))
             i = j
+            continue
+        three = sql[i:i + 3]
+        if three in _THREE_CHAR_OPS:
+            toks.append(Token(Tok.OP, three, i))
+            i += 3
             continue
         two = sql[i:i + 2]
         if two in _TWO_CHAR_OPS:

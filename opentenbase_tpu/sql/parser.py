@@ -429,12 +429,26 @@ class Parser:
             name = self.ident()
             self.expect_kw("on")
             table = self.ident()
+            method = ""
+            if self.accept_kw("using"):
+                method = self.ident()
             self.expect_op("(")
             cols = [self.ident()]
             while self.accept_op(","):
                 cols.append(self.ident())
             self.expect_op(")")
-            return A.CreateIndexStmt(name, table, cols, unique)
+            options = {}
+            if self.accept_kw("with"):
+                self.expect_op("(")
+                while True:
+                    k = self.ident()
+                    self.expect_op("=")
+                    options[k] = self.advance().value
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return A.CreateIndexStmt(name, table, cols, unique, method,
+                                     options)
         if self.accept_kw("barrier"):
             t = self.advance()
             return A.BarrierStmt(t.value)
@@ -617,7 +631,7 @@ class Parser:
 
     def additive(self) -> A.Node:
         left = self.multiplicative()
-        while self.at_op("+", "-", "||"):
+        while self.at_op("+", "-", "||", "<->", "<=>", "<#>"):
             op = self.advance().value
             left = A.BinOp(op, left, self.multiplicative())
         return left

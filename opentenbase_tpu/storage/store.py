@@ -91,7 +91,8 @@ class Chunk:
 
     @staticmethod
     def empty(td: TableDef, cap: int = CHUNK_CAP) -> "Chunk":
-        cols = {c.name: np.empty(cap, dtype=c.type.np_dtype)
+        cols = {c.name: np.empty((cap, *c.type.shape_suffix),
+                                 dtype=c.type.np_dtype)
                 for c in td.columns}
         return Chunk(
             columns=cols,
@@ -117,6 +118,9 @@ class TableStore:
         self.dicts: dict[str, StringDict] = {
             c.name: StringDict() for c in td.columns
             if c.type.kind == TypeKind.TEXT}
+        # ANN indexes over VECTOR columns: col -> {"centroids", "metric",
+        # "nprobe", "_assign_cache"} (contrib/pgvector IVFFlat analog)
+        self.ann_indexes: dict[str, dict] = {}
 
     # ------------------------------------------------------------------
     def row_count(self) -> int:
@@ -142,6 +146,19 @@ class TableStore:
             from ..catalog.types import date_to_days
             return np.asarray([date_to_days(str(v)) for v in values],
                               dtype=np.int32)
+        if k == TypeKind.VECTOR:
+            if arr.dtype.kind in "UO":
+                # pgvector text form: '[1,2,3]'
+                arr = np.asarray([
+                    np.array(str(v).strip().strip("[]").split(","),
+                             dtype=np.float32)
+                    if isinstance(v, str) else np.asarray(v, np.float32)
+                    for v in values])
+            arr = arr.astype(np.float32)
+            if arr.ndim != 2 or arr.shape[1] != col.type.dim:
+                raise ValueError(
+                    f"vector column {name!r} expects dim {col.type.dim}")
+            return arr
         return arr.astype(col.type.np_dtype)
 
     def insert(self, columns: dict[str, np.ndarray], nrows: int,
@@ -225,6 +242,31 @@ class TableStore:
         for i, ch in enumerate(self.chunks):
             if ch.nrows:
                 yield i, ch
+
+    def build_ann_index(self, col: str, lists: int = 0,
+                        metric: str = "l2", nprobe: int = 0) -> int:
+        """IVFFlat coarse quantizer over a VECTOR column (kmeans over
+        this store's rows) — contrib/pgvector ivfflat analog."""
+        cd = self.td.column(col)
+        if cd.type.kind != TypeKind.VECTOR:
+            raise ValueError(
+                f"ivfflat index requires a vector column, {col!r} is "
+                f"{cd.type}")
+        from ..ops.ann import kmeans
+        parts = [ch.columns[col][:ch.nrows] for _, ch in
+                 self.scan_chunks()]
+        vecs = np.concatenate(parts) if parts else \
+            np.zeros((0, cd.type.dim), np.float32)
+        n = len(vecs)
+        if lists <= 0:
+            lists = max(1, min(int(np.sqrt(max(n, 1))), 1024))
+        if nprobe <= 0:
+            nprobe = max(1, lists // 8)
+        centroids = kmeans(vecs.astype(np.float32), lists) if n else \
+            np.zeros((lists, cd.type.dim), np.float32)
+        self.ann_indexes[col] = {"centroids": centroids, "metric": metric,
+                                 "nprobe": nprobe}
+        return lists
 
     def visible_mask(self, ch: Chunk, snap_ts: int, my_txid: int) -> np.ndarray:
         """Host-side reference implementation of the visibility rule; the
