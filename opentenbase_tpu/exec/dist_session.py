@@ -151,7 +151,7 @@ class ClusterSession:
         walk(stmt)
         wanted = statviews.referenced_stat_tables(names)
         if wanted:
-            statviews.refresh(self.cluster, self, wanted)
+            statviews.refresh(self.cluster, wanted)
 
     def _exec_select(self, stmt: A.SelectStmt,
                      instrument: bool = False) -> tuple:
@@ -226,7 +226,11 @@ class ClusterSession:
             for dn_idx, idx in dests.items():
                 if len(idx) == 0:
                     continue
-                sub = {cn: [coldata[cn][j] for j in idx]
+                # ndarray fancy indexing preserves subclass markers
+                # (loader._PreScaled decimals must not be re-scaled)
+                sub = {cn: (coldata[cn][idx]
+                            if isinstance(coldata[cn], np.ndarray)
+                            else [coldata[cn][j] for j in idx])
                        for cn in coldata}
                 sub_sid = sid[idx] if sid is not None else None
                 c.datanodes[dn_idx].insert_raw(td.name, sub, len(idx),
@@ -304,19 +308,15 @@ class ClusterSession:
         return Result("UPDATE", rowcount=len(rows))
 
     def _exec_copy(self, stmt: A.CopyStmt) -> Result:
-        import pandas as pd
         td = self.cluster.catalog.table(stmt.table)
         if stmt.direction != "from":
             raise ExecError("COPY TO unsupported yet")
         delim = str(stmt.options.get("delimiter", "|"))
         cols = stmt.columns or td.column_names
-        df = pd.read_csv(stmt.filename, sep=delim, header=None,
-                         names=cols + ["__trail"], index_col=False,
-                         engine="c")
-        if df["__trail"].isna().all():
-            df = df.drop(columns="__trail")
-        coldata = {cn: df[cn].tolist() for cn in cols}
-        n = self._insert_rows(td, coldata, len(df))
+        from ..storage.loader import load_tbl
+        coldata = load_tbl(stmt.filename, td, cols, delim)
+        n = len(next(iter(coldata.values())))
+        n = self._insert_rows(td, coldata, n)
         return Result("COPY", rowcount=n)
 
     # ---- txn / utility ----

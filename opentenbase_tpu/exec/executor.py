@@ -293,7 +293,10 @@ class Executor:
         b = self.exec_node(node.child)
         cols, types, dicts, nulls = {}, {}, {}, {}
         for name, oe in node.outputs:
-            cols[name] = self._eval(oe, b)
+            arr = self._eval(oe, b)
+            if getattr(arr, "ndim", 1) == 0:   # constant: broadcast
+                arr = jnp.full((b.padded,), arr)
+            cols[name] = arr
             types[name] = oe.type
             d = _dict_for_expr(oe, b.dicts)
             if d is not None:

@@ -44,6 +44,16 @@ class Result:
     text: str = ""                      # EXPLAIN etc.
 
 
+def _text_log_array(v) -> np.ndarray:
+    """WAL representation of a TEXT column: must be a string-kind array —
+    numeric-looking values (zip codes) logged as ints would be mistaken
+    for dictionary codes at recovery."""
+    arr = np.asarray(v)
+    if arr.dtype.kind in "SU":
+        return arr
+    return np.asarray([str(x) for x in v])
+
+
 class TxnState:
     def __init__(self, txid: int, snapshot_ts: int):
         self.txid = txid
@@ -137,7 +147,9 @@ class LocalNode:
             enc = {}
             for cname, v in rec["columns"].items():
                 arr = np.asarray(v)
-                if arr.dtype.kind in "UO":
+                if arr.dtype.kind == "S":
+                    enc[cname] = st.encode_column(cname, arr)
+                elif arr.dtype.kind in "UO":
                     # TEXT columns are logged as raw strings (dictionary
                     # codes are not stable across restarts)
                     enc[cname] = st.encode_column(cname, list(arr))
@@ -364,7 +376,7 @@ class Session:
             if raw_for_route else None
         self.node._log({"op": "insert", "table": td.name, "n": n,
                         "txid": t.txid,
-                        "columns": {c: (list(map(str, v))
+                        "columns": {c: (_text_log_array(v)
                                         if td.column(c).type.kind
                                         == TypeKind.TEXT else
                                         np.asarray(enc[c]))
@@ -459,20 +471,15 @@ class Session:
 
     # ---- COPY ----
     def _exec_copy(self, stmt: A.CopyStmt) -> Result:
-        import pandas as pd
         td = self.node.catalog.table(stmt.table)
         st = self.node.stores[stmt.table]
         if stmt.direction != "from":
             raise ExecError("COPY TO unsupported yet")
         delim = str(stmt.options.get("delimiter", "|"))
         cols = stmt.columns or td.column_names
-        df = pd.read_csv(stmt.filename, sep=delim, header=None,
-                         names=cols + ["__trail"], index_col=False,
-                         engine="c")
-        if df["__trail"].isna().all():
-            df = df.drop(columns="__trail")
-        coldata = {c: df[c].tolist() for c in cols}
-        n = len(df)
+        from ..storage.loader import load_tbl
+        coldata = load_tbl(stmt.filename, td, cols, delim)
+        n = len(next(iter(coldata.values())))
         return Result("COPY", rowcount=self._insert_rows(td, st, coldata, n))
 
     # ---- txn / explain ----

@@ -45,32 +45,42 @@ class DataNode:
             os.makedirs(datadir, exist_ok=True)
 
     # ---- service surface -------------------------------------------------
+    @staticmethod
+    def _unlogged(table: str) -> bool:
+        """System stat views are UNLOGGED relations (PG concept): rebuilt
+        on read, never WAL'd — a monitoring loop must not grow the WAL."""
+        return table.startswith("otb_")
+
     def ddl_create(self, td: TableDef):
         if td.name not in self.stores:
             self.stores[td.name] = TableStore(td)
-            self.log({"op": "create_table", "table": td.to_json()})
+            if not self._unlogged(td.name):
+                self.log({"op": "create_table", "table": td.to_json()})
 
     def ddl_drop(self, name: str):
         st = self.stores.pop(name, None)
         if st is not None:
             self.cache.invalidate(st)
-        self.log({"op": "drop_table", "name": name})
+        if not self._unlogged(name):
+            self.log({"op": "drop_table", "name": name})
 
     def insert_raw(self, table: str, coldata: dict, n: int, txid: int,
                    shardids=None) -> int:
         """Insert raw (unencoded) values; encoding happens node-side where
         the dictionaries live."""
+        from ..exec.session import _text_log_array
         st = self.stores[table]
         td = st.td
         enc = {cn: st.encode_column(cn, vals)
                for cn, vals in coldata.items()}
-        self.log({"op": "insert", "table": table, "n": n, "txid": txid,
-                  "shardids": shardids,
-                  "columns": {cn: (np.asarray(v, dtype=object)
-                                   if td.column(cn).type.kind
-                                   == TypeKind.TEXT
-                                   else np.asarray(enc[cn]))
-                              for cn, v in coldata.items()}})
+        if not self._unlogged(table):
+            self.log({"op": "insert", "table": table, "n": n,
+                      "txid": txid, "shardids": shardids,
+                      "columns": {cn: (_text_log_array(v)
+                                       if td.column(cn).type.kind
+                                       == TypeKind.TEXT
+                                       else np.asarray(enc[cn]))
+                                  for cn, v in coldata.items()}})
         spans = st.insert(enc, n, txid, shardids=shardids)
         self.txn_spans.setdefault(txid, []).append(("ins", table, spans))
         return n
@@ -188,7 +198,9 @@ class DataNode:
                 enc = {}
                 for cname, v in rec["columns"].items():
                     arr = np.asarray(v)
-                    if arr.dtype.kind in "UO":
+                    if arr.dtype.kind == "S":
+                        enc[cname] = st.encode_column(cname, arr)
+                    elif arr.dtype.kind in "UO":
                         enc[cname] = st.encode_column(cname, list(arr))
                     else:
                         enc[cname] = arr.astype(

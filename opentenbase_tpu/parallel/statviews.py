@@ -50,7 +50,7 @@ def referenced_stat_tables(sql_tables) -> list[str]:
     return [t for t in sql_tables if t in STAT_TABLES]
 
 
-def refresh(cluster, session, names: list[str]):
+def refresh(cluster, names: list[str]):
     """Re-materialize the requested views (rows live on datanode 0)."""
     gtm = cluster.gtm
     for name in names:

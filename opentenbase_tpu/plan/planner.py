@@ -164,8 +164,16 @@ class Planner:
         for q in bq.where:
             where.extend(_hoist_or_common(q))
 
+        all_cols = set()
+        for cs_ in rte_cols.values():
+            all_cols |= cs_
+        param_filters = []   # reference no table column (init-plan probes)
+
         for q in where:
             cols = expr_cols(q)
+            if not (cols & all_cols):
+                param_filters.append(q)
+                continue
             own = owner_of(cols)
             if own is not None:
                 scan_filters[own].append(q)
@@ -192,6 +200,8 @@ class Planner:
         still = [q for q in residual if not expr_cols(q) <= avail]
         if still:
             raise PlanError(f"unplaceable predicates: {still}")
+        if param_filters:
+            plan = P.Filter(plan, param_filters)
 
         # aggregation / projection
         plan, out_names = self._plan_agg_project(bq, plan)
@@ -313,15 +323,29 @@ class Planner:
                            if isinstance(x, SubLink)
                            and x.link_kind == "scalar" else None)
 
+        def uncorrelated_exists(sl: SubLink) -> E.Expr:
+            """EXISTS with no outer reference: one-row init plan probing
+            whether any row exists, folded to a boolean."""
+            probe = dataclasses.replace(
+                sl.query, targets=[("__one", E.Lit(1, T.INT64))],
+                group_by=[], having=[], order_by=[], limit=1, offset=None)
+            name = f"__initplan{next(self._ip_counter)}"
+            init_plans.append(InitPlan(name, self._plan_query(probe,
+                                                              init_plans),
+                                       T.INT64))
+            op = "<>" if sl.negated else "="
+            return E.Cmp(op, E.Col(name, T.INT64), E.Lit(1, T.INT64))
+
         for q in bq.where:
-            if isinstance(q, SubLink) and q.link_kind in ("exists", "in"):
-                semijoins.append(self._sublink_to_semijoin(q, init_plans))
-                continue
             if isinstance(q, E.Not) and isinstance(q.arg, SubLink) \
                     and q.arg.link_kind in ("exists", "in"):
-                sl = SubLink(q.arg.link_kind, q.arg.query, q.arg.test_expr,
-                             q.arg.cmp_op, not q.arg.negated)
-                semijoins.append(self._sublink_to_semijoin(sl, init_plans))
+                q = SubLink(q.arg.link_kind, q.arg.query, q.arg.test_expr,
+                            q.arg.cmp_op, not q.arg.negated)
+            if isinstance(q, SubLink) and q.link_kind in ("exists", "in"):
+                if q.link_kind == "exists" and not q.query.correlated_cols:
+                    new_where.append(uncorrelated_exists(q))
+                    continue
+                semijoins.append(self._sublink_to_semijoin(q, init_plans))
                 continue
             new_where.append(rewrite_scalars(q))
 

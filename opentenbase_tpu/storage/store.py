@@ -67,6 +67,17 @@ class StringDict:
         return np.fromiter((self.encode_one(s) for s in strings),
                            dtype=np.int32, count=len(strings))
 
+    def encode_array(self, arr: np.ndarray) -> np.ndarray:
+        """Vectorized encode for numpy string/bytes arrays: unique once
+        (C speed), register only the uniques, map back by inverse."""
+        uniq, inv = np.unique(arr, return_inverse=True)
+        base = np.empty(len(uniq), dtype=np.int32)
+        for i, u in enumerate(uniq):
+            s = u.decode("utf-8", "replace") if isinstance(u, bytes) \
+                else str(u)
+            base[i] = self.encode_one(s)
+        return base[inv.reshape(-1)].astype(np.int32)
+
     def decode(self, codes: np.ndarray) -> list[str]:
         return [self.values[int(c)] for c in codes]
 
@@ -131,9 +142,14 @@ class TableStore:
         col = self.td.column(name)
         k = col.type.kind
         if k == TypeKind.TEXT:
+            if isinstance(values, np.ndarray) and values.dtype.kind in "SU":
+                return self.dicts[name].encode_array(values)
             return self.dicts[name].encode([str(v) for v in values])
         arr = np.asarray(values)
         if k == TypeKind.DECIMAL:
+            from .loader import _PreScaled
+            if isinstance(values, _PreScaled):
+                return np.asarray(values).astype(np.int64)
             scale = col.type.scale
             if arr.dtype.kind in "iu":
                 return arr.astype(np.int64) * np.int64(10 ** scale)

@@ -200,6 +200,40 @@ class TestNullsAcrossExchanges:
         assert got == [(2, 150.0)]
 
 
+class TestStatViews:
+    def test_stat_tables(self, cs):
+        got = cs.query("select datanode, rows from otb_stat_tables "
+                       "where table_name = 't' order by datanode")
+        assert sum(r[1] for r in got) == 40
+        assert len(got) == 3
+
+    def test_stat_gtm_refresh_is_read_only(self, cs):
+        from opentenbase_tpu.parallel import statviews
+        before = cs.cluster.gtm.stats()["ts"]
+        statviews.refresh(cs.cluster, ["otb_stat_gtm"])
+        assert cs.cluster.gtm.stats()["ts"] == before  # no allocation
+        assert cs.query("select * from otb_stat_gtm")[0][0] >= before
+
+    def test_nodes_view(self, cs):
+        got = cs.query("select kind, count(*) from otb_nodes "
+                       "group by kind order by kind")
+        assert ("datanode", 3) in got
+
+    def test_stat_view_in_subquery_refreshed(self, cs):
+        got = cs.query("select count(*) from t where exists "
+                       "(select 1 from otb_nodes where kind = 'datanode')")
+        assert got == [(40,)]
+
+    def test_unlogged_views_do_not_grow_wal(self, cs):
+        from opentenbase_tpu.storage.wal import Wal
+        dn0 = cs.cluster.datanodes[0]
+        before = len(list(Wal.replay(dn0.wal.path)))
+        for _ in range(3):
+            cs.query("select * from otb_stat_tables")
+        after = len(list(Wal.replay(dn0.wal.path)))
+        assert after == before
+
+
 class TestSequences:
     def test_global_sequence(self, cs):
         cs.execute("create sequence sq start with 5 increment by 2")

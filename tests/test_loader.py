@@ -1,0 +1,76 @@
+"""Native C++ bulk loader vs pandas fallback."""
+
+import numpy as np
+import pytest
+
+from opentenbase_tpu.catalog.schema import (ColumnDef, Distribution,
+                                            DistType, TableDef)
+from opentenbase_tpu.catalog import types as T
+from opentenbase_tpu.storage import loader
+from opentenbase_tpu.exec.session import LocalNode, Session
+
+
+TD = TableDef("t", [
+    ColumnDef("k", T.INT64),
+    ColumnDef("price", T.decimal(15, 2)),
+    ColumnDef("d", T.DATE),
+    ColumnDef("name", T.SqlType(T.TypeKind.TEXT, max_len=16)),
+    ColumnDef("x", T.FLOAT64),
+], Distribution(DistType.SHARD, ["k"]))
+
+
+@pytest.fixture()
+def tbl_file(tmp_path):
+    p = tmp_path / "t.tbl"
+    p.write_text(
+        "1|12.34|1995-03-15|alpha|1.5\n"
+        "2|-0.07|1970-01-01|beta beta|2.25\n"
+        "3|999.999|2000-02-29|x|0\n")   # over-precision truncates
+    return str(p)
+
+
+class TestNativeLoader:
+    def test_builds_and_parses(self, tbl_file):
+        assert loader.native_available(), "g++ build failed"
+        out = loader.load_tbl(tbl_file, TD, TD.column_names, "|")
+        assert out is not None
+        np.testing.assert_array_equal(out["k"], [1, 2, 3])
+        np.testing.assert_array_equal(np.asarray(out["price"]),
+                                      [1234, -7, 99999])
+        assert out["d"][0] == T.date_to_days("1995-03-15")
+        assert out["d"][2] == T.date_to_days("2000-02-29")
+        assert [s.decode() for s in out["name"]] == \
+            ["alpha", "beta beta", "x"]
+        np.testing.assert_allclose(out["x"], [1.5, 2.25, 0.0])
+
+    def test_prescaled_not_double_scaled(self, tbl_file):
+        from opentenbase_tpu.storage.store import TableStore
+        out = loader.load_tbl(tbl_file, TD, TD.column_names, "|")
+        st = TableStore(TD)
+        enc = st.encode_column("price", out["price"])
+        np.testing.assert_array_equal(enc, [1234, -7, 99999])
+
+    def test_copy_uses_native_end_to_end(self, tbl_file):
+        node = LocalNode()
+        s = Session(node)
+        s.execute("create table t (k bigint primary key, "
+                  "price decimal(15,2), d date, name varchar(16), "
+                  "x float) distribute by shard(k)")
+        r = s.execute(f"copy t from '{tbl_file}' with (delimiter '|')")[0]
+        assert r.rowcount == 3
+        assert s.query("select price from t where k = 1") == [(12.34,)]
+        assert s.query("select name from t where k = 2") == \
+            [("beta beta",)]
+
+    def test_matches_pandas_fallback(self, tbl_file):
+        import pandas as pd
+        out = loader.load_tbl(tbl_file, TD, TD.column_names, "|")
+        df = pd.read_csv(tbl_file, sep="|", header=None,
+                         names=TD.column_names + ["__trail"],
+                         index_col=False)
+        np.testing.assert_array_equal(out["k"], df.k.to_numpy())
+        np.testing.assert_allclose(out["x"], df.x.to_numpy())
+
+    def test_missing_file(self):
+        with pytest.raises(FileNotFoundError):
+            loader.load_tbl("/nonexistent.tbl", TD, TD.column_names, "|")
