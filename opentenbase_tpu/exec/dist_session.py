@@ -44,7 +44,21 @@ class ClusterSession:
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> list[Result]:
-        return [self._exec_stmt(s) for s in parse_sql(sql)]
+        out = []
+        audit = getattr(self.cluster, "audit", None) \
+            if self.cluster.gucs.get("audit_enabled", "off") == "on" \
+            else None
+        for s in parse_sql(sql):
+            try:
+                r = self._exec_stmt(s)
+            except Exception as e:
+                if audit:
+                    audit.record(type(s).__name__, str(e), ok=False)
+                raise
+            if audit:
+                audit.record(type(s).__name__, r.command, r.rowcount)
+            out.append(r)
+        return out
 
     def query(self, sql: str) -> list[tuple]:
         return self.execute(sql)[-1].rows
@@ -109,8 +123,11 @@ class ClusterSession:
             return Result("SHOW", names=[stmt.name],
                           rows=[(c.gucs.get(stmt.name, ""),)])
         if isinstance(stmt, A.VacuumStmt):
-            c.checkpoint()
-            return Result("VACUUM")
+            from ..parallel.maintenance import vacuum_cluster
+            n = vacuum_cluster(c, stmt.table)
+            if n < 0:
+                raise ExecError("VACUUM refused: transactions in flight")
+            return Result("VACUUM", rowcount=n)
         if isinstance(stmt, A.BarrierStmt):
             # 2-phase cluster-wide consistency point (reference:
             # pgxc/barrier/barrier.c): block new txns implicitly by
@@ -158,9 +175,16 @@ class ClusterSession:
         self._refresh_stat_views(stmt)
         dp = self._plan_distributed(stmt)
         t, implicit = self._begin_implicit()
-        ex = DistExecutor(self.cluster, t.snapshot_ts, t.txid,
-                          instrument=instrument)
-        batch = ex.run(dp)
+        queue = self.cluster.resource_queue()
+        if queue is not None:
+            queue.acquire()
+        try:
+            ex = DistExecutor(self.cluster, t.snapshot_ts, t.txid,
+                              instrument=instrument)
+            batch = ex.run(dp)
+        finally:
+            if queue is not None:
+                queue.release()
         names, rows = materialize(batch, dp.output_names)
         res = Result("SELECT", names=names, rows=rows, rowcount=len(rows))
         if instrument:

@@ -113,6 +113,8 @@ def _dispatch(node: DataNode, msg: dict):
         return node.wrote_in(msg["txid"])
     if op == "checkpoint":
         return node.checkpoint(None)
+    if op == "vacuum":
+        return node.vacuum(msg.get("table"), msg["cutoff"])
     if op == "row_count":
         st = node.stores.get(msg["table"])
         return st.row_count() if st else 0
@@ -205,6 +207,9 @@ class RemoteDataNode:
 
     def checkpoint(self, _catalog=None):
         return self._call(op="checkpoint")
+
+    def vacuum(self, table, cutoff):
+        return self._call(op="vacuum", table=table, cutoff=cutoff)
 
     def row_count(self, table):
         return self._call(op="row_count", table=table)

@@ -135,6 +135,23 @@ class DataNode:
         return self.stores[table].build_ann_index(col, lists, metric,
                                                   nprobe)
 
+    def vacuum(self, table, cutoff: int) -> int:
+        """Compact dead rows.  Refuses (-1) while any txn holds positional
+        spans on this node — compaction would shift the rows they
+        reference.  Checkpoints afterwards: WAL records must never be
+        replayed across a compaction (chunk offsets shift)."""
+        if self.txn_spans:
+            return -1
+        total = 0
+        for name, st in self.stores.items():
+            if table and name != table:
+                continue
+            total += st.vacuum(cutoff)
+            self.cache.invalidate(st)
+        if total:
+            self.checkpoint(None)
+        return total
+
     def prepare(self, gid: str, txid: int):
         self.log({"op": "prepare", "gid": gid, "txid": txid}, sync=True)
 
@@ -309,6 +326,31 @@ class Cluster:
             dn.open_wal()
         from . import statviews
         statviews.register(self)
+        self._init_services()
+
+    def _init_services(self):
+        from .maintenance import AuditLogger, ResourceQueue
+        self._resqueue: Optional[ResourceQueue] = None
+        self._resqueue_slots = 0
+        audit_path = os.path.join(self.datadir, "audit.log") \
+            if self.datadir else None
+        self.audit = AuditLogger(audit_path)
+
+    def resource_queue(self):
+        """Admission-control queue per max_concurrent_queries GUC
+        (reference: resource queues, commands/resqueue.c)."""
+        from .maintenance import ResourceQueue
+        raw = self.gucs.get("max_concurrent_queries", "")
+        try:
+            slots = int(raw)
+        except ValueError:
+            slots = 0
+        if slots <= 0:
+            return None
+        if self._resqueue is None or self._resqueue_slots != slots:
+            self._resqueue = ResourceQueue("default", slots)
+            self._resqueue_slots = slots
+        return self._resqueue
 
     @classmethod
     def connect(cls, catalog_path: str, dn_addrs: list[tuple],
@@ -334,6 +376,7 @@ class Cluster:
         self.gucs = {"enable_fast_query_shipping": "on"}
         from . import statviews
         statviews.register(self)
+        self._init_services()
         return self
 
     @property

@@ -1,0 +1,163 @@
+"""Cluster maintenance: VACUUM, online shard movement, resource queues,
+audit logging.
+
+Reference analogs:
+- VACUUM / shard-granular vacuum (shard/shard_vacuum.c, autovacuum)
+- online data redistribution (pgxc/locator/redistrib.c: ALTER TABLE ...
+  moves data between nodes with catalog update)
+- GTM-coordinated resource queues (commands/resqueue.c, gtm_resqueue.c:
+  cluster-wide concurrency slots per queue)
+- audit engine + dedicated audit logger process (src/backend/audit,
+  postmaster/auditlogger.c)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# vacuum
+# ---------------------------------------------------------------------------
+
+def vacuum_cluster(cluster, table: Optional[str] = None) -> int:
+    """Reclaim dead row versions on every datanode.  Refuses (-1) while
+    write txns are active anywhere (coordinator view OR node-local spans
+    — another coordinator's txn may hold positional references)."""
+    if cluster.active_txns:
+        return -1
+    cutoff = cluster.gtm.next_gts()
+    total = 0
+    for dn in cluster.datanodes:
+        n = dn.vacuum(table, cutoff)
+        if n < 0:
+            return -1   # node-local in-flight txn (another coordinator)
+        total += n
+    return total
+
+
+# ---------------------------------------------------------------------------
+# online shard movement
+# ---------------------------------------------------------------------------
+
+def move_shards(cluster, shard_ids: list[int], to_dn: int) -> int:
+    """Move the given shard groups to a new owner datanode: copy live rows
+    of every SHARD table, delete at the source, update the shard map.
+    All under one cluster txn (2PC covers source+target)."""
+    from ..catalog.schema import DistType
+    if any(not hasattr(dn, "stores") for dn in cluster.datanodes):
+        # remote sources would be silently skipped, committing a map
+        # change with no data movement — refuse until the RPC surface
+        # grows a shard-extraction op
+        raise NotImplementedError(
+            "online shard movement requires in-process datanodes")
+    sids = set(int(s) for s in shard_ids)
+    txid = cluster.gtm.next_txid()
+    moved = 0
+    written = []
+    try:
+        for dn in cluster.datanodes:
+            if dn.index == to_dn:
+                continue
+            for name, st in list(dn.stores.items()):
+                if st.td.distribution.dist_type != DistType.SHARD:
+                    continue
+                ext = st.rows_of_shards(sids)
+                if ext["n"] == 0:
+                    continue
+                # insert at target (WAL'd), delete at source (WAL'd)
+                cluster.datanodes[to_dn].insert_raw(
+                    name, ext["columns"], ext["n"], txid,
+                    shardids=ext["shardids"])
+                for ci, mask in ext["masks"]:
+                    if mask.any():
+                        span = st.mark_delete(ci, mask, txid)
+                        dn.txn_spans.setdefault(txid, []).append(
+                            ("del", name, span))
+                        dn.log({"op": "delete", "table": name,
+                                "chunk": ci, "mask": mask, "txid": txid})
+                moved += ext["n"]
+                written.append(dn.index)
+        written.append(to_dn)
+        cluster.commit_txn(txid, sorted(set(written)))
+        cluster.catalog.move_shards(list(sids), to_dn)
+        cluster._save_catalog()
+    except Exception:
+        # abort on ALL nodes: the target may hold inserted rows even when
+        # the failing source never made it into `written`
+        cluster.abort_txn(txid, None)
+        raise
+    return moved
+
+
+# ---------------------------------------------------------------------------
+# resource queues (concurrency admission control)
+# ---------------------------------------------------------------------------
+
+class ResourceQueue:
+    """Cluster-wide admission control: at most `slots` concurrent queries
+    per queue; waiters time out with a clean error (reference resqueue
+    semantics: acquire at executor start, release at end)."""
+
+    def __init__(self, name: str, slots: int):
+        self.name = name
+        self.slots = slots
+        self._sem = threading.BoundedSemaphore(slots)
+        self.waits = 0
+        self.admitted = 0
+
+    def acquire(self, timeout_s: float = 30.0):
+        if not self._sem.acquire(timeout=timeout_s):
+            raise RuntimeError(
+                f"resource queue {self.name!r} wait timeout "
+                f"({self.slots} slots busy)")
+        self.admitted += 1
+
+    def release(self):
+        self._sem.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+# ---------------------------------------------------------------------------
+# audit
+# ---------------------------------------------------------------------------
+
+class AuditLogger:
+    """Statement audit stream: JSON lines to a file plus an in-memory ring
+    for the otb-style views (reference: audit engine writing through the
+    auditlogger process)."""
+
+    def __init__(self, path: Optional[str] = None, ring: int = 256):
+        self.path = path
+        self._ring: list[dict] = []
+        self._ring_cap = ring
+        self._lock = threading.Lock()
+        self._f = open(path, "a") if path else None
+
+    def record(self, statement_type: str, detail: str, rowcount: int = 0,
+               ok: bool = True):
+        rec = {"ts": time.time(), "type": statement_type,
+               "detail": detail[:200], "rowcount": rowcount, "ok": ok}
+        with self._lock:
+            self._ring.append(rec)
+            if len(self._ring) > self._ring_cap:
+                self._ring.pop(0)
+            if self._f:
+                self._f.write(json.dumps(rec) + "\n")
+                self._f.flush()
+
+    def recent(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)

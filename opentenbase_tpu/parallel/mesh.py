@@ -1,0 +1,160 @@
+"""Device-mesh data plane: the FN forwarding plane mapped onto ICI.
+
+Reference analog: the FN shared-memory page pool + sender/receiver
+processes streaming tagged tuple pages between datanodes over TCP
+(src/backend/forward, postmaster/forwardsend.c:1-16, fnbufpage.h).  On a
+TPU pod the same role is played by XLA collectives inside one compiled
+program: hash-redistribute == all_to_all over ICI, broadcast == all_gather,
+partial/final aggregation == psum — no pages, no sockets, no copies
+through host memory.
+
+This module is the multi-chip execution tier: table shards live as
+device-sharded arrays over a `jax.sharding.Mesh` (one logical datanode per
+device), and whole plan fragments compile to a single shard_map program.
+The host-mediated exchange tier (exec/dist.py) remains the general path
+(arbitrary plans, multi-process clusters); this tier covers the fragment
+shapes where staying on-device end-to-end pays: scan -> redistribute ->
+join/aggregate pipelines.
+
+Static-shape contract: all_to_all needs equal-sized buckets, so each
+source packs at most `bucket` rows per destination per step (the FnPage
+analog: fixed-size pages, HUGE tuples span pages).  `redistribute`
+returns an overflow count so callers size buckets (power-of-two growth,
+like the executor's batch size classes) and re-run if rows would drop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from ..utils.hashing import splitmix64_jax
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis: str = "dn") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:n]), axis_names=(axis,))
+
+
+def shard_columns(mesh: Mesh, cols: dict, nrows: int):
+    """Pad columns to a per-device-even size and place them sharded over
+    the mesh axis.  Returns (device cols, valid mask)."""
+    n_dev = mesh.devices.size
+    per = -(-nrows // n_dev)
+    padded = per * n_dev
+    out = {}
+    sh = NamedSharding(mesh, P(mesh.axis_names[0]))
+    for name, arr in cols.items():
+        a = np.asarray(arr)
+        buf = np.zeros((padded, *a.shape[1:]), dtype=a.dtype)
+        buf[:nrows] = a[:nrows]
+        out[name] = jax.device_put(buf, sh)
+    valid = np.zeros(padded, dtype=bool)
+    valid[:nrows] = True
+    return out, jax.device_put(valid, sh)
+
+
+def _pack_for_a2a(key_hash, arrs, valid, n_dev: int, bucket: int):
+    """Inside shard_map: place each local row into its destination's
+    fixed-size bucket; count overflow."""
+    dest = (key_hash % jnp.uint64(n_dev)).astype(jnp.int32)
+    order = jnp.argsort(jnp.where(valid, dest, n_dev))
+    dst_s = jnp.where(valid, dest, n_dev)[order]
+    start = jnp.searchsorted(dst_s, jnp.arange(n_dev, dtype=jnp.int32))
+    slot = jnp.arange(dst_s.shape[0]) - start[jnp.clip(dst_s, 0,
+                                                       n_dev - 1)]
+    keep = (slot < bucket) & (dst_s < n_dev)
+    overflow = jnp.sum((slot >= bucket) & (dst_s < n_dev))
+    pack_idx = jnp.clip(dst_s, 0, n_dev - 1) * bucket + \
+        jnp.clip(slot, 0, bucket - 1)
+    packed = []
+    for a in arrs:
+        a_s = a[order]
+        shape = (n_dev * bucket, *a.shape[1:])
+        buf = jnp.zeros(shape, a.dtype).at[pack_idx].set(
+            jnp.where(keep.reshape(keep.shape[0],
+                                   *([1] * (a.ndim - 1))), a_s, 0))
+        packed.append(buf)
+    mask = jnp.zeros(n_dev * bucket, jnp.bool_).at[pack_idx].set(keep)
+    return packed, mask, overflow
+
+
+def redistribute(mesh: Mesh, cols: dict, valid, key_col: str,
+                 bucket: int):
+    """Hash-redistribute sharded columns by cols[key_col] so each row
+    lands on its owner device: ONE all_to_all per column over ICI.
+
+    Returns (new cols dict, new valid, overflow_total).  overflow > 0
+    means some source had more than `bucket` rows for one destination —
+    re-run with a larger bucket (size-class growth)."""
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    names = list(cols.keys())
+
+    def prog(valid_l, *arrs):
+        h = splitmix64_jax(arrs[names.index(key_col)].astype(jnp.uint64))
+        packed, mask, overflow = _pack_for_a2a(h, arrs, valid_l, n_dev,
+                                               bucket)
+        out = [jax.lax.all_to_all(p.reshape(n_dev, bucket,
+                                            *p.shape[1:]),
+                                  axis, 0, 0).reshape(n_dev * bucket,
+                                                      *p.shape[2:])
+               for p in packed]
+        omask = jax.lax.all_to_all(mask.reshape(n_dev, bucket), axis,
+                                   0, 0).reshape(-1)
+        return (omask, jax.lax.psum(overflow, axis), *out)
+
+    smapped = shard_map(
+        prog, mesh=mesh,
+        in_specs=(P(axis), *[P(axis)] * len(names)),
+        out_specs=(P(axis), P(), *[P(axis)] * len(names)))
+    res = jax.jit(smapped)(valid, *[cols[n] for n in names])
+    omask, overflow = res[0], int(jax.device_get(res[1]))
+    return dict(zip(names, res[2:])), omask, overflow
+
+
+def redistribute_auto(mesh: Mesh, cols: dict, valid, key_col: str,
+                      start_bucket: int = 256, max_bucket: int = 1 << 20):
+    """Size-class retry loop around redistribute (the dynamic-shape
+    strategy from SURVEY.md §7.3 applied to the exchange)."""
+    bucket = start_bucket
+    while True:
+        out, omask, overflow = redistribute(mesh, cols, valid, key_col,
+                                            bucket)
+        if overflow == 0:
+            return out, omask, bucket
+        if bucket >= max_bucket:
+            raise RuntimeError("redistribute bucket overflow at max size")
+        bucket *= 2
+
+
+def psum_partial(mesh: Mesh, fn, cols: dict, valid, n_out: int):
+    """Run fn(valid, cols) -> tuple of n_out per-shard partials, psum them
+    across the mesh (the partial->final aggregate split as one compiled
+    program)."""
+    axis = mesh.axis_names[0]
+    names = list(cols.keys())
+
+    def prog(valid_l, *arrs):
+        parts = fn(valid_l, dict(zip(names, arrs)))
+        return tuple(jax.lax.psum(p, axis) for p in parts)
+
+    smapped = shard_map(prog, mesh=mesh,
+                        in_specs=(P(axis), *[P(axis)] * len(names)),
+                        out_specs=tuple(P() for _ in range(n_out)))
+    return jax.jit(smapped)(valid, *[cols[n] for n in names])

@@ -259,6 +259,65 @@ class TableStore:
             if ch.nrows:
                 yield i, ch
 
+    def vacuum(self, cutoff_ts: int) -> int:
+        """Reclaim dead rows: drop versions deleted before cutoff_ts and
+        aborted inserts; compact chunks (reference: lazy vacuum +
+        shard-granular vacuum, pgxc/shard/shard_vacuum.c).  Returns rows
+        reclaimed."""
+        reclaimed = 0
+        new_chunks: list[Chunk] = []
+        for ch in self.chunks:
+            n = ch.nrows
+            if n == 0:
+                continue
+            dead = ((ch.xmax_ts[:n] <= cutoff_ts)
+                    | (ch.xmin_ts[:n] == ABORTED_TS))
+            keep = ~dead
+            reclaimed += int(dead.sum())
+            if keep.all():
+                new_chunks.append(ch)
+                continue
+            idx = np.nonzero(keep)[0]
+            kept = Chunk(
+                columns={name: arr[:n][idx].copy()
+                         for name, arr in ch.columns.items()},
+                xmin_ts=ch.xmin_ts[:n][idx].copy(),
+                xmax_ts=ch.xmax_ts[:n][idx].copy(),
+                xmin_txid=ch.xmin_txid[:n][idx].copy(),
+                xmax_txid=ch.xmax_txid[:n][idx].copy(),
+                shardid=ch.shardid[:n][idx].copy(),
+                nrows=len(idx), cap=len(idx) if len(idx) else 1)
+            if kept.nrows:
+                new_chunks.append(kept)
+        self.chunks = new_chunks
+        self.version = next(_VERSION_COUNTER)
+        return reclaimed
+
+    def rows_of_shards(self, shard_ids: set) -> dict:
+        """Extract live rows belonging to the given shard ids (for online
+        shard movement, reference: pgxc/locator/redistrib.c)."""
+        sel_cols: dict[str, list] = {c.name: [] for c in self.td.columns}
+        sids = []
+        masks = []
+        for ci, ch in self.scan_chunks():
+            n = ch.nrows
+            m = np.isin(ch.shardid[:n], list(shard_ids)) & \
+                (ch.xmax_ts[:n] == INF_TS) & (ch.xmin_ts[:n] < INF_TS)
+            masks.append((ci, m))
+            if m.any():
+                for name in sel_cols:
+                    vals = ch.columns[name][:n][m]
+                    if self.td.column(name).type.kind == TypeKind.TEXT:
+                        sel_cols[name].extend(
+                            self.dicts[name].decode(vals))
+                    else:
+                        sel_cols[name].extend(vals.tolist())
+                sids.extend(ch.shardid[:n][m].tolist())
+        n_out = len(sids)
+        return {"columns": sel_cols, "shardids":
+                np.asarray(sids, dtype=np.int32), "n": n_out,
+                "masks": masks}
+
     def build_ann_index(self, col: str, lists: int = 0,
                         metric: str = "l2", nprobe: int = 0) -> int:
         """IVFFlat coarse quantizer over a VECTOR column (kmeans over

@@ -234,6 +234,75 @@ class TestStatViews:
         assert after == before
 
 
+class TestMaintenance:
+    def test_vacuum_reclaims_dead_rows(self, cs):
+        cs.execute("delete from t where k < 20")
+        before = sum(dn.stores["t"].row_count()
+                     for dn in cs.cluster.datanodes)
+        assert before == 40  # dead versions still occupy chunks
+        r = cs.execute("vacuum t")[0]
+        assert r.rowcount == 20
+        after = sum(dn.stores["t"].row_count()
+                    for dn in cs.cluster.datanodes)
+        assert after == 20
+        assert cs.query("select count(*) from t") == [(20,)]
+
+    def test_online_shard_move(self, cs):
+        from opentenbase_tpu.parallel.maintenance import move_shards
+        from opentenbase_tpu.parallel.locator import shard_ids_for_columns
+        import numpy as np
+        # move every shard currently owned by dn0 to dn1
+        sids = np.nonzero(cs.cluster.catalog.shard_map == 0)[0].tolist()
+        moved = move_shards(cs.cluster, sids, 1)
+        assert moved > 0
+        assert cs.query("select count(*) from t") == [(40,)]
+        # dn0 holds no live rows of t anymore; routing follows the map
+        cs.execute("vacuum t")
+        assert cs.cluster.datanodes[0].stores["t"].row_count() == 0
+        cs.execute("insert into t values (777, 1.00, 'moved')")
+        assert cs.query("select v from t where k = 777") == [(1.0,)]
+
+    def test_vacuum_refused_during_txn(self, cs, tmp_path):
+        cs.execute("begin")
+        cs.execute("insert into t values (901, 1.00, 'x')")
+        from opentenbase_tpu.exec.executor import ExecError
+        with pytest.raises(ExecError, match="VACUUM refused"):
+            cs.execute("vacuum t")
+        cs.execute("commit")
+        cs.execute("vacuum t")  # fine now
+
+    def test_wal_safe_across_vacuum(self, cs, tmp_path):
+        # delete -> vacuum (compaction+checkpoint) -> delete -> recover:
+        # post-vacuum WAL records must apply to the compacted layout
+        cs.execute("delete from t where k < 10")
+        cs.execute("vacuum t")
+        cs.execute("delete from t where k >= 35")
+        s2 = ClusterSession(Cluster(datadir=str(tmp_path / "cl")))
+        assert s2.query("select count(*) from t") == [(25,)]
+        assert s2.query("select count(*) from t where k < 10") == [(0,)]
+
+    def test_resource_queue_limits(self, cs):
+        cs.execute("set max_concurrent_queries = 1")
+        q = cs.cluster.resource_queue()
+        assert q is not None and q.slots == 1
+        q.acquire()   # hog the only slot
+        import pytest as _pt
+        with _pt.raises(RuntimeError, match="resource queue"):
+            q.acquire(timeout_s=0.2)
+        q.release()
+        assert cs.query("select count(*) from t")[0][0] >= 0
+        cs.execute("set max_concurrent_queries = 0")
+
+    def test_audit_log(self, cs, tmp_path):
+        cs.execute("set audit_enabled = on")
+        cs.query("select count(*) from t")
+        cs.execute("insert into t values (900, 1.00, 'a')")
+        recent = cs.cluster.audit.recent()
+        types = [r["type"] for r in recent]
+        assert "SelectStmt" in types and "InsertStmt" in types
+        cs.execute("set audit_enabled = off")
+
+
 class TestSequences:
     def test_global_sequence(self, cs):
         cs.execute("create sequence sq start with 5 increment by 2")
