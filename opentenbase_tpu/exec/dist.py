@@ -303,4 +303,7 @@ def _bind_sources_host(node: P.PhysNode, sources: dict):
         c = getattr(clone, attr, None)
         if isinstance(c, P.PhysNode):
             setattr(clone, attr, _bind_sources_host(c, sources))
+    if isinstance(clone, P.Append):
+        clone.inputs = [_bind_sources_host(c, sources)
+                        for c in clone.inputs]
     return clone

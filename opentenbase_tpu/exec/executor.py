@@ -417,6 +417,13 @@ class Executor:
     def _exec_batchsource(self, node) -> DBatch:
         return node.batch
 
+    def _exec_append(self, node) -> DBatch:
+        """Concatenate children (UNION branches): through the host wire
+        format so node-local TEXT dictionaries merge correctly."""
+        from .dist import _concat_host, _to_device, _to_host
+        parts = [_to_host(self.exec_node(c)) for c in node.inputs]
+        return _to_device(_concat_host(parts))
+
     # ---- aggregate ----
     def _eval_group_keys(self, node: P.Agg, b: DBatch):
         key_arrs, key_types, key_dicts, dup_dicts = [], [], [], False

@@ -95,6 +95,8 @@ def fqs_target_node(bq: BoundQuery, catalog: Catalog) -> Optional[int]:
     subquery/sublink disables FQS here (the reference walks deeper;
     pgxcship.c handles many more cases — future widening).
     """
+    if not isinstance(bq, BoundQuery):
+        return None   # set operations: no single-node shipping yet
     loc = Locator(catalog)
     target: Optional[int] = None
     for _, e in bq.targets:
@@ -225,6 +227,20 @@ class Distributor:
                 node.child = self._add_gather(node.child)
                 d = Dist("cn")
             return node, d
+
+        if isinstance(node, P.Append):
+            # gather every branch to the coordinator, append there
+            # (branch distributions rarely align; CN append is always
+            # correct — colocated append is a future optimization)
+            new_inputs = []
+            for c in node.inputs:
+                cp, cd = self._walk(c)
+                if cd.kind != "cn":
+                    cp = self._add_gather(cp,
+                                          one=(cd.kind == "replicated"))
+                new_inputs.append(cp)
+            node.inputs = new_inputs
+            return node, Dist("cn")
 
         if isinstance(node, P.Result):
             return node, Dist("cn")
@@ -373,6 +389,8 @@ class Distributor:
                 c = getattr(node, attr, None)
                 if isinstance(c, P.PhysNode):
                     setattr(node, attr, cut(c))
+            if isinstance(node, P.Append):
+                node.inputs = [cut(c) for c in node.inputs]
             return node
 
         body = cut(plan)

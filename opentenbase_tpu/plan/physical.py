@@ -164,6 +164,16 @@ class Gather(PhysNode):
 
 
 @dataclasses.dataclass
+class Append(PhysNode):
+    """Concatenate children with positionally-aligned columns (set ops,
+    partition append — reference nodeAppend.c)."""
+    inputs: list[PhysNode] = dataclasses.field(default_factory=list)
+
+    def children(self):
+        return list(self.inputs)
+
+
+@dataclasses.dataclass
 class AnnSearch(PhysNode):
     """Top-k nearest-neighbor scan over a VECTOR column (pgvector's
     `ORDER BY vec <-> q LIMIT k` IVFFlat/seq path as one fused node)."""

@@ -131,10 +131,54 @@ class Planner:
         self._ip_counter = itertools.count()
 
     # -- public ------------------------------------------------------------
-    def plan(self, bq: BoundQuery) -> PlannedStmt:
+    def plan(self, bq) -> PlannedStmt:
+        from .query import BoundSetOp
         init_plans: list[InitPlan] = []
+        if isinstance(bq, BoundSetOp):
+            plan, names = self._plan_setop(bq, init_plans)
+            return PlannedStmt(plan, init_plans, names)
         plan = self._plan_query(bq, init_plans)
         return PlannedStmt(plan, init_plans, [n for n, _ in bq.targets])
+
+    def _plan_setop(self, so, init_plans):
+        from .query import BoundSetOp
+
+        def child_plan(c):
+            if isinstance(c, BoundSetOp):
+                p, names_, = self._plan_setop(c, init_plans)
+                return p, names_, c.target_types
+            p = self._plan_query(c, init_plans)
+            return p, [n for n, _ in c.targets], [e.type for _, e
+                                                  in c.targets]
+
+        names = so.target_names
+        inputs = []
+        for child in (so.left, so.right):
+            p, cnames, ctypes = child_plan(child)
+            # positional rename onto the combined output names, coercing
+            # decimal scales so appended values share a representation
+            outs = []
+            for i in range(len(names)):
+                e = E.Col(cnames[i], ctypes[i])
+                t = so.target_types[i]
+                if t.kind == ctypes[i].kind and \
+                        t.scale != ctypes[i].scale:
+                    e = E.Cast(e, t)
+                outs.append((names[i], e))
+            inputs.append(P.Project(p, outs))
+        plan = P.Append(inputs=inputs)
+        if not so.all:
+            plan = P.Agg(plan, [(n, E.Col(n, t)) for n, t in
+                               zip(names, so.target_types)], [], "single")
+        if so.order_by:
+            keys = [(E.Col(names[i], so.target_types[i]), desc)
+                    for i, desc in so.order_by]
+            plan = P.Sort(plan, keys,
+                          (so.limit + so.offset)
+                          if so.limit is not None else None)
+        if so.limit is not None or so.offset:
+            plan = P.Limit(plan, so.limit, so.offset)
+        return plan, names
 
     # -- query planning ----------------------------------------------------
     def _plan_query(self, bq: BoundQuery,

@@ -65,6 +65,21 @@ class SubLink(E.Expr):
 
 
 @dataclasses.dataclass
+class BoundSetOp:
+    """UNION [ALL] chain (transformSetOperationStmt analog)."""
+    op: str
+    all: bool
+    left: object                   # BoundQuery | BoundSetOp
+    right: object
+    target_names: list[str]
+    target_types: list[SqlType]
+    order_by: list[tuple[int, bool]] = dataclasses.field(
+        default_factory=list)      # (output column index, desc)
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+@dataclasses.dataclass
 class BoundQuery:
     rtable: list[RTE]
     join_order: list[JoinStep]            # left-deep sequence over rtable

@@ -10,6 +10,7 @@ aggregate detection, and star expansion.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 from ..catalog.catalog import Catalog, CatalogError
@@ -50,7 +51,7 @@ class Binder:
     def bind_select(self, stmt: A.SelectStmt,
                     outer: list[Scope] = ()) -> BoundQuery:
         if stmt.setop is not None:
-            raise BindError("set operations not supported yet")
+            return self._bind_setop(stmt, outer)
         rtable: list[RTE] = []
         join_order: list[JoinStep] = []
         where: list[E.Expr] = []
@@ -140,6 +141,75 @@ class Binder:
                           targets=targets, group_by=group_by, having=having,
                           order_by=order_by, limit=limit, offset=offset,
                           distinct=stmt.distinct, correlated_cols=correlated)
+
+    def _bind_setop(self, stmt: A.SelectStmt, outer) -> "BoundSetOp":
+        """UNION [ALL] chains (EXCEPT/INTERSECT planned).  Branches must
+        agree in arity and column kinds; ORDER BY/LIMIT/OFFSET of the
+        outermost statement apply to the combined result.  The parser
+        nests rightward; SQL set ops are LEFT-associative, so flatten the
+        chain and fold left (a UNION ALL b UNION c == (a UNION ALL b)
+        UNION c — the flags group differently than the parse tree)."""
+        from ..plan.query import BoundSetOp
+
+        selects = []
+        links = []   # (op, all) between consecutive selects
+        cur = stmt
+        while True:
+            setop = cur.setop
+            selects.append(dataclasses.replace(
+                cur, setop=None, order_by=[], limit=None, offset=None))
+            if setop is None:
+                break
+            op, all_, rhs = setop
+            if op != "union":
+                raise BindError(f"{op.upper()} not supported yet")
+            links.append((op, all_))
+            cur = rhs
+
+        def types_of(b):
+            if isinstance(b, BoundQuery):
+                return [e.type for _, e in b.targets]
+            return list(b.target_types)
+
+        acc = self.bind_select(selects[0], outer)
+        names = [n for n, _ in acc.targets] if isinstance(acc, BoundQuery) \
+            else list(acc.target_names)
+        for (op, all_), sel in zip(links, selects[1:]):
+            right = self.bind_select(sel, outer)
+            lt, rt = types_of(acc), types_of(right)
+            if len(lt) != len(rt):
+                raise BindError(
+                    "UNION branches have different column counts")
+            combined = []
+            for a, b in zip(lt, rt):
+                if a.kind != b.kind:
+                    raise BindError(
+                        f"UNION branch column types differ: {a} vs {b}")
+                if a.kind == TypeKind.DECIMAL and a.scale != b.scale:
+                    combined.append(T.decimal(30, max(a.scale, b.scale)))
+                else:
+                    combined.append(a)
+            acc = BoundSetOp(op, all_, acc, right, names, combined)
+
+        order_by = []
+        for si in stmt.order_by:
+            if isinstance(si.expr, A.ColRef) and len(si.expr.parts) == 1 \
+                    and si.expr.parts[0] in names:
+                i = names.index(si.expr.parts[0])
+            elif isinstance(si.expr, A.Const) and si.expr.kind == "int":
+                i = int(si.expr.value) - 1
+                if not (0 <= i < len(names)):
+                    raise BindError(
+                        f"ORDER BY position {si.expr.value} is out of "
+                        f"range (1..{len(names)})")
+            else:
+                raise BindError("UNION ORDER BY must reference an output "
+                                "column")
+            order_by.append((i, si.desc))
+        acc.order_by = order_by
+        acc.limit = self._const_int(stmt.limit) if stmt.limit else None
+        acc.offset = self._const_int(stmt.offset) if stmt.offset else 0
+        return acc
 
     # ------------------------------------------------------------------
     def _table(self, name):

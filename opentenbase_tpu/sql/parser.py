@@ -177,7 +177,10 @@ class Parser:
             all_ = self.accept_kw("all")
             if not all_:
                 self.accept_kw("distinct")
-            rhs = self.select_core()
+            # operands must not swallow the trailing ORDER BY/LIMIT:
+            # those bind to the whole set operation (parenthesize a
+            # branch to order it individually)
+            rhs = self.select_core(consume_tails=False)
             stmt = self._attach_setop(stmt, op, all_, rhs)
         # trailing ORDER BY / LIMIT bind to the set operation result
         self._tail_clauses(stmt)
@@ -190,7 +193,7 @@ class Parser:
         cur.setop = (op, all_, rhs)
         return lhs
 
-    def select_core(self) -> A.SelectStmt:
+    def select_core(self, consume_tails: bool = True) -> A.SelectStmt:
         if self.accept_op("("):
             s = self.select_stmt()
             self.expect_op(")")
@@ -220,7 +223,8 @@ class Parser:
         stmt = A.SelectStmt(items=items, from_=from_, where=where,
                             group_by=group_by, having=having,
                             distinct=distinct)
-        self._tail_clauses(stmt)
+        if consume_tails:
+            self._tail_clauses(stmt)
         return stmt
 
     def _tail_clauses(self, stmt: A.SelectStmt):

@@ -303,6 +303,64 @@ class TestMaintenance:
         cs.execute("set audit_enabled = off")
 
 
+class TestSetOps:
+    def test_union_all(self, cs):
+        got = cs.query("select k from t where k < 3 union all "
+                       "select k from t where k < 2 order by k")
+        assert got == [(0,), (0,), (1,), (1,), (2,)]
+
+    def test_union_distinct(self, cs):
+        got = cs.query("select k from t where k < 3 union "
+                       "select k from t where k < 5 order by k")
+        assert got == [(0,), (1,), (2,), (3,), (4,)]
+
+    def test_union_text_dict_merge(self, cs):
+        got = cs.query("select name from t where k = 1 union all "
+                       "select name from t where k = 2 order by 1")
+        assert got == [("n1",), ("n2",)]
+
+    def test_union_arity_mismatch(self, cs):
+        from opentenbase_tpu.sql.analyze import BindError
+        with pytest.raises(BindError, match="column counts"):
+            cs.query("select k, v from t union select k from t")
+
+    def test_union_limit(self, cs):
+        got = cs.query("select k from t union all select k from t "
+                       "order by k limit 3")
+        assert got == [(0,), (0,), (1,)]
+
+    def test_union_offset(self, cs):
+        got = cs.query("select k from t union all select k from t "
+                       "order by k limit 3 offset 2")
+        assert got == [(1,), (1,), (2,)]
+
+    def test_union_left_associative_mixed_all(self, cs):
+        # a UNION ALL b UNION c == (a UNION ALL b) UNION c: full dedupe
+        got = cs.query("select 0 from d union all select 0 from d "
+                       "union select 0 from d")
+        assert got == [(0,)] or len(got) == 1
+
+    def test_union_three_branches(self, cs):
+        got = cs.query("select k from t where k = 0 union all "
+                       "select k from t where k = 1 union all "
+                       "select k from t where k = 2 order by k")
+        assert got == [(0,), (1,), (2,)]
+
+    def test_union_decimal_scale_supertype(self, cs):
+        # scale-2 UNION scale-4: combined column keeps max precision
+        got = cs.query("select v from t where k = 1 union all "
+                       "select cast(v as decimal(10,4)) from t "
+                       "where k = 1")
+        vals = sorted(v for (v,) in got)
+        assert vals == [1.5, 1.5]
+
+    def test_union_order_by_position_range(self, cs):
+        from opentenbase_tpu.sql.analyze import BindError
+        with pytest.raises(BindError, match="out of range"):
+            cs.query("select k from t union all select k from t "
+                     "order by 5")
+
+
 class TestSequences:
     def test_global_sequence(self, cs):
         cs.execute("create sequence sq start with 5 increment by 2")
