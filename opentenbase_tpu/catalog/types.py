@@ -32,6 +32,8 @@ class TypeKind(enum.Enum):
     DATE = "date"
     TEXT = "text"    # dictionary-encoded
     VECTOR = "vector"  # fixed-dim float32 (pgvector analog)
+    NULL = "null"    # the type of a bare NULL literal before coercion
+    # (reference: UNKNOWNOID untyped literals, parse_coerce.c)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +55,7 @@ class SqlType:
             TypeKind.DATE: np.dtype(np.int32),
             TypeKind.TEXT: np.dtype(np.int32),   # dictionary code
             TypeKind.VECTOR: np.dtype(np.float32),
+            TypeKind.NULL: np.dtype(np.int64),  # placeholder storage
         }[self.kind]
 
     @property
@@ -85,6 +88,7 @@ INT64 = SqlType(TypeKind.INT64)
 FLOAT64 = SqlType(TypeKind.FLOAT64)
 DATE = SqlType(TypeKind.DATE)
 TEXT = SqlType(TypeKind.TEXT)
+NULLT = SqlType(TypeKind.NULL)
 
 
 def decimal(precision: int = 15, scale: int = 2) -> SqlType:

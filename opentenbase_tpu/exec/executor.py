@@ -393,9 +393,32 @@ class Executor:
             return DBatch(left.cols, left.valid & mask, left.types,
                           left.dicts, left.nulls)
         if left_outer:
-            # pairs killed by residual revert to null-extension... keep
-            # simple: residuals on outer joins were folded into `on` keys
-            out.valid = res_valid
+            if not hash_recheck and not node.residual:
+                return out  # nothing filtered: every pair stands as-is
+            # Null-extended pairs (bi<0) gathered build row 0's columns, so
+            # the key recheck/residual verdict on them is garbage — they are
+            # judged by whether any REAL pair of their probe row survived.
+            # A probe row whose real pairs were ALL killed by the residual
+            # reverts to null-extension (reference: ExecHashJoin emits the
+            # null-filled tuple when HJ_FILL_OUTER and no match passed
+            # joinqual, nodeHashjoin.c) — we convert its first output pair
+            # into the null-extended one.
+            null_ext = null_right
+            real_surv = res_valid & ~null_ext & out.valid
+            hits = jax.ops.segment_sum(
+                real_surv.astype(jnp.int32), pi,
+                num_segments=left.valid.shape[0])
+            need_null = left.valid & (hits == 0)
+            idx = jnp.arange(out_size)
+            first_idx = jax.ops.segment_min(
+                jnp.where(out.valid, idx, out_size), pi,
+                num_segments=left.valid.shape[0])
+            is_first = out.valid & (idx == first_idx[pi])
+            to_null = is_first & need_null[pi]
+            for n_ in right.cols:
+                rn = out.nulls.get(n_)
+                out.nulls[n_] = to_null if rn is None else (rn | to_null)
+            out.valid = real_surv | to_null
             return out
         out.valid = res_valid
         return out

@@ -12,8 +12,8 @@ gtm_store.c, standby streaming gtm_standby.c).  Re-designed host-side:
 - Persistence: periodic state snapshots + a reserve window so a crash can
   never hand out a timestamp twice (the reference reserves GTS ranges in
   its mmap'd store for the same reason).
-- Standby: a secondary GTM follows via the same protocol (log shipping of
-  reserve windows) and can be promoted.
+- Standby: see gtm/standby.py — a secondary GTM polls the primary's
+  persisted reserve windows and promotes by resuming past them.
 """
 
 from __future__ import annotations
@@ -35,14 +35,25 @@ class GtmCore:
     """The clock + txid + sequence state machine (shared by in-process and
     server modes)."""
 
-    def __init__(self, store_path: Optional[str] = None):
+    def __init__(self, store_path: Optional[str] = None,
+                 ship=None, sync_ship: bool = True):
+        """``ship``: optional hook called with each persisted state
+        snapshot (reserve-window replication to a GtmStandby — see
+        gtm/standby.py).  With ``sync_ship`` (the reference's synchronous
+        standby), a failed ship blocks allocation past the last shipped
+        window, so a promoted standby can never re-issue; async mode
+        keeps serving and flags ``standby_ok`` False instead."""
         self._lock = threading.Lock()
         self._ts = 100
         self._txid = 1
         self._sequences: dict[str, dict] = {}
         self._prepared: dict[str, dict] = {}   # gid -> info (2PC registry)
         self.store_path = store_path
+        self._ship = ship
+        self._sync_ship = sync_ship
+        self.standby_ok = ship is not None
         self._reserved_until = 0
+        self._txid_reserved_until = 0
         if store_path and os.path.exists(store_path):
             with open(store_path) as f:
                 st = json.load(f)
@@ -55,18 +66,29 @@ class GtmCore:
         self._persist_locked()
 
     def _persist_locked(self):
-        if not self.store_path:
-            self._reserved_until = self._ts + RESERVE
-            return
         st = {"reserved_ts": self._ts + RESERVE,
               "reserved_txid": self._txid + RESERVE,
               "sequences": self._sequences,
               "prepared": self._prepared}
-        tmp = self.store_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(st, f)
-        os.replace(tmp, self.store_path)
+        if self.store_path:
+            tmp = self.store_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(st, f)
+            os.replace(tmp, self.store_path)
+        if self._ship is not None:
+            # ship BEFORE extending the usable window: nothing may be
+            # issued from a window the standby hasn't durably seen.
+            # Deep-copied: an in-process standby must not alias the live
+            # sequence/prepared dicts of a primary that later mutates them
+            try:
+                self._ship(json.loads(json.dumps(st)))
+                self.standby_ok = True
+            except Exception:
+                self.standby_ok = False
+                if self._sync_ship:
+                    raise
         self._reserved_until = self._ts + RESERVE
+        self._txid_reserved_until = self._txid + RESERVE
 
     # ---- API ----
     def next_gts(self) -> int:
@@ -80,8 +102,11 @@ class GtmCore:
     def next_txid(self) -> int:
         with self._lock:
             self._txid += 1
-            if self._txid >= self._reserved_until - RESERVE + RESERVE:
-                pass
+            # txid allocation must trigger persistence on its own: a burst
+            # of txid-only grants past the reserve window would otherwise
+            # let a restarted GTM re-issue txids (advisor r1)
+            if self._txid >= self._txid_reserved_until:
+                self._persist_locked()
             return self._txid
 
     def seq_next(self, name: str, cache: int = 1) -> int:

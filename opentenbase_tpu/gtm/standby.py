@@ -1,0 +1,171 @@
+"""GTM standby — reserve-window shipping + promote.
+
+Reference analog: src/gtm/main/gtm_standby.c + gtm_xlog.c walsender/
+walreceiver threads and `gtm_ctl promote` (src/gtm/gtm_ctl).  Re-designed
+around this GTM's persistence model: the primary already makes itself
+crash-safe by persisting RESERVE-sized timestamp/txid windows before
+issuing from them (gtm/server.py).  Replication therefore does not need
+an xlog stream — shipping each persisted state snapshot to the standby
+gives the standby exactly the primary's crash-recovery point.  Promote =
+resume past the last shipped reserve window, the same rule the primary
+itself uses after a crash, so a promoted standby can never re-issue a
+timestamp or txid the old primary handed out (provided the ship was
+synchronous — see `sync` below).
+
+Wiring: pass ``ship=ship_to(host, port)`` (or ``ship=standby.apply`` in
+process) to GtmCore; run a GtmStandbyServer next to the standby.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+from typing import Callable, Optional
+
+from ..net.wire import recv_msg, send_msg
+from .server import GtmCore
+
+
+class GtmStandby:
+    """Holds the latest shipped primary state; promotable to a GtmCore.
+
+    ``apply`` is called with each persisted state snapshot (directly by
+    an in-process primary, or by GtmStandbyServer for a TCP primary).
+    The standby persists every snapshot to its own store before acking,
+    so a synchronous primary + acked ship implies the promote point is
+    durable here.
+    """
+
+    def __init__(self, store_path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self.store_path = store_path
+        self._state: Optional[dict] = None
+        self.applied = 0
+        if store_path and os.path.exists(store_path):
+            with open(store_path) as f:
+                self._state = json.load(f)
+
+    def apply(self, state: dict) -> None:
+        with self._lock:
+            self._state = state
+            self.applied += 1
+            if self.store_path:
+                tmp = self.store_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(state, f)
+                os.replace(tmp, self.store_path)
+
+    def state(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._state) if self._state else None
+
+    def promote(self, store_path: Optional[str] = None) -> GtmCore:
+        """Become the primary: build a GtmCore resuming past the last
+        shipped reserve window (the primary's own crash-recovery rule).
+        The promoted core persists to ``store_path`` (default: the
+        standby's own store)."""
+        with self._lock:
+            if self._state is None:
+                raise RuntimeError("standby has no shipped state to "
+                                   "promote from")
+            path = store_path or self.store_path
+            if path:
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(self._state, f)
+                os.replace(tmp, path)
+                return GtmCore(path)
+            # memory-only promote (tests): seed a core directly, from a
+            # deep copy — the core must not mutate the standby's retained
+            # snapshot (a re-promote after the core dies resumes from the
+            # last SHIPPED state, not the dead core's)
+            st = json.loads(json.dumps(self._state))
+            core = GtmCore(None)
+            core._ts = st["reserved_ts"]
+            core._txid = st["reserved_txid"]
+            core._sequences = st.get("sequences", {})
+            core._prepared = st.get("prepared", {})
+            core._persist_locked()
+            return core
+
+
+class GtmStandbyServer:
+    """TCP front end for a GtmStandby: accepts `replicate` frames from
+    the primary's ship hook, plus ping/stats for health checks."""
+
+    def __init__(self, standby: GtmStandby, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.standby = standby
+        sb = standby
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        msg = recv_msg(self.request)
+                    except (ConnectionError, EOFError):
+                        return
+                    if msg is None:
+                        return
+                    op = msg.get("op")
+                    try:
+                        if op == "replicate":
+                            sb.apply(msg["state"])
+                            resp = {"ok": True, "applied": sb.applied}
+                        elif op == "ping":
+                            resp = {"pong": True, "applied": sb.applied}
+                        elif op == "stats":
+                            resp = {"state": sb.state(),
+                                    "applied": sb.applied}
+                        else:
+                            resp = {"error": f"unknown op {op!r}"}
+                    except Exception as e:
+                        resp = {"error": str(e)}
+                    send_msg(self.request, resp)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def ship_to(host: str, port: int, timeout: float = 5.0) -> Callable:
+    """Build a ship hook for GtmCore: sends each persisted state to a
+    GtmStandbyServer and waits for the ack (synchronous replication —
+    the primary's _persist_locked fails if the standby didn't take it)."""
+    state_lock = threading.Lock()
+    conn: list[Optional[socket.socket]] = [None]
+
+    def ship(state: dict) -> None:
+        with state_lock:
+            if conn[0] is None:
+                conn[0] = socket.create_connection((host, port),
+                                                   timeout=timeout)
+            try:
+                send_msg(conn[0], {"op": "replicate", "state": state})
+                resp = recv_msg(conn[0])
+            except (ConnectionError, OSError):
+                try:
+                    conn[0].close()
+                finally:
+                    conn[0] = None
+                raise
+            if resp is None or not resp.get("ok"):
+                raise ConnectionError(f"standby rejected state: {resp}")
+
+    return ship

@@ -453,14 +453,51 @@ class Cluster:
         self.active_txns.discard(txid)
 
     # ---- in-doubt resolver (reference: clean2pc launcher/workers) ----
+    def _datanode_by_name(self, name: str):
+        for dn in self.datanodes:
+            if f"dn{dn.index}" == name:
+                return dn
+        return None
+
     def resolve_indoubt(self):
-        """Resolve prepared-but-undecided global txns: committed ones are
-        already durable per DN (recovery applies them); still-'prepared'
-        ones are presumed aborted."""
+        """Resolve prepared-but-undecided global txns; still-'prepared'
+        ones are presumed aborted.  A 'committed' gid is only forgotten
+        after the commit has been re-delivered to EVERY participant: a
+        participant that crashed before writing its commit WAL record and
+        recovers after the forget would get verdict 'unknown' and
+        presume-abort a committed txn (advisor r1).  Delivery is
+        idempotent (DataNode.commit replays as a no-op when already
+        applied)."""
+        done = getattr(self, "_redelivered", None)
+        if done is None:
+            done = self._redelivered = set()  # (gid, participant) acked
         for gid, info in list(self.gtm.prepared_list().items()):
             if info["state"] == "committed":
-                self.gtm.forget_txn(gid)
+                ts = int(info["commit_ts"])
+                delivered = True
+                for name in info["participants"]:
+                    if (gid, name) in done:
+                        continue  # already acked this run: don't re-WAL
+                    dn = self._datanode_by_name(name)
+                    if dn is None:
+                        continue  # decommissioned node: nothing to deliver
+                    try:
+                        dn.commit(info["txid"], ts)
+                        done.add((gid, name))
+                    except (ConnectionError, OSError, EOFError,
+                            RuntimeError):
+                        # unreachable, or net-mode stub surfaced a server
+                        # error reply as RuntimeError: retry next pass
+                        delivered = False
+                if delivered:
+                    self.gtm.forget_txn(gid)
             elif info["state"] in ("prepared", "aborted"):
+                aborted_all = True
                 for dn in self.datanodes:
-                    dn.abort(info["txid"])
-                self.gtm.forget_txn(gid)
+                    try:
+                        dn.abort(info["txid"])
+                    except (ConnectionError, OSError, EOFError,
+                            RuntimeError):
+                        aborted_all = False
+                if aborted_all:
+                    self.gtm.forget_txn(gid)

@@ -53,12 +53,17 @@ class Col(Expr):
 class Lit(Expr):
     """Literal already in storage representation (scaled int for DECIMAL,
     days for DATE).  TEXT literals never appear here — string predicates are
-    resolved against dictionaries at compile time (StrPred)."""
+    resolved against dictionaries at compile time (StrPred).  value=None is
+    the SQL NULL literal (reference: Const.constisnull, primnodes.h)."""
     value: object
     lit_type: SqlType
 
     def __post_init__(self):
         object.__setattr__(self, "type", self.lit_type)
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
 
 
 _NUM_RANK = {TypeKind.INT32: 0, TypeKind.INT64: 1, TypeKind.DECIMAL: 2,
@@ -228,6 +233,46 @@ class StrPred(Expr):
 
     def children(self):
         return (self.col,)
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Expr):
+    """expr IS [NOT] NULL — non-strict: consumes the null mask, never
+    produces one (reference: ExecEvalNullTest, execExprInterp.c)."""
+    arg: Expr
+    negated: bool = False
+
+    def __post_init__(self):
+        object.__setattr__(self, "type", BOOL)
+
+    def children(self):
+        return (self.arg,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Coalesce(Expr):
+    """COALESCE(a, b, ...) — first non-null argument (non-strict)."""
+    args: tuple[Expr, ...]
+    out_type: SqlType
+
+    def __post_init__(self):
+        object.__setattr__(self, "type", self.out_type)
+
+    def children(self):
+        return self.args
+
+
+@dataclasses.dataclass(frozen=True)
+class NullIf(Expr):
+    """NULLIF(a, b): NULL when a = b, else a."""
+    left: Expr
+    right: Expr
+
+    def __post_init__(self):
+        object.__setattr__(self, "type", self.left.type)
+
+    def children(self):
+        return (self.left, self.right)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -69,12 +69,21 @@ class Wal:
 
 
 def checkpoint_store(store, path: str):
-    """Write one TableStore as an npz + dictionary sidecar."""
+    """Write one TableStore as an npz + dictionary sidecar.
+
+    Also seals the LIVE store's layout to match what restore_store will
+    rebuild: restored chunks come back exact-sized (cap == nrows), so
+    post-checkpoint inserts open a fresh chunk there.  If the live store
+    kept free capacity in its last chunk, post-checkpoint inserts would
+    land at different (chunk, offset) coordinates live vs. replayed, and
+    WAL delete records (addressed by chunk+offset) would hit the wrong
+    rows after recovery.  Freezing cap at nrows (and dropping empty
+    chunks, which checkpoints skip) makes both layouts agree.
+    """
+    sealed = [ch for ch in store.chunks if ch.nrows]
     arrays = {}
-    for i, ch in enumerate(store.chunks):
+    for i, ch in enumerate(sealed):
         n = ch.nrows
-        if not n:
-            continue
         for name, arr in ch.columns.items():
             arrays[f"c{i}.{name}"] = arr[:n]
         arrays[f"c{i}.__xmin_ts"] = ch.xmin_ts[:n]
@@ -92,6 +101,12 @@ def checkpoint_store(store, path: str):
         # contain anything)
         f.write(struct.pack("<Q", len(dict_blob)))
     os.replace(tmp, path)
+    # Seal only after the checkpoint is durably in place: sealing first
+    # would diverge the live layout from the (old) on-disk one if the
+    # write failed mid-way.
+    for ch in sealed:
+        ch.cap = ch.nrows
+    store.chunks = sealed
 
 
 def restore_store(store, path: str):

@@ -139,6 +139,18 @@ class TestFaultInjection:
         s2 = self._restart(cs, tmp_path)
         assert s2.query("select count(*) from t") == [(80,)]
 
+    def test_resolve_indoubt_delivers_commit_to_participants(self, cs):
+        # GTM decided commit but no DN ever heard; the resolver must
+        # finish the commit on every participant BEFORE forgetting the
+        # gid, not just drop the record (advisor r1)
+        self._crashy_commit(cs, "AFTER_GTM_COMMIT_BEFORE_DN")
+        FI.disarm()
+        assert len(cs.cluster.gtm.prepared_list()) == 1
+        cs.cluster.resolve_indoubt()
+        assert cs.cluster.gtm.prepared_list() == {}
+        other = ClusterSession(cs.cluster)
+        assert other.query("select count(*) from t") == [(80,)]
+
 
 class TestClusterRecovery:
     def test_restart_preserves_data(self, cs, tmp_path):
@@ -366,3 +378,17 @@ class TestSequences:
         cs.execute("create sequence sq start with 5 increment by 2")
         vals = [cs.cluster.gtm.seq_next("sq") for _ in range(3)]
         assert vals == [5, 7, 9]
+
+
+class TestGtmPersistence:
+    def test_txid_burst_never_reissued_after_restart(self, tmp_path):
+        # a burst of txid-only allocations must extend the persisted
+        # reserve window on its own; a restarted GTM re-issuing txids
+        # breaks own-transaction visibility (advisor r1)
+        from opentenbase_tpu.gtm.server import GtmCore
+        path = str(tmp_path / "gtm.json")
+        g = GtmCore(path)
+        g._txid = g._txid_reserved_until - 2  # stand at the window edge
+        issued = [g.next_txid() for _ in range(4)]  # crosses the bound
+        g2 = GtmCore(path)  # simulated crash+restart
+        assert g2.next_txid() > issued[-1]

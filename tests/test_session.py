@@ -126,6 +126,19 @@ class TestReviewRegressions:
         s2 = Session(LocalNode(datadir=str(tmp_path / "data")))
         assert s2.query("select count(*) from emp") == [(4,)]
 
+    def test_delete_after_checkpoint_survives_restart(self, sess, tmp_path):
+        # checkpoint sealed the layout mid-chunk; a post-checkpoint insert
+        # + delete must replay against the SAME (chunk, offset) coordinates
+        # the live run used, or the deleted row is resurrected (advisor r1)
+        sess.node.checkpoint()
+        sess.execute("insert into emp values "
+                     "(4, 'dan', 10, date '2023-01-01'),"
+                     "(5, 'fay', 11, date '2023-01-01')")
+        sess.execute("delete from emp where id = 4")
+        s2 = Session(LocalNode(datadir=str(tmp_path / "data")))
+        assert s2.query("select id from emp order by id") == \
+            [(1,), (2,), (3,), (5,)]
+
     def test_insert_select_zero_rows(self, sess):
         sess.execute("create table emp2 (id bigint, name varchar(20), "
                      "sal decimal(10,2), hired date)")
@@ -161,6 +174,26 @@ class TestReviewRegressions:
         got = sess.query("select id, v from emp left join r on id = k "
                          "order by id")
         assert got == [(1, 100.0), (2, None), (3, None)]
+
+    def test_left_join_multikey_keeps_unmatched(self, sess):
+        # multi-key LEFT JOIN rides the hash-recheck path; unmatched left
+        # rows must still come back null-extended (advisor r1)
+        sess.execute("create table r (k bigint, y bigint, v decimal(10,2))")
+        sess.execute("insert into r values (1, 10, 100)")
+        got = sess.query("select id, v from emp "
+                         "left join r on id = k and id * 10 = y "
+                         "order by id")
+        assert got == [(1, 100.0), (2, None), (3, None)]
+
+    def test_left_join_residual_reverts_to_null_extension(self, sess):
+        # pairs killed by an ON residual revert to null-extension instead
+        # of dropping the probe row (advisor r1)
+        sess.execute("create table r (k bigint, v decimal(10,2))")
+        sess.execute("insert into r values (1, 600), (2, 100)")
+        got = sess.query("select id, v from emp "
+                         "left join r on id = k and v > 500 "
+                         "order by id")
+        assert got == [(1, 600.0), (2, None), (3, None)]
 
 
 class TestUtility:
