@@ -241,8 +241,19 @@ class ClusterSession:
                          for i in range(c.ndn)}          # write everywhere
                 sid = None
             else:
-                route_cols = {cn: np.asarray(coldata[cn])
-                              for cn in td.distribution.dist_cols}
+                route_cols = {}
+                for cn in td.distribution.dist_cols:
+                    vals = coldata[cn]
+                    if not (isinstance(vals, np.ndarray)
+                            and vals.dtype.kind != "O"):
+                        # NULL dist keys route deterministically on a
+                        # type-default fill (they can never be targeted
+                        # by key equality anyway)
+                        from ..catalog.types import TypeKind as _TK
+                        fill = "" if td.column(cn).type.kind == _TK.TEXT \
+                            else 0
+                        vals = [fill if v is None else v for v in vals]
+                    route_cols[cn] = np.asarray(vals)
                 nodes = c.locator.route_rows(td, route_cols, n)
                 sid = c.locator.shard_ids_for_rows(td, route_cols)
                 dests = {i: np.nonzero(nodes == i)[0]

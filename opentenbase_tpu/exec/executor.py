@@ -9,9 +9,10 @@ Volcano loop).  Architectural differences (SURVEY.md §7.1):
 - The scan stages table chunks into a device cache once per table version
   (the device is the buffer cache; host RAM is the source of truth) and
   fuses MVCC visibility + quals + projection in one jitted kernel.
-- NULLs exist only where the engine creates them (outer-join null-extended
-  columns), tracked as per-column null masks consumed by aggregates —
-  matching TPC-H/NOT NULL base data.
+- NULLs are per-column boolean masks (DBatch.nulls) flowing from storage
+  bitmaps through scans, joins (null-extension), aggregates and sorts;
+  expressions compile to (value, null-mask) pairs (exec/expr_compile.py)
+  so the NOT NULL fast paths carry zero mask overhead.
 """
 
 from __future__ import annotations
@@ -70,20 +71,33 @@ class DeviceTableCache:
         key = (id(store),)
         ver = store.version
         hit = self._cache.get(key)
+        nullwant = {f"__null.{c}" for c in colnames
+                    if c in store.null_columns}
         if hit is not None and hit[0] == ver and \
-                set(colnames) <= set(hit[1]):
+                (set(colnames) | nullwant) <= set(hit[1]):
             return hit[1], hit[2]
         n = store.row_count()
         padded = next_pow2(max(n, 1))
         arrs = {}
         want = set(colnames) | {"__xmin_ts", "__xmax_ts", "__xmin_txid",
-                                "__xmax_txid"}
+                                "__xmax_txid"} | nullwant
         if hit is not None and hit[0] == ver:
             # same version, new columns: merge — keep already-staged
             # device buffers, stage only what's missing
             arrs.update(hit[1])
             want -= set(arrs)
         for name in want:
+            if name.startswith("__null."):
+                col = name[len("__null."):]
+                parts = [ch.nulls[col][:ch.nrows] if col in ch.nulls
+                         else np.zeros(ch.nrows, dtype=bool)
+                         for _, ch in store.scan_chunks()]
+                host = np.concatenate(parts) if parts else \
+                    np.zeros(0, dtype=bool)
+                buf = np.zeros(padded, dtype=bool)
+                buf[:n] = host
+                arrs[name] = jax.device_put(buf)
+                continue
             if name == "__xmin_ts":
                 parts = [ch.xmin_ts[:ch.nrows] for _, ch in
                          store.scan_chunks()]
@@ -128,9 +142,16 @@ class ExecContext:
     cache: DeviceTableCache
     params: dict[str, tuple] = dataclasses.field(default_factory=dict)
     # init-plan results: name -> (value, SqlType)
+    staged: Optional[dict] = None
+    # fused-execution override: table -> (arrs, n) traced arrays replacing
+    # the device cache inside a jitted fragment program (exec/fused.py)
 
 
 class Executor:
+    #: True inside a jit trace (exec/fused.py): host-sync shortcuts like
+    #: count()-sized output classes switch to static worst-case shapes
+    _traced = False
+
     def __init__(self, ctx: ExecContext):
         self.ctx = ctx
 
@@ -144,15 +165,7 @@ class Executor:
         return out
 
     def _scalar_from_batch(self, b: DBatch, t: SqlType):
-        name = next(iter(b.cols))
-        arr = np.asarray(b.cols[name])
-        valid = np.asarray(b.valid)
-        vals = arr[valid]
-        if len(vals) == 0:
-            return 0
-        if len(vals) > 1:
-            raise ExecError("scalar subquery returned more than one row")
-        return vals[0].item()
+        return scalar_from_batch(b)
 
     # ------------------------------------------------------------------
     def _prep(self, e: E.Expr) -> E.Expr:
@@ -166,9 +179,8 @@ class Executor:
             return None
         return rewrite(e, sub)
 
-    def _compile(self, e: E.Expr, batch: DBatch):
-        from .expr_compile import compile_expr
-
+    @staticmethod
+    def _dictviews(batch: DBatch):
         class _DictView:
             def __init__(self, values):
                 self.values = values
@@ -177,14 +189,53 @@ class Executor:
                 return np.asarray([i for i, v in enumerate(self.values)
                                    if pred(v)], dtype=np.int32)
 
-        dicts = {n: _DictView(v) for n, v in batch.dicts.items()}
-        return compile_expr(self._prep(e), dicts)
+        return {n: _DictView(v) for n, v in batch.dicts.items()}
+
+    @staticmethod
+    def _env(batch: DBatch):
+        """Eval namespace: columns plus null masks under NULLKEY."""
+        from .expr_compile import NULLKEY
+        if not batch.nulls:
+            return batch.cols
+        env = dict(batch.cols)
+        for n, m in batch.nulls.items():
+            env[NULLKEY + n] = m
+        return env
 
     def _eval(self, e: E.Expr, batch: DBatch):
-        return self._compile(e, batch)(batch.cols)
+        """Value-only eval (garbage at NULL positions)."""
+        from .expr_compile import compile_expr
+        return compile_expr(self._prep(e), self._dictviews(batch),
+                            frozenset(batch.nulls))(self._env(batch))
+
+    def _eval_pair(self, e: E.Expr, batch: DBatch):
+        """(value, null_mask|None) eval; the mask is broadcast to batch
+        shape so downstream gathers can index it."""
+        from .expr_compile import compile_pair
+        vf, nf = compile_pair(self._prep(e), self._dictviews(batch),
+                              frozenset(batch.nulls))
+        env = self._env(batch)
+        val = vf(env)
+        if nf is None:
+            return val, None
+        mask = nf(env)
+        if getattr(mask, "ndim", 1) == 0:
+            mask = jnp.broadcast_to(mask, batch.valid.shape)
+        return val, mask
+
+    def _eval_pred(self, e: E.Expr, batch: DBatch):
+        """SQL 3VL predicate eval: True where definitely true."""
+        from .expr_compile import compile_pred
+        return compile_pred(self._prep(e), self._dictviews(batch),
+                            frozenset(batch.nulls))(self._env(batch))
 
     # ------------------------------------------------------------------
     def exec_node(self, node: P.PhysNode) -> DBatch:
+        if not self._traced:
+            from .fused import try_fused
+            out = try_fused(self, node)
+            if out is not None:
+                return out
         m = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if m is None:
             raise ExecError(f"no executor for {type(node).__name__}")
@@ -210,40 +261,49 @@ class Executor:
         for _, oe in outputs:
             needed |= {c.split(".", 1)[1] if "." in c else c
                        for c in _cols_of(oe)}
-        arrs, n = self.ctx.cache.get(store, sorted(needed))
+        staged = (self.ctx.staged or {}).get(table.name)
+        if staged is not None:
+            arrs, n = staged   # fused path: traced program inputs
+        else:
+            arrs, n = self.ctx.cache.get(store, sorted(needed))
 
-        qcols, types, dicts = {}, {}, {}
+        qcols, types, dicts, qnulls = {}, {}, {}, {}
         for c in store.td.columns:
             qname = f"{alias}.{c.name}"
             if c.name in arrs:
                 qcols[qname] = arrs[c.name]
+            if f"__null.{c.name}" in arrs:
+                qnulls[qname] = arrs[f"__null.{c.name}"]
             types[qname] = c.type
             if c.type.kind == TypeKind.TEXT and c.name in store.dicts:
                 dicts[qname] = store.dicts[c.name].values
 
         padded = next_pow2(max(n, 1))
-        base = DBatch(qcols, None, types, dicts)
+        base = DBatch(qcols, jnp.ones(padded, dtype=bool), types, dicts,
+                      qnulls)
         vis = K.visibility_mask(
             arrs["__xmin_ts"], arrs["__xmax_ts"], arrs["__xmin_txid"],
             arrs["__xmax_txid"], jnp.int64(self.ctx.snapshot_ts),
             jnp.int64(self.ctx.txid), jnp.int64(ABORTED_TS))
         vis = vis & (jnp.arange(padded) < n)
         for f in filters:
-            vis = vis & self._eval(f, base)
+            vis = vis & self._eval_pred(f, base)
         return store, base, vis, arrs, n, padded, outputs, dicts
 
     def _exec_seqscan(self, node: P.SeqScan) -> DBatch:
         (_store, base, vis, _arrs, _n, _padded, outputs,
          dicts) = self._scan_base(node.table, node.alias, node.filters,
                                   node.outputs)
-        out_cols, out_types, out_dicts = {}, {}, {}
+        out_cols, out_types, out_dicts, out_nulls = {}, {}, {}, {}
         for name, oe in outputs:
-            out_cols[name] = self._eval(oe, base)
+            out_cols[name], nm = self._eval_pair(oe, base)
+            if nm is not None:
+                out_nulls[name] = nm
             out_types[name] = oe.type
             d = _dict_for_expr(oe, dicts)
             if d is not None:
                 out_dicts[name] = d
-        return DBatch(out_cols, vis, out_types, out_dicts)
+        return DBatch(out_cols, vis, out_types, out_dicts, out_nulls)
 
     def _exec_annsearch(self, node) -> DBatch:
         """Top-k vector search: visibility+filters mask, IVF probe when an
@@ -286,14 +346,14 @@ class Executor:
         b = self.exec_node(node.child)
         valid = b.valid
         for q in node.quals:
-            valid = valid & self._eval(q, b)
+            valid = valid & self._eval_pred(q, b)
         return DBatch(b.cols, valid, b.types, b.dicts, b.nulls)
 
     def _exec_project(self, node: P.Project) -> DBatch:
         b = self.exec_node(node.child)
         cols, types, dicts, nulls = {}, {}, {}, {}
         for name, oe in node.outputs:
-            arr = self._eval(oe, b)
+            arr, nm = self._eval_pair(oe, b)
             if getattr(arr, "ndim", 1) == 0:   # constant: broadcast
                 arr = jnp.full((b.padded,), arr)
             cols[name] = arr
@@ -301,21 +361,35 @@ class Executor:
             d = _dict_for_expr(oe, b.dicts)
             if d is not None:
                 dicts[name] = d
-            if isinstance(oe, E.Col) and oe.name in b.nulls:
-                nulls[name] = b.nulls[oe.name]
+            if nm is not None:
+                nulls[name] = nm
         return DBatch(cols, b.valid, types, dicts, nulls)
 
     # ---- join ----
     def _join_key(self, keys: list[E.Expr], b: DBatch):
-        """Combine join key exprs into one int64 key column."""
-        arrs = [self._eval(k, b) for k in keys]
+        """Combine join key exprs into one int64 key column.  A NULL key
+        never matches (SQL: NULL = x is unknown): null positions take the
+        kernels' reserved unmatchable sentinel INT64_MAX (ops/kernels.py
+        join_probe_counts)."""
+        arrs, nulls = [], None
+        for k in keys:
+            a, nm = self._eval_pair(k, b)
+            arrs.append(a)
+            if nm is not None:
+                nulls = nm if nulls is None else (nulls | nm)
         if len(arrs) == 1:
             a = arrs[0]
             if a.dtype == jnp.bool_:
                 a = a.astype(jnp.int64)
-            return a.astype(jnp.int64), False
-        h = hash_columns_jax([a.astype(jnp.int64) for a in arrs])
-        return h.astype(jnp.int64), True   # hashed: residual recheck needed
+            a = a.astype(jnp.int64)
+            hashed = False
+        else:
+            a = hash_columns_jax([x.astype(jnp.int64) for x in arrs])
+            a = a.astype(jnp.int64)
+            hashed = True   # hashed: residual recheck needed
+        if nulls is not None:
+            a = jnp.where(nulls, K.INT64_MAX, a)
+        return a, hashed
 
     def _exec_hashjoin(self, node: P.HashJoin) -> DBatch:
         left = self.exec_node(node.left)
@@ -381,7 +455,7 @@ class Executor:
             res_valid = res_valid & (self._eval(lk, out) ==
                                      self._eval(rk, out))
         for q in node.residual:
-            res_valid = res_valid & self._eval(q, out)
+            res_valid = res_valid & self._eval_pred(q, out)
 
         if node.kind in ("semi", "anti"):
             # per-probe-row any(): scatter surviving pairs back to probe rows
@@ -449,9 +523,19 @@ class Executor:
 
     # ---- aggregate ----
     def _eval_group_keys(self, node: P.Agg, b: DBatch):
+        """Group key arrays + per-key null masks.  NULL keys group
+        together (SQL: GROUP BY treats NULLs as equal — nodeAgg.c grouping
+        equality): the value is canonicalized to 0 and the null bit
+        becomes an extra grouping column."""
         key_arrs, key_types, key_dicts, dup_dicts = [], [], [], False
+        key_nulls = []
         for name, ke in node.group_keys:
-            key_arrs.append(self._eval(ke, b).astype(jnp.int64))
+            arr, nm = self._eval_pair(ke, b)
+            arr = arr.astype(jnp.int64)
+            if nm is not None:
+                arr = jnp.where(nm, 0, arr)
+            key_arrs.append(arr)
+            key_nulls.append(nm)
             key_types.append(ke.type)
             d = _dict_for_expr(ke, b.dicts)
             key_dicts.append(d)
@@ -460,17 +544,28 @@ class Executor:
             # re-merged after decode
             if d is not None and len(set(d)) < len(d):
                 dup_dicts = True
-        return key_arrs, key_types, key_dicts, dup_dicts
+        return key_arrs, key_types, key_dicts, dup_dicts, key_nulls
+
+    @staticmethod
+    def _grouping_arrays(key_arrs, key_nulls):
+        """Key tuple for the sort kernels: values plus null-indicator
+        columns (so the NULL group is distinct from the value-0 group)."""
+        extra = [nm.astype(jnp.int64) for nm in key_nulls
+                 if nm is not None]
+        return tuple(key_arrs) + tuple(extra)
 
     def _assemble_agg_output(self, node: P.Agg, gkey_out, key_types,
-                             key_dicts, outs, out_specs, out_valid):
-        cols, types, dicts = {}, {}, {}
-        for (kname, _), karr, kt, kd in zip(node.group_keys, gkey_out,
-                                            key_types, key_dicts):
+                             key_dicts, outs, out_specs, out_valid,
+                             gkey_nulls=None):
+        cols, types, dicts, nulls = {}, {}, {}, {}
+        for i, ((kname, _), karr, kt, kd) in enumerate(
+                zip(node.group_keys, gkey_out, key_types, key_dicts)):
             cols[kname] = karr.astype(kt.np_dtype)
             types[kname] = kt
             if kd is not None:
                 dicts[kname] = kd
+            if gkey_nulls is not None and gkey_nulls[i] is not None:
+                nulls[kname] = gkey_nulls[i]
         oi = 0
         for name, t, special in out_specs:
             if special is not None and special[0] == "avg":
@@ -478,54 +573,62 @@ class Executor:
                 oi += 2
                 cols[name] = jnp.where(c > 0, s / jnp.maximum(c, 1)
                                        / (10 ** special[1]), 0.0)
+                nulls[name] = c == 0  # avg over zero non-null inputs
+            elif special is not None and special[0] == "nullable":
+                # value plus its non-null contribution count: the SQL
+                # aggregate is NULL when every input in the group was NULL
+                v, c = outs[oi], outs[oi + 1]
+                oi += 2
+                cols[name] = v
+                nulls[name] = c == 0
             else:
                 cols[name] = outs[oi]
                 oi += 1
             types[name] = t
-        return DBatch(cols, out_valid, types, dicts)
+        return DBatch(cols, out_valid, types, dicts, nulls)
 
-    def _exec_agg(self, node: P.Agg) -> DBatch:
-        b = self.exec_node(node.child)
-        if node.mode == "final":
-            return self._exec_agg_final(node, b)
-        key_arrs, key_types, key_dicts, text_transformed = \
-            self._eval_group_keys(node, b)
-
-        if any(ac.distinct for _, ac in node.aggs):
-            return self._exec_distinct_agg(node, b, key_arrs, key_types,
-                                           key_dicts)
-
-        # expand aggregate inputs
+    def _agg_inputs(self, node: P.Agg, b: DBatch, final: bool):
+        """Kernel inputs for the agg list.  `final` combines partial
+        columns (named inputs with exchange-carried null masks) instead of
+        raw argument expressions.  Aggregates over nullable inputs get a
+        parallel non-null-count input so all-NULL groups yield SQL NULL
+        (the ("nullable",) out_spec)."""
         kinds, inputs, out_specs = [], [], []
         for name, ac in node.aggs:
-            arg_arr = None
-            null_mask = None
-            if ac.arg is not None:
-                arg_arr = self._eval(ac.arg, b)
-                if isinstance(ac.arg, E.Col) and ac.arg.name in b.nulls:
-                    null_mask = b.nulls[ac.arg.name]
-            # SQL aggregates skip NULLs (outer-join null-extended rows):
-            # pre-mask inputs with the aggregate's neutral element
+            if final:
+                if ac.func == "avg":
+                    arg_arr = null_mask = None
+                else:
+                    arg_arr = b.cols.get(name)
+                    null_mask = b.nulls.get(name)
+            elif ac.arg is not None:
+                arg_arr, null_mask = self._eval_pair(ac.arg, b)
+            else:
+                arg_arr = null_mask = None
+
             def non_null(v, neutral):
                 if null_mask is None:
                     return v
                 return jnp.where(null_mask, jnp.asarray(neutral, v.dtype), v)
 
+            base = b.valid if null_mask is None else (b.valid & ~null_mask)
             if ac.func == "count":
-                base = b.valid if null_mask is None else \
-                    (b.valid & ~null_mask)
-                kinds.append("sum")
-                inputs.append(base.astype(jnp.int64))
+                if final:
+                    kinds.append("sum")
+                    inputs.append(non_null(arg_arr, 0))
+                else:
+                    kinds.append("sum")
+                    inputs.append(base.astype(jnp.int64))
                 out_specs.append((name, T.INT64, None))
             elif ac.func == "avg":
                 scale = ac.arg.type.scale \
                     if ac.arg.type.kind == TypeKind.DECIMAL else 0
                 kinds.append("sumf")
-                inputs.append(non_null(arg_arr, 0))
-                base = b.valid if null_mask is None else \
-                    (b.valid & ~null_mask)
+                inputs.append(b.cols[name + "__s"] if final
+                              else non_null(arg_arr, 0))
                 kinds.append("sum")
-                inputs.append(base.astype(jnp.int64))
+                inputs.append(b.cols[name + "__c"] if final
+                              else base.astype(jnp.int64))
                 if node.mode == "partial":
                     # components travel separately to the final agg
                     out_specs.append((name + "__s", T.FLOAT64, None))
@@ -535,13 +638,18 @@ class Executor:
             elif ac.func == "sum":
                 if ac.arg.type.kind == TypeKind.FLOAT64:
                     kinds.append("sumf")
-                    out_specs.append((name, T.FLOAT64, None))
+                    t = T.FLOAT64
                 else:
                     kinds.append("sum")
                     t = ac.arg.type if ac.arg.type.kind == TypeKind.DECIMAL \
                         else T.INT64
-                    out_specs.append((name, t, None))
                 inputs.append(non_null(arg_arr, 0))
+                if null_mask is not None:
+                    kinds.append("sum")
+                    inputs.append(base.astype(jnp.int64))
+                    out_specs.append((name, t, ("nullable",)))
+                else:
+                    out_specs.append((name, t, None))
             elif ac.func in ("min", "max"):
                 kinds.append(ac.func)
                 if null_mask is not None:
@@ -552,11 +660,32 @@ class Executor:
                         neutral = np.inf if ac.func == "min" else -np.inf
                     arg_arr = non_null(arg_arr, neutral)
                 inputs.append(arg_arr)
-                out_specs.append((name, ac.arg.type, None))
+                if null_mask is not None:
+                    kinds.append("sum")
+                    inputs.append(base.astype(jnp.int64))
+                    out_specs.append((name, ac.arg.type, ("nullable",)))
+                else:
+                    out_specs.append((name, ac.arg.type, None))
             else:
                 raise ExecError(f"aggregate {ac.func} unsupported")
+        return kinds, inputs, out_specs
+
+    def _exec_agg(self, node: P.Agg) -> DBatch:
+        b = self.exec_node(node.child)
+        if node.mode == "final":
+            return self._exec_agg_final(node, b)
+        key_arrs, key_types, key_dicts, text_transformed, key_nulls = \
+            self._eval_group_keys(node, b)
+
+        if any(ac.distinct for _, ac in node.aggs):
+            return self._exec_distinct_agg(node, b, key_arrs, key_types,
+                                           key_dicts, key_nulls)
+
+        kinds, inputs, out_specs = self._agg_inputs(node, b, final=False)
 
         n = b.padded
+        any_null_keys = any(nm is not None for nm in key_nulls)
+        gkey_nulls = [None] * len(key_arrs)
         if not key_arrs:
             gid = jnp.zeros(n, dtype=jnp.int64)
             (outs, present) = K.grouped_agg_dense(
@@ -565,7 +694,8 @@ class Executor:
             gkey_out = []
             padded_groups = 1
         else:
-            dense_bound = _dense_bound(key_types, key_dicts)
+            dense_bound = _dense_bound(key_types, key_dicts) \
+                if not any_null_keys else None
             if dense_bound is not None and dense_bound <= 4096:
                 gid = jnp.zeros(n, dtype=jnp.int64)
                 mult = 1
@@ -586,18 +716,27 @@ class Executor:
                     gkey_out.insert(0, (rem % doms[i]).astype(jnp.int64))
                     rem = rem // doms[i]
             else:
-                max_groups = next_pow2(max(b.count(), 1))
+                # traced (fused) programs can't sync a group count to the
+                # host: use the worst case (every row its own group) —
+                # padding is masked out downstream either way
+                max_groups = b.padded if self._traced else \
+                    next_pow2(max(b.count(), 1))
                 gkeys, outs, ng = K.grouped_agg_sort(
-                    tuple(key_arrs), b.valid, tuple(inputs),
-                    max_groups, tuple(kinds))
-                ng = int(ng)
+                    self._grouping_arrays(key_arrs, key_nulls), b.valid,
+                    tuple(inputs), max_groups, tuple(kinds))
+                if not self._traced:
+                    ng = int(ng)
                 padded_groups = max_groups
                 out_valid = jnp.arange(max_groups) < ng
-                gkey_out = list(gkeys)
+                gkey_out = list(gkeys[:len(key_arrs)])
+                extra = list(gkeys[len(key_arrs):])
+                for i, nm in enumerate(key_nulls):
+                    if nm is not None:
+                        gkey_nulls[i] = extra.pop(0).astype(bool)
 
         out = self._assemble_agg_output(node, gkey_out, key_types,
                                         key_dicts, outs, out_specs,
-                                        out_valid)
+                                        out_valid, gkey_nulls)
         # partial mode skips the re-merge: the exchange decodes transformed
         # dictionaries to strings and re-encodes uniquely, so the final agg
         # merges over-split groups by itself
@@ -610,94 +749,78 @@ class Executor:
         the CN-side combine of DN partials).  Input columns follow the
         partial naming convention; group keys are passthrough columns.
         Exchange re-encoding guarantees unique dictionary values here, so
-        no post-decode re-merge is needed."""
-        key_arrs, key_types, key_dicts, _ = self._eval_group_keys(node, b)
-
-        kinds, inputs, out_specs = [], [], []
-        for name, ac in node.aggs:
-            if ac.func == "avg":
-                scale = ac.arg.type.scale \
-                    if ac.arg.type.kind == TypeKind.DECIMAL else 0
-                kinds.append("sumf")
-                inputs.append(b.cols[name + "__s"])
-                kinds.append("sum")
-                inputs.append(b.cols[name + "__c"])
-                out_specs.append((name, T.FLOAT64, ("avg", scale)))
-            elif ac.func in ("count", "sum"):
-                arr = b.cols[name]
-                if ac.func == "sum" and ac.arg.type.kind == TypeKind.FLOAT64:
-                    kinds.append("sumf")
-                    out_specs.append((name, T.FLOAT64, None))
-                elif ac.func == "count":
-                    kinds.append("sum")
-                    out_specs.append((name, T.INT64, None))
-                else:
-                    kinds.append("sum")
-                    t = ac.arg.type if ac.arg.type.kind == TypeKind.DECIMAL \
-                        else T.INT64
-                    out_specs.append((name, t, None))
-                inputs.append(arr)
-            elif ac.func in ("min", "max"):
-                kinds.append(ac.func)
-                inputs.append(b.cols[name])
-                out_specs.append((name, ac.arg.type, None))
-            else:
-                raise ExecError(f"cannot finalise aggregate {ac.func}")
+        no post-decode re-merge is needed.  Null masks on partial columns
+        (a DN-group whose inputs were all NULL) combine through the same
+        skip-null rule as raw arguments."""
+        key_arrs, key_types, key_dicts, _, key_nulls = \
+            self._eval_group_keys(node, b)
+        kinds, inputs, out_specs = self._agg_inputs(node, b, final=True)
 
         n = b.padded
+        gkey_nulls = [None] * len(key_arrs)
         if not key_arrs:
             gid = jnp.zeros(n, dtype=jnp.int64)
             outs, present = K.grouped_agg_dense(
                 gid, b.valid, tuple(inputs), 1, tuple(kinds))
             out_valid = jnp.ones(1, dtype=bool)
             gkey_out = []
-            max_groups = 1
         else:
             max_groups = next_pow2(max(b.count(), 1))
             gkeys, outs, ng = K.grouped_agg_sort(
-                tuple(key_arrs), b.valid, tuple(inputs), max_groups,
-                tuple(kinds))
+                self._grouping_arrays(key_arrs, key_nulls), b.valid,
+                tuple(inputs), max_groups, tuple(kinds))
             out_valid = jnp.arange(max_groups) < int(ng)
-            gkey_out = list(gkeys)
+            gkey_out = list(gkeys[:len(key_arrs)])
+            extra = list(gkeys[len(key_arrs):])
+            for i, nm in enumerate(key_nulls):
+                if nm is not None:
+                    gkey_nulls[i] = extra.pop(0).astype(bool)
 
         return self._assemble_agg_output(node, gkey_out, key_types,
                                          key_dicts, outs, out_specs,
-                                         out_valid)
+                                         out_valid, gkey_nulls)
 
     def _exec_distinct_agg(self, node: P.Agg, b: DBatch, key_arrs,
-                           key_types, key_dicts) -> DBatch:
+                           key_types, key_dicts, key_nulls) -> DBatch:
         """count(DISTINCT x): dedupe on (group keys, x) then count per
         group — the reference handles this via sorted Agg transition
-        (nodeAgg.c DISTINCT path); here two sort-based passes."""
+        (nodeAgg.c DISTINCT path); here two sort-based passes.  NULL
+        arguments are skipped (count never counts NULL)."""
         if len(node.aggs) != 1 or node.aggs[0][1].func != "count":
             raise ExecError("only a single count(DISTINCT x) aggregate "
                             "is supported")
         name, ac = node.aggs[0]
-        arg_arr = self._eval(ac.arg, b).astype(jnp.int64)
+        arg_arr, arg_null = self._eval_pair(ac.arg, b)
+        arg_arr = arg_arr.astype(jnp.int64)
+        valid0 = b.valid if arg_null is None else (b.valid & ~arg_null)
         n = b.padded
         max_g1 = next_pow2(max(b.count(), 1))
+        nkeys1 = self._grouping_arrays(key_arrs, key_nulls) + (arg_arr,)
         gkeys1, _, ng1 = K.grouped_agg_sort(
-            tuple(key_arrs) + (arg_arr,), b.valid,
-            (b.valid.astype(jnp.int64),), max_g1, ("count",))
+            nkeys1, valid0, (valid0.astype(jnp.int64),), max_g1, ("count",))
         ng1 = int(ng1)
         valid1 = jnp.arange(max_g1) < ng1
         max_g2 = next_pow2(max(ng1, 1))
+        n_gkeys = len(nkeys1) - 1
         gkeys2, (cnt,), ng2 = K.grouped_agg_sort(
-            tuple(g for g in gkeys1[:-1]) if key_arrs else
+            tuple(gkeys1[:n_gkeys]) if key_arrs else
             (jnp.zeros(max_g1, jnp.int64),),
             valid1, (valid1.astype(jnp.int64),), max_g2, ("count",))
         ng2 = int(ng2)
-        cols, types, dicts = {}, {}, {}
-        for (kname, _), karr, kt, kd in zip(node.group_keys, gkeys2,
-                                            key_types, key_dicts):
+        cols, types, dicts, nulls = {}, {}, {}, {}
+        extra = list(gkeys2[len(key_arrs):n_gkeys])
+        for i, ((kname, _), karr, kt, kd) in enumerate(
+                zip(node.group_keys, gkeys2, key_types, key_dicts)):
             cols[kname] = karr[:max_g2].astype(kt.np_dtype)
             types[kname] = kt
             if kd is not None:
                 dicts[kname] = kd
+            if key_nulls[i] is not None:
+                nulls[kname] = extra.pop(0).astype(bool)
         cols[name] = cnt
         types[name] = T.INT64
         out_valid = jnp.arange(max_g2) < (ng2 if key_arrs else 1)
-        return DBatch(cols, out_valid, types, dicts)
+        return DBatch(cols, out_valid, types, dicts, nulls)
 
     def _remerge_text_groups(self, node: P.Agg, b: DBatch) -> DBatch:
         """Group keys built from transformed dictionaries (substring) may
@@ -759,7 +882,7 @@ class Executor:
         b = self.exec_node(node.child)
         key_arrs, descs = [], []
         for ke, desc in node.keys:
-            arr = self._eval(ke, b)
+            arr, nm = self._eval_pair(ke, b)
             d = _dict_for_expr(ke, b.dicts)
             if d is not None:
                 # dictionary codes are unordered: map code -> rank
@@ -767,6 +890,16 @@ class Executor:
                 rank = np.empty(max(len(d), 1), dtype=np.int32)
                 rank[order] = np.arange(len(d), dtype=np.int32)
                 arr = jnp.asarray(rank)[jnp.clip(arr, 0, len(d) - 1)]
+            if nm is not None:
+                # NULLs sort as +infinity: last under ASC, first under
+                # DESC — PostgreSQL's default NULLS LAST/FIRST pairing
+                if arr.dtype == jnp.bool_:
+                    big = jnp.asarray(True)
+                elif jnp.issubdtype(arr.dtype, jnp.floating):
+                    big = jnp.asarray(np.inf, arr.dtype)
+                else:
+                    big = jnp.asarray(jnp.iinfo(arr.dtype).max, arr.dtype)
+                arr = jnp.where(nm, big, arr)
             key_arrs.append(arr)
             descs.append(bool(desc))
         names = list(b.cols.keys())
@@ -793,14 +926,16 @@ class Executor:
         return DBatch(b.cols, keep, b.types, b.dicts, b.nulls)
 
     def _exec_result(self, node: P.Result) -> DBatch:
-        cols, types = {}, {}
+        cols, types, nulls = {}, {}, {}
         base = DBatch({}, jnp.ones(1, dtype=bool), {}, {})
         for name, oe in node.outputs:
-            arr = self._eval(oe, base)
+            arr, nm = self._eval_pair(oe, base)
             cols[name] = jnp.broadcast_to(arr, (1,)) \
                 if getattr(arr, "ndim", 0) == 0 else arr
+            if nm is not None:
+                nulls[name] = nm
             types[name] = oe.type
-        return DBatch(cols, jnp.ones(1, dtype=bool), types, {})
+        return DBatch(cols, jnp.ones(1, dtype=bool), types, {}, nulls)
 
     def _exec_gather(self, node: P.Gather) -> DBatch:
         return self.exec_node(node.child)
@@ -842,6 +977,22 @@ def _dict_for_expr(e: E.Expr, dicts: dict):
     return None
 
 
+def scalar_from_batch(b: DBatch):
+    """One value or SQL NULL (None) from a scalar-subquery result — an
+    empty subquery is NULL, not 0 (reference: ExecScanSubPlan's
+    unset-param NULL).  Shared by the local and distributed executors."""
+    name = next(iter(b.cols))
+    valid = np.asarray(b.valid)
+    vals = np.asarray(b.cols[name])[valid]
+    if len(vals) == 0:
+        return None
+    if len(vals) > 1:
+        raise ExecError("scalar subquery returned more than one row")
+    if name in b.nulls and bool(np.asarray(b.nulls[name])[valid][0]):
+        return None
+    return vals[0].item()
+
+
 def materialize(b: DBatch, names: Optional[list[str]] = None):
     """DBatch -> (column_names, list of python row tuples), decoded."""
     if names is None:
@@ -855,19 +1006,27 @@ def materialize(b: DBatch, names: Optional[list[str]] = None):
         nullm = np.asarray(b.nulls[n])[rows_idx] if n in b.nulls else None
         if t.kind == TypeKind.TEXT:
             d = b.dicts.get(n, [])
-            vals = [d[int(c)] if 0 <= int(c) < len(d) else None for c in arr]
+            if d:
+                table = np.asarray(list(d) + [None], dtype=object)
+                codes = np.where((arr >= 0) & (arr < len(d)), arr, len(d))
+                vals = table[codes].tolist()
+            else:
+                vals = [None] * len(arr)
         elif t.kind == TypeKind.DECIMAL:
-            vals = [v.item() / 10 ** t.scale for v in arr]
+            vals = (arr / 10 ** t.scale).tolist()
         elif t.kind == TypeKind.DATE:
-            vals = [T.days_to_date(int(v)) for v in arr]
+            epoch = np.datetime64("1970-01-01", "D")
+            vals = [str(v) for v in
+                    (epoch + arr.astype("timedelta64[D]"))]
         elif t.kind == TypeKind.BOOL:
-            vals = [bool(v) for v in arr]
+            vals = arr.astype(bool).tolist()
         elif t.kind == TypeKind.FLOAT64:
-            vals = [float(v) for v in arr]
+            vals = arr.astype(np.float64).tolist()
         elif t.kind == TypeKind.VECTOR:
             vals = [tuple(float(x) for x in v) for v in arr]
         else:
-            vals = [int(v) for v in arr]
+            vals = arr.astype(np.int64).tolist() \
+                if arr.dtype.kind in "iu" else arr.tolist()
         if nullm is not None:
             vals = [None if m else v for v, m in zip(vals, nullm)]
         out_cols.append(vals)

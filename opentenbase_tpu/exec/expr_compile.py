@@ -7,6 +7,20 @@ tier (src/backend/jit/llvm/llvmjit_expr.c).  Here both tiers are one step:
 under jax.jit it becomes fused XLA ops — the TPU executes the whole
 qual+projection as part of the scan kernel, no per-tuple dispatch.
 
+NULL semantics are compiled as a parallel mask program (compile_pair):
+every expression yields (value_fn, null_fn|None).  Strict operators union
+their children's masks and leave garbage at null positions of the value
+array (the positions are masked before anything observes them — the
+vectorized version of the reference's per-step NULL flag in
+execExprInterp.c).  Non-strict nodes (AND/OR/NOT via Kleene 3VL, CASE,
+COALESCE, NULLIF, IS NULL) manipulate the masks directly.  `null_fn is
+None` proves the expression can never be NULL — the TPC-H hot paths
+compile exactly as before, zero mask overhead.
+
+Predicates go through `compile_pred`, which returns the SQL "is true"
+test (value & ~null): a WHERE clause keeps a row only when the qual is
+definitely true (reference: ExecQual's treatment of NULL as false).
+
 String predicates (LIKE/=/< over TEXT) are resolved at compile time against
 the store's dictionary into code sets; on device they are integer membership
 tests.  This trades the reference's per-tuple varlena compares for one
@@ -16,7 +30,7 @@ host-side dictionary pass per (query, dictionary version).
 from __future__ import annotations
 
 import re
-from typing import Callable
+from typing import Callable, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -24,7 +38,9 @@ import numpy as np
 from ..catalog.types import TypeKind
 from ..plan import exprs as E
 
-Arrays = dict  # name -> jnp array
+Arrays = dict  # name -> jnp array (null masks under NULLKEY + name)
+
+NULLKEY = "__null__:"   # env key prefix for column null masks
 
 
 def like_to_regex(pattern: str) -> re.Pattern:
@@ -119,24 +135,61 @@ def _civil(days):
     return year, month, day
 
 
-def compile_expr(e: E.Expr, dicts: dict) -> Callable[[Arrays], object]:
-    """Return fn(columns) -> array.  `dicts` maps TEXT column name ->
-    StringDict for string-predicate resolution."""
+NullFn = Optional[Callable[[Arrays], object]]
 
-    def c(x: E.Expr) -> Callable[[Arrays], object]:
+
+def _union(*nfs: NullFn) -> NullFn:
+    """OR-combine null masks (strict-operator propagation)."""
+    live = [f for f in nfs if f is not None]
+    if not live:
+        return None
+    if len(live) == 1:
+        return live[0]
+
+    def nf(env, _fs=tuple(live)):
+        m = _fs[0](env)
+        for f in _fs[1:]:
+            m = m | f(env)
+        return m
+    return nf
+
+
+def _truth(vf, nf: NullFn):
+    """SQL three-valued 'is true' / 'is false' closures from a pair."""
+    if nf is None:
+        return vf, (lambda env, _v=vf: ~_v(env))
+    t = lambda env, _v=vf, _n=nf: _v(env) & ~_n(env)
+    f = lambda env, _v=vf, _n=nf: ~_v(env) & ~_n(env)
+    return t, f
+
+
+def compile_pair(e: E.Expr, dicts: dict, nullable=frozenset()):
+    """Return (value_fn, null_fn|None).  `nullable` is the set of column
+    names that carry a null mask in the eval env (under NULLKEY+name);
+    null_fn None proves the result is never NULL."""
+
+    def c(x: E.Expr):
         if isinstance(x, E.Col):
             name = x.name
-            return lambda cols: cols[name]
+            vf = lambda cols: cols[name]
+            if name in nullable:
+                key = NULLKEY + name
+                return vf, (lambda env: env[key])
+            return vf, None
 
         if isinstance(x, E.Lit):
             t = x.lit_type
-            val = x.value
             dt = _np_dtype(t)
-            return lambda cols: jnp.asarray(val, dtype=dt)
+            if x.value is None:
+                return (lambda cols: jnp.asarray(0, dtype=dt),
+                        lambda env: jnp.asarray(True))
+            val = x.value
+            return (lambda cols: jnp.asarray(val, dtype=dt)), None
 
         if isinstance(x, E.Arith):
             lt, rt = x.left.type, x.right.type
-            lf, rf = c(x.left), c(x.right)
+            (lf, ln), (rf, rn) = c(x.left), c(x.right)
+            nf = _union(ln, rn)
             if x.type.kind == TypeKind.FLOAT64:
                 lf2 = (lambda cols, _f=lf, _s=lt.scale:
                        _f(cols).astype(jnp.float64) / 10 ** _s) \
@@ -150,44 +203,45 @@ def compile_expr(e: E.Expr, dicts: dict) -> Callable[[Arrays], object]:
                 return {"+": lambda cols: lf2(cols) + rf2(cols),
                         "-": lambda cols: lf2(cols) - rf2(cols),
                         "*": lambda cols: lf2(cols) * rf2(cols),
-                        "/": lambda cols: lf2(cols) / rf2(cols)}[op]
+                        "/": lambda cols: lf2(cols) / rf2(cols)}[op], nf
             if x.type.kind == TypeKind.DECIMAL and x.op in "+-":
                 s = x.type.scale
                 lf = _rescale(lf, lt.scale if lt.kind == TypeKind.DECIMAL
                               else 0, s) if lt.kind == TypeKind.DECIMAL \
-                    else _rescale(lambda cols, _f=lf: _f(cols).astype(jnp.int64),
-                                  0, s)
+                    else _rescale(lambda cols, _f=lf:
+                                  _f(cols).astype(jnp.int64), 0, s)
                 rf = _rescale(rf, rt.scale if rt.kind == TypeKind.DECIMAL
                               else 0, s) if rt.kind == TypeKind.DECIMAL \
-                    else _rescale(lambda cols, _f=rf: _f(cols).astype(jnp.int64),
-                                  0, s)
+                    else _rescale(lambda cols, _f=rf:
+                                  _f(cols).astype(jnp.int64), 0, s)
             if x.op == "+":
-                return lambda cols: lf(cols) + rf(cols)
+                return (lambda cols: lf(cols) + rf(cols)), nf
             if x.op == "-":
-                return lambda cols: lf(cols) - rf(cols)
+                return (lambda cols: lf(cols) - rf(cols)), nf
             if x.op == "*":
-                return lambda cols: (lf(cols).astype(jnp.int64)
-                                     * rf(cols).astype(jnp.int64)) \
-                    if x.type.kind == TypeKind.DECIMAL \
-                    else lf(cols) * rf(cols)
+                return (lambda cols: (lf(cols).astype(jnp.int64)
+                                      * rf(cols).astype(jnp.int64))
+                        if x.type.kind == TypeKind.DECIMAL
+                        else lf(cols) * rf(cols)), nf
             if x.op == "%":
                 # SQL modulo truncates toward zero (sign of the dividend);
                 # python/numpy % floors (sign of the divisor)
-                return lambda cols: jnp.fmod(lf(cols), rf(cols))
+                return (lambda cols: jnp.fmod(lf(cols), rf(cols))), nf
             raise E.ExprError(f"bad arith op {x.op}")
 
         if isinstance(x, E.Neg):
-            f = c(x.arg)
-            return lambda cols: -f(cols)
+            f, nf = c(x.arg)
+            return (lambda cols: -f(cols)), nf
 
         if isinstance(x, E.Cmp):
             lt, rt = x.left.type, x.right.type
-            lf, rf = c(x.left), c(x.right)
+            (lf, ln), (rf, rn) = c(x.left), c(x.right)
             # align decimal scales / promote to float if either is float
             if TypeKind.FLOAT64 in (lt.kind, rt.kind):
                 def mk(f, t):
                     if t.kind == TypeKind.DECIMAL:
-                        return lambda cols: f(cols).astype(jnp.float64) / 10 ** t.scale
+                        return lambda cols: (f(cols).astype(jnp.float64)
+                                             / 10 ** t.scale)
                     return lambda cols: f(cols).astype(jnp.float64)
                 lf, rf = mk(lf, lt), mk(rf, rt)
             elif TypeKind.DECIMAL in (lt.kind, rt.kind):
@@ -195,100 +249,243 @@ def compile_expr(e: E.Expr, dicts: dict) -> Callable[[Arrays], object]:
                 lf = _rescale(lf, lt.scale, s)
                 rf = _rescale(rf, rt.scale, s)
             op = x.op
-            return {"=": lambda cols: lf(cols) == rf(cols),
-                    "<>": lambda cols: lf(cols) != rf(cols),
-                    "<": lambda cols: lf(cols) < rf(cols),
-                    "<=": lambda cols: lf(cols) <= rf(cols),
-                    ">": lambda cols: lf(cols) > rf(cols),
-                    ">=": lambda cols: lf(cols) >= rf(cols)}[op]
+            vf = {"=": lambda cols: lf(cols) == rf(cols),
+                  "<>": lambda cols: lf(cols) != rf(cols),
+                  "<": lambda cols: lf(cols) < rf(cols),
+                  "<=": lambda cols: lf(cols) <= rf(cols),
+                  ">": lambda cols: lf(cols) > rf(cols),
+                  ">=": lambda cols: lf(cols) >= rf(cols)}[op]
+            return vf, _union(ln, rn)
 
         if isinstance(x, E.BoolOp):
-            fs = [c(a) for a in x.args]
-            if x.op == "and":
-                def andf(cols):
-                    m = fs[0](cols)
-                    for f in fs[1:]:
-                        m = m & f(cols)
+            pairs = [c(a) for a in x.args]
+            if all(n is None for _, n in pairs):
+                fs = [v for v, _ in pairs]
+                if x.op == "and":
+                    def andf(cols, _fs=tuple(fs)):
+                        m = _fs[0](cols)
+                        for f in _fs[1:]:
+                            m = m & f(cols)
+                        return m
+                    return andf, None
+
+                def orf(cols, _fs=tuple(fs)):
+                    m = _fs[0](cols)
+                    for f in _fs[1:]:
+                        m = m | f(cols)
                     return m
-                return andf
-            def orf(cols):
-                m = fs[0](cols)
-                for f in fs[1:]:
-                    m = m | f(cols)
-                return m
-            return orf
+                return orf, None
+            # Kleene 3VL: value = "definitely true", false = "definitely
+            # false", null = neither (reference: ExecEvalBoolAndStep /
+            # OrStep NULL handling in execExprInterp.c)
+            truths = [_truth(v, n) for v, n in pairs]
+            if x.op == "and":
+                def tf(env, _ts=tuple(t for t, _ in truths)):
+                    m = _ts[0](env)
+                    for t in _ts[1:]:
+                        m = m & t(env)
+                    return m
+
+                def ff(env, _fs=tuple(f for _, f in truths)):
+                    m = _fs[0](env)
+                    for f in _fs[1:]:
+                        m = m | f(env)
+                    return m
+            else:
+                def tf(env, _ts=tuple(t for t, _ in truths)):
+                    m = _ts[0](env)
+                    for t in _ts[1:]:
+                        m = m | t(env)
+                    return m
+
+                def ff(env, _fs=tuple(f for _, f in truths)):
+                    m = _fs[0](env)
+                    for f in _fs[1:]:
+                        m = m & f(env)
+                    return m
+            return tf, (lambda env: ~tf(env) & ~ff(env))
 
         if isinstance(x, E.Not):
-            f = c(x.arg)
-            return lambda cols: ~f(cols)
+            vf, nf = c(x.arg)
+            if nf is None:
+                return (lambda cols: ~vf(cols)), None
+            t, f = _truth(vf, nf)
+            return f, nf  # NOT null is null; NOT true=false, NOT false=true
+
+        if isinstance(x, E.IsNull):
+            _, nf = c(x.arg)
+            if nf is None:
+                const = bool(x.negated)  # never null
+                return (lambda cols: jnp.asarray(const)), None
+            if x.negated:
+                return (lambda env: ~nf(env)), None
+            return nf, None
+
+        if isinstance(x, E.Coalesce):
+            pairs = [c(a) for a in x.args]
+            dt = _np_dtype(x.type)
+            first_vf = pairs[0][0]
+            if pairs[0][1] is None:
+                return (lambda cols: first_vf(cols).astype(dt)), None
+
+            def vf(env, _pairs=tuple(pairs)):
+                out = _pairs[-1][0](env).astype(dt)
+                for v, n in reversed(_pairs[:-1]):
+                    if n is None:
+                        out = v(env).astype(dt)
+                    else:
+                        out = jnp.where(n(env), out, v(env).astype(dt))
+                return out
+            nfs = [n for _, n in pairs]
+            if any(n is None for n in nfs):
+                return vf, None  # some arg can never be null
+
+            def nf(env, _ns=tuple(nfs)):
+                m = _ns[0](env)
+                for n in _ns[1:]:
+                    m = m & n(env)
+                return m
+            return vf, nf
+
+        if isinstance(x, E.NullIf):
+            lf, ln = c(x.left)
+            # the equality goes through Cmp so decimal scales/floats align
+            eqt, _ = _truth(*c(E.Cmp("=", x.left, x.right)))
+            nf = (lambda env: ln(env) | eqt(env)) if ln is not None \
+                else eqt
+            return lf, nf
 
         if isinstance(x, E.Case):
-            conds = [c(w[0]) for w in x.whens]
-            vals = [c(w[1]) for w in x.whens]
-            elsef = c(x.else_) if x.else_ is not None else None
+            cond_truths = [_truth(*c(w[0]))[0] for w in x.whens]
+            val_pairs = [c(w[1]) for w in x.whens]
+            else_pair = c(x.else_) if x.else_ is not None else None
             dt = _np_dtype(x.type)
 
-            def casef(cols):
-                out = elsef(cols) if elsef is not None \
+            def casef(env):
+                out = else_pair[0](env) if else_pair is not None \
                     else jnp.zeros((), dtype=dt)
-                for cond, val in zip(reversed(conds), reversed(vals)):
-                    out = jnp.where(cond(cols), val(cols), out)
+                for cond, (val, _) in zip(reversed(cond_truths),
+                                          reversed(val_pairs)):
+                    out = jnp.where(cond(env), val(env), out)
                 return out
-            return casef
+
+            # null when the chosen branch is null; a missing ELSE is NULL
+            branch_nulls = [n for _, n in val_pairs]
+            else_null = None if else_pair is None else else_pair[1]
+            if all(n is None for n in branch_nulls) and (
+                    x.else_ is not None and else_null is None):
+                return casef, None
+
+            def case_nf(env):
+                if x.else_ is None:
+                    out = jnp.asarray(True)
+                elif else_null is None:
+                    out = jnp.asarray(False)
+                else:
+                    out = else_null(env)
+                for cond, bn in zip(reversed(cond_truths),
+                                    reversed(branch_nulls)):
+                    bval = jnp.asarray(False) if bn is None else bn(env)
+                    out = jnp.where(cond(env), bval, out)
+                return out
+            return casef, case_nf
 
         if isinstance(x, E.InList):
-            f = c(x.arg)
+            f, nf = c(x.arg)
             vals = np.asarray(x.values)
-            return lambda cols: _membership(f(cols), vals)
+            return (lambda cols: _membership(f(cols), vals)), nf
 
         if isinstance(x, E.StrPred):
             codes = _codes_for_strpred(x, dicts)
             name = _strpred_colname(x)
             neg = x.kind in ("ne", "not_like", "not_in")
+            nf = (lambda env, _k=NULLKEY + name: env[_k]) \
+                if name in nullable else None
             if neg:
-                return lambda cols: ~_membership(cols[name], codes)
-            return lambda cols: _membership(cols[name], codes)
+                return (lambda cols: ~_membership(cols[name], codes)), nf
+            return (lambda cols: _membership(cols[name], codes)), nf
 
         if isinstance(x, E.TextExpr):
             # codes pass through; only the decode dictionary changes
             name = x.col.name
-            return lambda cols: cols[name]
+            nf = (lambda env, _k=NULLKEY + name: env[_k]) \
+                if name in nullable else None
+            return (lambda cols: cols[name]), nf
 
         if isinstance(x, E.DistExpr):
             from ..ops.ann import distances
             name = x.col.name
             q = np.asarray(x.query, dtype=np.float32)
             metric = x.metric
-            return lambda cols: distances(cols[name], jnp.asarray(q),
-                                          metric).astype(jnp.float64)
+            return (lambda cols: distances(cols[name], jnp.asarray(q),
+                                           metric).astype(jnp.float64)), None
 
         if isinstance(x, E.Extract):
-            f = c(x.arg)
+            f, nf = c(x.arg)
             idx = {"year": 0, "month": 1, "day": 2}[x.field]
-            return lambda cols: _civil(f(cols))[idx].astype(jnp.int32)
+            return (lambda cols: _civil(f(cols))[idx].astype(jnp.int32)), nf
 
         if isinstance(x, E.Cast):
-            f = c(x.arg)
+            f, nf = c(x.arg)
             src, dst = x.arg.type, x.to
+            if src.kind == TypeKind.NULL:
+                dt = _np_dtype(dst)
+                return (lambda cols: jnp.asarray(0, dtype=dt)), \
+                    (lambda env: jnp.asarray(True))
             if dst.kind == TypeKind.FLOAT64 and src.kind == TypeKind.DECIMAL:
-                return lambda cols: f(cols).astype(jnp.float64) / 10 ** src.scale
+                return (lambda cols: f(cols).astype(jnp.float64)
+                        / 10 ** src.scale), nf
             if dst.kind == TypeKind.DECIMAL and src.kind == TypeKind.DECIMAL:
-                return _rescale(f, src.scale, dst.scale)
+                return _rescale(f, src.scale, dst.scale), nf
             if dst.kind in (TypeKind.INT32, TypeKind.INT64) \
                     and src.kind == TypeKind.DECIMAL:
                 dt = _np_dtype(dst)
                 sc = 10 ** src.scale
-                return lambda cols: jnp.floor_divide(
-                    f(cols), jnp.int64(sc)).astype(dt)
+                return (lambda cols: jnp.floor_divide(
+                    f(cols), jnp.int64(sc)).astype(dt)), nf
             if dst.kind == TypeKind.DECIMAL and src.kind in (
                     TypeKind.INT32, TypeKind.INT64):
-                return lambda cols: f(cols).astype(jnp.int64) * 10 ** dst.scale
+                return (lambda cols: f(cols).astype(jnp.int64)
+                        * 10 ** dst.scale), nf
             if dst.kind == TypeKind.DECIMAL and src.kind == TypeKind.FLOAT64:
-                return lambda cols: jnp.round(
-                    f(cols) * 10 ** dst.scale).astype(jnp.int64)
+                return (lambda cols: jnp.round(
+                    f(cols) * 10 ** dst.scale).astype(jnp.int64)), nf
             dt = _np_dtype(dst)
-            return lambda cols: f(cols).astype(dt)
+            return (lambda cols: f(cols).astype(dt)), nf
 
         raise E.ExprError(f"cannot compile {type(x).__name__}")
 
     return c(e)
+
+
+def compile_expr(e: E.Expr, dicts: dict,
+                 nullable=frozenset()) -> Callable[[Arrays], object]:
+    """Value-only compile: fn(columns) -> array (garbage at null
+    positions — pair with compile_pair's null_fn when they matter)."""
+    return compile_pair(e, dicts, nullable)[0]
+
+
+def compile_pred(e: E.Expr, dicts: dict,
+                 nullable=frozenset()) -> Callable[[Arrays], object]:
+    """Predicate compile under SQL 3VL: fn(env) -> bool array that is True
+    exactly where the qual is definitely true (NULL counts as false —
+    reference: ExecQual)."""
+    vf, nf = compile_pair(e, dicts, nullable)
+    if nf is None:
+        return vf
+    return _truth(vf, nf)[0]
+
+
+def host_chunk_env(alias: str, ch):
+    """Qual-eval namespace over one raw storage chunk (host numpy): the
+    alias-qualified columns plus null masks under NULLKEY.  Returns
+    (env, nullable_names) for compile_pred — DML paths (DELETE/UPDATE
+    scans) share NULL semantics with the device executor this way."""
+    n = ch.nrows
+    env = {f"{alias}.{name}": arr[:n] for name, arr in ch.columns.items()}
+    nullable = set()
+    for name, m in ch.nulls.items():
+        q = f"{alias}.{name}"
+        env[NULLKEY + q] = m[:n]
+        nullable.add(q)
+    return env, nullable

@@ -159,7 +159,8 @@ class LocalNode:
                     # decimals
                     enc[cname] = arr.astype(
                         st.td.column(cname).type.np_dtype)
-            spans = st.insert(enc, rec["n"], rec["txid"])
+            spans = st.insert(enc, rec["n"], rec["txid"],
+                              nulls=rec.get("nulls"))
             pending.setdefault(rec["txid"], []).append(("ins", st, spans))
         elif op == "delete":
             st = self.stores[rec["table"]]
@@ -367,21 +368,31 @@ class Session:
                      coldata: dict, n: int) -> int:
         t, implicit = self._begin_implicit()
         self._track_write(t)
-        enc = {c: st.encode_column(c, vals) for c, vals in coldata.items()}
+        clean, masks = {}, {}
+        for c, vals in coldata.items():
+            cv, m = st.split_nulls(c, vals)
+            clean[c] = cv
+            if m is not None:
+                masks[c] = m
+        enc = {c: st.encode_column(c, vals) for c, vals in clean.items()}
         loc = Locator(self.node.catalog)
-        raw_for_route = {c: np.asarray(coldata[c])
+        raw_for_route = {c: np.asarray(clean[c])
                          for c in td.distribution.dist_cols} \
             if td.distribution.dist_type == DistType.SHARD else {}
         sid = loc.shard_ids_for_rows(td, raw_for_route) \
             if raw_for_route else None
-        self.node._log({"op": "insert", "table": td.name, "n": n,
-                        "txid": t.txid,
-                        "columns": {c: (_text_log_array(v)
-                                        if td.column(c).type.kind
-                                        == TypeKind.TEXT else
-                                        np.asarray(enc[c]))
-                                    for c, v in coldata.items()}})
-        spans = st.insert(enc, n, t.txid, shardids=sid)
+        rec = {"op": "insert", "table": td.name, "n": n,
+               "txid": t.txid,
+               "columns": {c: (_text_log_array(v)
+                               if td.column(c).type.kind
+                               == TypeKind.TEXT else
+                               np.asarray(enc[c]))
+                           for c, v in clean.items()}}
+        if masks:
+            rec["nulls"] = masks
+        self.node._log(rec)
+        spans = st.insert(enc, n, t.txid, shardids=sid,
+                          nulls=masks or None)
         t.insert_spans.append((st, spans))
         if implicit:
             self._commit(t)
@@ -400,21 +411,19 @@ class Session:
                                where=stmt.where)
             bq = binder.bind_select(sel)
             quals = bq.where
-        from .expr_compile import compile_expr
+        from .expr_compile import compile_pred, host_chunk_env
         n_deleted = 0
         try:
             for ci, ch in st.scan_chunks():
                 vis = st.visible_mask(ch, t.snapshot_ts, t.txid)
                 mask = vis
                 if quals:
-                    cols = {f"{stmt.table}.{c.name}":
-                            ch.columns[c.name][:ch.nrows]
-                            for c in td.columns}
+                    env, nullable = host_chunk_env(stmt.table, ch)
                     dicts = {f"{stmt.table}.{k}": d
                              for k, d in st.dicts.items()}
                     for q in quals:
                         mask = mask & np.asarray(
-                            compile_expr(q, dicts)(cols))
+                            compile_pred(q, dicts, nullable)(env))
                 if mask.any():
                     span = st.mark_delete(ci, mask, t.txid)
                     t.delete_spans.append((st, span))

@@ -67,39 +67,47 @@ class DataNode:
     def insert_raw(self, table: str, coldata: dict, n: int, txid: int,
                    shardids=None) -> int:
         """Insert raw (unencoded) values; encoding happens node-side where
-        the dictionaries live."""
+        the dictionaries live.  Python None entries become NULLs."""
         from ..exec.session import _text_log_array
         st = self.stores[table]
         td = st.td
+        clean, masks = {}, {}
+        for cn, vals in coldata.items():
+            cv, m = st.split_nulls(cn, vals)
+            clean[cn] = cv
+            if m is not None:
+                masks[cn] = m
         enc = {cn: st.encode_column(cn, vals)
-               for cn, vals in coldata.items()}
+               for cn, vals in clean.items()}
         if not self._unlogged(table):
-            self.log({"op": "insert", "table": table, "n": n,
-                      "txid": txid, "shardids": shardids,
-                      "columns": {cn: (_text_log_array(v)
-                                       if td.column(cn).type.kind
-                                       == TypeKind.TEXT
-                                       else np.asarray(enc[cn]))
-                                  for cn, v in coldata.items()}})
-        spans = st.insert(enc, n, txid, shardids=shardids)
+            rec = {"op": "insert", "table": table, "n": n,
+                   "txid": txid, "shardids": shardids,
+                   "columns": {cn: (_text_log_array(v)
+                                    if td.column(cn).type.kind
+                                    == TypeKind.TEXT
+                                    else np.asarray(enc[cn]))
+                               for cn, v in clean.items()}}
+            if masks:
+                rec["nulls"] = masks
+            self.log(rec)
+        spans = st.insert(enc, n, txid, shardids=shardids,
+                          nulls=masks or None)
         self.txn_spans.setdefault(txid, []).append(("ins", table, spans))
         return n
 
     def delete_where(self, table: str, quals: list, snapshot_ts: int,
                      txid: int) -> int:
-        from ..exec.expr_compile import compile_expr
+        from ..exec.expr_compile import compile_pred, host_chunk_env
         st = self.stores[table]
-        td = st.td
         n_deleted = 0
         for ci, ch in st.scan_chunks():
             mask = st.visible_mask(ch, snapshot_ts, txid)
             if quals:
-                colmap = {f"{table}.{col.name}":
-                          ch.columns[col.name][:ch.nrows]
-                          for col in td.columns}
+                env, nullable = host_chunk_env(table, ch)
                 dicts = {f"{table}.{k}": d for k, d in st.dicts.items()}
                 for q in quals:
-                    mask = mask & np.asarray(compile_expr(q, dicts)(colmap))
+                    mask = mask & np.asarray(
+                        compile_pred(q, dicts, nullable)(env))
             if mask.any():
                 span = st.mark_delete(ci, mask, txid)
                 self.txn_spans.setdefault(txid, []).append(
@@ -223,7 +231,8 @@ class DataNode:
                         enc[cname] = arr.astype(
                             st.td.column(cname).type.np_dtype)
                 spans = st.insert(enc, rec["n"], rec["txid"],
-                                  shardids=rec.get("shardids"))
+                                  shardids=rec.get("shardids"),
+                                  nulls=rec.get("nulls"))
                 pending.setdefault(rec["txid"], []).append(
                     ("ins", st, spans))
             elif op == "delete":
@@ -491,6 +500,10 @@ class Cluster:
                         delivered = False
                 if delivered:
                     self.gtm.forget_txn(gid)
+                    # prune acks: a reused gid must re-deliver, and the
+                    # set must not grow for the cluster's lifetime
+                    self._redelivered = {e for e in done if e[0] != gid}
+                    done = self._redelivered
             elif info["state"] in ("prepared", "aborted"):
                 aborted_all = True
                 for dn in self.datanodes:

@@ -110,19 +110,34 @@ class Binder:
             where.extend(split_conjuncts(
                 self.bind_expr(stmt.where, scopes, correlated)))
 
-        # targets (with star expansion)
+        # targets (with star expansion).  Output names are uniquified:
+        # the engine keys result columns by name (PG keeps duplicate
+        # resnames apart positionally; here 'count(a), count(b)' would
+        # silently collapse otherwise)
         targets: list[tuple[str, E.Expr]] = []
+        used_names: set[str] = set()
+
+        def uniq(name: str) -> str:
+            if name not in used_names:
+                used_names.add(name)
+                return name
+            i = 1
+            while f"{name}_{i}" in used_names:
+                i += 1
+            used_names.add(f"{name}_{i}")
+            return f"{name}_{i}"
+
         for it in stmt.items:
             if isinstance(it.expr, A.Star):
                 for rte in rtable:
                     if it.expr.table and rte.alias != it.expr.table:
                         continue
                     for plain, (qname, t) in rte.columns.items():
-                        targets.append((plain, E.Col(qname, t)))
+                        targets.append((uniq(plain), E.Col(qname, t)))
                 continue
             bound = self.bind_expr(it.expr, scopes, correlated)
             name = it.alias or self._default_name(it.expr, len(targets))
-            targets.append((name, bound))
+            targets.append((uniq(name), bound))
 
         group_by = [self._bind_groupref(g, scopes, correlated, targets)
                     for g in stmt.group_by]
@@ -331,18 +346,26 @@ class Binder:
                 return E.StrPred(arg, "not_in" if node.negated else "in",
                                  tuple(vals))
             vals = []
+            has_null = False
             for it in node.items:
                 lit = b(it)
                 if not isinstance(lit, E.Lit):
                     raise BindError("IN list must be literals")
+                if lit.value is None:
+                    has_null = True
+                    continue
                 vals.append(self._to_storage(lit, arg.type))
             e = E.InList(arg, tuple(vals))
+            if has_null:
+                # x IN (..., NULL) is true on a match, else UNKNOWN:
+                # OR-in an unknown term so Kleene logic (and NOT IN's
+                # never-true) falls out of the 3VL compiler
+                e = E.BoolOp("or", (e, E.Cmp("=", arg,
+                                             E.Lit(None, arg.type))))
             return self._negate(e) if node.negated else e
 
         if isinstance(node, A.NullTest):
-            # No NULL storage yet (TPC-H base data is NOT NULL); outer-join
-            # null flags are handled by the planner's join machinery.
-            return E.Lit(not node.is_null, T.BOOL)
+            return E.IsNull(b(node.arg), negated=not node.is_null)
 
         if isinstance(node, A.ExistsExpr):
             sub = self.bind_select(node.subquery, outer=scopes)
@@ -416,7 +439,7 @@ class Binder:
             # default TEXT marker
             return E.Lit(node.value, T.TEXT)
         if node.kind == "null":
-            raise BindError("NULL literal unsupported (no null storage yet)")
+            return E.Lit(None, T.NULLT)
         raise BindError(f"bad const kind {node.kind}")
 
     def _negate(self, e: E.Expr) -> E.Expr:
@@ -519,8 +542,16 @@ class Binder:
         return E.Cmp(op, left, right)
 
     def _coerce_pair(self, left: E.Expr, right: E.Expr):
-        """Insert coercions for str-lit vs date, etc."""
+        """Insert coercions for str-lit vs date, NULL literal typing, etc."""
         lt, rt = left.type, right.type
+        # a bare NULL literal takes the other operand's type (reference:
+        # UNKNOWN-type coercion, parse_coerce.c)
+        if lt.kind == TypeKind.NULL and rt.kind != TypeKind.NULL:
+            left = E.Lit(None, rt)
+            lt = rt
+        elif rt.kind == TypeKind.NULL and lt.kind != TypeKind.NULL:
+            right = E.Lit(None, lt)
+            rt = lt
         if lt.kind == TypeKind.DATE and rt.kind == TypeKind.TEXT \
                 and isinstance(right, E.Lit):
             right = E.Lit(T.date_to_days(right.value), T.DATE)
@@ -540,6 +571,9 @@ class Binder:
         return int(v)
 
     def _common_case_type(self, types: list[SqlType]) -> SqlType:
+        types = [u for u in types if u.kind != TypeKind.NULL]
+        if not types:
+            raise BindError("cannot resolve a type: all branches are NULL")
         t = types[0]
         for u in types[1:]:
             if u.kind == t.kind and u.scale == t.scale:
@@ -557,6 +591,8 @@ class Binder:
 
     def _coerce_case(self, whens, else_, t: SqlType):
         def fix(e: E.Expr) -> E.Expr:
+            if isinstance(e, E.Lit) and e.value is None:
+                return E.Lit(None, t)
             if e.type.kind == t.kind and e.type.scale == t.scale:
                 return e
             return E.Cast(e, t)
@@ -571,6 +607,20 @@ class Binder:
             if len(node.args) != 1:
                 raise BindError(f"{name} takes one argument")
             return E.AggCall(name, b(node.args[0]), distinct=node.distinct)
+        if name == "coalesce":
+            if not node.args:
+                raise BindError("coalesce takes at least one argument")
+            args = [b(a) for a in node.args]
+            t = self._common_case_type([a.type for a in args])
+            fixed, _ = self._coerce_case(
+                tuple((E.Lit(True, T.BOOL), a) for a in args), None, t)
+            return E.Coalesce(tuple(v for _, v in fixed), t)
+        if name == "nullif":
+            if len(node.args) != 2:
+                raise BindError("nullif takes two arguments")
+            left, right = self._coerce_pair(b(node.args[0]),
+                                            b(node.args[1]))
+            return E.NullIf(left, right)
         raise BindError(f"function {name!r} unsupported")
 
 

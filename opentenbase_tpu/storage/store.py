@@ -99,6 +99,10 @@ class Chunk:
     shardid: np.ndarray
     nrows: int
     cap: int
+    # per-column null bitmaps, allocated lazily on the first NULL
+    # (reference: the per-tuple null bitmap in HeapTupleHeader,
+    # include/access/htup_details.h t_bits)
+    nulls: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
 
     @staticmethod
     def empty(td: TableDef, cap: int = CHUNK_CAP) -> "Chunk":
@@ -113,6 +117,14 @@ class Chunk:
             xmax_txid=np.empty(cap, dtype=np.int64),
             shardid=np.empty(cap, dtype=np.int32),
             nrows=0, cap=cap)
+
+    def null_mask_for(self, name: str) -> np.ndarray:
+        """The column's null bitmap, allocating a cleared one on demand."""
+        m = self.nulls.get(name)
+        if m is None:
+            m = self.nulls[name] = np.zeros(len(self.columns[name]),
+                                            dtype=bool)
+        return m
 
     @property
     def free(self) -> int:
@@ -129,6 +141,9 @@ class TableStore:
         self.dicts: dict[str, StringDict] = {
             c.name: StringDict() for c in td.columns
             if c.type.kind == TypeKind.TEXT}
+        # columns that hold at least one NULL anywhere (drives null-mask
+        # staging into the device cache; empty for NOT NULL workloads)
+        self.null_columns: set[str] = set()
         # ANN indexes over VECTOR columns: col -> {"centroids", "metric",
         # "nprobe", "_assign_cache"} (contrib/pgvector IVFFlat analog)
         self.ann_indexes: dict[str, dict] = {}
@@ -136,6 +151,33 @@ class TableStore:
     # ------------------------------------------------------------------
     def row_count(self) -> int:
         return sum(c.nrows for c in self.chunks)
+
+    def split_nulls(self, name: str, values):
+        """Split python None entries out of a raw value sequence:
+        returns (clean_values, mask|None).  NULL positions take a
+        DETERMINISTIC type-default fill (""/0/epoch) — never a value from
+        the batch — so NULL distribution-key rows always route to the
+        same shard regardless of batch contents (matches the
+        dist_session routing fill)."""
+        if isinstance(values, np.ndarray) and values.dtype.kind != "O":
+            return values, None
+        mask = np.fromiter((v is None for v in values), dtype=bool,
+                           count=len(values))
+        if not mask.any():
+            return values, None
+        ct = self.td.column(name).type
+        k = ct.kind
+        if k == TypeKind.TEXT:
+            fill = ""
+        elif k == TypeKind.VECTOR:
+            fill = [0.0] * ct.dim
+        elif k == TypeKind.DATE and any(
+                isinstance(v, str) for v in values if v is not None):
+            fill = "1970-01-01"  # string-modal date batch: epoch string
+        else:
+            fill = 0
+        clean = [fill if v is None else v for v in values]
+        return clean, mask
 
     def encode_column(self, name: str, values) -> np.ndarray:
         """Convert python/raw values into the stored array representation."""
@@ -179,17 +221,23 @@ class TableStore:
 
     def insert(self, columns: dict[str, np.ndarray], nrows: int,
                txid: int, shardids: Optional[np.ndarray] = None,
-               commit_ts: Optional[int] = None) -> list[tuple[int, int, int]]:
+               commit_ts: Optional[int] = None,
+               nulls: Optional[dict[str, np.ndarray]] = None
+               ) -> list[tuple[int, int, int]]:
         """Append rows (already encoded).  Returns [(chunk_idx, start, end)]
         spans for the transaction's backfill list.  If commit_ts is given the
         rows are born committed (bulk load fast path, like the reference's
-        COPY FREEZE)."""
+        COPY FREEZE).  `nulls` maps column -> bool mask of NULL positions
+        (value arrays hold type-default fill there)."""
         if nrows == 0:
             return []
         self.version = next(_VERSION_COUNTER)
         spans = []
         done = 0
         born_ts = INF_TS if commit_ts is None else np.int64(commit_ts)
+        live_nulls = {n: m for n, m in (nulls or {}).items()
+                      if np.any(m)}
+        self.null_columns |= set(live_nulls)
         while done < nrows:
             if not self.chunks or self.chunks[-1].free == 0:
                 self.chunks.append(Chunk.empty(self.td, CHUNK_CAP))
@@ -198,6 +246,13 @@ class TableStore:
             lo, hi = ch.nrows, ch.nrows + take
             for name, arr in columns.items():
                 ch.columns[name][lo:hi] = arr[done:done + take]
+            for name, m in live_nulls.items():
+                ch.null_mask_for(name)[lo:hi] = m[done:done + take]
+            for name in ch.nulls:
+                # a chunk that already tracks nulls for a column must
+                # clear the bits for rows inserted without nulls
+                if name not in live_nulls:
+                    ch.nulls[name][lo:hi] = False
             ch.xmin_ts[lo:hi] = born_ts
             ch.xmax_ts[lo:hi] = INF_TS
             ch.xmin_txid[lo:hi] = txid
@@ -286,7 +341,9 @@ class TableStore:
                 xmin_txid=ch.xmin_txid[:n][idx].copy(),
                 xmax_txid=ch.xmax_txid[:n][idx].copy(),
                 shardid=ch.shardid[:n][idx].copy(),
-                nrows=len(idx), cap=len(idx) if len(idx) else 1)
+                nrows=len(idx), cap=len(idx) if len(idx) else 1,
+                nulls={name: m[:n][idx].copy()
+                       for name, m in ch.nulls.items()})
             if kept.nrows:
                 new_chunks.append(kept)
         self.chunks = new_chunks
@@ -295,7 +352,9 @@ class TableStore:
 
     def rows_of_shards(self, shard_ids: set) -> dict:
         """Extract live rows belonging to the given shard ids (for online
-        shard movement, reference: pgxc/locator/redistrib.c)."""
+        shard movement, reference: pgxc/locator/redistrib.c).  NULL
+        positions come back as python None in the value lists (the wire
+        form re-splits them at the destination)."""
         sel_cols: dict[str, list] = {c.name: [] for c in self.td.columns}
         sids = []
         masks = []
@@ -308,10 +367,14 @@ class TableStore:
                 for name in sel_cols:
                     vals = ch.columns[name][:n][m]
                     if self.td.column(name).type.kind == TypeKind.TEXT:
-                        sel_cols[name].extend(
-                            self.dicts[name].decode(vals))
+                        out = self.dicts[name].decode(vals)
                     else:
-                        sel_cols[name].extend(vals.tolist())
+                        out = vals.tolist()
+                    nm = ch.nulls.get(name)
+                    if nm is not None:
+                        out = [None if isnull else v for v, isnull
+                               in zip(out, nm[:n][m])]
+                    sel_cols[name].extend(out)
                 sids.extend(ch.shardid[:n][m].tolist())
         n_out = len(sids)
         return {"columns": sel_cols, "shardids":

@@ -91,6 +91,9 @@ def checkpoint_store(store, path: str):
         arrays[f"c{i}.__xmin_txid"] = ch.xmin_txid[:n]
         arrays[f"c{i}.__xmax_txid"] = ch.xmax_txid[:n]
         arrays[f"c{i}.__shardid"] = ch.shardid[:n]
+        for name, m in ch.nulls.items():
+            if m[:n].any():
+                arrays[f"c{i}.__null.{name}"] = m[:n]
     dicts = {name: d.values for name, d in store.dicts.items()}
     tmp = path + ".tmp"
     dict_blob = pickle.dumps(dicts, protocol=4)
@@ -123,6 +126,12 @@ def restore_store(store, path: str):
         names = [c.name for c in store.td.columns]
         cols = {n: np.array(npz[f"c{ci}.{n}"]) for n in names}
         nrows = len(next(iter(cols.values())))
+        nulls = {}
+        for n in names:
+            key = f"c{ci}.__null.{n}"
+            if key in npz.files:
+                nulls[n] = np.array(npz[key])
+                store.null_columns.add(n)
         ch = Chunk(
             columns={n: _grow(cols[n]) for n in names},
             xmin_ts=_grow(np.array(npz[f"c{ci}.__xmin_ts"])),
@@ -130,7 +139,7 @@ def restore_store(store, path: str):
             xmin_txid=_grow(np.array(npz[f"c{ci}.__xmin_txid"])),
             xmax_txid=_grow(np.array(npz[f"c{ci}.__xmax_txid"])),
             shardid=_grow(np.array(npz[f"c{ci}.__shardid"])),
-            nrows=nrows, cap=max(nrows, 1))
+            nrows=nrows, cap=max(nrows, 1), nulls=nulls)
         ch.cap = len(next(iter(ch.columns.values())))
         store.chunks.append(ch)
     for name, values in dicts.items():

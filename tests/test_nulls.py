@@ -1,0 +1,255 @@
+"""NULL semantics end-to-end: storage bitmaps, 3VL predicates,
+null-skipping aggregates, NULL group/order/join keys, recovery.
+
+Reference analog: PostgreSQL NULL handling — per-tuple null bitmaps
+(include/access/htup_details.h t_bits), strict-function NULL propagation
+and Kleene AND/OR (execExprInterp.c), ExecQual's NULL-is-false,
+nodeAgg.c null skipping, GROUP BY null grouping, NULLS LAST ordering.
+"""
+
+import pytest
+
+from opentenbase_tpu.exec.dist_session import ClusterSession
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.parallel.cluster import Cluster
+
+
+@pytest.fixture()
+def sess(tmp_path):
+    s = Session(LocalNode(datadir=str(tmp_path / "d")))
+    s.execute("create table t (k bigint, v decimal(10,2), "
+              "name varchar(10))")
+    s.execute("insert into t values (1, 10.5, 'a'), (2, null, 'b'), "
+              "(3, 20, null), (null, 5, 'd')")
+    return s
+
+
+@pytest.fixture()
+def cs(tmp_path):
+    cl = Cluster(n_datanodes=3, datadir=str(tmp_path / "cl"))
+    s = ClusterSession(cl)
+    s.execute("create table t (k bigint primary key, v decimal(10,2), "
+              "name varchar(10)) distribute by shard(k)")
+    s.execute("insert into t values (1, 10.5, 'a'), (2, null, 'b'), "
+              "(3, 20, null), (4, 5, 'd'), (5, null, 'e'), (6, 7, null)")
+    return s
+
+
+class TestPredicates3VL:
+    def test_is_null(self, sess):
+        assert sess.query("select k from t where v is null") == [(2,)]
+
+    def test_is_not_null(self, sess):
+        got = sess.query("select k from t where v is not null "
+                         "and k is not null order by k")
+        assert got == [(1,), (3,)]
+
+    def test_null_comparison_is_not_true(self, sess):
+        # v > 5: NULL rows drop; v <= 5 complements only over non-nulls
+        assert sess.query("select k from t where v > 5 order by k") == \
+            [(1,), (3,)]
+        assert sess.query("select count(*) from t where v <= 5") == [(1,)]
+
+    def test_equals_null_literal_never_true(self, sess):
+        assert sess.query("select k from t where v = null") == []
+        assert sess.query("select k from t where v <> null") == []
+
+    def test_kleene_or(self, sess):
+        # NULL OR TRUE = TRUE: row 2 (v null) still matches k = 2
+        got = sess.query("select k from t where v > 100 or k = 2")
+        assert got == [(2,)]
+
+    def test_kleene_and_not(self, sess):
+        # NOT (v > 5) is NULL for null v: excluded either way
+        got = sess.query("select k from t where not (v > 5) "
+                         "and k is not null")
+        assert got == [(3,)] or got == []  # v=5 -> not(5>5)=true? k=null row excluded
+        # the definite check: k=3 has v=20 -> not true -> excluded;
+        # row k=1 v=10.5 -> excluded; row with v=5 has k NULL -> excluded
+        assert sess.query("select count(*) from t where not (v > 5)") \
+            == [(1,)]  # only the k-null row with v=5
+
+    def test_in_list_with_null(self, sess):
+        # x IN (1, NULL): true on match, UNKNOWN otherwise
+        assert sess.query("select k from t where k in (1, null)") == [(1,)]
+        # x NOT IN (1, NULL) is never true (NOT unknown is unknown)
+        assert sess.query("select k from t where k not in (1, null)") == []
+
+    def test_case_missing_else_is_null(self, sess):
+        got = sess.query("select k, case when v > 15 then 1 end from t "
+                         "order by k")
+        assert got == [(1, None), (2, None), (3, 1), (None, None)]
+
+
+class TestFunctions:
+    def test_coalesce(self, sess):
+        got = sess.query("select k, coalesce(v, 0) from t order by k")
+        assert got == [(1, 10.5), (2, 0.0), (3, 20.0), (None, 5.0)]
+
+    def test_coalesce_multi(self, sess):
+        got = sess.query("select coalesce(null, null, 7) from t limit 1")
+        assert got == [(7,)]
+
+    def test_nullif(self, sess):
+        got = sess.query("select k, nullif(v, 20) from t order by k")
+        assert got == [(1, 10.5), (2, None), (3, None), (None, 5.0)]
+
+    def test_arith_propagates_null(self, sess):
+        got = sess.query("select k, v + 1 from t order by k")
+        assert got == [(1, 11.5), (2, None), (3, 21.0), (None, 6.0)]
+
+
+class TestAggregates:
+    def test_null_skipping(self, sess):
+        got = sess.query("select sum(v), count(v), count(*), avg(v), "
+                         "min(v), max(v) from t")
+        assert got == [(35.5, 3, 4, pytest.approx(35.5 / 3), 5.0, 20.0)]
+
+    def test_all_null_group(self, sess):
+        sess.execute("create table g (grp bigint, v decimal(10,2))")
+        sess.execute("insert into g values (1, null), (1, null), (2, 5)")
+        got = sess.query("select grp, sum(v), min(v), max(v), count(v) "
+                         "from g group by grp order by grp")
+        assert got == [(1, None, None, None, 0), (2, 5.0, 5.0, 5.0, 1)]
+
+    def test_count_distinct_skips_nulls(self, sess):
+        sess.execute("create table cd (x bigint)")
+        sess.execute("insert into cd values (1), (1), (2), (null), (null)")
+        assert sess.query("select count(distinct x) from cd") == [(2,)]
+
+    def test_duplicate_agg_names_stay_distinct(self, sess):
+        got = sess.query("select count(v), count(*) from t")
+        assert got == [(3, 4)]
+
+
+class TestGroupingOrdering:
+    def test_group_by_nullable_key(self, sess):
+        got = sess.query("select name, count(*) from t group by name "
+                         "order by name")
+        assert got == [("a", 1), ("b", 1), ("d", 1), (None, 1)]
+
+    def test_null_groups_together(self, sess):
+        sess.execute("insert into t values (7, 1, null)")
+        got = sess.query("select name, count(*) from t where name is null "
+                         "group by name")
+        assert got == [(None, 2)]
+
+    def test_null_group_distinct_from_zero(self, sess):
+        sess.execute("create table z (x bigint)")
+        sess.execute("insert into z values (0), (null), (0)")
+        got = sess.query("select x, count(*) from z group by x order by x")
+        assert got == [(0, 2), (None, 1)]
+
+    def test_order_nulls_last_asc_first_desc(self, sess):
+        asc = sess.query("select v from t order by v")
+        assert asc == [(5.0,), (10.5,), (20.0,), (None,)]
+        desc = sess.query("select v from t order by v desc")
+        assert desc == [(None,), (20.0,), (10.5,), (5.0,)]
+
+
+class TestJoins:
+    def test_null_keys_never_match(self, sess):
+        sess.execute("create table r (rk bigint, w decimal(10,2))")
+        sess.execute("insert into r values (null, 99), (1, 50)")
+        # NULL = NULL is unknown: the null k row must not join the null rk
+        got = sess.query("select k, w from t, r where k = rk")
+        assert got == [(1, 50.0)]
+
+    def test_left_join_null_key_extends(self, sess):
+        sess.execute("create table r (rk bigint, w decimal(10,2))")
+        sess.execute("insert into r values (1, 50)")
+        got = sess.query("select k, w from t left join r on k = rk "
+                         "order by k")
+        assert got == [(1, 50.0), (2, None), (3, None), (None, None)]
+
+
+class TestScalarSubquery:
+    def test_empty_scalar_is_null(self, sess):
+        # x > NULL is never true (was: compared against 0)
+        got = sess.query("select k from t where v > "
+                         "(select v from t where k = 99)")
+        assert got == []
+
+    def test_null_scalar_output(self, sess):
+        got = sess.query("select (select v from t where k = 99) from t "
+                         "limit 1")
+        assert got == [(None,)]
+
+
+class TestDml:
+    def test_delete_where_is_null(self, sess):
+        r = sess.execute("delete from t where v is null")[0]
+        assert r.rowcount == 1
+        assert sess.query("select count(*) from t") == [(3,)]
+
+    def test_delete_null_qual_not_true(self, sess):
+        # v > 100 is unknown for the null row: must not delete it
+        r = sess.execute("delete from t where v > 100")[0]
+        assert r.rowcount == 0
+
+    def test_update_to_null(self, sess):
+        sess.execute("update t set v = null where k = 1")
+        got = sess.query("select k from t where v is null order by k")
+        assert got == [(1,), (2,)]
+
+    def test_update_null_away(self, sess):
+        sess.execute("update t set v = 1 where v is null")
+        assert sess.query("select count(*) from t where v is null") == \
+            [(0,)]
+
+
+class TestPersistence:
+    def test_nulls_survive_wal_replay(self, sess, tmp_path):
+        s2 = Session(LocalNode(datadir=str(tmp_path / "d")))
+        assert s2.query("select k from t where v is null") == [(2,)]
+        assert s2.query("select sum(v) from t") == [(35.5,)]
+
+    def test_nulls_survive_checkpoint(self, sess, tmp_path):
+        sess.node.checkpoint()
+        sess.execute("insert into t values (9, null, 'z')")
+        s2 = Session(LocalNode(datadir=str(tmp_path / "d")))
+        got = s2.query("select k from t where v is null order by k")
+        assert got == [(2,), (9,)]
+
+
+class TestDistributedNulls:
+    def test_agg_across_nodes(self, cs):
+        got = cs.query("select sum(v), count(v), count(*), min(v) from t")
+        assert got == [(42.5, 4, 6, 5.0)]
+
+    def test_group_by_nullable_text_across_nodes(self, cs):
+        got = cs.query("select name, count(*) from t group by name "
+                       "order by name")
+        assert got == [("a", 1), ("b", 1), ("d", 1), ("e", 1), (None, 2)]
+
+    def test_is_null_filter_distributed(self, cs):
+        got = cs.query("select k from t where v is null order by k")
+        assert got == [(2,), (5,)]
+
+    def test_join_null_keys_distributed(self, cs):
+        cs.execute("create table r (rk bigint primary key, "
+                   "w decimal(10,2)) distribute by shard(rk)")
+        cs.execute("insert into r values (1, 50), (3, 60)")
+        got = cs.query("select k, w from t left join r on k = rk "
+                       "where k < 4 order by k")
+        assert got == [(1, 50.0), (2, None), (3, 60.0)]
+
+    def test_insert_null_distkey(self, cs):
+        cs.execute("create table nk (x bigint, y bigint) "
+                   "distribute by shard(x)")
+        cs.execute("insert into nk values (null, 1), (2, 2)")
+        assert cs.query("select count(*) from nk") == [(2,)]
+        assert cs.query("select y from nk where x is null") == [(1,)]
+
+    def test_all_null_group_distributed(self, cs):
+        cs.execute("create table g (grp bigint, v decimal(10,2)) "
+                   "distribute by shard(grp)")
+        cs.execute("insert into g values (1, null), (1, null), (2, 5)")
+        got = cs.query("select grp, sum(v), count(v) from g group by grp "
+                       "order by grp")
+        assert got == [(1, None, 0), (2, 5.0, 1)]
+
+    def test_restart_preserves_nulls(self, cs, tmp_path):
+        s2 = ClusterSession(Cluster(datadir=str(tmp_path / "cl")))
+        got = s2.query("select k from t where v is null order by k")
+        assert got == [(2,), (5,)]
