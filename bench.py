@@ -1,11 +1,18 @@
-"""Benchmark driver: TPC-H Q1 through the full SQL engine on the real chip.
+"""Benchmark driver: TPC-H through the engine on the real chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-- value: Q1 throughput in Mrows/s of lineitem scanned (engine, device path)
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+- value: Q1 throughput in Mrows/s of lineitem scanned (engine device path)
 - vs_baseline: speedup over the CPU control arm (pandas, BASELINE.md's
   "CPU DataNode" stand-in) on the same machine & data
+- tpu_unavailable: true when the axon tunnel was down and the run fell
+  back to CPU (the number is then NOT a TPU measurement)
 
-Scale via env: BENCH_SF (default 1.0), BENCH_REPEAT (default 5).
+Modes via env:
+- BENCH_SF (default 1.0), BENCH_REPEAT (default 5)
+- BENCH_MODE=single (default): single-node Q1 through the fused engine
+- BENCH_MODE=mesh: distributed Q1 over an in-process cluster whose
+  datanode fragments + exchanges run as ONE shard_map program per query
+  on a mesh of all visible devices (exec/mesh_exec.py)
 """
 
 import json
@@ -17,44 +24,15 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from opentenbase_tpu.utils.backend import ensure_alive_backend  # noqa: E402
 
+requested_tpu = os.environ.get("JAX_PLATFORMS", "") != "cpu"
 platform = ensure_alive_backend(timeout_s=90)
+tpu_unavailable = requested_tpu and platform == "cpu"
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
 
-def main():
-    sf = float(os.environ.get("BENCH_SF", "1.0"))
-    repeat = int(os.environ.get("BENCH_REPEAT", "5"))
-
-    from opentenbase_tpu.exec.session import LocalNode, Session
-    from opentenbase_tpu.tpch import datagen
-    from opentenbase_tpu.tpch.queries import Q
-    from opentenbase_tpu.tpch.schema import SCHEMA
-
-    t0 = time.time()
-    data = datagen.generate(sf=sf)
-    node = LocalNode()
-    s = Session(node)
-    s.execute(SCHEMA)
-    # bench loads only what Q1 needs (lineitem)
-    td = node.catalog.table("lineitem")
-    st = node.stores["lineitem"]
-    tbl = data["lineitem"]
-    n_rows = len(tbl["l_orderkey"])
-    s._insert_rows(td, st, tbl, n_rows)
-    gen_s = time.time() - t0
-
-    # warm (compile + device staging)
-    s.query(Q[1])
-    times = []
-    for _ in range(repeat):
-        t1 = time.perf_counter()
-        s.query(Q[1])
-        times.append(time.perf_counter() - t1)
-    engine_s = min(times)
-
-    # CPU control arm: pandas (the classic CPU DataNode stand-in)
+def _pandas_q1(tbl, repeat):
     import pandas as pd
     li = pd.DataFrame({k: tbl[k] for k in
                        ("l_returnflag", "l_linestatus", "l_quantity",
@@ -74,18 +52,69 @@ def main():
             aq=("l_quantity", "mean"), ap=("l_extendedprice", "mean"),
             ad=("l_discount", "mean"), n=("l_quantity", "count"))
         ptimes.append(time.perf_counter() - t2)
-    pandas_s = min(ptimes)
+    return min(ptimes)
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
+    repeat = int(os.environ.get("BENCH_REPEAT", "5"))
+    mode = os.environ.get("BENCH_MODE", "single")
+
+    from opentenbase_tpu.tpch import datagen
+    from opentenbase_tpu.tpch.queries import Q
+    from opentenbase_tpu.tpch.schema import SCHEMA
+
+    t0 = time.time()
+    data = datagen.generate(sf=sf)
+    tbl = data["lineitem"]
+    n_rows = len(tbl["l_orderkey"])
+
+    if mode == "mesh":
+        from opentenbase_tpu.exec.dist_session import ClusterSession
+        from opentenbase_tpu.parallel.cluster import Cluster
+        ndn = max(len(jax.devices()), 1)
+        s = ClusterSession(Cluster(n_datanodes=ndn))
+        s.execute(SCHEMA)
+        td = s.cluster.catalog.table("lineitem")
+        s._insert_rows(td, tbl, n_rows)
+        s.execute("set enable_mesh_exchange = on")
+        run = lambda: s.query(Q[1])
+        label = f"mesh x{ndn}"
+    else:
+        from opentenbase_tpu.exec.session import LocalNode, Session
+        node = LocalNode()
+        s = Session(node)
+        s.execute(SCHEMA)
+        td = node.catalog.table("lineitem")
+        st = node.stores["lineitem"]
+        s._insert_rows(td, st, tbl, n_rows)
+        run = lambda: s.query(Q[1])
+        label = "single"
+    gen_s = time.time() - t0
+
+    run()  # warm (compile + device staging)
+    times = []
+    for _ in range(repeat):
+        t1 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t1)
+    engine_s = min(times)
+
+    pandas_s = _pandas_q1(tbl, repeat)
 
     mrows = n_rows / engine_s / 1e6
-    print(json.dumps({
-        "metric": f"TPC-H Q1 SF{sf:g} throughput ({platform})",
+    out = {
+        "metric": f"TPC-H Q1 SF{sf:g} throughput ({platform}, {label})",
         "value": round(mrows, 3),
         "unit": "Mrows/s",
         "vs_baseline": round(pandas_s / engine_s, 3),
-    }))
+    }
+    if tpu_unavailable:
+        out["tpu_unavailable"] = True
+    print(json.dumps(out))
     print(f"# rows={n_rows} engine={engine_s*1e3:.1f}ms "
           f"pandas={pandas_s*1e3:.1f}ms datagen={gen_s:.1f}s "
-          f"platform={platform}", file=sys.stderr)
+          f"platform={platform} mode={mode}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -119,12 +119,13 @@ def _to_device(hb: HostBatch) -> DBatch:
 
 class DistExecutor:
     def __init__(self, cluster: Cluster, snapshot_ts: int, txid: int,
-                 instrument: bool = False):
+                 instrument: bool = False, use_mesh: bool = False):
         self.cluster = cluster
         self.snapshot_ts = snapshot_ts
         self.txid = txid
         self.params: dict[str, tuple] = {}
         self.instrument = instrument
+        self.use_mesh = use_mesh
         # (fragment, where) -> {"ms": float, "rows": int} — the
         # distributed-EXPLAIN instrumentation the reference ships DN->CN
         # (commands/explain_dist.c)
@@ -148,6 +149,22 @@ class DistExecutor:
         return scalar_from_batch(b)
 
     def _run_distplan(self, dp: DistPlan) -> DBatch:
+        if self.use_mesh and dp.fqs_node is None:
+            # device data plane: DN fragments + exchanges compile into one
+            # shard_map program (all_to_all/all_gather over the mesh)
+            from .mesh_exec import MeshUnsupported, mesh_runner_for
+            runner = mesh_runner_for(self.cluster)
+            if runner is not None:
+                try:
+                    gathered = runner.run(dp, self.snapshot_ts, self.txid,
+                                          self.params)
+                    gex = next(ex.index for ex in dp.exchanges
+                               if ex.kind in ("gather", "gather_one"))
+                    top = dp.fragments[dp.top_fragment]
+                    return self._exec_fragment_on(
+                        top, dp, "cn", {(gex, "cn"): gathered})
+                except MeshUnsupported:
+                    pass  # host-mediated tier handles everything else
         if dp.fqs_node is not None:
             # whole-query shipped to one datanode (FQS).  An in-process
             # datanode returns the device batch directly (no host
@@ -304,18 +321,21 @@ class DistExecutor:
 
 def _bind_sources_host(node: P.PhysNode, sources: dict):
     """Copy the fragment plan with ExchangeRef leaves replaced by
-    BatchSource over the staged exchange input."""
+    BatchSource over the staged exchange input (HostBatch from the host
+    tier, or an already-device DBatch from the mesh tier)."""
     if isinstance(node, ExchangeRef):
         hb = sources.get(node.index)
         if hb is None:
             raise ExecError(f"exchange {node.index} has no input here")
+        if isinstance(hb, DBatch):
+            return BatchSource(hb)
         return BatchSource(_to_device(hb))
     clone = dataclasses.replace(node)
     for attr in ("child", "left", "right"):
         c = getattr(clone, attr, None)
         if isinstance(c, P.PhysNode):
             setattr(clone, attr, _bind_sources_host(c, sources))
-    if isinstance(clone, P.Append):
+    if isinstance(clone, (P.Append, P.SetOp)):
         clone.inputs = [_bind_sources_host(c, sources)
                         for c in clone.inputs]
     return clone

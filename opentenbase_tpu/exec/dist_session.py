@@ -180,7 +180,9 @@ class ClusterSession:
             queue.acquire()
         try:
             ex = DistExecutor(self.cluster, t.snapshot_ts, t.txid,
-                              instrument=instrument)
+                              instrument=instrument,
+                              use_mesh=self.cluster.gucs.get(
+                                  "enable_mesh_exchange") == "on")
             batch = ex.run(dp)
         finally:
             if queue is not None:
@@ -253,7 +255,9 @@ class ClusterSession:
                         fill = "" if td.column(cn).type.kind == _TK.TEXT \
                             else 0
                         vals = [fill if v is None else v for v in vals]
-                    route_cols[cn] = np.asarray(vals)
+                    # asanyarray: the loader's _PreScaled decimal marker
+                    # must survive into the locator's canonicalization
+                    route_cols[cn] = np.asanyarray(vals)
                 nodes = c.locator.route_rows(td, route_cols, n)
                 sid = c.locator.shard_ids_for_rows(td, route_cols)
                 dests = {i: np.nonzero(nodes == i)[0]

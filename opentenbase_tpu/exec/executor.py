@@ -144,7 +144,12 @@ class ExecContext:
     # init-plan results: name -> (value, SqlType)
     staged: Optional[dict] = None
     # fused-execution override: table -> (arrs, n) traced arrays replacing
-    # the device cache inside a jitted fragment program (exec/fused.py)
+    # the device cache inside a jitted fragment program (exec/fused.py).
+    # n may itself be traced (per-shard row counts under shard_map).
+    join_size_factor: int = 1
+    # traced joins can't sync their output size: out_size = probe padded
+    # * factor; the mesh runner doubles the factor and re-traces when a
+    # join reports overflow (the size-class ladder, SURVEY §7.3)
 
 
 class Executor:
@@ -154,6 +159,9 @@ class Executor:
 
     def __init__(self, ctx: ExecContext):
         self.ctx = ctx
+        # traced-join overflow telemetry: (required_rows, out_size) per
+        # join, checked host-side after the program runs (mesh runner)
+        self.join_required: list = []
 
     # ------------------------------------------------------------------
     def run(self, planned: PlannedStmt):
@@ -263,9 +271,13 @@ class Executor:
                        for c in _cols_of(oe)}
         staged = (self.ctx.staged or {}).get(table.name)
         if staged is not None:
-            arrs, n = staged   # fused path: traced program inputs
+            # fused/mesh path: traced program inputs; n may be a traced
+            # per-shard scalar, so the static pad comes from the arrays
+            arrs, n = staged
+            padded_static = int(next(iter(arrs.values())).shape[0])
         else:
             arrs, n = self.ctx.cache.get(store, sorted(needed))
+            padded_static = None
 
         qcols, types, dicts, qnulls = {}, {}, {}, {}
         for c in store.td.columns:
@@ -278,7 +290,8 @@ class Executor:
             if c.type.kind == TypeKind.TEXT and c.name in store.dicts:
                 dicts[qname] = store.dicts[c.name].values
 
-        padded = next_pow2(max(n, 1))
+        padded = padded_static if padded_static is not None \
+            else next_pow2(max(n, 1))
         base = DBatch(qcols, jnp.ones(padded, dtype=bool), types, dicts,
                       qnulls)
         vis = K.visibility_mask(
@@ -370,10 +383,23 @@ class Executor:
         """Combine join key exprs into one int64 key column.  A NULL key
         never matches (SQL: NULL = x is unknown): null positions take the
         kernels' reserved unmatchable sentinel INT64_MAX (ops/kernels.py
-        join_probe_counts)."""
-        arrs, nulls = [], None
+        join_probe_counts).  TEXT keys are translated to stable string
+        hashes so both sides share a key space (dictionary codes are
+        column-local); text pairs are excluded from the hash recheck —
+        the hash IS the equality.  Returns (key, recheck_mask) where
+        recheck_mask[i] says key i can be re-verified by value."""
+        from .expr_compile import _text_hash_fn
+        arrs, nulls, recheckable = [], None, []
+        env = self._env(b)
         for k in keys:
-            a, nm = self._eval_pair(k, b)
+            if k.type.kind == TypeKind.TEXT:
+                a = _text_hash_fn(self._prep(k),
+                                  self._dictviews(b))(env)
+                _, nm = self._eval_pair(k, b)
+                recheckable.append(False)
+            else:
+                a, nm = self._eval_pair(k, b)
+                recheckable.append(True)
             arrs.append(a)
             if nm is not None:
                 nulls = nm if nulls is None else (nulls | nm)
@@ -389,7 +415,7 @@ class Executor:
             hashed = True   # hashed: residual recheck needed
         if nulls is not None:
             a = jnp.where(nulls, K.INT64_MAX, a)
-        return a, hashed
+        return a, hashed, recheckable
 
     def _exec_hashjoin(self, node: P.HashJoin) -> DBatch:
         left = self.exec_node(node.left)
@@ -398,15 +424,17 @@ class Executor:
         if node.kind == "cross":
             return self._cross_join(left, right)
 
-        lkey, lhashed = self._join_key(node.left_keys, left)
-        rkey, rhashed = self._join_key(node.right_keys, right)
+        lkey, lhashed, lcheck = self._join_key(node.left_keys, left)
+        rkey, rhashed, rcheck = self._join_key(node.right_keys, right)
         skeys, perm = K.join_build(rkey, right.valid)
         lo, counts = K.join_probe_counts(skeys, lkey, left.valid)
 
         hash_recheck = []
         if lhashed or rhashed:
-            hash_recheck = [(lk, rk) for lk, rk in
-                            zip(node.left_keys, node.right_keys)]
+            hash_recheck = [
+                (lk, rk) for (lk, rk), lok, rok in
+                zip(zip(node.left_keys, node.right_keys), lcheck, rcheck)
+                if lok and rok]
 
         if node.kind in ("semi", "anti") and not node.residual \
                 and not hash_recheck:
@@ -415,16 +443,21 @@ class Executor:
             return DBatch(left.cols, left.valid & mask, left.types,
                           left.dicts, left.nulls)
 
-        total = int(jnp.sum(counts))
-        left_outer = node.kind == "left"
-        if left_outer:
-            total = int(jnp.sum(jnp.where(left.valid,
-                                          jnp.maximum(counts, 1), 0)))
-        out_size = next_pow2(max(total, 1))
+        left_outer = node.kind in ("left", "full")
+        total = jnp.sum(jnp.where(left.valid, jnp.maximum(counts, 1), 0)) \
+            if left_outer else jnp.sum(counts)
+        if self._traced:
+            # no host sync inside a compiled (shard_map) program: static
+            # probe-proportional out_size; overflow reported for retry
+            out_size = left.padded * self.ctx.join_size_factor
+            self.join_required.append((total, out_size))
+        else:
+            out_size = next_pow2(max(int(total), 1))
         pi, bi, tot = K.join_expand(lo, counts, perm, out_size,
                                     left_outer=left_outer,
                                     probe_valid=left.valid)
-        tot = int(tot)
+        if not self._traced:
+            tot = int(tot)
         valid = jnp.arange(out_size) < tot
         null_right = (bi < 0) if left_outer else None
         bi_safe = jnp.where(bi < 0, 0, bi) if left_outer else bi
@@ -467,33 +500,59 @@ class Executor:
             return DBatch(left.cols, left.valid & mask, left.types,
                           left.dicts, left.nulls)
         if left_outer:
-            if not hash_recheck and not node.residual:
-                return out  # nothing filtered: every pair stands as-is
-            # Null-extended pairs (bi<0) gathered build row 0's columns, so
-            # the key recheck/residual verdict on them is garbage — they are
-            # judged by whether any REAL pair of their probe row survived.
-            # A probe row whose real pairs were ALL killed by the residual
-            # reverts to null-extension (reference: ExecHashJoin emits the
-            # null-filled tuple when HJ_FILL_OUTER and no match passed
-            # joinqual, nodeHashjoin.c) — we convert its first output pair
-            # into the null-extended one.
             null_ext = null_right
-            real_surv = res_valid & ~null_ext & out.valid
-            hits = jax.ops.segment_sum(
-                real_surv.astype(jnp.int32), pi,
-                num_segments=left.valid.shape[0])
-            need_null = left.valid & (hits == 0)
-            idx = jnp.arange(out_size)
-            first_idx = jax.ops.segment_min(
-                jnp.where(out.valid, idx, out_size), pi,
-                num_segments=left.valid.shape[0])
-            is_first = out.valid & (idx == first_idx[pi])
-            to_null = is_first & need_null[pi]
-            for n_ in right.cols:
-                rn = out.nulls.get(n_)
-                out.nulls[n_] = to_null if rn is None else (rn | to_null)
-            out.valid = real_surv | to_null
-            return out
+            if hash_recheck or node.residual:
+                # Null-extended pairs (bi<0) gathered build row 0's
+                # columns, so the key recheck/residual verdict on them is
+                # garbage — they are judged by whether any REAL pair of
+                # their probe row survived.  A probe row whose real pairs
+                # were ALL killed reverts to null-extension (reference:
+                # ExecHashJoin emits the null-filled tuple when
+                # HJ_FILL_OUTER and no match passed joinqual,
+                # nodeHashjoin.c) — we convert its first output pair into
+                # the null-extended one.
+                real_surv = res_valid & ~null_ext & out.valid
+                hits = jax.ops.segment_sum(
+                    real_surv.astype(jnp.int32), pi,
+                    num_segments=left.valid.shape[0])
+                need_null = left.valid & (hits == 0)
+                idx = jnp.arange(out_size)
+                first_idx = jax.ops.segment_min(
+                    jnp.where(out.valid, idx, out_size), pi,
+                    num_segments=left.valid.shape[0])
+                is_first = out.valid & (idx == first_idx[pi])
+                to_null = is_first & need_null[pi]
+                for n_ in right.cols:
+                    rn = out.nulls.get(n_)
+                    out.nulls[n_] = to_null if rn is None \
+                        else (rn | to_null)
+                out.valid = real_surv | to_null
+                null_ext = null_ext | to_null
+            if node.kind != "full":
+                return out
+            # FULL: append the unmatched BUILD rows null-extended on the
+            # left — computed AFTER recheck/revert so pairs killed there
+            # count their build row as unmatched (reference: ExecHashJoin
+            # HJ_FILL_INNER / ExecScanHashTableForUnmatched)
+            bhits = jax.ops.segment_sum(
+                (out.valid & ~null_ext).astype(jnp.int32), bi_safe,
+                num_segments=right.padded)
+            r_unmatched = right.valid & (bhits == 0)
+            cols2, nulls2 = {}, {}
+            for n_, a in out.cols.items():
+                if n_ in right.cols:
+                    cols2[n_] = jnp.concatenate([a, right.cols[n_]])
+                    tail_m = right.nulls.get(
+                        n_, jnp.zeros(right.padded, dtype=bool))
+                else:  # left column: null-extended in the appended rows
+                    pad = jnp.zeros((right.padded, *a.shape[1:]), a.dtype)
+                    cols2[n_] = jnp.concatenate([a, pad])
+                    tail_m = jnp.ones(right.padded, dtype=bool)
+                base_m = out.nulls.get(
+                    n_, jnp.zeros(out.padded, dtype=bool))
+                nulls2[n_] = jnp.concatenate([base_m, tail_m])
+            valid2 = jnp.concatenate([out.valid, r_unmatched])
+            return DBatch(cols2, valid2, out.types, out.dicts, nulls2)
         out.valid = res_valid
         return out
 
@@ -513,6 +572,77 @@ class Executor:
 
     def _exec_batchsource(self, node) -> DBatch:
         return node.batch
+
+    def _exec_setop(self, node: P.SetOp) -> DBatch:
+        """INTERSECT/EXCEPT [ALL]: side-tagged merge, per-group per-side
+        counts by sort, then emit min(c1,c2) / max(c1-c2,0) copies (the
+        reference's hashed SETOPCMD_* counting, nodeSetOp.c:49-66).
+        NULLs compare equal here (null-indicator grouping columns), per
+        SQL set-operation semantics."""
+        from .dist import _concat_host, _to_device, _to_host
+        parts = []
+        for side, child in enumerate(node.inputs):
+            hb = _to_host(self.exec_node(child))
+            hb.cols["__side"] = np.full(hb.nrows, side, np.int64)
+            hb.types["__side"] = T.INT64
+            parts.append(hb)
+        b = _to_device(_concat_host(parts))
+        side = b.cols["__side"]
+        key_arrs = []
+        for n in node.names:
+            arr = b.cols[n]
+            if b.types[n].kind == TypeKind.FLOAT64:
+                # canonicalize -0.0 so SQL equality groups it with +0.0
+                arr = jnp.where(arr == 0.0, 0.0, arr)
+                arr = jax.lax.bitcast_convert_type(arr, jnp.int64)
+            arr = arr.astype(jnp.int64)
+            nm = b.nulls.get(n)
+            if nm is not None:
+                key_arrs.append(jnp.where(nm, 0, arr))
+                key_arrs.append(nm.astype(jnp.int64))
+            else:
+                key_arrs.append(arr)
+        if not key_arrs:
+            key_arrs = [jnp.zeros(b.padded, jnp.int64)]
+        max_groups = next_pow2(max(b.count(), 1))
+        c_left = (b.valid & (side == 0)).astype(jnp.int64)
+        c_right = (b.valid & (side == 1)).astype(jnp.int64)
+        gkeys, (c1, c2), ng = K.grouped_agg_sort(
+            tuple(key_arrs), b.valid, (c_left, c_right), max_groups,
+            ("sum", "sum"))
+        ng = int(ng)
+        gvalid = jnp.arange(max_groups) < ng
+        if node.op == "intersect":
+            copies = jnp.minimum(c1, c2)
+            if not node.all:
+                copies = jnp.minimum(copies, 1)
+        elif node.all:   # except all: multiset difference
+            copies = jnp.maximum(c1 - c2, 0)
+        else:            # except distinct: present left, absent right
+            copies = ((c1 > 0) & (c2 == 0)).astype(jnp.int64)
+        copies = jnp.where(gvalid, copies, 0)
+        total = int(jnp.sum(copies))
+        out_size = next_pow2(max(total, 1))
+        csum = jnp.cumsum(copies)
+        j = jnp.arange(out_size, dtype=jnp.int64)
+        gi = jnp.searchsorted(csum, j, side="right")
+        gi = jnp.clip(gi, 0, max_groups - 1)
+        out_valid = j < total
+        cols, types, nulls = {}, {}, {}
+        ki = 0
+        for n in node.names:
+            t = b.types[n]
+            arr = gkeys[ki][gi]
+            ki += 1
+            if n in b.nulls:
+                nulls[n] = gkeys[ki][gi].astype(bool)
+                ki += 1
+            if t.kind == TypeKind.FLOAT64:
+                arr = jax.lax.bitcast_convert_type(arr, jnp.float64)
+            cols[n] = arr.astype(t.np_dtype)
+            types[n] = t
+        dicts = {n: b.dicts[n] for n in node.names if n in b.dicts}
+        return DBatch(cols, out_valid, types, dicts, nulls)
 
     def _exec_append(self, node) -> DBatch:
         """Concatenate children (UNION branches): through the host wire
@@ -876,6 +1006,163 @@ class Executor:
             cols[an] = jnp.asarray(arr)
         valid = jnp.asarray(np.arange(padded) < ng)
         return DBatch(cols, valid, b.types, new_dicts)
+
+    # ---- window functions ----
+    def _win_key(self, e: E.Expr, b: DBatch, for_order: bool):
+        """Sortable key + null mask for a window partition/order
+        expression.  The caller adds the null mask as its OWN sort/
+        grouping column, so NULL never collides with +inf/INT64_MAX
+        values (PG sorts NULL as a distinct peer group)."""
+        arr, nm = self._eval_pair(e, b)
+        d = _dict_for_expr(e, b.dicts)
+        if d is not None and for_order:
+            # dictionary codes are unordered: map code -> rank
+            order = np.argsort(np.asarray(d, dtype=object))
+            rank = np.empty(max(len(d), 1), dtype=np.int32)
+            rank[order] = np.arange(len(d), dtype=np.int32)
+            arr = jnp.asarray(rank)[jnp.clip(arr, 0, len(d) - 1)]
+        if arr.dtype == jnp.bool_:
+            arr = arr.astype(jnp.int32)
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(jnp.int64)
+        if nm is not None:
+            # canonicalize the value under NULL so grouping is stable
+            arr = jnp.where(nm, jnp.zeros((), arr.dtype), arr)
+        return arr, nm
+
+    def _exec_window(self, node: P.Window) -> DBatch:
+        """Sorted-partition window computation (reference:
+        nodeWindowAgg.c): one lax.sort per distinct (partition, order)
+        spec, partition/peer boundaries by neighbor compare, running
+        aggregates via prefix sums over the SQL default frame (RANGE
+        UNBOUNDED PRECEDING..CURRENT ROW — peers share values), results
+        scattered back to input row order."""
+        b = self.exec_node(node.child)
+        n = b.padded
+        iota = jnp.arange(n, dtype=jnp.int64)
+        new_cols: dict = {}
+        new_nulls: dict = {}
+        specs: dict = {}
+        for name, wc in node.calls:
+            specs.setdefault((wc.partition, wc.order), []).append(
+                (name, wc))
+        for (part, order), calls in specs.items():
+            pkeys = []
+            for pe in part:
+                arr, nm = self._win_key(pe, b, for_order=False)
+                if nm is not None:
+                    pkeys.append(nm.astype(jnp.int64))
+                pkeys.append(arr)
+            okeys = []
+            for oe, desc in order:
+                arr, nm = self._win_key(oe, b, for_order=True)
+                if nm is not None:
+                    # NULLS LAST asc / FIRST desc, as a separate key so
+                    # NULL stays a distinct peer group
+                    okeys.append(K._order_key(nm.astype(jnp.int32),
+                                              desc))
+                okeys.append(K._order_key(arr, desc))
+            operands = [~b.valid] + pkeys + okeys + [iota]
+            sorted_ = jax.lax.sort(operands,
+                                   num_keys=len(operands) - 1)
+            s_iota = sorted_[-1]
+            s_pk = sorted_[1:1 + len(pkeys)]
+            s_ok = sorted_[1 + len(pkeys):-1]
+            s_valid = b.valid[s_iota]
+            first = iota == 0
+            p_bound = first
+            for k in s_pk:
+                p_bound = p_bound | (k != jnp.roll(k, 1))
+            o_bound = p_bound
+            for k in s_ok:
+                o_bound = o_bound | (k != jnp.roll(k, 1))
+            p_start = jax.lax.cummax(jnp.where(p_bound, iota, 0))
+            peer_start = jax.lax.cummax(jnp.where(o_bound, iota, 0))
+            # next peer boundary strictly after i -> end of i's peer group
+            nb = jnp.where(o_bound, iota, n)
+            nxt = jax.lax.cummin(nb[::-1])[::-1]
+            peer_end = jnp.concatenate(
+                [nxt[1:], jnp.asarray([n], jnp.int64)]) - 1
+            pid = jnp.cumsum(p_bound.astype(jnp.int64)) - 1
+            ob_cum = jnp.cumsum(o_bound.astype(jnp.int64))
+
+            def scatter(res):
+                return jnp.zeros(n, res.dtype).at[s_iota].set(res)
+
+            for name, wc in calls:
+                if wc.func == "row_number":
+                    new_cols[name] = scatter(iota - p_start + 1)
+                    continue
+                if wc.func == "rank":
+                    new_cols[name] = scatter(peer_start - p_start + 1)
+                    continue
+                if wc.func == "dense_rank":
+                    dr = ob_cum - ob_cum[p_start] + 1
+                    new_cols[name] = scatter(dr)
+                    continue
+                # aggregate over the frame
+                if wc.arg is not None:
+                    a, anm = self._eval_pair(wc.arg, b)
+                    a_s = a[s_iota]
+                    anm_s = anm[s_iota] if anm is not None else None
+                else:
+                    a_s, anm_s = None, None
+                contrib = s_valid if anm_s is None else \
+                    (s_valid & ~anm_s)
+                if wc.func in ("min", "max"):
+                    if order:
+                        raise ExecError(
+                            f"running {wc.func} OVER (ORDER BY) "
+                            "unsupported; omit the window ORDER BY")
+                    neutral = jnp.iinfo(jnp.int64).max \
+                        if wc.func == "min" else jnp.iinfo(jnp.int64).min
+                    if jnp.issubdtype(a_s.dtype, jnp.floating):
+                        neutral = np.inf if wc.func == "min" else -np.inf
+                    vals = jnp.where(contrib, a_s,
+                                     jnp.asarray(neutral, a_s.dtype))
+                    segf = jax.ops.segment_min if wc.func == "min" \
+                        else jax.ops.segment_max
+                    per = segf(vals, pid, num_segments=n)
+                    cnt = jax.ops.segment_sum(
+                        contrib.astype(jnp.int64), pid, num_segments=n)
+                    new_cols[name] = scatter(per[pid])
+                    new_nulls[name] = scatter(cnt[pid] == 0)
+                    continue
+                cvals = contrib.astype(jnp.int64)
+                ccum = jnp.cumsum(cvals)
+                cex = ccum - cvals
+                rcount = ccum[peer_end] - cex[p_start]
+                if wc.func == "count":
+                    new_cols[name] = scatter(rcount)
+                    continue
+                if wc.func in ("sum", "avg"):
+                    av = a_s.astype(jnp.float64) \
+                        if wc.func == "avg" else a_s
+                    av = jnp.where(contrib, av, jnp.zeros((), av.dtype))
+                    scum = jnp.cumsum(av)
+                    sex = scum - av
+                    rsum = scum[peer_end] - sex[p_start]
+                    if wc.func == "avg":
+                        scale = wc.arg.type.scale \
+                            if wc.arg.type.kind == TypeKind.DECIMAL else 0
+                        res = jnp.where(
+                            rcount > 0,
+                            rsum / jnp.maximum(rcount, 1) / 10 ** scale,
+                            0.0)
+                    else:
+                        res = rsum
+                    new_cols[name] = scatter(res)
+                    new_nulls[name] = scatter(rcount == 0)
+                    continue
+                raise ExecError(f"window function {wc.func} unsupported")
+        cols = dict(b.cols)
+        cols.update(new_cols)
+        types = dict(b.types)
+        for name, wc in node.calls:
+            types[name] = wc.type
+        nulls = dict(b.nulls)
+        nulls.update(new_nulls)
+        return DBatch(cols, b.valid, types, dict(b.dicts), nulls)
 
     # ---- sort / limit ----
     def _exec_sort(self, node: P.Sort) -> DBatch:

@@ -138,6 +138,29 @@ def _civil(days):
 NullFn = Optional[Callable[[Arrays], object]]
 
 
+def _text_hash_fn(e: E.Expr, dicts: dict) -> Callable[[Arrays], object]:
+    """Codes -> stable string-hash translation for one TEXT column
+    (possibly transformed): cross-dictionary comparisons happen in the
+    shared 64-bit hash space (utils/hashing.hash_string, the same hash
+    routing/distribution uses)."""
+    from ..utils.hashing import hash_string
+    if isinstance(e, E.TextExpr):
+        name, transform = e.col.name, e.apply
+    elif isinstance(e, E.Col):
+        name, transform = e.name, (lambda s: s)
+    else:
+        raise E.ExprError(
+            "text comparison requires plain text columns")
+    d = dicts.get(name)
+    if d is None:
+        raise E.ExprError(f"no dictionary for TEXT column {name!r}")
+    lut = np.asarray([hash_string(transform(v)) for v in d.values]
+                     or [0], dtype=np.uint64).view(np.int64)
+    jl = jnp.asarray(lut)
+    return lambda cols, _j=jl, _n=name: \
+        _j[jnp.clip(cols[_n], 0, _j.shape[0] - 1)]
+
+
 def _union(*nfs: NullFn) -> NullFn:
     """OR-combine null masks (strict-operator propagation)."""
     live = [f for f in nfs if f is not None]
@@ -235,6 +258,24 @@ def compile_pair(e: E.Expr, dicts: dict, nullable=frozenset()):
 
         if isinstance(x, E.Cmp):
             lt, rt = x.left.type, x.right.type
+            if lt.kind == TypeKind.TEXT and rt.kind == TypeKind.TEXT:
+                # text-to-text equality: dictionary codes live in
+                # DIFFERENT code spaces per column — translate both
+                # sides to stable string hashes (64-bit; collisions
+                # vanishingly unlikely) and compare those
+                if x.op not in ("=", "<>"):
+                    raise E.ExprError(
+                        "text-to-text ordering comparison unsupported "
+                        "(dictionary orders are column-local)")
+                lh = _text_hash_fn(x.left, dicts)
+                rh = _text_hash_fn(x.right, dicts)
+                _, lnn = c(x.left)
+                _, rnn = c(x.right)
+                if x.op == "=":
+                    vf = lambda cols: lh(cols) == rh(cols)
+                else:
+                    vf = lambda cols: lh(cols) != rh(cols)
+                return vf, _union(lnn, rnn)
             (lf, ln), (rf, rn) = c(x.left), c(x.right)
             # align decimal scales / promote to float if either is float
             if TypeKind.FLOAT64 in (lt.kind, rt.kind):

@@ -376,7 +376,7 @@ class Session:
                 masks[c] = m
         enc = {c: st.encode_column(c, vals) for c, vals in clean.items()}
         loc = Locator(self.node.catalog)
-        raw_for_route = {c: np.asarray(clean[c])
+        raw_for_route = {c: np.asanyarray(clean[c])
                          for c in td.distribution.dist_cols} \
             if td.distribution.dist_type == DistType.SHARD else {}
         sid = loc.shard_ids_for_rows(td, raw_for_route) \

@@ -25,28 +25,93 @@ def shard_of_hash(h: np.ndarray) -> np.ndarray:
     return (h % np.uint64(NUM_SHARDS)).astype(np.int32)
 
 
+def _canon_bulk(col, arr: np.ndarray) -> np.ndarray:
+    """Canonical uint64 hash input for one dist-key column of raw
+    values.  The SAME canonical form is used by FQS point routing
+    (_canon_point), so `where key = literal` pins to the node the
+    insert path chose: TEXT -> string hash; DECIMAL -> scaled int at
+    the COLUMN scale (the storage representation); DATE -> epoch days;
+    FLOAT -> zero-normalized bit pattern; ints -> int64."""
+    k = col.type.kind
+    if k == TypeKind.TEXT:
+        if arr.dtype.kind not in "UO":
+            raise ValueError(
+                f"TEXT distribution key {col.name!r} must be routed on "
+                f"raw strings, not dictionary codes (dtype {arr.dtype})")
+        return np.asarray([hash_string(str(s)) for s in arr],
+                          dtype=np.uint64)
+    if k == TypeKind.DECIMAL:
+        from ..catalog.types import decimal_to_int
+        from ..storage.loader import _PreScaled
+        if isinstance(arr, _PreScaled):
+            # bulk-loader columns arrive already in storage scale
+            return np.asarray(arr).astype(np.int64).view(np.uint64)
+        if arr.dtype.kind in "iu":
+            return (arr.astype(np.int64)
+                    * np.int64(10 ** col.type.scale)).view(np.uint64)
+        if arr.dtype.kind == "f":
+            return np.round(arr * 10 ** col.type.scale).astype(
+                np.int64).view(np.uint64)
+        return np.asarray([decimal_to_int(str(v), col.type.scale)
+                           for v in arr], dtype=np.int64).view(np.uint64)
+    if k == TypeKind.DATE and arr.dtype.kind in "UO":
+        from ..catalog.types import date_to_days
+        return np.asarray([date_to_days(str(v)) for v in arr],
+                          dtype=np.int64).view(np.uint64)
+    if k == TypeKind.FLOAT64:
+        f = np.asarray([float(x) for x in arr], dtype=np.float64)
+        f = np.where(f == 0.0, 0.0, f)  # -0.0 == +0.0
+        return f.view(np.uint64)
+    return arr.astype(np.int64).view(np.uint64)
+
+
+def _canon_point(col, v) -> Optional[np.ndarray]:
+    """Canonical uint64 (len-1) for one FQS literal — accepts raw python
+    values or binder literals (E.Lit, whose DECIMAL values are already
+    scaled at the LITERAL's scale).  None = the value cannot exist at
+    the column's scale (the query matches nothing on this node set)."""
+    from ..plan import exprs as E
+    k = col.type.kind
+    lit_t = None
+    if isinstance(v, E.Lit):
+        lit_t, v = v.lit_type, v.value
+    if k == TypeKind.TEXT:
+        return np.asarray([hash_string(str(v))], dtype=np.uint64)
+    if k == TypeKind.DECIMAL:
+        cs = col.type.scale
+        if lit_t is not None and lit_t.kind == TypeKind.DECIMAL:
+            diff = cs - lit_t.scale
+            if diff >= 0:
+                sv = int(v) * 10 ** diff
+            elif int(v) % 10 ** (-diff) == 0:
+                sv = int(v) // 10 ** (-diff)
+            else:
+                return None  # finer than the column can store
+        elif isinstance(v, (int, np.integer)):
+            sv = int(v) * 10 ** cs
+        else:
+            from ..catalog.types import decimal_to_int
+            sv = decimal_to_int(str(v), cs)
+        return np.asarray([sv], dtype=np.int64).view(np.uint64)
+    if k == TypeKind.DATE and isinstance(v, str):
+        from ..catalog.types import date_to_days
+        v = date_to_days(v)
+    if k == TypeKind.FLOAT64:
+        if lit_t is not None and lit_t.kind == TypeKind.DECIMAL:
+            v = int(v) / 10 ** lit_t.scale
+        f = np.asarray([float(v)], dtype=np.float64)
+        f = np.where(f == 0.0, 0.0, f)
+        return f.view(np.uint64)
+    return np.asarray([int(v)], dtype=np.int64).view(np.uint64)
+
+
 def _dist_key_arrays(td: TableDef,
                      columns: dict[str, np.ndarray]) -> list[np.ndarray]:
-    """Normalize distribution-key columns to uint64 hash inputs.
-
-    TEXT keys must arrive as *raw strings* (dtype U/O): dictionary codes are
-    node-local and would break the host/device routing agreement.  Numeric
-    keys pass through as int64.
-    """
-    out = []
-    for name in td.distribution.dist_cols:
-        arr = np.asarray(columns[name])
-        is_text = td.column(name).type.kind == TypeKind.TEXT
-        if is_text:
-            if arr.dtype.kind not in "UO":
-                raise ValueError(
-                    f"TEXT distribution key {name!r} must be routed on raw "
-                    f"strings, not dictionary codes (got dtype {arr.dtype})")
-            out.append(np.asarray([hash_string(str(s)) for s in arr],
-                                  dtype=np.uint64))
-        else:
-            out.append(arr.astype(np.int64).view(np.uint64))
-    return out
+    """Normalize distribution-key columns to uint64 hash inputs (see
+    _canon_bulk for the canonical forms).  asanyarray keeps the
+    loader's _PreScaled marker subclass intact."""
+    return [_canon_bulk(td.column(name), np.asanyarray(columns[name]))
+            for name in td.distribution.dist_cols]
 
 
 def shard_ids_for_columns(cols: Sequence[np.ndarray]) -> np.ndarray:
@@ -114,13 +179,12 @@ class Locator:
             return None
         arrs = []
         for v, colname in zip(values, td.distribution.dist_cols):
-            col = td.column(colname)
-            if col.type.kind == TypeKind.TEXT:
-                arrs.append(np.asarray([hash_string(str(v))], dtype=np.uint64))
-            else:
-                arrs.append(np.asarray([v], dtype=np.int64))
+            a = _canon_point(td.column(colname), v)
+            if a is None:
+                return None  # literal unrepresentable: not pinnable
+            arrs.append(a)
         if dt == DistType.MODULO:
-            return int(np.asarray(values[0], dtype=np.int64) % ndn)
+            return int(arrs[0].view(np.int64)[0] % ndn)
         if dt == DistType.HASH:
             return int(hash_columns_np(arrs)[0] % np.uint64(ndn))
         if dt == DistType.SHARD:

@@ -121,7 +121,9 @@ def fqs_target_node(bq: BoundQuery, catalog: Catalog) -> Optional[int]:
                     and isinstance(q.left, E.Col) \
                     and isinstance(q.right, E.Lit) \
                     and q.left.name in dist_cols:
-                values[q.left.name] = q.right.value
+                # pass the full literal: point routing canonicalizes it
+                # to the same representation bulk routing used
+                values[q.left.name] = q.right
         if set(values) != set(dist_cols):
             return None
         node = loc.node_for_values(
@@ -205,6 +207,24 @@ class Distributor:
                 return node, Dist("sharded", tuple(out))
             return node, d
 
+        if isinstance(node, P.Window):
+            node.child, d = self._walk(node.child)
+            if d.kind != "sharded":
+                return node, d
+            # local only when every call partitions by (at least) the
+            # distribution keys — partitions then never span nodes
+            # (reference: window paths keep Distribution when partition
+            # clause covers the distribution key)
+            common = None
+            for _, wc in node.calls:
+                this = {k.name for k in wc.partition
+                        if isinstance(k, E.Col)}
+                common = this if common is None else (common & this)
+            if d.keys and common and set(d.keys) <= common:
+                return node, d
+            node.child = self._add_gather(node.child)
+            return node, Dist("cn")
+
         if isinstance(node, P.HashJoin):
             return self._walk_join(node)
 
@@ -228,10 +248,10 @@ class Distributor:
                 d = Dist("cn")
             return node, d
 
-        if isinstance(node, P.Append):
-            # gather every branch to the coordinator, append there
-            # (branch distributions rarely align; CN append is always
-            # correct — colocated append is a future optimization)
+        if isinstance(node, (P.Append, P.SetOp)):
+            # gather every branch to the coordinator, combine there
+            # (branch distributions rarely align; CN combine is always
+            # correct — colocated append/setop is a future optimization)
             new_inputs = []
             for c in node.inputs:
                 cp, cd = self._walk(c)
@@ -274,6 +294,21 @@ class Distributor:
                 node.right = self._add_broadcast(node.right)
             return node, (ld if ld.kind != "replicated"
                           else Dist("replicated"))
+
+        if node.kind == "full":
+            # FULL JOIN emits unmatched rows from BOTH sides: broadcast
+            # would duplicate them per node.  Colocated/replicated pairs
+            # stay local; otherwise join at the coordinator.
+            if (li is not None and ri is not None and li == ri) or \
+                    (ld.kind == "replicated" and rd.kind == "replicated"):
+                return node, (ld if ld.kind != "replicated" else rd)
+            if ld.kind != "cn":
+                node.left = self._add_gather(
+                    node.left, one=(ld.kind == "replicated"))
+            if rd.kind != "cn":
+                node.right = self._add_gather(
+                    node.right, one=(rd.kind == "replicated"))
+            return node, Dist("cn")
 
         # colocated: both sharded on the same join pair
         if li is not None and ri is not None and li == ri:
@@ -389,7 +424,7 @@ class Distributor:
                 c = getattr(node, attr, None)
                 if isinstance(c, P.PhysNode):
                     setattr(node, attr, cut(c))
-            if isinstance(node, P.Append):
+            if isinstance(node, (P.Append, P.SetOp)):
                 node.inputs = [cut(c) for c in node.inputs]
             return node
 

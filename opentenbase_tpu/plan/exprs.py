@@ -321,6 +321,39 @@ class Cast(Expr):
 
 AGG_FUNCS = ("sum", "count", "avg", "min", "max")
 
+WINDOW_FUNCS = ("row_number", "rank", "dense_rank") + AGG_FUNCS
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowCall(Expr):
+    """func(arg) OVER (PARTITION BY ... ORDER BY ...) — consumed by the
+    Window operator (reference: WindowFunc + nodeWindowAgg.c).  With an
+    ORDER BY, aggregate functions use the SQL default frame (RANGE
+    UNBOUNDED PRECEDING..CURRENT ROW): running values, peers equal."""
+    func: str
+    arg: Optional[Expr]
+    partition: tuple[Expr, ...]
+    order: tuple[tuple[Expr, bool], ...]   # (expr, desc)
+
+    def __post_init__(self):
+        if self.func not in WINDOW_FUNCS:
+            raise ExprError(f"unknown window function {self.func}")
+        if self.func in ("row_number", "rank", "dense_rank"):
+            t = INT64
+        elif self.func == "count":
+            t = INT64
+        elif self.func == "avg":
+            t = FLOAT64
+        else:
+            t = self.arg.type
+        object.__setattr__(self, "type", t)
+
+    def children(self):
+        out = list(self.partition) + [e for e, _ in self.order]
+        if self.arg is not None:
+            out.append(self.arg)
+        return tuple(out)
+
 
 @dataclasses.dataclass(frozen=True)
 class AggCall(Expr):

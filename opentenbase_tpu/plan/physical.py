@@ -174,6 +174,39 @@ class Append(PhysNode):
 
 
 @dataclasses.dataclass
+class Window(PhysNode):
+    """Window-function computation: adds one column per call, rows
+    pass through (reference: nodeWindowAgg.c — sorted partitions,
+    per-frame aggregation; here sort + segment scans in one kernel)."""
+    child: Optional[PhysNode] = None
+    calls: list = dataclasses.field(default_factory=list)
+    # [(output name, E.WindowCall)]
+
+    def children(self):
+        return [self.child]
+
+    def title(self):
+        return f"Window calls={len(self.calls)}"
+
+
+@dataclasses.dataclass
+class SetOp(PhysNode):
+    """INTERSECT / EXCEPT [ALL] over two positionally-aligned inputs
+    (reference: nodeSetOp.c — hashed set-op counting per input side)."""
+    inputs: list[PhysNode] = dataclasses.field(default_factory=list)
+    op: str = "intersect"          # 'intersect' | 'except'
+    all: bool = False
+    names: list[str] = dataclasses.field(default_factory=list)
+    types: list = dataclasses.field(default_factory=list)
+
+    def children(self):
+        return list(self.inputs)
+
+    def title(self):
+        return f"SetOp {self.op}{' all' if self.all else ''}"
+
+
+@dataclasses.dataclass
 class AnnSearch(PhysNode):
     """Top-k nearest-neighbor scan over a VECTOR column (pgvector's
     `ORDER BY vec <-> q LIMIT k` IVFFlat/seq path as one fused node)."""

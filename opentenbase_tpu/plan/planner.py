@@ -89,6 +89,17 @@ def rewrite(e: E.Expr, fn) -> E.Expr:
         if isinstance(x, E.AggCall):
             return E.AggCall(x.func, rec(x.arg) if x.arg is not None
                              else None, x.distinct)
+        if isinstance(x, E.WindowCall):
+            return E.WindowCall(
+                x.func, rec(x.arg) if x.arg is not None else None,
+                tuple(rec(p) for p in x.partition),
+                tuple((rec(o), d) for o, d in x.order))
+        if isinstance(x, E.Coalesce):
+            return E.Coalesce(tuple(rec(a) for a in x.args), x.out_type)
+        if isinstance(x, E.NullIf):
+            return E.NullIf(rec(x.left), rec(x.right))
+        if isinstance(x, E.IsNull):
+            return E.IsNull(rec(x.arg), x.negated)
         return x
     return rec(e)
 
@@ -166,10 +177,16 @@ class Planner:
                     e = E.Cast(e, t)
                 outs.append((names[i], e))
             inputs.append(P.Project(p, outs))
-        plan = P.Append(inputs=inputs)
-        if not so.all:
-            plan = P.Agg(plan, [(n, E.Col(n, t)) for n, t in
-                               zip(names, so.target_types)], [], "single")
+        if so.op in ("intersect", "except"):
+            plan = P.SetOp(inputs=inputs, op=so.op, all=so.all,
+                           names=list(names),
+                           types=list(so.target_types))
+        else:
+            plan = P.Append(inputs=inputs)
+            if not so.all:
+                plan = P.Agg(plan, [(n, E.Col(n, t)) for n, t in
+                                    zip(names, so.target_types)], [],
+                             "single")
         if so.order_by:
             keys = [(E.Col(names[i], so.target_types[i]), desc)
                     for i, desc in so.order_by]
@@ -257,7 +274,11 @@ class Planner:
             # scan emits qualified names
             outputs = [(q, E.Col(q, t)) for _, (q, t) in rte.columns.items()]
             return P.SeqScan(rte.table, rte.alias, filters, outputs)
-        sub = self._plan_query(rte.subquery, init_plans)
+        from .query import BoundSetOp
+        if isinstance(rte.subquery, BoundSetOp):
+            sub, _names = self._plan_setop(rte.subquery, init_plans)
+        else:
+            sub = self._plan_query(rte.subquery, init_plans)
         return _RenameHelper.wrap(sub, rte, filters)
 
     # -- join ordering -----------------------------------------------------
@@ -266,7 +287,8 @@ class Planner:
         order = [s.rte_index for s in bq.join_order]
         aliases = [bq.rtable[i].alias for i in order]
         outer_steps = {bq.rtable[s.rte_index].alias: s
-                       for s in bq.join_order if s.kind == "left"}
+                       for s in bq.join_order if s.kind in ("left",
+                                                            "full")}
 
         joined: list[str] = []
         plan: Optional[P.PhysNode] = None
@@ -300,7 +322,11 @@ class Planner:
                 if step is not None:
                     lk, rk, res = self._outer_keys(step.on, avail,
                                                    rte_cols[cand])
-                    plan = P.HashJoin(plan, right, lk, rk, "left", res)
+                    if step.kind == "full" and res:
+                        raise PlanError("FULL JOIN supports only "
+                                        "equi-key ON conditions")
+                    plan = P.HashJoin(plan, right, lk, rk, step.kind,
+                                      res)
                 else:
                     edges = edges_between(cand)
                     if edges:
@@ -535,6 +561,28 @@ class Planner:
         else:
             proj = list(targets)
             order = list(bq.order_by)
+
+        # window functions evaluate over the (post-aggregate) row set;
+        # each distinct call becomes a computed __winN column
+        wins: list[tuple[str, E.Expr]] = []
+
+        def wrepl(x: E.Expr):
+            if isinstance(x, E.WindowCall):
+                for wname, wc in wins:
+                    if wc == x:
+                        return E.Col(wname, x.type)
+                wname = f"__win{len(wins)}"
+                wins.append((wname, x))
+                return E.Col(wname, x.type)
+            return None
+
+        if any(isinstance(x, E.WindowCall)
+               for _, e in proj for x in E.walk(e)) or \
+           any(isinstance(x, E.WindowCall)
+               for o, _ in order for x in E.walk(o)):
+            proj = [(n, rewrite(e, wrepl)) for n, e in proj]
+            order = [(rewrite(o, wrepl), d) for o, d in order]
+            plan = P.Window(plan, wins)
 
         # pgvector pattern: ORDER BY vec <metric> 'q' LIMIT k over a plain
         # scan -> one fused AnnSearch node (top-k on device)

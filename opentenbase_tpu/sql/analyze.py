@@ -50,6 +50,22 @@ class Binder:
     # ------------------------------------------------------------------
     def bind_select(self, stmt: A.SelectStmt,
                     outer: list[Scope] = ()) -> BoundQuery:
+        saved_ctes = getattr(self, "_ctes", {})
+        if stmt.ctes:
+            # non-recursive WITH: each CTE sees only the ones declared
+            # before it (reference: transformWithClause, parse_cte.c) —
+            # snapshot the visible map per declaration
+            m = dict(saved_ctes)
+            for name, col_aliases, sub in stmt.ctes:
+                m[name] = (sub, col_aliases, dict(m))
+            self._ctes = m
+        try:
+            return self._bind_select_body(stmt, outer)
+        finally:
+            self._ctes = saved_ctes
+
+    def _bind_select_body(self, stmt: A.SelectStmt,
+                          outer: list[Scope] = ()) -> BoundQuery:
         if stmt.setop is not None:
             return self._bind_setop(stmt, outer)
         rtable: list[RTE] = []
@@ -60,7 +76,42 @@ class Binder:
         scopes = [scope, *outer]
 
         def add_rte(item, kind_for_step="cross", on_ast=None):
-            if isinstance(item, A.TableRef):
+            if isinstance(item, A.TableRef) and \
+                    item.name in getattr(self, "_ctes", {}):
+                sub_stmt, col_aliases, visible = self._ctes[item.name]
+                hold, self._ctes = self._ctes, visible
+                try:
+                    # a CTE body is an independent query: no correlation
+                    # into the referencing scope (matches PG)
+                    sub = self.bind_select(sub_stmt)
+                finally:
+                    self._ctes = hold
+                if col_aliases:
+                    names = sub.targets if isinstance(sub, BoundQuery) \
+                        else None
+                    if names is not None:
+                        if len(col_aliases) != len(names):
+                            raise BindError(
+                                f"CTE {item.name!r} column alias count")
+                        sub.targets = [(a, e) for a, (_, e)
+                                       in zip(col_aliases, sub.targets)]
+                    else:
+                        if len(col_aliases) != len(sub.target_names):
+                            raise BindError(
+                                f"CTE {item.name!r} column alias count")
+                        sub.target_names = list(col_aliases)
+                alias = item.alias or item.name
+                self._check_dup_alias(rtable, alias)
+                if isinstance(sub, BoundQuery):
+                    cols = {name: (f"{alias}.{name}", e.type)
+                            for name, e in sub.targets}
+                else:
+                    cols = {name: (f"{alias}.{name}", t)
+                            for name, t in zip(sub.target_names,
+                                               sub.target_types)}
+                rtable.append(RTE(alias, "subquery", subquery=sub,
+                                  columns=cols))
+            elif isinstance(item, A.TableRef):
                 td = self._table(item.name)
                 alias = item.alias or item.name
                 self._check_dup_alias(rtable, alias)
@@ -71,8 +122,13 @@ class Binder:
                 sub = self.bind_select(item.subquery, outer=scopes)
                 alias = item.alias
                 self._check_dup_alias(rtable, alias)
-                cols = {name: (f"{alias}.{name}", expr.type)
-                        for name, expr in sub.targets}
+                if isinstance(sub, BoundQuery):
+                    cols = {name: (f"{alias}.{name}", expr.type)
+                            for name, expr in sub.targets}
+                else:  # set operation body
+                    cols = {name: (f"{alias}.{name}", t)
+                            for name, t in zip(sub.target_names,
+                                               sub.target_types)}
                 rtable.append(RTE(alias, "subquery", subquery=sub,
                                   columns=cols))
             else:
@@ -84,12 +140,19 @@ class Binder:
 
         def walk_from(item):
             if isinstance(item, A.JoinRef):
+                if item.kind == "right":
+                    # a RIGHT JOIN b == b LEFT JOIN a (reference: the
+                    # planner swaps via JOIN_RIGHT -> JOIN_LEFT too)
+                    if isinstance(item.left, A.JoinRef):
+                        raise BindError(
+                            "RIGHT JOIN after a join chain is not "
+                            "supported; rewrite as LEFT JOIN")
+                    item = A.JoinRef("left", item.right, item.left,
+                                     item.on)
                 walk_from(item.left)
                 if isinstance(item.right, A.JoinRef):
                     raise BindError("parenthesized right-side joins "
                                     "not supported")
-                if item.kind in ("right", "full"):
-                    raise BindError(f"{item.kind} join not supported yet")
                 step = add_rte(item.right,
                                "inner" if item.kind == "cross"
                                else item.kind)
@@ -158,12 +221,13 @@ class Binder:
                           distinct=stmt.distinct, correlated_cols=correlated)
 
     def _bind_setop(self, stmt: A.SelectStmt, outer) -> "BoundSetOp":
-        """UNION [ALL] chains (EXCEPT/INTERSECT planned).  Branches must
-        agree in arity and column kinds; ORDER BY/LIMIT/OFFSET of the
-        outermost statement apply to the combined result.  The parser
-        nests rightward; SQL set ops are LEFT-associative, so flatten the
-        chain and fold left (a UNION ALL b UNION c == (a UNION ALL b)
-        UNION c — the flags group differently than the parse tree)."""
+        """Set-operation chains.  Branches must agree in arity and column
+        kinds; ORDER BY/LIMIT/OFFSET of the outermost statement apply to
+        the combined result.  The parser nests rightward; SQL set ops
+        are LEFT-associative with INTERSECT binding tighter than
+        UNION/EXCEPT (a UNION b INTERSECT c == a UNION (b INTERSECT c)
+        — reference: gram.y set-op precedence), so flatten the chain,
+        group INTERSECT runs, then fold left."""
         from ..plan.query import BoundSetOp
 
         selects = []
@@ -176,8 +240,6 @@ class Binder:
             if setop is None:
                 break
             op, all_, rhs = setop
-            if op != "union":
-                raise BindError(f"{op.upper()} not supported yet")
             links.append((op, all_))
             cur = rhs
 
@@ -186,25 +248,47 @@ class Binder:
                 return [e.type for _, e in b.targets]
             return list(b.target_types)
 
-        acc = self.bind_select(selects[0], outer)
-        names = [n for n, _ in acc.targets] if isinstance(acc, BoundQuery) \
-            else list(acc.target_names)
-        for (op, all_), sel in zip(links, selects[1:]):
-            right = self.bind_select(sel, outer)
+        def names_of(b):
+            if isinstance(b, BoundQuery):
+                return [n for n, _ in b.targets]
+            return list(b.target_names)
+
+        def combine(op, all_, acc, right):
             lt, rt = types_of(acc), types_of(right)
             if len(lt) != len(rt):
                 raise BindError(
-                    "UNION branches have different column counts")
+                    f"{op.upper()} branches have different column counts")
             combined = []
             for a, b in zip(lt, rt):
+                if a.kind == TypeKind.NULL:
+                    a = b
+                if b.kind == TypeKind.NULL:
+                    b = a
                 if a.kind != b.kind:
                     raise BindError(
-                        f"UNION branch column types differ: {a} vs {b}")
+                        f"{op.upper()} branch column types differ: "
+                        f"{a} vs {b}")
                 if a.kind == TypeKind.DECIMAL and a.scale != b.scale:
                     combined.append(T.decimal(30, max(a.scale, b.scale)))
                 else:
                     combined.append(a)
-            acc = BoundSetOp(op, all_, acc, right, names, combined)
+            return BoundSetOp(op, all_, acc, right, names_of(acc),
+                              combined)
+
+        # precedence pass: fold INTERSECT runs into sub-nodes first
+        items: list = [self.bind_select(selects[0], outer)]
+        ops: list = []
+        for (op, all_), sel in zip(links, selects[1:]):
+            right = self.bind_select(sel, outer)
+            if op == "intersect":
+                items[-1] = combine(op, all_, items[-1], right)
+            else:
+                ops.append((op, all_))
+                items.append(right)
+        acc = items[0]
+        for (op, all_), it in zip(ops, items[1:]):
+            acc = combine(op, all_, acc, it)
+        names = names_of(acc)
 
         order_by = []
         for si in stmt.order_by:
@@ -536,8 +620,13 @@ class Binder:
                         ">": "<", ">=": "<="}[op]
                 return self._bind_cmp(swap, right, left)
             if lt.kind == TypeKind.TEXT and rt.kind == TypeKind.TEXT:
-                raise BindError("text-to-text column comparison requires "
-                                "shared dictionary (unsupported)")
+                if op in ("=", "<>") and \
+                        isinstance(left, (E.Col, E.TextExpr)) and \
+                        isinstance(right, (E.Col, E.TextExpr)):
+                    # compiled as a cross-dictionary string-hash compare
+                    return E.Cmp(op, left, right)
+                raise BindError("text-to-text comparison supports only "
+                                "=/<> between columns")
         left, right = self._coerce_pair(left, right)
         return E.Cmp(op, left, right)
 
@@ -601,6 +690,22 @@ class Binder:
 
     def _bind_func(self, node: A.FuncCall, b) -> E.Expr:
         name = node.name
+        if node.over is not None:
+            if name not in E.WINDOW_FUNCS:
+                raise BindError(f"window function {name!r} unsupported")
+            arg = None
+            if node.star and name != "count":
+                raise BindError(f"{name}(*) is not allowed")
+            if name in E.AGG_FUNCS and not node.star:
+                if len(node.args) != 1:
+                    raise BindError(f"{name} takes one argument")
+                arg = b(node.args[0])
+            elif name not in E.AGG_FUNCS and node.args:
+                raise BindError(f"{name}() takes no arguments")
+            part = tuple(b(p) for p in node.over.partition_by)
+            order = tuple((b(si.expr), bool(si.desc))
+                          for si in node.over.order_by)
+            return E.WindowCall(name, arg, part, order)
         if name in E.AGG_FUNCS:
             if node.star:
                 return E.AggCall("count", None)

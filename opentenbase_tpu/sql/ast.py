@@ -73,6 +73,13 @@ class FuncCall(Node):
     args: list[Node]
     distinct: bool = False
     star: bool = False                # count(*)
+    over: Optional["WindowDef"] = None  # window function call
+
+
+@dataclasses.dataclass
+class WindowDef(Node):
+    partition_by: list[Node] = dataclasses.field(default_factory=list)
+    order_by: list["SortItem"] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -197,6 +204,9 @@ class SelectStmt(Node):
     offset: Optional[Node] = None
     distinct: bool = False
     setop: Optional[tuple[str, bool, "SelectStmt"]] = None  # (op, all, rhs)
+    ctes: list = dataclasses.field(default_factory=list)
+    # WITH clause: [(name, col_aliases|None, SelectStmt)]
+    parenthesized: bool = False   # was written as (SELECT ...)
 
 
 # ---- DML ------------------------------------------------------------------

@@ -99,10 +99,14 @@ class Parser:
 
     def statement(self) -> A.Node:
         t = self.tok
+        if self.at_op("("):
+            return self.select_stmt()
         if t.kind != Tok.IDENT:
             raise SqlSyntaxError(f"unexpected {t.value!r}", self.sql, t.pos)
         v = t.value
-        if v == "select" or self.at_op("("):
+        if v == "select":
+            return self.select_stmt()
+        if v == "with":
             return self.select_stmt()
         if v == "insert":
             return self.insert_stmt()
@@ -171,20 +175,58 @@ class Parser:
 
     # ---- SELECT ----
     def select_stmt(self) -> A.SelectStmt:
+        ctes = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.ident()
+                col_aliases = None
+                if self.accept_op("("):
+                    col_aliases = [self.ident()]
+                    while self.accept_op(","):
+                        col_aliases.append(self.ident())
+                    self.expect_op(")")
+                self.expect_kw("as")
+                self.expect_op("(")
+                sub = self.select_stmt()
+                self.expect_op(")")
+                ctes.append((name, col_aliases, sub))
+                if not self.accept_op(","):
+                    break
         stmt = self.select_core()
+        if self.at_kw("union", "except", "intersect"):
+            stmt = self._wrap_tailed_branch(stmt)
+        # ctes attach to the outermost statement (after any branch wrap)
+        # so every set-op branch sees them; a parenthesized inner WITH
+        # keeps its own entries (declared after, so they may shadow)
+        stmt.ctes = ctes + stmt.ctes
         while self.at_kw("union", "except", "intersect"):
             op = self.advance().value
             all_ = self.accept_kw("all")
             if not all_:
                 self.accept_kw("distinct")
             # operands must not swallow the trailing ORDER BY/LIMIT:
-            # those bind to the whole set operation (parenthesize a
-            # branch to order it individually)
-            rhs = self.select_core(consume_tails=False)
+            # those bind to the whole set operation; a parenthesized
+            # branch's own tails apply to that branch alone
+            rhs = self._wrap_tailed_branch(
+                self.select_core(consume_tails=False))
             stmt = self._attach_setop(stmt, op, all_, rhs)
         # trailing ORDER BY / LIMIT bind to the set operation result
         self._tail_clauses(stmt)
         return stmt
+
+    _branch_n = 0
+
+    def _wrap_tailed_branch(self, s: A.SelectStmt) -> A.SelectStmt:
+        """A parenthesized set-op branch carrying its own ORDER BY/LIMIT
+        becomes a subquery: (SELECT ... LIMIT 2) UNION ... applies the
+        LIMIT to the branch, not to the whole set operation."""
+        if s.parenthesized and (s.order_by or s.limit is not None
+                                or s.offset is not None):
+            Parser._branch_n += 1
+            return A.SelectStmt(
+                items=[A.SelectItem(A.Star())],
+                from_=[A.SubqueryRef(s, f"__setop_b{Parser._branch_n}")])
+        return s
 
     def _attach_setop(self, lhs, op, all_, rhs):
         cur = lhs
@@ -197,6 +239,7 @@ class Parser:
         if self.accept_op("("):
             s = self.select_stmt()
             self.expect_op(")")
+            s.parenthesized = True
             return s
         self.expect_kw("select")
         distinct = False
@@ -770,21 +813,45 @@ class Parser:
             self.advance()  # (
             if self.accept_op("*"):
                 self.expect_op(")")
-                return A.FuncCall(name, [], star=True)
+                return self._maybe_over(A.FuncCall(name, [], star=True))
             if self.accept_op(")"):
-                return A.FuncCall(name, [])
+                return self._maybe_over(A.FuncCall(name, []))
             distinct = self.accept_kw("distinct")
             args = [self.expr()]
             while self.accept_op(","):
                 args.append(self.expr())
             self.expect_op(")")
-            return A.FuncCall(name, args, distinct=distinct)
+            return self._maybe_over(
+                A.FuncCall(name, args, distinct=distinct))
         parts = [self.ident()]
         while self.accept_op("."):
             if self.accept_op("*"):
                 return A.Star(table=parts[0])
             parts.append(self.ident())
         return A.ColRef(tuple(parts))
+
+    def _maybe_over(self, fc: A.FuncCall) -> A.Node:
+        """Attach an OVER (...) window to a function call."""
+        if not (self.tok.kind == Tok.IDENT and self.tok.value == "over"
+                and self.peek().kind == Tok.OP
+                and self.peek().value == "("):
+            return fc
+        self.advance()  # over
+        self.advance()  # (
+        wd = A.WindowDef()
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            wd.partition_by.append(self.expr())
+            while self.accept_op(","):
+                wd.partition_by.append(self.expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            wd.order_by.append(self.sort_item())
+            while self.accept_op(","):
+                wd.order_by.append(self.sort_item())
+        self.expect_op(")")
+        fc.over = wd
+        return fc
 
     def case_expr(self) -> A.CaseExpr:
         self.expect_kw("case")

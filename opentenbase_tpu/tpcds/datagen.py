@@ -1,0 +1,107 @@
+"""Deterministic TPC-DS-shaped data generator (starter scale).
+
+Not dsdgen-conformant — a seeded synthetic population with the joins,
+skew, and NULL characteristics the starter queries exercise (dsdgen's
+output is only needed for published-result comparability; correctness
+is asserted against pandas oracles on THIS data)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+BRANDS = [f"brand#{i}" for i in range(1, 11)]
+CATEGORIES = ["Books", "Electronics", "Home", "Music", "Sports"]
+CLASSES = ["c1", "c2", "c3"]
+FIRST = ["ada", "bob", "carol", "dan", "eve", "frank"]
+LAST = ["smith", "jones", "lee", "patel", "kim"]
+
+
+def generate(sf: float = 1.0, seed: int = 7) -> dict:
+    rng = np.random.default_rng(seed)
+    n_dates = 730                      # two years of days
+    n_items = max(int(60 * sf), 20)
+    n_cust = max(int(120 * sf), 30)
+    n_stores = 6
+    n_ss = max(int(4000 * sf), 400)
+    n_cs = max(int(1500 * sf), 150)
+    n_ws = max(int(1500 * sf), 150)
+
+    base = np.datetime64("1999-01-01")
+    dates = {
+        "d_date_sk": np.arange(1, n_dates + 1, dtype=np.int64),
+        "d_date": [str(base + np.timedelta64(i, "D"))
+                   for i in range(n_dates)],
+        "d_year": np.asarray(
+            [(base + np.timedelta64(i, "D")).astype("datetime64[Y]")
+             .astype(int) + 1970 for i in range(n_dates)], np.int32),
+        "d_moy": np.asarray(
+            [int(str(base + np.timedelta64(i, "D"))[5:7])
+             for i in range(n_dates)], np.int32),
+        "d_month_seq": np.asarray(
+            [(base + np.timedelta64(i, "D")).astype("datetime64[M]")
+             .astype(int) for i in range(n_dates)], np.int32),
+    }
+
+    items = {
+        "i_item_sk": np.arange(1, n_items + 1, dtype=np.int64),
+        "i_brand_id": rng.integers(1, len(BRANDS) + 1,
+                                   n_items).astype(np.int32),
+        "i_category_id": rng.integers(1, len(CATEGORIES) + 1,
+                                      n_items).astype(np.int32),
+        "i_manager_id": rng.integers(1, 40, n_items).astype(np.int32),
+        "i_current_price": np.round(
+            rng.uniform(0.5, 99.0, n_items), 2),
+    }
+    items["i_brand"] = [BRANDS[b - 1] for b in items["i_brand_id"]]
+    items["i_category"] = [CATEGORIES[c - 1]
+                           for c in items["i_category_id"]]
+    items["i_class"] = [CLASSES[i % len(CLASSES)]
+                        for i in range(n_items)]
+
+    stores = {
+        "s_store_sk": np.arange(1, n_stores + 1, dtype=np.int64),
+        "s_store_name": [f"store_{i}" for i in range(n_stores)],
+    }
+
+    cust = {
+        "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_first_name": [FIRST[i % len(FIRST)] for i in range(n_cust)],
+        "c_last_name": [LAST[i % len(LAST)] for i in range(n_cust)],
+        "c_birth_year": rng.integers(1940, 2000,
+                                     n_cust).astype(np.int32),
+    }
+
+    def sales(n, prefix, rng, with_store=False):
+        out = {
+            f"{prefix}_sold_date_sk": rng.integers(
+                1, n_dates + 1, n).astype(np.int64),
+            f"{prefix}_item_sk": (rng.zipf(1.3, n).clip(1, n_items)
+                                  ).astype(np.int64),
+            f"{prefix}_quantity": rng.integers(1, 20, n).astype(np.int32),
+        }
+        price = np.round(rng.uniform(1.0, 300.0, n), 2)
+        out[f"{prefix}_ext_sales_price"] = price
+        return out
+
+    ss = sales(n_ss, "ss", rng)
+    ss["ss_ticket"] = np.arange(1, n_ss + 1, dtype=np.int32)
+    ss["ss_customer_sk"] = rng.integers(1, n_cust + 1,
+                                        n_ss).astype(np.int64)
+    ss["ss_store_sk"] = rng.integers(1, n_stores + 1,
+                                     n_ss).astype(np.int64)
+    ss["ss_net_profit"] = np.round(
+        ss["ss_ext_sales_price"] * rng.uniform(-0.2, 0.4, n_ss), 2)
+
+    cs = sales(n_cs, "cs", rng)
+    cs["cs_order"] = np.arange(1, n_cs + 1, dtype=np.int32)
+    cs["cs_bill_customer_sk"] = rng.integers(
+        1, n_cust + 1, n_cs).astype(np.int64)
+
+    ws = sales(n_ws, "ws", rng)
+    ws["ws_order"] = np.arange(1, n_ws + 1, dtype=np.int32)
+    ws["ws_bill_customer_sk"] = rng.integers(
+        1, n_cust + 1, n_ws).astype(np.int64)
+
+    return {"date_dim": dates, "item": items, "store": stores,
+            "customer": cust, "store_sales": ss,
+            "catalog_sales": cs, "web_sales": ws}

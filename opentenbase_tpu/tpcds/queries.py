@@ -1,0 +1,143 @@
+"""TPC-DS starter queries (10), adapted to the trimmed starter schema.
+
+Numbering follows the official templates they are shaped after
+(reference: the TPC-DS specification's query templates; OpenTenBase
+runs the full set through its PostgreSQL grammar).  Adaptations: the
+trimmed column set, no ROLLUP/GROUPING SETS, and literal parameters.
+Coverage: star joins + aggregation (3, 42, 52, 55), window ranking
+over aggregates (67, 12), CTE + FULL JOIN + running windows (51),
+channel INTERSECT (38), channel EXCEPT (87), customer-channel
+correlation (54-lite)."""
+
+Q = {}
+
+# Q3: brand revenue by year for one manufacturer-ish slice
+Q[3] = """
+select d_year, i_brand_id, i_brand, sum(ss_ext_sales_price) as sum_agg
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id <= 20 and d_moy = 11
+group by d_year, i_brand_id, i_brand
+order by d_year, sum_agg desc, i_brand_id
+limit 100
+"""
+
+# Q42: category revenue for a month/year
+Q[42] = """
+select d_year, i_category_id, i_category,
+       sum(ss_ext_sales_price) as rev
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and d_moy = 12 and d_year = 1999
+group by d_year, i_category_id, i_category
+order by rev desc, d_year, i_category_id, i_category
+limit 100
+"""
+
+# Q52: brand revenue for a month/year
+Q[52] = """
+select d_year, i_brand_id, i_brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and d_moy = 12 and d_year = 1999
+group by d_year, i_brand_id, i_brand
+order by d_year, ext_price desc, i_brand_id
+limit 100
+"""
+
+# Q55: brand revenue for one manager slice in one month
+Q[55] = """
+select i_brand_id, i_brand, sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id <= 10 and d_moy = 11 and d_year = 2000
+group by i_brand_id, i_brand
+order by ext_price desc, i_brand_id
+limit 100
+"""
+
+# Q67-lite: rank categories' brands by revenue, keep the top 3 per
+# category (window over aggregate)
+Q[67] = """
+select * from (
+  select i_category, i_brand, sum(ss_ext_sales_price) as rev,
+         rank() over (partition by i_category
+                      order by sum(ss_ext_sales_price) desc) as rk
+  from store_sales, item
+  where ss_item_sk = i_item_sk
+  group by i_category, i_brand
+) ranked
+where rk <= 3
+order by i_category, rk, i_brand
+"""
+
+# Q12-lite: revenue share of an item's class within its category
+# (window sum over aggregate partition)
+Q[12] = """
+select i_category, i_class, sum(ws_ext_sales_price) as itemrevenue,
+       sum(ws_ext_sales_price) * 100.0 /
+       sum(sum(ws_ext_sales_price)) over (partition by i_category)
+       as revenueratio
+from web_sales, item
+where ws_item_sk = i_item_sk and i_category in ('Books', 'Music')
+group by i_category, i_class
+order by i_category, revenueratio
+"""
+
+# Q51-lite: cumulative store vs web revenue by day for one item
+# class, FULL JOINed on the date (CTEs + FULL JOIN + running windows)
+Q[51] = """
+with web_v as (
+  select ws_sold_date_sk as dsk, sum(ws_ext_sales_price) as rev
+  from web_sales, item
+  where ws_item_sk = i_item_sk and i_class = 'c1'
+  group by ws_sold_date_sk
+), store_v as (
+  select ss_sold_date_sk as dsk, sum(ss_ext_sales_price) as rev
+  from store_sales, item
+  where ss_item_sk = i_item_sk and i_class = 'c1'
+  group by ss_sold_date_sk
+)
+select coalesce(web_v.dsk, store_v.dsk) as day_sk,
+       web_v.rev as web_rev, store_v.rev as store_rev
+from web_v full join store_v on web_v.dsk = store_v.dsk
+order by day_sk
+limit 200
+"""
+
+# Q38-lite: customers who bought in ALL THREE channels (INTERSECT)
+Q[38] = """
+select count(*) from (
+  select ss_customer_sk as c from store_sales
+  intersect
+  select cs_bill_customer_sk as c from catalog_sales
+  intersect
+  select ws_bill_customer_sk as c from web_sales
+) hot
+"""
+
+# Q87-lite: store-channel customers who never bought by catalog or web
+# (EXCEPT chain)
+Q[87] = """
+select count(*) from (
+  select ss_customer_sk as c from store_sales
+  except
+  select cs_bill_customer_sk as c from catalog_sales
+  except
+  select ws_bill_customer_sk as c from web_sales
+) cool
+"""
+
+# Q54-lite: revenue of customers whose first store purchase was in 1999
+# (CTE + aggregate join filter)
+Q[54] = """
+with first_buy as (
+  select ss_customer_sk as c, min(ss_sold_date_sk) as first_dsk
+  from store_sales group by ss_customer_sk
+)
+select count(*) as n, sum(ss_ext_sales_price) as rev
+from store_sales, first_buy, date_dim
+where ss_customer_sk = first_buy.c
+  and d_date_sk = first_buy.first_dsk and d_year = 1999
+"""

@@ -1,0 +1,67 @@
+"""TPC-DS starter schema (trimmed to the columns the starter queries
+touch).  Distribution follows TPC-DS practice on XC-style clusters:
+fact tables sharded on their sales surrogate keys, dimensions
+replicated (reference: the same layout OpenTenBase docs recommend for
+star schemas — small dims LOCATOR_TYPE_REPLICATED, facts SHARD)."""
+
+SCHEMA = """
+create table date_dim (
+    d_date_sk bigint primary key,
+    d_date date,
+    d_year int,
+    d_moy int,
+    d_month_seq int
+) distribute by replication;
+
+create table item (
+    i_item_sk bigint primary key,
+    i_brand_id int,
+    i_brand varchar(20),
+    i_category_id int,
+    i_category varchar(20),
+    i_class varchar(20),
+    i_manager_id int,
+    i_current_price decimal(7,2)
+) distribute by replication;
+
+create table store (
+    s_store_sk bigint primary key,
+    s_store_name varchar(20)
+) distribute by replication;
+
+create table customer (
+    c_customer_sk bigint primary key,
+    c_first_name varchar(16),
+    c_last_name varchar(16),
+    c_birth_year int
+) distribute by replication;
+
+create table store_sales (
+    ss_ticket int,
+    ss_sold_date_sk bigint,
+    ss_item_sk bigint,
+    ss_customer_sk bigint,
+    ss_store_sk bigint,
+    ss_quantity int,
+    ss_ext_sales_price decimal(10,2),
+    ss_net_profit decimal(10,2)
+) distribute by shard(ss_ticket);
+
+create table catalog_sales (
+    cs_order int,
+    cs_sold_date_sk bigint,
+    cs_item_sk bigint,
+    cs_bill_customer_sk bigint,
+    cs_quantity int,
+    cs_ext_sales_price decimal(10,2)
+) distribute by shard(cs_order);
+
+create table web_sales (
+    ws_order int,
+    ws_sold_date_sk bigint,
+    ws_item_sk bigint,
+    ws_bill_customer_sk bigint,
+    ws_quantity int,
+    ws_ext_sales_price decimal(10,2)
+) distribute by shard(ws_order);
+"""

@@ -1,0 +1,298 @@
+"""SQL-surface tier 2: window functions, CTEs, INTERSECT/EXCEPT,
+RIGHT/FULL joins — single-node and distributed.
+
+Reference analogs: nodeWindowAgg.c (windows), parse_cte.c/nodeCtescan.c
+(WITH), nodeSetOp.c (INTERSECT/EXCEPT), nodeHashjoin.c HJ_FILL_INNER
+(FULL)."""
+
+import pytest
+
+from opentenbase_tpu.exec.dist_session import ClusterSession
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.parallel.cluster import Cluster
+
+
+@pytest.fixture()
+def sess():
+    s = Session(LocalNode())
+    s.execute("create table t (g varchar(2), x bigint, v decimal(6,1))")
+    s.execute("insert into t values ('a',1,10.0),('a',2,20.0),"
+              "('a',2,30.0),('b',5,1.5),('b',7,2.5)")
+    return s
+
+
+@pytest.fixture()
+def cs():
+    s = ClusterSession(Cluster(n_datanodes=3))
+    s.execute("create table t (k bigint primary key, g varchar(2), "
+              "x bigint, v decimal(6,1)) distribute by shard(k)")
+    s.execute("insert into t values " + ", ".join(
+        f"({i}, 'g{i % 3}', {i % 7}, {i}.5)" for i in range(30)))
+    return s
+
+
+class TestWindows:
+    def test_row_number_rank_dense(self, sess):
+        got = sess.query(
+            "select g, x, row_number() over (partition by g order by x),"
+            " rank() over (partition by g order by x),"
+            " dense_rank() over (partition by g order by x) "
+            "from t order by g, x, 3")
+        assert got == [("a", 1, 1, 1, 1), ("a", 2, 2, 2, 2),
+                       ("a", 2, 3, 2, 2), ("b", 5, 1, 1, 1),
+                       ("b", 7, 2, 2, 2)]
+
+    def test_running_sum_peers_share(self, sess):
+        got = sess.query("select g, x, sum(v) over (partition by g "
+                         "order by x) from t order by g, x, 1")
+        # the two x=2 peers both see the full running total 60
+        assert got == [("a", 1, 10.0), ("a", 2, 60.0), ("a", 2, 60.0),
+                       ("b", 5, 1.5), ("b", 7, 4.0)]
+
+    def test_partition_aggregates(self, sess):
+        got = sess.query("select g, sum(v) over (partition by g), "
+                         "avg(v) over (partition by g), "
+                         "min(v) over (partition by g), "
+                         "max(v) over (partition by g), "
+                         "count(*) over (partition by g) "
+                         "from t where x = 1 or x = 5 order by g")
+        assert got == [("a", 10.0, 10.0, 10.0, 10.0, 1),
+                       ("b", 1.5, 1.5, 1.5, 1.5, 1)]
+
+    def test_window_desc_global(self, sess):
+        got = sess.query("select x, row_number() over (order by x desc) "
+                         "from t order by 2")
+        assert [r[0] for r in got][:2] == [7, 5]
+
+    def test_window_over_aggregate(self, sess):
+        # rank() over the result of a GROUP BY (TPC-DS staple)
+        got = sess.query(
+            "select g, sum(v) as s, rank() over (order by sum(v) desc) "
+            "from t group by g order by 3")
+        assert got == [("a", 60.0, 1), ("b", 4.0, 2)]
+
+    def test_window_in_subquery_filter(self, sess):
+        got = sess.query(
+            "select g, x from (select g, x, row_number() over "
+            "(partition by g order by x) as rn from t) w "
+            "where rn = 1 order by g")
+        assert got == [("a", 1), ("b", 5)]
+
+    def test_window_distributed_gather(self, cs):
+        got = cs.query("select k, rank() over (order by v desc) from t "
+                       "order by 2 limit 3")
+        assert [r[0] for r in got] == [29, 28, 27]
+
+    def test_window_distributed_partition(self, cs):
+        got = cs.query("select g, k, row_number() over (partition by g "
+                       "order by k) from t where k < 6 order by g, k")
+        assert got == [("g0", 0, 1), ("g0", 3, 2), ("g1", 1, 1),
+                       ("g1", 4, 2), ("g2", 2, 1), ("g2", 5, 2)]
+
+
+class TestCtes:
+    def test_basic_and_aliases(self, sess):
+        got = sess.query("with c (p, q) as (select g, sum(v) from t "
+                         "group by g) select p, q from c order by p")
+        assert got == [("a", 60.0), ("b", 4.0)]
+
+    def test_chained_ctes(self, sess):
+        got = sess.query(
+            "with c1 as (select g, x, v from t where x > 1), "
+            "c2 as (select g, sum(v) as s from c1 group by g) "
+            "select g, s from c2 order by g")
+        assert got == [("a", 50.0), ("b", 4.0)]
+
+    def test_cte_referenced_twice(self, sess):
+        got = sess.query(
+            "with c as (select x, v from t where g = 'a') "
+            "select a.x, b.x from c a, c b where a.v < b.v "
+            "order by a.x, b.x")
+        assert len(got) == 3
+
+    def test_cte_union_body(self, sess):
+        got = sess.query(
+            "with c as (select x from t where g = 'a' union "
+            "select x from t where g = 'b') "
+            "select count(*) from c")
+        assert got == [(4,)]  # distinct of {1,2,5,7}
+
+    def test_cte_distributed(self, cs):
+        got = cs.query("with hot as (select k, v from t where v > 25) "
+                       "select count(*) from hot")
+        assert got == [(cs.query(
+            "select count(*) from t where v > 25")[0][0],)]
+
+
+class TestSetOps:
+    def test_intersect(self, sess):
+        got = sess.query("select x from t where g = 'a' intersect "
+                         "select x from t order by x")
+        assert got == [(1,), (2,)]
+
+    def test_intersect_all(self, sess):
+        got = sess.query("select x from t intersect all "
+                         "select x from t order by x")
+        assert got == [(1,), (2,), (2,), (5,), (7,)]
+
+    def test_except(self, sess):
+        got = sess.query("select x from t except "
+                         "select x from t where g = 'a' order by x")
+        assert got == [(5,), (7,)]
+
+    def test_except_all_multiset(self, sess):
+        # x=2 appears twice on both sides -> fully cancelled
+        got = sess.query("select x from t except all "
+                         "select x from t where x = 2 order by x")
+        assert got == [(1,), (5,), (7,)]
+        # one copy removed leaves one behind
+        got = sess.query("select x from t except all "
+                         "select x from t where g = 'b' and x = 5 "
+                         "union all select x from t where x = 99 "
+                         "order by x")
+        assert got == [(1,), (2,), (2,), (7,)]
+
+    def test_except_distinct_removes_present(self, sess):
+        got = sess.query("select x from t except "
+                         "select x from t where x = 2 order by x")
+        assert got == [(1,), (5,), (7,)]
+
+    def test_setop_nulls_equal(self, sess):
+        sess.execute("insert into t values (null, null, null)")
+        got = sess.query("select g from t intersect "
+                         "select g from t where g is null")
+        assert got == [(None,)]
+
+    def test_intersect_binds_tighter_than_union(self, sess):
+        # 1 UNION (2 INTERSECT 2) = {1, 2}; flat-left fold would give {2}
+        got = sess.query("select x from t where x = 1 union "
+                         "select x from t where x = 2 intersect "
+                         "select x from t where x = 2 order by x")
+        assert got == [(1,), (2,)]
+
+    def test_parenthesized_branch_keeps_own_limit(self, sess):
+        got = sess.query("(select x from t order by x desc limit 1) "
+                         "union select x from t where x = 1 order by x")
+        assert got == [(1,), (7,)]
+
+    def test_setop_float_zero_sign(self, sess):
+        sess.execute("create table fz (f float)")
+        sess.execute("insert into fz values (0.0)")
+        sess.execute("create table fz2 (f float)")
+        sess.execute("insert into fz2 values (-0.0)")
+        got = sess.query("select f from fz intersect select f from fz2")
+        assert got == [(0.0,)]
+
+    def test_setop_distributed(self, cs):
+        got = cs.query("select k from t where k < 10 except "
+                       "select k from t where k < 5 order by k")
+        assert got == [(5,), (6,), (7,), (8,), (9,)]
+
+
+class TestRoutingCanonicalization:
+    def test_decimal_dist_key_fqs_agrees_with_insert(self, cs):
+        # insert routing and FQS point routing must hash the SAME
+        # canonical representation (advisor: float-bits vs scaled-int
+        # mismatch silently returned zero rows)
+        cs.execute("create table dk (price decimal(10,2) primary key, "
+                   "n bigint) distribute by shard(price)")
+        cs.execute("insert into dk values (5.25, 1), (7, 2), (0.10, 3)")
+        assert cs.query("select n from dk where price = 5.25") == [(1,)]
+        assert cs.query("select n from dk where price = 7") == [(2,)]
+        assert cs.query("select n from dk where price = 0.1") == [(3,)]
+
+    def test_date_dist_key_point_lookup(self, cs):
+        cs.execute("create table dd (d date primary key, n bigint) "
+                   "distribute by shard(d)")
+        cs.execute("insert into dd values (date '2020-03-01', 1), "
+                   "(date '2021-07-04', 2)")
+        got = cs.query("select n from dd where d = date '2021-07-04'")
+        assert got == [(2,)]
+
+    def test_cte_visible_to_all_branches_with_wrapped_head(self, sess):
+        got = sess.query(
+            "with src as (select x from t) "
+            "(select x from src order by x limit 1) "
+            "union select x from src where x = 7 order by x")
+        assert got == [(1,), (7,)]
+
+
+class TestTextJoins:
+    def test_text_equi_join(self, sess):
+        sess.execute("create table n1 (s varchar(4), a bigint)")
+        sess.execute("create table n2 (s varchar(4), b bigint)")
+        sess.execute("insert into n1 values ('x', 1), ('y', 2), ('q', 9)")
+        sess.execute("insert into n2 values ('y', 20), ('x', 10), "
+                     "('z', 30)")
+        got = sess.query("select n1.s, a, b from n1, n2 "
+                         "where n1.s = n2.s order by n1.s")
+        assert got == [("x", 1, 10), ("y", 2, 20)]
+
+    def test_text_ne_filter(self, sess):
+        sess.execute("create table n1 (s varchar(4))")
+        sess.execute("create table n2 (s2 varchar(4))")
+        sess.execute("insert into n1 values ('x'), ('y')")
+        sess.execute("insert into n2 values ('x')")
+        got = sess.query("select n1.s from n1, n2 where n1.s <> s2")
+        assert got == [("y",)]
+
+    def test_text_left_join_distributed(self, cs):
+        cs.execute("create table names (nm varchar(8), tag varchar(8)) "
+                   "distribute by replication")
+        cs.execute("insert into names values ('g0', 'zero'), "
+                   "('g9', 'nine')")
+        got = cs.query("select tag, count(*) from t left join names "
+                       "on g = nm group by tag order by tag")
+        assert got == [("zero", 10), (None, 20)]
+
+
+class TestOuterJoins:
+    def test_right_join(self, sess):
+        sess.execute("create table r (y bigint, w decimal(5,1))")
+        sess.execute("insert into r values (1, 9.5), (9, 1.0)")
+        got = sess.query("select x, y, w from t right join r on x = y "
+                         "order by y")
+        assert got == [(1, 1, 9.5), (None, 9, 1.0)]
+
+    def test_full_join(self, sess):
+        sess.execute("create table r (y bigint, w decimal(5,1))")
+        sess.execute("insert into r values (1, 9.5), (9, 1.0)")
+        got = sess.query("select x, y from t full join r on x = y "
+                         "order by x, y")
+        assert got == [(1, 1), (2, None), (2, None), (5, None),
+                       (7, None), (None, 9)]
+
+    def test_full_join_aggregates(self, sess):
+        sess.execute("create table r (y bigint, w decimal(5,1))")
+        sess.execute("insert into r values (1, 9.5), (9, 1.0)")
+        got = sess.query("select count(*), count(x), count(y) from t "
+                         "full join r on x = y")
+        assert got == [(6, 5, 2)]
+
+    def test_full_join_multikey_recheck(self, sess):
+        # multi-key FULL JOIN rides the hashed-key recheck: a killed
+        # pair must null-extend the probe row AND emit the build row
+        sess.execute("create table a2 (p bigint, q bigint)")
+        sess.execute("create table b2 (p bigint, q bigint)")
+        sess.execute("insert into a2 values (1, 10), (2, 20)")
+        sess.execute("insert into b2 values (1, 10), (3, 30)")
+        got = sess.query("select a2.p, b2.p from a2 full join b2 "
+                         "on a2.p = b2.p and a2.q = b2.q "
+                         "order by a2.p, b2.p")
+        assert got == [(1, 1), (2, None), (None, 3)]
+
+    def test_window_null_order_distinct_peer(self, sess):
+        sess.execute("create table w (v decimal(5,1))")
+        sess.execute("insert into w values (5.0), (null), (7.0)")
+        got = sess.query("select v, rank() over (order by v) from w "
+                         "order by 2")
+        assert got == [(5.0, 1), (7.0, 2), (None, 3)]
+
+    def test_full_join_distributed(self, cs):
+        cs.execute("create table r (rk bigint primary key, "
+                   "w decimal(5,1)) distribute by shard(rk)")
+        cs.execute("insert into r values (1, 1.0), (100, 2.0)")
+        got = cs.query("select k, rk from t full join r on k = rk "
+                       "where k is null or k < 3 or rk is not null "
+                       "order by k, rk")
+        assert (None, 100) in got and (1, 1) in got

@@ -1,0 +1,230 @@
+"""TPC-DS starter set (10 queries) vs pandas oracles — single node and
+4-DN cluster (BASELINE config 5 path; reference: the TPC-DS templates
+through OpenTenBase's PG grammar)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from opentenbase_tpu.exec.dist_session import ClusterSession
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.parallel.cluster import Cluster
+from opentenbase_tpu.tpcds import datagen
+from opentenbase_tpu.tpcds.queries import Q
+from opentenbase_tpu.tpcds.schema import SCHEMA
+
+SF = 0.5
+
+
+@pytest.fixture(scope="module")
+def data():
+    return datagen.generate(sf=SF)
+
+
+@pytest.fixture(scope="module")
+def frames(data):
+    return {name: pd.DataFrame(dict(cols))
+            for name, cols in data.items()}
+
+
+@pytest.fixture(scope="module")
+def sess(data):
+    s = Session(LocalNode())
+    s.execute(SCHEMA)
+    for tname, cols in data.items():
+        td = s.node.catalog.table(tname)
+        st = s.node.stores[tname]
+        s._insert_rows(td, st, cols,
+                       len(next(iter(cols.values()))))
+    return s
+
+
+@pytest.fixture(scope="module")
+def cs(data):
+    s = ClusterSession(Cluster(n_datanodes=4))
+    s.execute(SCHEMA)
+    for tname, cols in data.items():
+        td = s.cluster.catalog.table(tname)
+        s._insert_rows(td, cols, len(next(iter(cols.values()))))
+    return s
+
+
+def rows_equal(got, want, tol=1e-6):
+    assert len(got) == len(want), f"{len(got)} rows != {len(want)}"
+    for g, w in zip(got, want):
+        assert len(g) == len(w)
+        for a, b in zip(g, w):
+            if isinstance(a, float) or isinstance(b, float):
+                assert a == pytest.approx(b, rel=tol), (g, w)
+            else:
+                assert a == b, (g, w)
+
+
+def _r2(x):
+    return float(np.round(x, 10))
+
+
+class TestTpcdsStarter:
+    def _q3(self, f):
+        m = (f["store_sales"]
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk"))
+        m = m[(m.i_manager_id <= 20) & (m.d_moy == 11)]
+        g = (m.groupby(["d_year", "i_brand_id", "i_brand"],
+                       as_index=False)
+             .agg(sum_agg=("ss_ext_sales_price", "sum")))
+        g = g.sort_values(["d_year", "sum_agg", "i_brand_id"],
+                          ascending=[True, False, True]).head(100)
+        return [(int(r.d_year), int(r.i_brand_id), r.i_brand,
+                 _r2(r.sum_agg)) for r in g.itertuples()]
+
+    def test_q3(self, sess, frames):
+        rows_equal(sess.query(Q[3]), self._q3(frames))
+
+    def test_q3_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[3]), self._q3(frames))
+
+    def _q42(self, f):
+        m = (f["store_sales"]
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk"))
+        m = m[(m.d_moy == 12) & (m.d_year == 1999)]
+        g = (m.groupby(["d_year", "i_category_id", "i_category"],
+                       as_index=False)
+             .agg(rev=("ss_ext_sales_price", "sum")))
+        g = g.sort_values(["rev", "d_year", "i_category_id",
+                           "i_category"],
+                          ascending=[False, True, True, True]).head(100)
+        return [(int(r.d_year), int(r.i_category_id), r.i_category,
+                 _r2(r.rev)) for r in g.itertuples()]
+
+    def test_q42(self, sess, frames):
+        rows_equal(sess.query(Q[42]), self._q42(frames))
+
+    def _q52(self, f):
+        m = (f["store_sales"]
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk"))
+        m = m[(m.d_moy == 12) & (m.d_year == 1999)]
+        g = (m.groupby(["d_year", "i_brand_id", "i_brand"],
+                       as_index=False)
+             .agg(p=("ss_ext_sales_price", "sum")))
+        g = g.sort_values(["d_year", "p", "i_brand_id"],
+                          ascending=[True, False, True]).head(100)
+        return [(int(r.d_year), int(r.i_brand_id), r.i_brand, _r2(r.p))
+                for r in g.itertuples()]
+
+    def test_q52(self, sess, frames):
+        rows_equal(sess.query(Q[52]), self._q52(frames))
+
+    def _q55(self, f):
+        m = (f["store_sales"]
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk"))
+        m = m[(m.i_manager_id <= 10) & (m.d_moy == 11)
+              & (m.d_year == 2000)]
+        g = (m.groupby(["i_brand_id", "i_brand"], as_index=False)
+             .agg(p=("ss_ext_sales_price", "sum")))
+        g = g.sort_values(["p", "i_brand_id"],
+                          ascending=[False, True]).head(100)
+        return [(int(r.i_brand_id), r.i_brand, _r2(r.p))
+                for r in g.itertuples()]
+
+    def test_q55(self, sess, frames):
+        rows_equal(sess.query(Q[55]), self._q55(frames))
+
+    def test_q55_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[55]), self._q55(frames))
+
+    def _q67(self, f):
+        m = f["store_sales"].merge(
+            f["item"], left_on="ss_item_sk", right_on="i_item_sk")
+        g = (m.groupby(["i_category", "i_brand"], as_index=False)
+             .agg(rev=("ss_ext_sales_price", "sum")))
+        g["rk"] = g.groupby("i_category")["rev"].rank(
+            method="min", ascending=False).astype(int)
+        g = g[g.rk <= 3].sort_values(["i_category", "rk", "i_brand"])
+        return [(r.i_category, r.i_brand, _r2(r.rev), int(r.rk))
+                for r in g.itertuples()]
+
+    def test_q67_window_rank(self, sess, frames):
+        rows_equal(sess.query(Q[67]), self._q67(frames))
+
+    def test_q67_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[67]), self._q67(frames))
+
+    def _q12(self, f):
+        m = f["web_sales"].merge(
+            f["item"], left_on="ws_item_sk", right_on="i_item_sk")
+        m = m[m.i_category.isin(["Books", "Music"])]
+        g = (m.groupby(["i_category", "i_class"], as_index=False)
+             .agg(rev=("ws_ext_sales_price", "sum")))
+        g["ratio"] = g.rev * 100.0 / g.groupby("i_category")[
+            "rev"].transform("sum")
+        g = g.sort_values(["i_category", "ratio"])
+        return [(r.i_category, r.i_class, _r2(r.rev), r.ratio)
+                for r in g.itertuples()]
+
+    def test_q12_revenue_ratio(self, sess, frames):
+        rows_equal(sess.query(Q[12]), self._q12(frames))
+
+    def _q51(self, f):
+        wi = f["web_sales"].merge(
+            f["item"], left_on="ws_item_sk", right_on="i_item_sk")
+        wi = wi[wi.i_class == "c1"].groupby("ws_sold_date_sk")[
+            "ws_ext_sales_price"].sum()
+        si = f["store_sales"].merge(
+            f["item"], left_on="ss_item_sk", right_on="i_item_sk")
+        si = si[si.i_class == "c1"].groupby("ss_sold_date_sk")[
+            "ss_ext_sales_price"].sum()
+        merged = pd.merge(wi.rename("web"), si.rename("store"),
+                          how="outer", left_index=True,
+                          right_index=True).sort_index().head(200)
+        out = []
+        for dsk, r in merged.iterrows():
+            out.append((int(dsk),
+                        None if pd.isna(r.web) else _r2(r.web),
+                        None if pd.isna(r.store) else _r2(r.store)))
+        return out
+
+    def test_q51_full_join_ctes(self, sess, frames):
+        rows_equal(sess.query(Q[51]), self._q51(frames))
+
+    def _chans(self, f):
+        s = set(f["store_sales"].ss_customer_sk)
+        c = set(f["catalog_sales"].cs_bill_customer_sk)
+        w = set(f["web_sales"].ws_bill_customer_sk)
+        return s, c, w
+
+    def test_q38_intersect(self, sess, frames):
+        s, c, w = self._chans(frames)
+        assert sess.query(Q[38]) == [(len(s & c & w),)]
+
+    def test_q38_distributed(self, cs, frames):
+        s, c, w = self._chans(frames)
+        assert cs.query(Q[38]) == [(len(s & c & w),)]
+
+    def test_q87_except(self, sess, frames):
+        s, c, w = self._chans(frames)
+        assert sess.query(Q[87]) == [(len(s - c - w),)]
+
+    def _q54(self, f):
+        fb = f["store_sales"].groupby("ss_customer_sk")[
+            "ss_sold_date_sk"].min().rename("first_dsk").reset_index()
+        m = (f["store_sales"]
+             .merge(fb, on="ss_customer_sk")
+             .merge(f["date_dim"], left_on="first_dsk",
+                    right_on="d_date_sk"))
+        m = m[m.d_year == 1999]
+        return [(len(m), _r2(m.ss_ext_sales_price.sum()))]
+
+    def test_q54_cte_agg_join(self, sess, frames):
+        rows_equal(sess.query(Q[54]), self._q54(frames))
