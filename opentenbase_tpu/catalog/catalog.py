@@ -33,6 +33,13 @@ class Catalog:
         # shard map: shard id (0..4095) -> datanode index
         # (reference: pgxc_shard_map catalog + shmem map, shardmap.c:60-71)
         self.shard_map: np.ndarray = np.zeros(NUM_SHARDS, dtype=np.int32)
+        # btree-equivalent index registry: table -> set of indexed
+        # columns (reference: pg_index; the planner consults this for
+        # index-scan eligibility, store-level structures live per DN)
+        self.btree_cols: dict[str, set] = {}
+        # ANALYZE output: table -> {"rows", "cols": {col: {"ndv", "min",
+        # "max"}}} (reference: pg_statistic, consumed by costsize.c)
+        self.stats: dict[str, dict] = {}
         self._next_oid = 16384
 
     # ---- tables ----
@@ -108,6 +115,9 @@ class Catalog:
                 "nodes": [n.to_json() for n in self.nodes.values()],
                 "sequences": [s.to_json() for s in self.sequences.values()],
                 "shard_map": self.shard_map.tolist(),
+                "btree_cols": {t: sorted(cs)
+                               for t, cs in self.btree_cols.items()},
+                "stats": self.stats,
                 "next_oid": self._next_oid,
             }
         tmp = path + ".tmp"
@@ -130,5 +140,8 @@ class Catalog:
             sd = SequenceDef.from_json(s)
             cat.sequences[sd.name] = sd
         cat.shard_map = np.asarray(blob["shard_map"], dtype=np.int32)
+        cat.btree_cols = {t: set(cs) for t, cs in
+                          blob.get("btree_cols", {}).items()}
+        cat.stats = blob.get("stats", {})
         cat._next_oid = blob.get("next_oid", 16384)
         return cat

@@ -103,6 +103,29 @@ class ClusterSession:
                 metric = str(stmt.options.get("metric", "l2"))
                 for dn in c.datanodes:
                     dn.build_ann_index(stmt.table, col, lists, metric)
+            elif stmt.method == "hnsw":
+                try:
+                    for dn in c.datanodes:
+                        dn.build_hnsw_index(
+                            stmt.table, stmt.columns[0],
+                            int(stmt.options.get("m", 16)),
+                            int(stmt.options.get("ef_construction", 64)),
+                            str(stmt.options.get("metric", "l2")))
+                except (ValueError, KeyError, RuntimeError) as e:
+                    raise ExecError(str(e)) from None
+            else:  # btree: built per DN over its shard (a LOCAL index;
+                   # global secondary indexes are a design note in
+                   # PARITY.md — the planner still fans point queries
+                   # to all DNs, each answering via its local index)
+                try:
+                    for dn in c.datanodes:
+                        dn.build_btree_index(stmt.table,
+                                             list(stmt.columns))
+                except (ValueError, KeyError, RuntimeError) as e:
+                    raise ExecError(str(e)) from None
+                c.catalog.btree_cols.setdefault(
+                    stmt.table, set()).update(stmt.columns)
+                c._save_catalog()
             return Result("CREATE INDEX")
         if isinstance(stmt, A.InsertStmt):
             return self._exec_insert(stmt)
@@ -128,6 +151,23 @@ class ClusterSession:
             if n < 0:
                 raise ExecError("VACUUM refused: transactions in flight")
             return Result("VACUUM", rowcount=n)
+        if isinstance(stmt, A.AnalyzeStmt):
+            from ..parallel.statistics import merge_stats
+            names = [stmt.table] if stmt.table else \
+                list(c.catalog.tables)
+            for name in names:
+                if name.startswith("otb_"):
+                    continue
+                if name not in c.catalog.tables:
+                    raise ExecError(f"table {name!r} does not exist")
+                try:
+                    parts = [dn.analyze_table(name)
+                             for dn in c.datanodes]
+                except (KeyError, RuntimeError) as e:
+                    raise ExecError(str(e)) from None
+                c.catalog.stats[name] = merge_stats(parts)
+            c._save_catalog()
+            return Result("ANALYZE")
         if isinstance(stmt, A.BarrierStmt):
             # 2-phase cluster-wide consistency point (reference:
             # pgxc/barrier/barrier.c): block new txns implicitly by

@@ -318,6 +318,36 @@ class Executor:
                 out_dicts[name] = d
         return DBatch(out_cols, vis, out_types, out_dicts, out_nulls)
 
+    def _exec_indexscan(self, node: P.IndexScan) -> DBatch:
+        """Index scan: host binary search -> gather only the candidate
+        rows -> the regular fused scan path over that staged subset
+        (reference: ExecIndexScan; visibility/filters re-verify on the
+        subset, so a stale bound can only over-select, never miss)."""
+        from .fused import _needed_columns
+        seq = P.SeqScan(node.table, node.alias, node.filters,
+                        node.outputs)
+        store = self.ctx.stores.get(node.table.name)
+        if store is None:
+            raise ExecError(f"no store for table {node.table.name}")
+        if (self.ctx.staged or {}).get(node.table.name) is not None:
+            return self._exec_seqscan(seq)  # already subset-staged
+        pos = store.btree_lookup(node.key_col, node.lo, node.hi,
+                                 node.lo_strict, node.hi_strict)
+        if pos is None:
+            return self._exec_seqscan(seq)  # index dropped: full scan
+        needed = sorted((_needed_columns(seq, node.alias)
+                         | _needed_columns(seq, node.table.name))
+                        & set(store.td.column_names))
+        host = store.gather_rows(pos, needed)
+        from ..storage.batch import stage_padded
+        arrs, n = stage_padded(host, slice(None))
+        old = self.ctx.staged
+        self.ctx.staged = {**(old or {}), node.table.name: (arrs, n)}
+        try:
+            return self._exec_seqscan(seq)
+        finally:
+            self.ctx.staged = old
+
     def _exec_annsearch(self, node) -> DBatch:
         """Top-k vector search: visibility+filters mask, IVF probe when an
         index exists, exact distances otherwise, lax.top_k, gather."""
@@ -331,7 +361,32 @@ class Executor:
         q = jnp.asarray(np.asarray(node.query, dtype=np.float32))
         k = min(node.k, padded)
         idx_info = store.ann_indexes.get(plain_vec)
-        if idx_info is not None and idx_info["metric"] == node.metric:
+        hnsw_info = store.hnsw_index(plain_vec) \
+            if idx_info is not None and idx_info.get("kind") == "hnsw" \
+            else None
+        if hnsw_info is not None and hnsw_info["metric"] == node.metric:
+            # graph traversal host-side, exact re-rank of candidates
+            # (ops/hnsw.py); over-fetch so visibility filtering can
+            # still fill k
+            hidx = hnsw_info["index"]
+            qh = np.asarray(node.query, dtype=np.float32)
+            ids = hidx.search(qh, min(4 * k, max(len(hidx.vecs), 1)))
+            vmask = np.asarray(valid)[ids] if len(ids) else \
+                np.zeros(0, bool)
+            ids = ids[vmask]
+            from ..ops.hnsw import _dist as _hdist
+            ds = _hdist(node.metric, qh, hidx.vecs[ids]) if len(ids) \
+                else np.zeros(0)
+            if node.metric == "l2":
+                ds = np.sqrt(np.maximum(ds, 0.0))  # match ANN.distances
+            order = np.argsort(ds)[:k]
+            idx_h = np.zeros(k, np.int64)
+            dist_h = np.full(k, np.inf)
+            idx_h[:len(order)] = ids[order]
+            dist_h[:len(order)] = ds[order]
+            idx, dist = jnp.asarray(idx_h), jnp.asarray(dist_h)
+        elif idx_info is not None and idx_info.get("kind") != "hnsw" \
+                and idx_info["metric"] == node.metric:
             assign, centroids = _ann_assignments(store, plain_vec, vecs, n)
             nprobe = min(idx_info["nprobe"], centroids.shape[0])
             idx, dist = ANN.ivf_search(vecs, assign, centroids, q, valid,

@@ -114,7 +114,11 @@ def _walk_plan_exprs(node):
     if isinstance(node, P.Sort):
         for ke, _ in node.keys:
             yield from E.walk(ke)
-    for attr in ("child",):
+    if isinstance(node, P.HashJoin):
+        for e in (list(node.left_keys) + list(node.right_keys)
+                  + list(node.residual or [])):
+            yield from E.walk(e)
+    for attr in ("child", "left", "right"):
         c = getattr(node, attr, None)
         if isinstance(c, P.PhysNode):
             yield from _walk_plan_exprs(c)

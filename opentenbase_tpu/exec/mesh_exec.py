@@ -189,28 +189,17 @@ class MeshRunner:
         per_dn: list[dict[str, np.ndarray]] = []
         counts = []
         for si, st in enumerate(stores):
-            cols: dict[str, np.ndarray] = {}
-            chunks = list(st.scan_chunks())
-            n_i = sum(ch.nrows for _, ch in chunks)
-            counts.append(n_i)
+            # shared host-staging source (storage/store.py), with this
+            # node's TEXT codes remapped into the union dictionary
+            cols = st.host_live_columns([c.name for c in td.columns])
+            counts.append(len(next(iter(cols.values())))
+                          if cols else st.row_count())
             for c in td.columns:
-                parts = [ch.columns[c.name][:ch.nrows]
-                         for _, ch in chunks]
-                arr = np.concatenate(parts) if parts else \
-                    np.empty((0, *c.type.shape_suffix), c.type.np_dtype)
-                if c.type.kind == TypeKind.TEXT:
-                    arr = luts[c.name][si][arr] if len(arr) else arr
-                cols[c.name] = arr
-            for sys in ("xmin_ts", "xmax_ts", "xmin_txid", "xmax_txid"):
-                parts = [getattr(ch, sys)[:ch.nrows] for _, ch in chunks]
-                cols[f"__{sys}"] = np.concatenate(parts) if parts else \
-                    np.empty(0, np.int64)
+                if c.type.kind == TypeKind.TEXT and len(cols[c.name]):
+                    cols[c.name] = luts[c.name][si][cols[c.name]]
             for nc in null_columns:
-                parts = [ch.nulls[nc][:ch.nrows] if nc in ch.nulls
-                         else np.zeros(ch.nrows, bool)
-                         for _, ch in chunks]
-                cols[f"__null.{nc}"] = np.concatenate(parts) if parts \
-                    else np.zeros(0, bool)
+                if f"__null.{nc}" not in cols:
+                    cols[f"__null.{nc}"] = np.zeros(counts[-1], bool)
             per_dn.append(cols)
 
         padded = next_pow2(max(max(counts), 1))

@@ -281,6 +281,23 @@ class Session:
                         str(stmt.options.get("metric", "l2")))
                 except ValueError as e:
                     raise ExecError(str(e)) from None
+            elif stmt.method == "hnsw":
+                try:
+                    self.node.stores[stmt.table].build_hnsw_index(
+                        stmt.columns[0],
+                        int(stmt.options.get("m", 16)),
+                        int(stmt.options.get("ef_construction", 64)),
+                        str(stmt.options.get("metric", "l2")))
+                except ValueError as e:
+                    raise ExecError(str(e)) from None
+            else:  # btree (the default access method)
+                try:
+                    for col in stmt.columns:
+                        self.node.stores[stmt.table].build_btree_index(col)
+                except (ValueError, KeyError) as e:
+                    raise ExecError(str(e)) from None
+                self.node.catalog.btree_cols.setdefault(
+                    stmt.table, set()).update(stmt.columns)
             return Result("CREATE INDEX")
         if isinstance(stmt, A.InsertStmt):
             return self._exec_insert(stmt)
@@ -303,6 +320,16 @@ class Session:
         if isinstance(stmt, A.VacuumStmt):
             self.node.checkpoint()
             return Result("VACUUM")
+        if isinstance(stmt, A.AnalyzeStmt):
+            from ..parallel.statistics import analyze_store
+            names = [stmt.table] if stmt.table else \
+                list(self.node.stores)
+            for name in names:
+                st = self.node.stores.get(name)
+                if st is None:
+                    raise ExecError(f"table {name!r} does not exist")
+                self.node.catalog.stats[name] = analyze_store(st)
+            return Result("ANALYZE")
         if isinstance(stmt, A.BarrierStmt):
             self.node.checkpoint()
             return Result("BARRIER")
@@ -317,9 +344,19 @@ class Session:
     def _exec_select(self, stmt: A.SelectStmt) -> Result:
         planned = self._plan_select(stmt)
         t, implicit = self._begin_implicit()
-        ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
-                          self.node.cache)
-        batch = Executor(ctx).run(planned)
+        batch = None
+        raw_budget = self.node.gucs.get("work_mem_rows", "")
+        if raw_budget.isdigit() and int(raw_budget) > 0:
+            # beyond-HBM tier: multi-pass partitioned execution when a
+            # scanned table exceeds the staging budget (exec/spill.py)
+            from .spill import SpillDriver
+            drv = SpillDriver(self.node.stores, self.node.cache,
+                              t.snapshot_ts, t.txid, int(raw_budget))
+            batch = drv.try_run(planned)
+        if batch is None:
+            ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
+                              self.node.cache)
+            batch = Executor(ctx).run(planned)
         names, rows = materialize(batch, planned.output_names)
         return Result("SELECT", names=names, rows=rows, rowcount=len(rows))
 

@@ -1,0 +1,311 @@
+"""Spill tier: beyond-HBM execution by partitioned multi-pass plans.
+
+Reference analog: the hybrid hash join's nbatch partitioning
+(src/backend/executor/nodeHash.c:584 ExecChooseHashTableSize nbatch
+growth) and the workfile manager
+(src/backend/utils/workfile_manager/workfile_mgr.c).  In this engine
+host RAM is the spill tier (SURVEY §7.3: "the host becomes the disk"):
+table chunks already live on the host, so spilling means staging only a
+BOUNDED SLICE of rows to device HBM per pass:
+
+- scan→aggregate plans: row-range slabs, each aggregated in partial
+  mode; the final aggregate merges slab partials (the same partial/
+  final protocol DN fan-out uses, so NULL/avg/count semantics are
+  identical)
+- single equi-join plans: grace hash — both sides partitioned by the
+  join-key hash (host-side numpy over chunks), each partition pair
+  joined on device independently; TEXT keys hash their strings so the
+  two tables' private dictionaries agree
+- cross joins: block-nested-loop over left-side slabs (this replaces
+  the old hard 2^22 cap for plans routed through the spill tier)
+
+Activation: GUC `work_mem_rows` (rows stageable per operator input).
+The driver returns None for shapes it does not cover — the in-memory
+path runs as before.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..catalog.types import TypeKind
+from ..plan import exprs as E
+from ..plan import physical as P
+from ..plan.distribute import BatchSource
+from ..storage.batch import next_pow2, stage_padded
+from ..utils.hashing import hash_columns_np, hash_string
+
+
+def _walk_nodes(node):
+    yield node
+    for attr in ("child", "left", "right"):
+        c = getattr(node, attr, None)
+        if isinstance(c, P.PhysNode):
+            yield from _walk_nodes(c)
+    for c in getattr(node, "inputs", None) or []:
+        if isinstance(c, P.PhysNode):
+            yield from _walk_nodes(c)
+
+
+def _clone_replacing(node, target, replacement):
+    if node is target:
+        return replacement
+    clone = dataclasses.replace(node)
+    for attr in ("child", "left", "right"):
+        c = getattr(clone, attr, None)
+        if isinstance(c, P.PhysNode):
+            setattr(clone, attr, _clone_replacing(c, target, replacement))
+    return clone
+
+
+def _needed_cols(subtree, alias):
+    from .fused import _needed_columns
+    return _needed_columns(subtree, alias)
+
+
+def _host_key_hash(store, key: E.Expr, alias: str) -> Optional[np.ndarray]:
+    """Join-key hash over ALL live rows of a table, host-side (the
+    grace-partition assignment).  Plain columns only."""
+    if isinstance(key, E.Col):
+        plain = key.name.split(".", 1)[1] if "." in key.name else key.name
+        if key.name.split(".", 1)[0] != alias:
+            return None
+        if plain not in store.td.column_names:
+            return None
+    else:
+        return None
+    arrs = [ch.columns[plain][:ch.nrows] for _, ch in store.scan_chunks()]
+    arr = np.concatenate(arrs) if arrs else np.empty(0, np.int64)
+    if store.td.column(plain).type.kind == TypeKind.TEXT:
+        d = store.dicts[plain].values
+        lut = np.asarray([hash_string(v) for v in d] or [0],
+                         dtype=np.uint64)
+        return lut[np.clip(arr, 0, len(lut) - 1)]
+    return hash_columns_np([arr.astype(np.int64)])
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class _ScanInfo:
+    node: P.SeqScan
+    store: object
+    rows: int
+    # eq=False: identity hashing so infos key _stage_for's dicts
+
+
+class SpillDriver:
+    """Plan-shape matcher + multi-pass executor for one session node."""
+
+    def __init__(self, stores: dict, cache, snapshot_ts: int, txid: int,
+                 budget: int):
+        self.stores = stores
+        self.cache = cache
+        self.snapshot_ts = snapshot_ts
+        self.txid = txid
+        self.budget = max(int(budget), 1024)
+        self.passes = 0   # instrumentation: device passes executed
+        self._host_cache: dict = {}  # (id(store), version) -> host cols
+
+    # -- shape analysis ------------------------------------------------
+    def _scan_infos(self, plan) -> Optional[list[_ScanInfo]]:
+        infos = []
+        for nd in _walk_nodes(plan):
+            if isinstance(nd, P.SeqScan):
+                st = self.stores.get(nd.table.name)
+                if st is None:
+                    return None
+                infos.append(_ScanInfo(nd, st, st.row_count()))
+            elif isinstance(nd, (P.AnnSearch, P.Window, P.SetOp,
+                                 P.Append, BatchSource)):
+                return None
+        return infos
+
+    def try_run(self, planned) -> Optional[object]:
+        """Returns the result DBatch, or None when the plan/shape is not
+        spill-eligible (caller uses the in-memory path)."""
+        if planned.init_plans:
+            return None
+        plan = planned.plan
+        infos = self._scan_infos(plan)
+        if not infos:
+            return None
+        if max(i.rows for i in infos) <= self.budget:
+            return None
+        joins = [nd for nd in _walk_nodes(plan)
+                 if isinstance(nd, P.HashJoin)]
+        aggs = [nd for nd in _walk_nodes(plan) if isinstance(nd, P.Agg)]
+        if len(aggs) > 1 or any(a.mode != "single" for a in aggs):
+            return None
+        if any(any(ac.distinct for _, ac in a.aggs) for a in aggs):
+            return None
+        agg = aggs[0] if aggs else None
+        if not joins:
+            if len(infos) != 1 or agg is None:
+                return None
+            return self._run_slabbed_agg(plan, agg, infos[0])
+        if len(joins) == 1 and joins[0].kind == "cross" \
+                and len(infos) == 2:
+            return self._run_block_cross(plan, joins[0], agg, infos)
+        if len(joins) == 1 and joins[0].kind in ("inner", "left",
+                                                 "semi", "anti") \
+                and len(infos) == 2:
+            return self._run_grace_join(plan, joins[0], agg, infos)
+        return None
+
+    # -- execution helpers --------------------------------------------
+    def _exec_with_staged(self, plan, staged):
+        from .executor import ExecContext, Executor
+        ctx = ExecContext(self.stores, self.snapshot_ts, self.txid,
+                          self.cache, staged=staged)
+        self.passes += 1
+        return Executor(ctx).exec_node(plan)
+
+    def _combine_host(self, batches):
+        from .dist import _concat_host, _to_device, _to_host
+        return _to_device(_concat_host([_to_host(b) for b in batches]))
+
+    def _stage_for(self, subtree, infos_sel: dict):
+        """Stage each scanned table's selected rows; returns ctx.staged.
+        The host concatenation is built once per (store, version) and
+        sliced per pass."""
+        staged = {}
+        for info, sel in infos_sel.items():
+            needed = sorted(_needed_cols(subtree, info.node.alias)
+                            | _needed_cols(subtree, info.node.table.name))
+            hkey = (id(info.store), info.store.version, tuple(needed))
+            host = self._host_cache.get(hkey)
+            if host is None:
+                host = info.store.host_live_columns(needed)
+                self._host_cache = {hkey: host, **{
+                    k: v for k, v in list(self._host_cache.items())[-3:]}}
+            arrs, n = stage_padded(host, sel)
+            staged[info.node.table.name] = (arrs, n)
+        return staged
+
+    # -- shapes --------------------------------------------------------
+    def _run_slabbed_agg(self, plan, agg, info: _ScanInfo):
+        """scan→agg: row-range slabs in partial mode + one final."""
+        partial = dataclasses.replace(agg, mode="partial")
+        partials = []
+        for lo in range(0, info.rows, self.budget):
+            sel = slice(lo, min(lo + self.budget, info.rows))
+            staged = self._stage_for(partial, {info: sel})
+            partials.append(self._exec_with_staged(partial, staged))
+        combined = self._combine_host(partials)
+        final = P.Agg(BatchSource(combined),
+                      [(n, E.Col(n, ke.type))
+                       for n, ke in agg.group_keys], agg.aggs, "final")
+        return self._finish_with(plan, agg, final)
+
+    def _finish_with(self, plan, target, replacement_node):
+        rest = _clone_replacing(plan, target, replacement_node)
+        from .executor import ExecContext, Executor
+        ctx = ExecContext(self.stores, self.snapshot_ts, self.txid,
+                          self.cache)
+        return Executor(ctx).exec_node(rest)
+
+    def _join_partition_plan(self, plan, join, agg):
+        """The subtree to execute per partition: the join, wrapped in a
+        partial aggregate when the plan aggregates above it."""
+        if agg is not None:
+            sub = dataclasses.replace(agg, mode="partial")
+            return sub, agg
+        return join, join
+
+    def _run_grace_join(self, plan, join, agg, infos):
+        lkeys, rkeys = join.left_keys, join.right_keys
+        left_info = self._info_for_side(join.left, infos)
+        right_info = self._info_for_side(join.right, infos)
+        if left_info is None or right_info is None:
+            return None
+        lh = self._side_hash(left_info, lkeys)
+        rh = self._side_hash(right_info, rkeys)
+        if lh is None or rh is None:
+            return None
+        nparts = max(1, 2 ** math.ceil(math.log2(max(
+            1, math.ceil(max(left_info.rows, right_info.rows)
+                         / self.budget)))))
+        per_plan, replace_target = self._join_partition_plan(plan, join,
+                                                             agg)
+        outs = []
+        lp = (lh % np.uint64(nparts)).astype(np.int64)
+        rp = (rh % np.uint64(nparts)).astype(np.int64)
+        for p in range(nparts):
+            lsel = np.nonzero(lp == p)[0]
+            rsel = np.nonzero(rp == p)[0]
+            if join.kind in ("inner", "semi") and \
+                    (len(lsel) == 0 or len(rsel) == 0):
+                continue
+            if len(lsel) == 0:
+                continue
+            staged = self._stage_for(per_plan, {left_info: lsel,
+                                                right_info: rsel})
+            outs.append(self._exec_with_staged(per_plan, staged))
+        if not outs:
+            return None  # degenerate; let the in-memory path handle it
+        combined = self._combine_host(outs)
+        if agg is not None:
+            final = P.Agg(BatchSource(combined),
+                          [(n, E.Col(n, ke.type))
+                           for n, ke in agg.group_keys], agg.aggs,
+                          "final")
+            return self._finish_with(plan, replace_target, final)
+        return self._finish_with(plan, replace_target,
+                                 BatchSource(combined))
+
+    def _run_block_cross(self, plan, join, agg, infos):
+        left_info = self._info_for_side(join.left, infos)
+        right_info = self._info_for_side(join.right, infos)
+        if left_info is None or right_info is None:
+            return None
+        per_plan, replace_target = self._join_partition_plan(plan, join,
+                                                             agg)
+        outs = []
+        # bound the cross PRODUCT per pass (the padded pair expansion is
+        # the memory cost), not just the left staging
+        r_padded = next_pow2(max(right_info.rows, 1))
+        pair_budget = max(self.budget * 8, 1 << 20)
+        slab = max(pair_budget // r_padded, 64)
+        for lo in range(0, left_info.rows, slab):
+            lsel = slice(lo, min(lo + slab, left_info.rows))
+            rsel = slice(0, right_info.rows)
+            staged = self._stage_for(per_plan, {left_info: lsel,
+                                                right_info: rsel})
+            outs.append(self._exec_with_staged(per_plan, staged))
+        combined = self._combine_host(outs)
+        if agg is not None:
+            final = P.Agg(BatchSource(combined),
+                          [(n, E.Col(n, ke.type))
+                           for n, ke in agg.group_keys], agg.aggs,
+                          "final")
+            return self._finish_with(plan, replace_target, final)
+        return self._finish_with(plan, replace_target,
+                                 BatchSource(combined))
+
+    def _info_for_side(self, side_plan, infos) -> Optional[_ScanInfo]:
+        scans = [nd for nd in _walk_nodes(side_plan)
+                 if isinstance(nd, P.SeqScan)]
+        if len(scans) != 1:
+            return None
+        for i in infos:
+            if i.node is scans[0]:
+                return i
+        return None
+
+    def _side_hash(self, info: _ScanInfo, keys) -> Optional[np.ndarray]:
+        hs = []
+        for k in keys:
+            h = _host_key_hash(info.store, k, info.node.alias)
+            if h is None:
+                return None
+            hs.append(h)
+        if not hs:
+            return None
+        out = hs[0]
+        for h in hs[1:]:
+            from ..utils.hashing import combine_np
+            out = combine_np(out, h)
+        return out

@@ -103,6 +103,15 @@ def _dispatch(node: DataNode, msg: dict):
                                     msg.get("lists", 0),
                                     msg.get("metric", "l2"),
                                     msg.get("nprobe", 0))
+    if op == "build_btree_index":
+        return node.build_btree_index(msg["table"], msg["cols"])
+    if op == "analyze_table":
+        return node.analyze_table(msg["table"])
+    if op == "build_hnsw_index":
+        return node.build_hnsw_index(msg["table"], msg["col"],
+                                     msg.get("m", 16),
+                                     msg.get("ef_construction", 64),
+                                     msg.get("metric", "l2"))
     if op == "prepare":
         return node.prepare(msg["gid"], msg["txid"])
     if op == "commit":
@@ -192,6 +201,18 @@ class RemoteDataNode:
     def build_ann_index(self, table, col, lists=0, metric="l2", nprobe=0):
         return self._call(op="build_ann_index", table=table, col=col,
                           lists=lists, metric=metric, nprobe=nprobe)
+
+    def build_btree_index(self, table, cols):
+        return self._call(op="build_btree_index", table=table, cols=cols)
+
+    def analyze_table(self, table):
+        return self._call(op="analyze_table", table=table)
+
+    def build_hnsw_index(self, table, col, m=16, ef_construction=64,
+                         metric="l2"):
+        return self._call(op="build_hnsw_index", table=table, col=col,
+                          m=m, ef_construction=ef_construction,
+                          metric=metric)
 
     def prepare(self, gid, txid):
         return self._call(op="prepare", gid=gid, txid=txid)

@@ -41,8 +41,27 @@ class DataNode:
         self.datadir = datadir
         self.wal: Optional[Wal] = None
         self.txn_spans: dict[int, list] = {}  # txid -> [(kind, table, span)]
+        # streaming replication (storage/replication.py WalShip); set via
+        # attach_standby BEFORE open_wal
+        self._ship = None
         if datadir:
             os.makedirs(datadir, exist_ok=True)
+
+    def attach_standby(self, host: str, port: int,
+                       sync: bool = True) -> None:
+        """Start shipping WAL + checkpoints to a DnStandbyServer
+        (reference: walsender registration).  Seeds the standby with the
+        current checkpoint artifacts so it can catch up mid-life."""
+        from ..storage.replication import WalShip
+        self._ship = WalShip(host, port)
+        self._sync_standby = sync
+        if self.datadir:
+            # base backup: checkpoint ships its artifacts itself now
+            # that _ship is set (snapshot + empty WAL on the standby)
+            self.checkpoint(None)
+        if self.wal is not None:
+            self.wal._ship = self._ship.frame
+            self.wal._sync_ship = sync
 
     # ---- service surface -------------------------------------------------
     @staticmethod
@@ -143,6 +162,27 @@ class DataNode:
         return self.stores[table].build_ann_index(col, lists, metric,
                                                   nprobe)
 
+    def build_hnsw_index(self, table: str, col: str, m: int = 16,
+                         ef_construction: int = 64,
+                         metric: str = "l2") -> int:
+        """Build an HNSW graph over a VECTOR column on this node."""
+        return self.stores[table].build_hnsw_index(col, m,
+                                                   ef_construction,
+                                                   metric)
+
+    def analyze_table(self, table: str) -> dict:
+        """Per-shard statistics for ANALYZE (reference: analyze.c run on
+        each DN, merged at the CN)."""
+        from .statistics import analyze_store
+        return analyze_store(self.stores[table])
+
+    def build_btree_index(self, table: str, cols: list) -> int:
+        """Build btree-equivalent sorted indexes on this node's shard."""
+        total = 0
+        for col in cols:
+            total += self.stores[table].build_btree_index(col)
+        return total
+
     def vacuum(self, table, cutoff: int) -> int:
         """Compact dead rows.  Refuses (-1) while any txn holds positional
         spans on this node — compaction would shift the rows they
@@ -194,7 +234,9 @@ class DataNode:
 
     def open_wal(self):
         if self.datadir:
-            self.wal = Wal(os.path.join(self.datadir, "wal.log"))
+            self.wal = Wal(os.path.join(self.datadir, "wal.log"),
+                           ship=self._ship.frame if self._ship else None,
+                           sync_ship=getattr(self, "_sync_standby", True))
 
     def log(self, rec: dict, sync: bool = False):
         if self.wal:
@@ -291,6 +333,10 @@ class DataNode:
             checkpoint_store(st, os.path.join(self.datadir, f"{name}.ckpt"))
         if self.wal:
             self.wal.truncate()
+        if self._ship is not None:
+            # the standby mirrors the truncation: snapshot + fresh log
+            from ..storage.replication import checkpoint_files
+            self._ship.checkpoint(checkpoint_files(self.datadir))
 
 
 class Cluster:
@@ -460,6 +506,20 @@ class Cluster:
             if dns is None or dn.index in dns:
                 dn.abort(txid)
         self.active_txns.discard(txid)
+
+    # ---- failover (reference: pg_ctl promote + pgxc_ctl failover) ----
+    def promote_standby(self, dn_index: int, standby_datadir: str):
+        """Replace a (dead) datanode with its promoted standby: normal
+        crash recovery over the standby's shipped directory, then swap
+        it into the node table.  In-doubt prepared txns resolve against
+        the GTM exactly as after a primary crash."""
+        dn = DataNode(dn_index, standby_datadir)
+        max_txid = dn.recover(self.catalog, self.gtm)
+        if hasattr(self.gtm, "_txid"):
+            self.gtm._txid = max(self.gtm._txid, max_txid)
+        dn.open_wal()
+        self.datanodes[dn_index] = dn
+        return dn
 
     # ---- in-doubt resolver (reference: clean2pc launcher/workers) ----
     def _datanode_by_name(self, name: str):

@@ -1,0 +1,71 @@
+"""ANALYZE: table/column statistics for cost-based planning.
+
+Reference analog: commands/analyze.c feeding pg_statistic, consumed by
+optimizer/path/costsize.c.  Collected per store (per DN shard) with a
+bounded sample, merged cluster-wide: row counts, per-column NDV,
+numeric min/max in STORAGE representation (so selectivity bounds
+compare directly against binder literals converted the same way the
+index tier converts them)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..catalog.types import TypeKind
+
+SAMPLE = 50_000
+
+
+def analyze_store(store, sample: int = SAMPLE) -> dict:
+    """Stats for one TableStore (one DN's shard of the table)."""
+    rows = store.row_count()
+    cols: dict[str, dict] = {}
+    for c in store.td.columns:
+        if c.type.kind == TypeKind.VECTOR:
+            continue
+        if c.type.kind == TypeKind.TEXT:
+            # the dictionary IS the exact distinct-value set
+            cols[c.name] = {"ndv": max(len(store.dicts[c.name].values), 1),
+                            "min": None, "max": None}
+            continue
+        parts = [ch.columns[c.name][:ch.nrows]
+                 for _, ch in store.scan_chunks()]
+        arr = np.concatenate(parts) if parts else np.empty(0)
+        if len(arr) > sample:
+            idx = np.linspace(0, len(arr) - 1, sample).astype(np.int64)
+            samp = arr[idx]
+            scale_up = len(arr) / sample
+        else:
+            samp, scale_up = arr, 1.0
+        if len(samp) == 0:
+            cols[c.name] = {"ndv": 1, "min": None, "max": None}
+            continue
+        ndv = int(min(len(np.unique(samp)) * max(scale_up ** 0.5, 1.0),
+                      rows or 1))
+        cols[c.name] = {"ndv": max(ndv, 1),
+                        "min": float(np.min(arr)),
+                        "max": float(np.max(arr))}
+    return {"rows": rows, "cols": cols}
+
+
+def merge_stats(parts: list[dict]) -> dict:
+    """Cluster-wide merge of per-DN stats (reference: the CN keeps one
+    pg_statistic; here rows sum, bounds widen, NDV takes the max per-DN
+    value bounded by total rows — a safe lower estimate)."""
+    rows = sum(p["rows"] for p in parts)
+    cols: dict[str, dict] = {}
+    names = set()
+    for p in parts:
+        names |= set(p["cols"])
+    for n in names:
+        entries = [p["cols"][n] for p in parts if n in p["cols"]]
+        mins = [e["min"] for e in entries if e["min"] is not None]
+        maxs = [e["max"] for e in entries if e["max"] is not None]
+        cols[n] = {
+            "ndv": min(max(e["ndv"] for e in entries), max(rows, 1)),
+            "min": min(mins) if mins else None,
+            "max": max(maxs) if maxs else None,
+        }
+    return {"rows": rows, "cols": cols}

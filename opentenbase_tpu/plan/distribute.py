@@ -83,6 +83,30 @@ class DistPlan:
     fqs_node: Optional[int] = None     # set => whole plan runs on one DN
 
 
+def _subtree_est(node) -> Optional[float]:
+    """Worst-case row estimate of a fragment subtree from its scan
+    estimates (set by the planner from ANALYZE stats); None = unknown."""
+    ests = []
+    stack = [node]
+    while stack:
+        nd = stack.pop()
+        if isinstance(nd, (P.SeqScan, P.IndexScan)):
+            e = getattr(nd, "est_rows", None)
+            if e is None:
+                return None
+            ests.append(float(e))
+        for attr in ("child", "left", "right"):
+            c = getattr(nd, attr, None)
+            if isinstance(c, P.PhysNode):
+                stack.append(c)
+    if not ests:
+        return None
+    out = 1.0
+    for e in ests:
+        out *= max(e, 1.0)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # FQS analysis
 # ---------------------------------------------------------------------------
@@ -168,7 +192,7 @@ class Distributor:
 
     # -- annotation walk: returns (new_plan, Dist) --
     def _walk(self, node: P.PhysNode):
-        if isinstance(node, P.SeqScan):
+        if isinstance(node, (P.SeqScan, P.IndexScan)):
             dt = node.table.distribution
             if dt.dist_type == DistType.REPLICATED:
                 return node, Dist("replicated")
@@ -336,6 +360,16 @@ class Distributor:
             # no equi keys (pure residual join): broadcast build side
             node.right = self._add_broadcast(node.right)
             return node, ld
+        # cost choice (reference: create_remotesubplan_path weighing
+        # replication vs redistribution): a SMALL build side broadcasts
+        # once instead of moving both sides — needs ANALYZE estimates
+        if node.kind == "inner":
+            rest = _subtree_est(node.right)
+            lest = _subtree_est(node.left)
+            if rest is not None and rest <= 4096 and \
+                    (lest is None or lest > 8 * rest):
+                node.right = self._add_broadcast(node.right)
+                return node, ld
         # redistribute both by the full key set
         node.left = self._add_redistribute(node.left,
                                            [p[0] for p in pairs])

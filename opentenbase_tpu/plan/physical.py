@@ -174,6 +174,27 @@ class Append(PhysNode):
 
 
 @dataclasses.dataclass
+class IndexScan(PhysNode):
+    """Point/range scan through a btree-equivalent sorted index
+    (reference: nbtree + ExecIndexScan): host binary search selects the
+    candidate rows, only those stage to device; the full filter list
+    re-verifies on the staged subset (bounds are a pre-selection)."""
+    table: object = None
+    alias: str = ""
+    key_col: str = ""          # plain column name
+    lo: object = None          # storage-representation bounds
+    hi: object = None
+    lo_strict: bool = False
+    hi_strict: bool = False
+    filters: list = dataclasses.field(default_factory=list)
+    outputs: list = dataclasses.field(default_factory=list)
+
+    def title(self):
+        return f"IndexScan {self.table.name} as {self.alias} " \
+               f"key={self.key_col}"
+
+
+@dataclasses.dataclass
 class Window(PhysNode):
     """Window-function computation: adds one column per call, rows
     pass through (reference: nodeWindowAgg.c — sorted partitions,

@@ -22,6 +22,8 @@ import dataclasses
 import itertools
 from typing import Optional
 
+import numpy as np
+
 from ..catalog.catalog import Catalog
 from ..catalog import types as T
 from ..catalog.types import TypeKind
@@ -122,6 +124,11 @@ def _hoist_or_common(q: E.Expr) -> list[E.Expr]:
         rest_branches.append(rest[0] if len(rest) == 1
                              else E.BoolOp("and", tuple(rest)))
     return common + [E.BoolOp("or", tuple(rest_branches))]
+
+
+def _strpred_plain(p: E.StrPred) -> str:
+    c = p.col.col if isinstance(p.col, E.TextExpr) else p.col
+    return c.name.split(".", 1)[-1]
 
 
 def _is_equi_pair(e: E.Expr):
@@ -273,13 +280,156 @@ class Planner:
         if rte.kind == "table":
             # scan emits qualified names
             outputs = [(q, E.Col(q, t)) for _, (q, t) in rte.columns.items()]
-            return P.SeqScan(rte.table, rte.alias, filters, outputs)
+            scan = self._try_index_scan(rte, filters, outputs)
+            if scan is None:
+                scan = P.SeqScan(rte.table, rte.alias, filters, outputs)
+            # estimate rides on the node for the distributed planner's
+            # broadcast-vs-redistribute choice
+            scan.est_rows = self._est_scan(rte, filters)
+            return scan
         from .query import BoundSetOp
         if isinstance(rte.subquery, BoundSetOp):
             sub, _names = self._plan_setop(rte.subquery, init_plans)
         else:
             sub = self._plan_query(rte.subquery, init_plans)
         return _RenameHelper.wrap(sub, rte, filters)
+
+    def _try_index_scan(self, rte: RTE, filters,
+                        outputs) -> Optional[P.PhysNode]:
+        """Rewrite a scan into an IndexScan when a filter bounds an
+        indexed column (reference: create_index_paths +
+        ExecIndexBuildScanKeys).  Bounds are converted into the storage
+        representation; the filter list stays intact and re-verifies."""
+        indexed = self.catalog.btree_cols.get(rte.table.name) or set()
+        if not indexed:
+            return None
+        best = None
+        for q in filters:
+            if not (isinstance(q, E.Cmp) and isinstance(q.left, E.Col)
+                    and isinstance(q.right, E.Lit)
+                    and q.right.value is not None):
+                continue
+            plain = q.left.name.split(".", 1)[-1]
+            if plain not in indexed:
+                continue
+            col = rte.table.column(plain)
+            if col.type.kind == TypeKind.TEXT:
+                continue   # codes are unordered; text btree is a follow-up
+            v = self._storage_bound(col.type, q.right)
+            if v is None:
+                continue
+            b = best
+            if b is None:
+                b = {"col": plain, "lo": None, "hi": None,
+                     "lo_strict": False, "hi_strict": False}
+            elif b["col"] != plain:
+                continue    # one index per scan for now
+            op = q.op
+            if op == "=":
+                b["lo"] = v if b["lo"] is None else max(b["lo"], v)
+                b["hi"] = v if b["hi"] is None else min(b["hi"], v)
+            elif op in (">", ">="):
+                if b["lo"] is None or v >= b["lo"]:
+                    b["lo"], b["lo_strict"] = v, (op == ">")
+            elif op in ("<", "<="):
+                if b["hi"] is None or v <= b["hi"]:
+                    b["hi"], b["hi_strict"] = v, (op == "<")
+            else:
+                continue
+            best = b
+        if best is None or (best["lo"] is None and best["hi"] is None):
+            return None
+        return P.IndexScan(rte.table, rte.alias, best["col"],
+                           best["lo"], best["hi"], best["lo_strict"],
+                           best["hi_strict"], filters, outputs)
+
+    @staticmethod
+    def _storage_bound(ct, lit: E.Lit):
+        """Literal -> the column's storage representation for index
+        comparison; None when not convertible."""
+        from ..catalog import types as T
+        v, lt = lit.value, lit.lit_type
+        k = ct.kind
+        try:
+            if k == TypeKind.DECIMAL:
+                if lt.kind == TypeKind.DECIMAL:
+                    diff = ct.scale - lt.scale
+                    return int(v) * 10 ** diff if diff >= 0 else \
+                        int(v) / 10 ** (-diff)
+                if isinstance(v, (int, np.integer)):
+                    return int(v) * 10 ** ct.scale
+                return T.decimal_to_int(str(v), ct.scale)
+            if k == TypeKind.DATE:
+                return T.date_to_days(v) if isinstance(v, str) else int(v)
+            if k == TypeKind.FLOAT64:
+                if lt.kind == TypeKind.DECIMAL:
+                    return int(v) / 10 ** lt.scale
+                return float(v)
+            if k in (TypeKind.INT32, TypeKind.INT64):
+                if lt.kind == TypeKind.DECIMAL:
+                    # fractional bound against an int column: keep the
+                    # float (searchsorted handles mixed compare)
+                    return int(v) / 10 ** lt.scale if lt.scale else int(v)
+                return int(v)
+        except (TypeError, ValueError):
+            return None
+        return None
+
+    # -- statistics / cost estimation --------------------------------------
+    DEFAULT_ROWS = 1000.0
+
+    def _table_stats(self, rte: RTE) -> Optional[dict]:
+        if rte.kind != "table":
+            return None
+        return self.catalog.stats.get(rte.table.name)
+
+    def _est_scan(self, rte: RTE, filters) -> Optional[float]:
+        """Estimated scan output rows, or None without ANALYZE stats
+        (reference: costsize.c set_baserel_size_estimates +
+        clause_selectivity)."""
+        st = self._table_stats(rte)
+        if st is None:
+            return None
+        rows = float(max(st["rows"], 1))
+        for q in filters:
+            sel = 0.33
+            if isinstance(q, E.Cmp) and isinstance(q.left, E.Col) \
+                    and isinstance(q.right, E.Lit):
+                plain = q.left.name.split(".", 1)[-1]
+                cst = st["cols"].get(plain)
+                if q.op == "=":
+                    sel = 1.0 / max(cst["ndv"], 1) if cst else 0.1
+                elif cst and cst.get("min") is not None and \
+                        q.op in ("<", "<=", ">", ">="):
+                    span = max(cst["max"] - cst["min"], 1e-9)
+                    v = self._storage_bound(
+                        rte.table.column(plain).type, q.right)
+                    if v is not None:
+                        frac = (float(v) - cst["min"]) / span
+                        frac = min(max(frac, 0.0), 1.0)
+                        sel = frac if q.op in ("<", "<=") else 1.0 - frac
+            elif isinstance(q, E.StrPred):
+                cst = st["cols"].get(_strpred_plain(q))
+                if q.kind in ("eq", "in"):
+                    k = len(q.patterns)
+                    sel = k / max(cst["ndv"], 1) if cst else 0.1
+                elif q.kind in ("like",):
+                    sel = 0.1
+                else:
+                    sel = 0.33
+            elif isinstance(q, E.InList):
+                sel = 0.2
+            rows *= max(sel, 1e-6)
+        return max(rows, 1.0)
+
+    def _edge_ndv(self, expr: E.Expr, alias_rtes: dict) -> float:
+        if isinstance(expr, E.Col) and "." in expr.name:
+            alias, plain = expr.name.split(".", 1)
+            rte = alias_rtes.get(alias)
+            st = self._table_stats(rte) if rte is not None else None
+            if st and plain in st["cols"]:
+                return float(max(st["cols"][plain]["ndv"], 1))
+        return 0.0
 
     # -- join ordering -----------------------------------------------------
     def _join_tables(self, bq, scans, rte_cols, join_edges, residual,
@@ -289,6 +439,7 @@ class Planner:
         outer_steps = {bq.rtable[s.rte_index].alias: s
                        for s in bq.join_order if s.kind in ("left",
                                                             "full")}
+        alias_rtes = {bq.rtable[i].alias: bq.rtable[i] for i in order}
 
         joined: list[str] = []
         plan: Optional[P.PhysNode] = None
@@ -304,16 +455,74 @@ class Planner:
                     out.append((re_, le))
             return out
 
+        # cost mode needs every base table ANALYZEd (reference:
+        # costsize.c falls back to defaults; we fall back to the greedy
+        # FROM-order walk, the round-1 behavior)
+        base_est = {a: self._est_scan(alias_rtes[a],
+                                      getattr(scans[a], "filters", []))
+                    for a in aliases}
+        cost_mode = all(v is not None for v in base_est.values()) \
+            and len(aliases) > 1
+        cur_est = 0.0
+
+        def join_est(cand: str) -> float:
+            edges = edges_between(cand)
+            if not edges:
+                return cur_est * base_est[cand]  # cross
+            sel = 1.0
+            for le, re_ in edges:
+                ndv = max(self._edge_ndv(le, alias_rtes),
+                          self._edge_ndv(re_, alias_rtes))
+                if ndv <= 0:
+                    ndv = max(cur_est, base_est[cand], 1.0)
+                sel *= 1.0 / ndv
+            return max(cur_est * base_est[cand] * sel, 1.0)
+
         while remaining:
-            # pick next connected table (FROM order preference)
             cand = None
-            for a in remaining:
-                if plan is None or edges_between(a) or a in outer_steps:
-                    cand = a
-                    break
+            # outer joins are not reorderable past inner candidates:
+            # take the next FROM-order outer step as soon as it appears
+            if remaining[0] in outer_steps and plan is not None:
+                cand = remaining[0]
+            elif cost_mode and plan is None:
+                # starting table = one side of the cheapest join pair
+                # (Selinger's level-2 seed, costsize.c-style)
+                best_cost = None
+                for lo_a, ro_a, le, re_ in join_edges:
+                    if lo_a in outer_steps or ro_a in outer_steps:
+                        continue
+                    ndv = max(self._edge_ndv(le, alias_rtes),
+                              self._edge_ndv(re_, alias_rtes)) or \
+                        max(base_est[lo_a], base_est[ro_a], 1.0)
+                    c = base_est[lo_a] * base_est[ro_a] / ndv
+                    if best_cost is None or c < best_cost:
+                        best_cost = c
+                        cand = lo_a if base_est[lo_a] >= base_est[ro_a] \
+                            else ro_a
+            elif cost_mode and plan is not None:
+                best_cost = None
+                for a in remaining:
+                    if a in outer_steps:
+                        continue
+                    if not edges_between(a) and len(remaining) > 1:
+                        continue   # delay cross joins
+                    c = join_est(a)
+                    if best_cost is None or c < best_cost:
+                        best_cost, cand = c, a
+            if cand is None:
+                for a in remaining:
+                    # an outer step may only fire in FROM order — its
+                    # null-preserved left side must already be joined
+                    if plan is None or edges_between(a) \
+                            or (a in outer_steps and a == remaining[0]):
+                        cand = a
+                        break
             if cand is None:
                 cand = remaining[0]      # forced cross join
             remaining.remove(cand)
+            if cost_mode:
+                cur_est = base_est[cand] if plan is None \
+                    else join_est(cand)
             right = scans[cand]
             if plan is None:
                 plan = right

@@ -315,6 +315,11 @@ class VacuumStmt(Node):
 
 
 @dataclasses.dataclass
+class AnalyzeStmt(Node):
+    table: Optional[str]
+
+
+@dataclasses.dataclass
 class BarrierStmt(Node):
     name: str
 

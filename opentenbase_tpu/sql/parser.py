@@ -159,6 +159,12 @@ class Parser:
             if self.tok.kind == Tok.IDENT and not self.tok.is_keyword:
                 tname = self.ident()
             return A.VacuumStmt(tname)
+        if v in ("analyze", "analyse"):
+            self.advance()
+            tname = None
+            if self.tok.kind == Tok.IDENT and not self.tok.is_keyword:
+                tname = self.ident()
+            return A.AnalyzeStmt(tname)
         if v == "execute":
             self.advance()
             self.expect_kw("direct")

@@ -112,3 +112,24 @@ def next_pow2(n: int, floor: int = 256) -> int:
     while p < n:
         p <<= 1
     return p
+
+
+def stage_padded(host_cols, sel):
+    """Host column slices -> pow2-padded device arrays for one pass.
+    `sel` is a slice (row-range slab), an int index array (hash
+    partition / index lookup), or slice(None) for everything.  The
+    shared device-staging tail of the spill, mesh, and index tiers."""
+    import jax
+    import numpy as np
+
+    out = {}
+    n = None
+    for name, arr in host_cols.items():
+        sub = arr[sel]
+        if n is None:
+            n = len(sub)
+        padded = next_pow2(max(n, 1))
+        buf = np.zeros((padded, *sub.shape[1:]), dtype=sub.dtype)
+        buf[:n] = sub
+        out[name] = jax.device_put(buf)
+    return out, (n or 0)

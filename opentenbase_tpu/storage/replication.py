@@ -1,0 +1,179 @@
+"""Datanode streaming replication: WAL shipping to a standby + promote.
+
+Reference analog: src/backend/replication/walsender.c / walreceiver.c +
+syncrep.c, scoped to this engine's redo-only logical WAL
+(storage/wal.py): the primary ships every framed WAL record as it is
+written, and ships its checkpoint artifacts (npz snapshots + catalog)
+when it truncates the log — the standby's data directory is therefore
+always a valid crash-image of the primary, and PROMOTE is exactly crash
+recovery on that directory (the same rule GTM standby promotion uses,
+gtm/standby.py).
+
+Sync mode (the default, reference synchronous_commit=on under sync
+standby): a failed ship raises out of Wal.append, so a commit is never
+ACKNOWLEDGED that the standby hasn't durably received.  As in the
+reference (syncrep.c waits after the local flush), the record is
+locally durable before the ship — a crash may therefore recover an
+unacknowledged transaction; acknowledged ones exist on both sides.
+Async mode keeps serving and flags `standby_ok` False.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Optional
+
+from ..net.wire import recv_msg, send_msg
+
+
+class DnStandby:
+    """Receives a primary's WAL stream + checkpoint artifacts into its
+    own data directory.  `promote()` hands the directory to a normal
+    recovery (DataNode.recover / LocalNode._recover replays it)."""
+
+    def __init__(self, datadir: str):
+        self.datadir = datadir
+        os.makedirs(datadir, exist_ok=True)
+        self._wal = open(os.path.join(datadir, "wal.log"), "ab")
+        self._lock = threading.Lock()
+        self.records = 0
+
+    def apply_wal(self, frame: bytes) -> None:
+        """One framed (length+crc+blob) WAL record, verbatim."""
+        with self._lock:
+            self._wal.write(frame)
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+            self.records += 1
+
+    def apply_checkpoint(self, files: dict[str, bytes]) -> None:
+        """Checkpoint artifacts (table .ckpt npz files, catalog.json,
+        meta.json) + WAL truncation — mirrors the primary's state at its
+        checkpoint exactly."""
+        with self._lock:
+            for name, blob in files.items():
+                safe = os.path.basename(name)
+                tmp = os.path.join(self.datadir, safe + ".tmp")
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, os.path.join(self.datadir, safe))
+            self._wal.close()
+            self._wal = open(os.path.join(self.datadir, "wal.log"), "wb")
+            self._wal.flush()
+
+    def close(self):
+        with self._lock:
+            self._wal.close()
+
+
+class DnStandbyServer:
+    """TCP front end for a DnStandby (the walreceiver process)."""
+
+    def __init__(self, standby: DnStandby, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.standby = standby
+        sb = standby
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        msg = recv_msg(self.request)
+                    except (ConnectionError, EOFError):
+                        return
+                    if msg is None:
+                        return
+                    op = msg.get("op")
+                    try:
+                        if op == "wal":
+                            sb.apply_wal(msg["frame"])
+                            resp = {"ok": True, "records": sb.records}
+                        elif op == "checkpoint":
+                            sb.apply_checkpoint(msg["files"])
+                            resp = {"ok": True}
+                        elif op == "ping":
+                            resp = {"pong": True, "records": sb.records}
+                        else:
+                            resp = {"error": f"unknown op {op!r}"}
+                    except Exception as e:
+                        resp = {"error": str(e)}
+                    send_msg(self.request, resp)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class WalShip:
+    """Primary-side shipping hooks: `frame(bytes)` per WAL record and
+    `checkpoint(files)` per checkpoint.  One persistent connection,
+    synchronous acks."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.addr = (host, port)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self.addr, timeout=self.timeout)
+        return self._sock
+
+    def _call(self, msg: dict) -> None:
+        with self._lock:
+            try:
+                s = self._conn()
+                send_msg(s, msg)
+                resp = recv_msg(s)
+            except (ConnectionError, OSError):
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                finally:
+                    self._sock = None
+                raise
+            if resp is None or not resp.get("ok"):
+                raise ConnectionError(f"standby rejected: {resp}")
+
+    def frame(self, frame: bytes) -> None:
+        self._call({"op": "wal", "frame": frame})
+
+    def checkpoint(self, files: dict[str, bytes]) -> None:
+        self._call({"op": "checkpoint", "files": files})
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+
+def checkpoint_files(datadir: str) -> dict[str, bytes]:
+    """The artifacts a checkpoint must ship: every table snapshot plus
+    catalog/meta (the pg_basebackup-lite set for this engine)."""
+    out = {}
+    for name in os.listdir(datadir):
+        if name.endswith(".ckpt") or name in ("catalog.json",
+                                              "meta.json"):
+            with open(os.path.join(datadir, name), "rb") as f:
+                out[name] = f.read()
+    return out

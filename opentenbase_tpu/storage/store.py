@@ -147,6 +147,11 @@ class TableStore:
         # ANN indexes over VECTOR columns: col -> {"centroids", "metric",
         # "nprobe", "_assign_cache"} (contrib/pgvector IVFFlat analog)
         self.ann_indexes: dict[str, dict] = {}
+        # btree-equivalent indexes: col -> {"keys": sorted values,
+        # "pos": live-row positions, "version": built-at store version}
+        # (reference: nbtree — here a sorted array + binary search, the
+        # pointer-free TPU-era shape of the same idea)
+        self.btree_indexes: dict[str, dict] = {}
 
     # ------------------------------------------------------------------
     def row_count(self) -> int:
@@ -405,6 +410,145 @@ class TableStore:
         self.ann_indexes[col] = {"centroids": centroids, "metric": metric,
                                  "nprobe": nprobe}
         return lists
+
+    def build_hnsw_index(self, col: str, m: int = 16,
+                         ef_construction: int = 64,
+                         metric: str = "l2") -> int:
+        """HNSW graph over a VECTOR column (contrib/pgvector hnsw.c
+        analog; ops/hnsw.py).  Rebuilt lazily when the store version
+        moves (pgvector inserts incrementally; bulk rebuild first)."""
+        cd = self.td.column(col)
+        if cd.type.kind != TypeKind.VECTOR:
+            raise ValueError(
+                f"hnsw index requires a vector column, {col!r} is "
+                f"{cd.type}")
+        from ..ops import hnsw as H
+        parts = [ch.columns[col][:ch.nrows] for _, ch in
+                 self.scan_chunks()]
+        vecs = np.concatenate(parts) if parts else \
+            np.zeros((0, cd.type.dim), np.float32)
+        self.ann_indexes[col] = {
+            "kind": "hnsw", "metric": metric, "m": m,
+            "ef_construction": ef_construction,
+            "index": H.build(vecs.astype(np.float32), metric, m,
+                             ef_construction),
+            "version": self.version,
+        }
+        return len(vecs)
+
+    def hnsw_index(self, col: str):
+        """Current HNSW index for a column (rebuilding on staleness),
+        or None."""
+        info = self.ann_indexes.get(col)
+        if info is None or info.get("kind") != "hnsw":
+            return None
+        if info.get("version") != self.version:
+            self.build_hnsw_index(col, info["m"],
+                                  info["ef_construction"],
+                                  info["metric"])
+            info = self.ann_indexes[col]
+        return info
+
+    def build_btree_index(self, col: str) -> int:
+        """(Re)build the sorted index over one column.  Positions address
+        the live-row concatenation order scans use.  Rebuilds are lazy:
+        lookups rebuild when the store version moved (write-heavy
+        workloads amortize; incremental maintenance is a follow-up —
+        reference nbtree inserts keys per tuple)."""
+        cd = self.td.column(col)
+        if cd.type.kind == TypeKind.VECTOR:
+            raise ValueError("btree index unsupported on vector columns")
+        parts = [ch.columns[col][:ch.nrows] for _, ch in
+                 self.scan_chunks()]
+        arr = np.concatenate(parts) if parts else \
+            np.empty(0, cd.type.np_dtype)
+        order = np.argsort(arr, kind="stable")
+        self.btree_indexes[col] = {
+            "keys": np.ascontiguousarray(arr[order]),
+            "pos": order.astype(np.int64),
+            "version": self.version,
+        }
+        return len(arr)
+
+    def btree_lookup(self, col: str, lo=None, hi=None,
+                     lo_strict: bool = False,
+                     hi_strict: bool = False) -> Optional[np.ndarray]:
+        """Live-row positions whose `col` value is within [lo, hi]
+        (bounds optional, strictness per side); None when no index."""
+        idx = self.btree_indexes.get(col)
+        if idx is None:
+            return None
+        if idx["version"] != self.version:
+            self.build_btree_index(col)
+            idx = self.btree_indexes[col]
+        keys = idx["keys"]
+        a = 0 if lo is None else int(np.searchsorted(
+            keys, lo, side="right" if lo_strict else "left"))
+        b = len(keys) if hi is None else int(np.searchsorted(
+            keys, hi, side="left" if hi_strict else "right"))
+        return np.sort(idx["pos"][a:b])
+
+    def host_live_columns(self, colnames) -> dict[str, np.ndarray]:
+        """Live-row concatenation (scan order) of the given value
+        columns plus MVCC sys columns and null masks — the ONE host
+        source the staging tiers (spill slabs/partitions, mesh sharding,
+        index-scan subsets) slice from."""
+        want = set(colnames)
+        nullcols = {c for c in want if c in self.null_columns}
+        host: dict[str, np.ndarray] = {}
+        chunks = list(self.scan_chunks())
+        for name in want:
+            cd = self.td.column(name)
+            arrs = [ch.columns[name][:ch.nrows] for _, ch in chunks]
+            host[name] = np.concatenate(arrs) if arrs else \
+                np.empty((0, *cd.type.shape_suffix), cd.type.np_dtype)
+        for sys in ("xmin_ts", "xmax_ts", "xmin_txid", "xmax_txid"):
+            arrs = [getattr(ch, sys)[:ch.nrows] for _, ch in chunks]
+            host[f"__{sys}"] = np.concatenate(arrs) if arrs else \
+                np.empty(0, np.int64)
+        for name in nullcols:
+            arrs = [ch.nulls[name][:ch.nrows] if name in ch.nulls
+                    else np.zeros(ch.nrows, bool) for _, ch in chunks]
+            host[f"__null.{name}"] = np.concatenate(arrs) if arrs else \
+                np.zeros(0, bool)
+        return host
+
+    def gather_rows(self, positions: np.ndarray,
+                    colnames) -> dict[str, np.ndarray]:
+        """Host gather of specific live rows (positions in scan
+        concatenation order) — O(k + chunks), the index-scan staging
+        path.  Returns value columns + MVCC sys columns + null masks."""
+        chunks = [ch for _, ch in self.scan_chunks()]
+        starts = np.cumsum([0] + [ch.nrows for ch in chunks])
+        ci = np.searchsorted(starts, positions, side="right") - 1
+        off = positions - starts[ci]
+        out: dict[str, np.ndarray] = {}
+        names = list(colnames)
+        nullcols = [c for c in names if c in self.null_columns]
+        k = len(positions)
+        for name in names:
+            cd = self.td.column(name)
+            buf = np.empty((k, *cd.type.shape_suffix), cd.type.np_dtype)
+            for i, ch in enumerate(chunks):
+                m = ci == i
+                if m.any():
+                    buf[m] = ch.columns[name][off[m]]
+            out[name] = buf
+        for sys in ("xmin_ts", "xmax_ts", "xmin_txid", "xmax_txid"):
+            buf = np.empty(k, np.int64)
+            for i, ch in enumerate(chunks):
+                m = ci == i
+                if m.any():
+                    buf[m] = getattr(ch, sys)[off[m]]
+            out[f"__{sys}"] = buf
+        for name in nullcols:
+            buf = np.zeros(k, bool)
+            for i, ch in enumerate(chunks):
+                m = ci == i
+                if m.any() and name in ch.nulls:
+                    buf[m] = ch.nulls[name][off[m]]
+            out[f"__null.{name}"] = buf
+        return out
 
     def visible_mask(self, ch: Chunk, snap_ts: int, my_txid: int) -> np.ndarray:
         """Host-side reference implementation of the visibility rule; the

@@ -23,17 +23,38 @@ _HDR = struct.Struct("<II")  # length, crc32
 
 
 class Wal:
-    def __init__(self, path: str):
+    def __init__(self, path: str, ship=None, sync_ship: bool = True):
+        """``ship``: optional hook receiving every framed record as raw
+        bytes (streaming replication to a DnStandby,
+        storage/replication.py).  Sync mode propagates ship failures so
+        the statement is never ACKNOWLEDGED unless the standby durably
+        took the record.  As in the reference (synchronous_commit waits
+        AFTER the local WAL flush, syncrep.c), the record is already
+        locally durable at that point: a crash may recover an
+        UNACKNOWLEDGED transaction as committed — acknowledged ones are
+        always on both sides.  Async mode keeps serving and flags
+        ``standby_ok``."""
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "ab")
+        self._ship = ship
+        self._sync_ship = sync_ship
+        self.standby_ok = ship is not None
 
     def append(self, record: dict, sync: bool = False):
         blob = pickle.dumps(record, protocol=4)
-        self._f.write(_HDR.pack(len(blob), zlib.crc32(blob)))
-        self._f.write(blob)
+        frame = _HDR.pack(len(blob), zlib.crc32(blob)) + blob
+        self._f.write(frame)
         if sync:
             self.flush(fsync=True)
+        if self._ship is not None:
+            try:
+                self._ship(frame)
+                self.standby_ok = True
+            except Exception:
+                self.standby_ok = False
+                if self._sync_ship:
+                    raise
 
     def flush(self, fsync: bool = False):
         self._f.flush()

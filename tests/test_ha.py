@@ -71,3 +71,88 @@ class TestGtmStandby:
     def test_promote_without_state_refuses(self):
         with pytest.raises(RuntimeError, match="no shipped state"):
             GtmStandby().promote()
+
+
+class TestDnReplication:
+    """Datanode WAL shipping + kill/failover (reference:
+    walsender/walreceiver + opentenbase_test/t/example/demo_kill.test)."""
+
+    def _cluster(self, tmp_path, n=3):
+        from opentenbase_tpu.exec.dist_session import ClusterSession
+        from opentenbase_tpu.parallel.cluster import Cluster
+        cl = Cluster(n_datanodes=n, datadir=str(tmp_path / "cl"))
+        s = ClusterSession(cl)
+        s.execute("create table t (k bigint primary key, v decimal(10,2))"
+                  " distribute by shard(k)")
+        s.execute("insert into t values " + ", ".join(
+            f"({i}, {i}.5)" for i in range(30)))
+        return s
+
+    def test_kill_and_promote_no_committed_loss(self, tmp_path):
+        from opentenbase_tpu.exec.dist_session import ClusterSession
+        from opentenbase_tpu.storage.replication import (DnStandby,
+                                                         DnStandbyServer)
+        s = self._cluster(tmp_path)
+        cl = s.cluster
+        sb = DnStandby(str(tmp_path / "standby0"))
+        srv = DnStandbyServer(sb).start()
+        try:
+            # attach mid-life: base backup + stream from here on
+            cl.datanodes[0].attach_standby(srv.host, srv.port)
+            s.execute("insert into t values " + ", ".join(
+                f"({i}, {i}.5)" for i in range(100, 140)))
+            s.execute("delete from t where k = 5")
+            before = s.query("select count(*), sum(v) from t")
+            # "kill" dn0: drop the object, promote the shipped directory
+            cl.datanodes[0].wal.close()
+            cl.promote_standby(0, sb.datadir)
+            s2 = ClusterSession(cl)
+            assert s2.query("select count(*), sum(v) from t") == before
+            assert s2.query("select v from t where k = 5") == []
+            # the promoted node serves writes
+            s2.execute("insert into t values (999, 1.00)")
+            assert s2.query("select v from t where k = 999") == [(1.0,)]
+        finally:
+            srv.stop()
+
+    def test_checkpoint_ships_and_standby_survives(self, tmp_path):
+        from opentenbase_tpu.exec.dist_session import ClusterSession
+        from opentenbase_tpu.storage.replication import (DnStandby,
+                                                         DnStandbyServer)
+        s = self._cluster(tmp_path)
+        cl = s.cluster
+        sb = DnStandby(str(tmp_path / "standby0"))
+        srv = DnStandbyServer(sb).start()
+        try:
+            cl.datanodes[0].attach_standby(srv.host, srv.port)
+            s.execute("insert into t values (200, 2.0), (201, 3.0)")
+            assert cl.checkpoint() is True   # truncates + ships snapshot
+            s.execute("insert into t values (202, 4.0)")
+            before = s.query("select count(*) from t")
+            cl.promote_standby(0, sb.datadir)
+            s2 = ClusterSession(cl)
+            assert s2.query("select count(*) from t") == before
+        finally:
+            srv.stop()
+
+    def test_sync_ship_failure_blocks_writes(self, tmp_path):
+        from opentenbase_tpu.exec.executor import ExecError
+        from opentenbase_tpu.storage.replication import (DnStandby,
+                                                         DnStandbyServer)
+        s = self._cluster(tmp_path)
+        cl = s.cluster
+        sb = DnStandby(str(tmp_path / "standby0"))
+        srv = DnStandbyServer(sb).start()
+        cl.datanodes[0].attach_standby(srv.host, srv.port)
+
+        def boom(frame):
+            raise RuntimeError("standby disk full")
+
+        sb.apply_wal = boom  # standby stops taking WAL
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                # a write touching dn0 cannot commit without the standby
+                for i in range(300, 340):
+                    s.execute(f"insert into t values ({i}, 1.0)")
+        finally:
+            srv.stop()

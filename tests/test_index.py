@@ -1,0 +1,105 @@
+"""Btree-equivalent index tier: sorted arrays + binary search feeding
+subset-staged scans (reference: nbtree/nbtsearch.c + ExecIndexScan).
+Global secondary indexes remain a fan-out of per-shard local indexes
+(design note in PARITY.md)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from opentenbase_tpu.exec.dist_session import ClusterSession
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.parallel.cluster import Cluster
+
+N = 60000
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session(LocalNode())
+    s.execute("create table big (id bigint, grp bigint, amt decimal(8,2))")
+    rng = np.random.default_rng(5)
+    ids = rng.permutation(N).astype(np.int64)
+    s._insert_rows(s.node.catalog.table("big"), s.node.stores["big"],
+                   {"id": ids, "grp": ids % 50,
+                    "amt": (ids % 1000).astype(float)}, N)
+    s.execute("create index big_id on big (id)")
+    return s
+
+
+class TestIndexScan:
+    def test_plan_uses_index(self, sess):
+        txt = sess.execute("explain select grp from big "
+                           "where id = 7")[0].text
+        assert "IndexScan" in txt and "key=id" in txt
+
+    def test_point_lookup(self, sess):
+        assert sess.query("select grp from big where id = 777") == \
+            [(777 % 50,)]
+
+    def test_range_lookup(self, sess):
+        got = sess.query("select count(*), min(id), max(id) from big "
+                         "where id >= 100 and id < 200")
+        assert got == [(100, 100, 199)]
+
+    def test_strict_bounds(self, sess):
+        got = sess.query("select count(*) from big "
+                         "where id > 100 and id <= 200")
+        assert got == [(100,)]
+
+    def test_residual_filter_reverifies(self, sess):
+        got = sess.query("select count(*) from big "
+                         "where id < 100 and grp = 1")
+        assert got == [(2,)]  # ids 1 and 51
+
+    def test_index_sees_new_rows(self, sess):
+        sess.execute("insert into big values (9000001, 3, 1.5)")
+        assert sess.query("select grp from big where id = 9000001") == \
+            [(3,)]
+        sess.execute("delete from big where id = 9000001")
+        assert sess.query("select grp from big where id = 9000001") == []
+
+    def test_update_through_index(self, sess):
+        sess.execute("update big set amt = 42.42 where id = 888")
+        assert sess.query("select amt from big where id = 888") == \
+            [(42.42,)]
+
+    def test_index_lookup_beats_seqscan(self, sess):
+        sess.query("select grp from big where id = 1")  # warm
+        t0 = time.perf_counter()
+        for i in range(10):
+            sess.query(f"select grp from big where id = {i}")
+        idx_t = time.perf_counter() - t0
+        saved = dict(sess.node.catalog.btree_cols)
+        sess.node.catalog.btree_cols.clear()
+        try:
+            sess.query("select grp from big where id = 1")
+            t0 = time.perf_counter()
+            for i in range(10):
+                sess.query(f"select grp from big where id = {i}")
+            seq_t = time.perf_counter() - t0
+        finally:
+            sess.node.catalog.btree_cols.update(saved)
+        assert idx_t * 2 < seq_t, (idx_t, seq_t)
+
+
+class TestDistributedIndex:
+    def test_point_on_non_dist_key(self, tmp_path):
+        # the VERDICT scenario: point SELECT on a NON-distribution key
+        # hits each DN's local index instead of full scans
+        cs = ClusterSession(Cluster(n_datanodes=3,
+                                    datadir=str(tmp_path / "cl")))
+        cs.execute("create table o (okey bigint primary key, "
+                   "cust bigint, amt decimal(8,2)) "
+                   "distribute by shard(okey)")
+        rows = ", ".join(f"({i}, {i % 97}, {i}.25)" for i in range(500))
+        cs.execute(f"insert into o values {rows}")
+        cs.execute("create index o_cust on o (cust)")
+        got = cs.query("select count(*) from o where cust = 11")
+        assert got == [(len([i for i in range(500) if i % 97 == 11]),)]
+        # restart keeps the registry (catalog persistence)
+        cs2 = ClusterSession(Cluster(datadir=str(tmp_path / "cl")))
+        txt = cs2.execute("explain select amt from o "
+                          "where cust = 11")[0].text
+        assert "IndexScan" in txt

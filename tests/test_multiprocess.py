@@ -72,6 +72,47 @@ class TestTcpCluster:
         ts = [c.next_gts() for _ in range(10)]
         assert ts == sorted(ts) and len(set(ts)) == 10
 
+    def test_concurrent_fragment_dispatch(self):
+        """Fragment fan-out must overlap datanodes: wall-clock ≈
+        max(DN), not sum(DN) (reference: RunRemoteController)."""
+        import time
+
+        from opentenbase_tpu.exec.dist import DistExecutor
+        from opentenbase_tpu.plan.distribute import (DistPlan, Exchange,
+                                                     ExchangeRef,
+                                                     Fragment)
+
+        DELAY = 0.25
+
+        class SlowRemote:                 # no .stores => remote-shaped
+            def __init__(self, index):
+                self.index = index
+
+            def exec_plan(self, plan, snapshot_ts, txid, params,
+                          sources):
+                time.sleep(DELAY)
+                from opentenbase_tpu.exec.dist import HostBatch
+                import numpy as np
+                from opentenbase_tpu.catalog import types as T
+                return HostBatch({"x": np.asarray([self.index])},
+                                 {"x": T.INT64}, 1)
+
+        class FakeCluster:
+            datanodes = [SlowRemote(i) for i in range(3)]
+            ndn = 3
+
+        ex = DistExecutor(FakeCluster(), 10**15, 1)
+        frag = Fragment(0, ExchangeRef(99), "dn")  # plan is unused
+        dp = DistPlan([frag], [Exchange(0, "gather", [], 0)], 0, [], [])
+        t0 = time.perf_counter()
+        out: dict = {}
+        ex._feed_exchanges(frag, dp, out)
+        elapsed = time.perf_counter() - t0
+        assert (0, "cn") in out and out[(0, "cn")].nrows == 3
+        # sequential would take 3*DELAY; concurrent ≈ DELAY
+        assert elapsed < 2 * DELAY, \
+            f"dispatch not concurrent: {elapsed:.2f}s for 3x{DELAY}s"
+
     def test_dn_restart_recovers_over_tcp(self, tcp_cluster, tmp_path):
         s, servers, gtm, d = tcp_cluster
         s.execute("create table t3 (k bigint primary key, "
