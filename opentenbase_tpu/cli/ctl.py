@@ -47,25 +47,92 @@ def cmd_start(args):
     print(f"gtm listening on {gtm.host}:{gtm.port}")
     catalog_path = os.path.join(args.dir, "catalog.json")
     servers = []
+    factories = []
+
+    def make_factory(i):
+        def factory():
+            return DnServer(i, os.path.join(args.dir, f"dn{i}"),
+                            catalog_path, gtm_addr=(gtm.host, gtm.port),
+                            port=cfg["dn_base_port"] + i).start()
+        return factory
+
     for i in range(cfg["datanodes"]):
-        srv = DnServer(i, os.path.join(args.dir, f"dn{i}"), catalog_path,
-                       gtm_addr=(gtm.host, gtm.port),
-                       port=cfg["dn_base_port"] + i).start()
+        factories.append(make_factory(i))
+        srv = factories[i]()
         servers.append(srv)
         print(f"dn{i} listening on {srv.host}:{srv.port}")
     addrs = {"gtm": [gtm.host, gtm.port],
              "datanodes": [[s.host, s.port] for s in servers]}
     with open(os.path.join(args.dir, "addresses.json"), "w") as f:
         json.dump(addrs, f)
-    print("cluster up; ^C to stop")
+    print("cluster up (supervised); ^C to stop")
     try:
-        import time
-        while True:
-            time.sleep(3600)
+        Supervisor(servers, factories).run(interval=5.0)
     except KeyboardInterrupt:
         for s in servers:
             s.stop()
         gtm.stop()
+
+
+class Supervisor:
+    """Datanode watchdog: ping each server, restart dead ones from
+    their data directories (reference: the postmaster restarting dead
+    children, postmaster.c, + the cluster monitor's health map,
+    nodemgr.c:1122 PgxcNodeGetHealthMap)."""
+
+    def __init__(self, servers: list, factories: list):
+        self.servers = servers          # mutated in place on restart
+        self.factories = factories      # index -> () -> started server
+
+    def _alive(self, i: int) -> bool:
+        """Fresh connection per probe, closed afterwards: liveness means
+        'the acceptor answers NOW' — a pooled socket can outlive a dead
+        listener and mask the failure."""
+        from ..net.dn_server import RemoteDataNode
+        srv = self.servers[i]
+        proxy = None
+        try:
+            proxy = RemoteDataNode(i, srv.host, srv.port)
+            return proxy.ping()
+        except Exception:
+            return False
+        finally:
+            if proxy is not None:
+                try:
+                    proxy.close()
+                except Exception:
+                    pass
+
+    def check_once(self) -> list[int]:
+        """Ping every datanode; recreate the dead ones (recovery replays
+        their WAL).  Returns the restarted indexes.  A failed restart is
+        logged and retried next tick — one sick node must not kill the
+        watchdog (the postmaster keeps supervising too)."""
+        restarted = []
+        for i in range(len(self.servers)):
+            if self._alive(i):
+                continue
+            try:
+                self.servers[i].stop()
+            except Exception:
+                pass
+            try:
+                self.servers[i] = self.factories[i]()
+            except Exception as e:
+                print(f"supervisor: dn{i} restart failed "
+                      f"({type(e).__name__}: {e}); retrying next tick")
+                continue
+            restarted.append(i)
+        return restarted
+
+    def run(self, interval: float = 5.0):
+        import time
+        while True:
+            time.sleep(interval)
+            for i in self.check_once():
+                srv = self.servers[i]
+                print(f"supervisor: restarted dn{i} on "
+                      f"{srv.host}:{srv.port}")
 
 
 def _connect(args):

@@ -719,16 +719,21 @@ class Executor:
             arr = arr.astype(jnp.int64)
             if nm is not None:
                 arr = jnp.where(nm, 0, arr)
+            d = _dict_for_expr(ke, b.dicts)
+            if d is not None and len(set(d)) < len(d):
+                # a transformed dictionary (substring etc.) can map
+                # several codes to one string: canonicalize codes
+                # sharing a string BEFORE grouping, so groups never
+                # over-split (canonical codes still decode correctly)
+                canon: dict = {}
+                lut = np.empty(max(len(d), 1), np.int64)
+                for ci, v in enumerate(d):
+                    lut[ci] = canon.setdefault(v, ci)
+                arr = jnp.asarray(lut)[jnp.clip(arr, 0, len(d) - 1)]
             key_arrs.append(arr)
             key_nulls.append(nm)
             key_types.append(ke.type)
-            d = _dict_for_expr(ke, b.dicts)
             key_dicts.append(d)
-            # a transformed dictionary (substring etc.) can map several
-            # codes to one string: groups on codes over-split and must be
-            # re-merged after decode
-            if d is not None and len(set(d)) < len(d):
-                dup_dicts = True
         return key_arrs, key_types, key_dicts, dup_dicts, key_nulls
 
     @staticmethod
@@ -922,11 +927,6 @@ class Executor:
         out = self._assemble_agg_output(node, gkey_out, key_types,
                                         key_dicts, outs, out_specs,
                                         out_valid, gkey_nulls)
-        # partial mode skips the re-merge: the exchange decodes transformed
-        # dictionaries to strings and re-encodes uniquely, so the final agg
-        # merges over-split groups by itself
-        if text_transformed and node.mode == "single":
-            out = self._remerge_text_groups(node, out)
         return out
 
     def _exec_agg_final(self, node: P.Agg, b: DBatch) -> DBatch:
@@ -967,100 +967,140 @@ class Executor:
 
     def _exec_distinct_agg(self, node: P.Agg, b: DBatch, key_arrs,
                            key_types, key_dicts, key_nulls) -> DBatch:
-        """count(DISTINCT x): dedupe on (group keys, x) then count per
-        group — the reference handles this via sorted Agg transition
-        (nodeAgg.c DISTINCT path); here two sort-based passes.  NULL
-        arguments are skipped (count never counts NULL)."""
-        if len(node.aggs) != 1 or node.aggs[0][1].func != "count":
-            raise ExecError("only a single count(DISTINCT x) aggregate "
-                            "is supported")
-        name, ac = node.aggs[0]
-        arg_arr, arg_null = self._eval_pair(ac.arg, b)
-        arg_arr = arg_arr.astype(jnp.int64)
-        valid0 = b.valid if arg_null is None else (b.valid & ~arg_null)
-        n = b.padded
-        max_g1 = next_pow2(max(b.count(), 1))
-        nkeys1 = self._grouping_arrays(key_arrs, key_nulls) + (arg_arr,)
-        gkeys1, _, ng1 = K.grouped_agg_sort(
-            nkeys1, valid0, (valid0.astype(jnp.int64),), max_g1, ("count",))
-        ng1 = int(ng1)
-        valid1 = jnp.arange(max_g1) < ng1
-        max_g2 = next_pow2(max(ng1, 1))
-        n_gkeys = len(nkeys1) - 1
-        gkeys2, (cnt,), ng2 = K.grouped_agg_sort(
-            tuple(gkeys1[:n_gkeys]) if key_arrs else
-            (jnp.zeros(max_g1, jnp.int64),),
-            valid1, (valid1.astype(jnp.int64),), max_g2, ("count",))
-        ng2 = int(ng2)
-        cols, types, dicts, nulls = {}, {}, {}, {}
-        extra = list(gkeys2[len(key_arrs):n_gkeys])
-        for i, ((kname, _), karr, kt, kd) in enumerate(
-                zip(node.group_keys, gkeys2, key_types, key_dicts)):
-            cols[kname] = karr[:max_g2].astype(kt.np_dtype)
-            types[kname] = kt
-            if kd is not None:
-                dicts[kname] = kd
-            if key_nulls[i] is not None:
-                nulls[kname] = extra.pop(0).astype(bool)
-        cols[name] = cnt
-        types[name] = T.INT64
-        out_valid = jnp.arange(max_g2) < (ng2 if key_arrs else 1)
-        return DBatch(cols, out_valid, types, dicts, nulls)
+        """DISTINCT aggregates — count/sum/avg/min/max(DISTINCT x), any
+        number, freely mixed with plain aggregates (reference: the
+        sorted Agg transition, nodeAgg.c DISTINCT path).  Each DISTINCT
+        aggregate runs dedupe-then-reduce (two sorted passes); plain
+        aggregates run one pass.  Every pass groups on the SAME key
+        columns with the same validity, so group ordering is identical
+        and per-pass outputs align positionally."""
+        gkeys_full = self._grouping_arrays(key_arrs, key_nulls)
+        max_g = next_pow2(max(b.count(), 1))
+        n_gk = len(gkeys_full)
 
-    def _remerge_text_groups(self, node: P.Agg, b: DBatch) -> DBatch:
-        """Group keys built from transformed dictionaries (substring) may
-        map many codes to one string: decode and re-aggregate host-side
-        (cheap: operates on groups, not rows)."""
-        valid = np.asarray(b.valid)
-        merged: dict[tuple, list] = {}
-        key_names = [n for n, _ in node.group_keys]
-        agg_names = [n for n, _ in node.aggs]
-        host = {n: np.asarray(a) for n, a in b.cols.items()}
-        for i in np.nonzero(valid)[0]:
-            key = tuple(
-                b.dicts[kn][int(host[kn][i])] if kn in b.dicts
-                else host[kn][i].item() for kn in key_names)
-            acc = merged.get(key)
-            if acc is None:
-                merged[key] = [host[an][i].item() for an in agg_names]
+        out_cols: dict = {}
+        out_types: dict = {}
+        out_nulls: dict = {}
+        base = None
+
+        def knulls_from(gkeys_out):
+            extra = list(gkeys_out[len(key_arrs):n_gk])
+            return [extra.pop(0).astype(bool) if nm is not None else None
+                    for nm in key_nulls]
+
+        plain = [(n_, ac) for n_, ac in node.aggs if not ac.distinct]
+        if plain:
+            pseudo = dataclasses.replace(node, aggs=plain)
+            kinds, inputs, out_specs = self._agg_inputs(pseudo, b,
+                                                        final=False)
+            gkeys_p, outs, ng = K.grouped_agg_sort(
+                gkeys_full or (jnp.zeros(b.padded, jnp.int64),),
+                b.valid, tuple(inputs), max_g, tuple(kinds))
+            pb = self._assemble_agg_output(
+                pseudo, list(gkeys_p[:len(key_arrs)]), key_types,
+                key_dicts, outs, out_specs,
+                jnp.arange(max_g) < (int(ng) if key_arrs else 1),
+                knulls_from(gkeys_p))
+            base = pb
+            for n_, _ac in plain:
+                out_cols[n_] = pb.cols[n_]
+                out_types[n_] = pb.types[n_]
+                if n_ in pb.nulls:
+                    out_nulls[n_] = pb.nulls[n_]
+
+        for name, ac in node.aggs:
+            if not ac.distinct:
+                continue
+            arg_arr, arg_null = self._eval_pair(ac.arg, b)
+            is_float = jnp.issubdtype(arg_arr.dtype, jnp.floating)
+            if is_float:
+                # -0.0 == +0.0 in SQL: normalize before the bit-pattern
+                # dedupe
+                f64 = arg_arr.astype(jnp.float64)
+                f64 = jnp.where(f64 == 0.0, 0.0, f64)
+                enc = jax.lax.bitcast_convert_type(f64, jnp.int64)
             else:
-                for j, (an, (_, ac)) in enumerate(
-                        zip(agg_names, node.aggs)):
-                    v = host[an][i].item()
-                    if ac.func in ("sum", "count"):
-                        acc[j] += v
-                    elif ac.func == "min":
-                        acc[j] = min(acc[j], v)
-                    elif ac.func == "max":
-                        acc[j] = max(acc[j], v)
-                    else:
-                        raise ExecError("avg through text re-merge "
-                                        "unsupported; decompose first")
-        # rebuild
-        ng = len(merged)
-        padded = next_pow2(max(ng, 1))
-        cols = {}
-        new_dicts = {}
-        keys_list = list(merged.keys())
-        for ki, kn in enumerate(key_names):
-            if kn in b.dicts:
-                vals = [k[ki] for k in keys_list]
-                uniq = sorted(set(vals))
-                lut = {v: i for i, v in enumerate(uniq)}
-                arr = np.zeros(padded, np.int32)
-                arr[:ng] = [lut[v] for v in vals]
-                cols[kn] = jnp.asarray(arr)
-                new_dicts[kn] = uniq
+                enc = arg_arr.astype(jnp.int64)
+            nn = jnp.zeros(b.padded, bool) if arg_null is None \
+                else arg_null
+            # pass 1: dedupe (group keys, value, value-null); null rows
+            # KEEP their group alive so passes stay aligned
+            enc = jnp.where(nn, 0, enc)
+            keys1 = gkeys_full + (enc, nn.astype(jnp.int64))
+            g1_pad = next_pow2(max(b.count(), 1))
+            gkeys1, _, ng1 = K.grouped_agg_sort(
+                keys1, b.valid, (b.valid.astype(jnp.int64),), g1_pad,
+                ("count",))
+            valid1 = jnp.arange(g1_pad) < ng1
+            dval = gkeys1[n_gk]
+            dnull = gkeys1[n_gk + 1].astype(bool)
+            contrib = valid1 & ~dnull
+            if is_float:
+                fval = jax.lax.bitcast_convert_type(dval, jnp.float64)
             else:
-                arr = np.zeros(padded, b.types[kn].np_dtype)
-                arr[:ng] = [k[ki] for k in keys_list]
-                cols[kn] = jnp.asarray(arr)
-        for j, an in enumerate(agg_names):
-            arr = np.zeros(padded, b.types[an].np_dtype)
-            arr[:ng] = [merged[k][j] for k in keys_list]
-            cols[an] = jnp.asarray(arr)
-        valid = jnp.asarray(np.arange(padded) < ng)
-        return DBatch(cols, valid, b.types, new_dicts)
+                fval = dval
+            # pass 2: reduce the deduped values per group
+            if ac.func == "count":
+                kinds2 = ("sum",)
+                ins2 = (contrib.astype(jnp.int64),)
+            elif ac.func in ("sum", "avg"):
+                v = jnp.where(contrib, fval,
+                              jnp.zeros((), fval.dtype))
+                kinds2 = ("sumf" if (is_float or ac.func == "avg")
+                          else "sum", "sum")
+                ins2 = (v.astype(jnp.float64) if ac.func == "avg"
+                        else v, contrib.astype(jnp.int64))
+            elif ac.func in ("min", "max"):
+                if is_float:
+                    neutral = np.inf if ac.func == "min" else -np.inf
+                else:
+                    info = jnp.iinfo(jnp.int64)
+                    neutral = info.max if ac.func == "min" else info.min
+                kinds2 = (ac.func, "sum")
+                ins2 = (jnp.where(contrib, fval,
+                                  jnp.asarray(neutral, fval.dtype)),
+                        contrib.astype(jnp.int64))
+            else:
+                raise ExecError(
+                    f"DISTINCT {ac.func} unsupported")
+            gkeys2, outs2, ng2 = K.grouped_agg_sort(
+                tuple(gkeys1[:n_gk]) if n_gk else
+                (jnp.zeros(g1_pad, jnp.int64),),
+                valid1, ins2, max_g, kinds2)
+            if base is None:
+                base = self._assemble_agg_output(
+                    dataclasses.replace(node, aggs=[]),
+                    list(gkeys2[:len(key_arrs)]), key_types, key_dicts,
+                    [], [],
+                    jnp.arange(max_g) < (int(ng2) if key_arrs else 1),
+                    knulls_from(gkeys2))
+            if ac.func == "count":
+                out_cols[name] = outs2[0]
+                out_types[name] = T.INT64
+            elif ac.func == "avg":
+                s, c = outs2
+                scale = ac.arg.type.scale \
+                    if ac.arg.type.kind == TypeKind.DECIMAL else 0
+                out_cols[name] = jnp.where(
+                    c > 0, s / jnp.maximum(c, 1) / 10 ** scale, 0.0)
+                out_types[name] = T.FLOAT64
+                out_nulls[name] = c == 0
+            else:
+                v, c = outs2
+                out_cols[name] = v
+                out_types[name] = ac.arg.type if ac.func != "count" \
+                    else T.INT64
+                out_nulls[name] = c == 0
+
+        cols = dict(base.cols)
+        types = dict(base.types)
+        nulls = dict(base.nulls)
+        for n_, a in out_cols.items():
+            cols[n_] = a
+            types[n_] = out_types[n_]
+        for n_, m in out_nulls.items():
+            nulls[n_] = m
+        return DBatch(cols, base.valid, types, base.dicts, nulls)
 
     # ---- window functions ----
     def _win_key(self, e: E.Expr, b: DBatch, for_order: bool):

@@ -88,8 +88,9 @@ def _find_scan(node) -> Optional[P.SeqScan]:
 
 def _has_transformed_dup_dict(node, store) -> bool:
     """True when a group key is a TextExpr whose transformed dictionary
-    maps several codes to one string — that path re-merges groups
-    host-side (executor._remerge_text_groups) and cannot trace."""
+    maps several codes to one string — key canonicalization builds a
+    host LUT per batch (executor._eval_group_keys), which is fine eager
+    but not worth special-casing under the trace: fall back."""
     for x in _walk_plan_exprs(node):
         if isinstance(x, E.TextExpr):
             base = store.dicts.get(x.col.name.split(".", 1)[-1])

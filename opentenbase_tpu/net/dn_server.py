@@ -50,8 +50,14 @@ class DnServer:
                     if msg is None:
                         return
                     try:
-                        with lock:
-                            resp = {"ok": _dispatch(node, msg)}
+                        if msg.get("op") == "ping":
+                            # liveness must not queue behind a long
+                            # query: the supervisor would mistake a busy
+                            # node for a dead one and restart it
+                            resp = {"ok": "pong"}
+                        else:
+                            with lock:
+                                resp = {"ok": _dispatch(node, msg)}
                     except Exception as e:
                         resp = {"error": f"{type(e).__name__}: {e}"}
                     send_msg(self.request, resp)

@@ -380,6 +380,27 @@ class TestSequences:
         assert vals == [5, 7, 9]
 
 
+class TestSizeClassBoundaries:
+    def test_exchange_crosses_size_classes(self, cs):
+        """Pad classes are pow2 with floor 256: grow a table through
+        the 256→512→1024 boundaries in waves, re-running a
+        redistribute-join + grouped agg at each size class (VERDICT r1:
+        no distributed test crossed a boundary under the SQL path)."""
+        cs.execute("create table u (uk bigint primary key, tk bigint, "
+                   "w decimal(10,2)) distribute by shard(uk)")
+        total = 0
+        for wave, count in enumerate((200, 400, 900)):
+            rows = ", ".join(
+                f"({total + i + 1000}, {(total + i) % 40}, 1.00)"
+                for i in range(count))
+            cs.execute(f"insert into u values {rows}")
+            total += count
+            got = cs.query("select count(*) from t, u where k = tk")
+            assert got == [(total,)], (wave, got)
+            got = cs.query("select sum(w), count(*) from u")
+            assert got == [(float(total), total)]
+
+
 class TestGtmPersistence:
     def test_txid_burst_never_reissued_after_restart(self, tmp_path):
         # a burst of txid-only allocations must extend the persisted

@@ -72,6 +72,38 @@ class TestTcpCluster:
         ts = [c.next_gts() for _ in range(10)]
         assert ts == sorted(ts) and len(set(ts)) == 10
 
+    def test_supervisor_restarts_dead_dn(self, tcp_cluster):
+        """The postmaster-restart analog: a dead DN server comes back
+        with its data (WAL recovery) on the same port."""
+        s, servers, gtm, d = tcp_cluster
+        from opentenbase_tpu.cli.ctl import Supervisor
+        s.execute("create table t (k bigint primary key, "
+                  "v decimal(10,2)) distribute by shard(k)")
+        s.execute("insert into t values " + ", ".join(
+            f"({i}, {i}.25)" for i in range(20)))
+        catalog_path = os.path.join(d, "catalog.json")
+
+        def make_factory(i, port):
+            def factory():
+                return DnServer(i, os.path.join(d, f"dn{i}"),
+                                catalog_path,
+                                gtm_addr=(gtm.host, gtm.port),
+                                port=port).start()
+            return factory
+
+        factories = [make_factory(i, srv.port)
+                     for i, srv in enumerate(servers)]
+        sup = Supervisor(servers, factories)
+        assert sup.check_once() == []       # all healthy: no restarts
+        servers[0].stop()                   # "kill" dn0
+        assert sup.check_once() == [0]      # detected + restarted
+        s2 = ClusterSession(Cluster.connect(
+            catalog_path, [(srv.host, srv.port) for srv in servers],
+            (gtm.host, gtm.port)))
+        assert s2.query("select count(*) from t") == [(20,)]
+        s2.execute("insert into t values (999, 1.00)")
+        assert s2.query("select v from t where k = 999") == [(1.0,)]
+
     def test_concurrent_fragment_dispatch(self):
         """Fragment fan-out must overlap datanodes: wall-clock ≈
         max(DN), not sum(DN) (reference: RunRemoteController)."""

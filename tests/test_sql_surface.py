@@ -189,6 +189,40 @@ class TestSetOps:
         assert got == [(5,), (6,), (7,), (8,), (9,)]
 
 
+class TestDistinctAggregates:
+    @pytest.fixture()
+    def ds(self):
+        s = Session(LocalNode())
+        s.execute("create table t (g varchar(2), x bigint, "
+                  "v decimal(6,1))")
+        s.execute("insert into t values ('a',1,10.0),('a',1,10.0),"
+                  "('a',2,20.0),('b',5,1.5),('b',5,2.5),"
+                  "('b',null,2.5),('a',2,null)")
+        return s
+
+    def test_mixed_plain_and_distinct(self, ds):
+        got = ds.query("select g, count(distinct x), count(*), sum(v), "
+                       "sum(distinct v), avg(distinct v), "
+                       "min(distinct x) from t group by g order by g")
+        assert got == [("a", 2, 4, 40.0, 30.0, 15.0, 1),
+                       ("b", 1, 3, 6.5, 4.0, 2.0, 5)]
+
+    def test_multiple_distinct_aggs_global(self, ds):
+        assert ds.query("select count(distinct g), count(distinct x) "
+                        "from t") == [(2, 3)]
+
+    def test_distinct_skips_nulls(self, ds):
+        assert ds.query("select count(distinct v) from t "
+                        "where v is null") == [(0,)]
+
+    def test_distinct_text(self, ds):
+        assert ds.query("select count(distinct g) from t") == [(2,)]
+
+    def test_distributed_mixed_distinct(self, cs):
+        got = cs.query("select count(distinct g), count(*) from t")
+        assert got == [(3, 30)]
+
+
 class TestRoutingCanonicalization:
     def test_decimal_dist_key_fqs_agrees_with_insert(self, cs):
         # insert routing and FQS point routing must hash the SAME
