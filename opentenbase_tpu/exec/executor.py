@@ -1137,6 +1137,7 @@ class Executor:
         iota = jnp.arange(n, dtype=jnp.int64)
         new_cols: dict = {}
         new_nulls: dict = {}
+        new_dicts: dict = {}
         specs: dict = {}
         for name, wc in node.calls:
             specs.setdefault((wc.partition, wc.order), []).append(
@@ -1194,6 +1195,44 @@ class Executor:
                 if wc.func == "dense_rank":
                     dr = ob_cum - ob_cum[p_start] + 1
                     new_cols[name] = scatter(dr)
+                    continue
+                if wc.func in ("lag", "lead"):
+                    # ROW-offset within the partition (reference:
+                    # WinGetFuncArgInPartition); default fills only
+                    # out-of-partition offsets, a NULL source value
+                    # stays NULL
+                    a, anm = self._eval_pair(wc.arg, b)
+                    a_s = a[s_iota]
+                    anm_s = anm[s_iota] if anm is not None else None
+                    src = iota - wc.offset if wc.func == "lag" \
+                        else iota + wc.offset
+                    srcc = jnp.clip(src, 0, n - 1)
+                    inside = (src >= 0) & (src < n) & \
+                        (p_start[srcc] == p_start[iota]) & s_valid[srcc]
+                    val = a_s[srcc]
+                    src_null = anm_s[srcc] if anm_s is not None else \
+                        jnp.zeros(n, bool)
+                    if wc.default is not None:
+                        dv, dnm = self._eval_pair(wc.default, b)
+                        # default evaluates in INPUT row order: re-sort
+                        # alongside the values before combining
+                        if getattr(dv, "ndim", 0):
+                            dv = dv[s_iota]
+                        if dnm is not None:
+                            dnm = dnm[s_iota]
+                        val = jnp.where(inside, val,
+                                        jnp.asarray(dv).astype(
+                                            val.dtype))
+                        nullm = inside & src_null
+                        if dnm is not None:
+                            nullm = nullm | (~inside & dnm)
+                    else:
+                        nullm = ~inside | src_null
+                    new_cols[name] = scatter(val)
+                    new_nulls[name] = scatter(nullm)
+                    d = _dict_for_expr(wc.arg, b.dicts)
+                    if d is not None:   # TEXT codes keep their decode
+                        new_dicts[name] = d
                     continue
                 # aggregate over the frame
                 if wc.arg is not None:
@@ -1257,7 +1296,9 @@ class Executor:
             types[name] = wc.type
         nulls = dict(b.nulls)
         nulls.update(new_nulls)
-        return DBatch(cols, b.valid, types, dict(b.dicts), nulls)
+        dicts = dict(b.dicts)
+        dicts.update(new_dicts)
+        return DBatch(cols, b.valid, types, dicts, nulls)
 
     # ---- sort / limit ----
     def _exec_sort(self, node: P.Sort) -> DBatch:

@@ -85,7 +85,7 @@ class _StagedTable:
 
 
 _ALLOWED = (P.SeqScan, P.Filter, P.Project, P.HashJoin, P.Agg, P.Sort,
-            P.Limit, ExchangeRef)
+            P.Limit, P.Window, ExchangeRef)
 
 
 class MeshRunner:
@@ -411,6 +411,9 @@ class MeshRunner:
                     node.limit, MeshRunner._plan_key(node.child))
         if isinstance(node, P.Limit):
             return (t, node.count, node.offset,
+                    MeshRunner._plan_key(node.child))
+        if isinstance(node, P.Window):
+            return (t, tuple(node.calls),
                     MeshRunner._plan_key(node.child))
         raise MeshUnsupported(t)
 

@@ -1,0 +1,134 @@
+"""GTM proxy — connection concentrator between backends and the GTM.
+
+Reference analog: src/gtm/proxy/proxy_main.c / proxy_thread.c (enabled
+by the `enable_gtm_proxy` GUC): many backend connections multiplex onto
+ONE upstream GTM connection, and concurrent GTS requests coalesce into
+a single batched fetch — the GTM's critical section is a clock bump, so
+the win is connection count and round trips, not compute.
+
+Speaks exactly the GtmServer wire protocol on both sides: backends
+point their GtmClient at the proxy and notice nothing.
+"""
+
+from __future__ import annotations
+
+import queue
+import socketserver
+import threading
+from typing import Optional
+
+from ..net.wire import recv_msg, send_msg
+from .server import GtmClient
+
+
+class _Pending:
+    __slots__ = ("msg", "event", "resp")
+
+    def __init__(self, msg):
+        self.msg = msg
+        self.event = threading.Event()
+        self.resp: Optional[dict] = None
+
+
+class GtmProxy:
+    """TCP front end multiplexing backends onto one upstream client."""
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.upstream = GtmClient(upstream_host, upstream_port)
+        self._q: "queue.Queue[_Pending]" = queue.Queue()
+        self.batched_gts = 0     # observability: coalesced GTS fetches
+        self.upstream_calls = 0
+        proxy = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        msg = recv_msg(self.request)
+                    except (ConnectionError, EOFError):
+                        return
+                    if msg is None:
+                        return
+                    p = _Pending(msg)
+                    proxy._q.put(p)
+                    p.event.wait()
+                    send_msg(self.request, p.resp)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._srv_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._pump_thread = threading.Thread(target=self._pump,
+                                             daemon=True)
+        self._stopping = False
+
+    # ------------------------------------------------------------------
+    def _pump(self):
+        """Single drain loop owning the upstream connection (the
+        reference's proxy worker thread).  Waiting GTS requests are
+        answered from ONE gts_batch round trip."""
+        while not self._stopping:
+            try:
+                first = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            batch = [first]
+            # opportunistic coalescing: everything already queued
+            while True:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            gts_reqs = [p for p in batch if p.msg.get("op") == "gts"]
+            others = [p for p in batch if p.msg.get("op") != "gts"]
+            if gts_reqs:
+                try:
+                    self.upstream_calls += 1
+                    if len(gts_reqs) == 1:
+                        gts_reqs[0].resp = self.upstream.call(op="gts")
+                    else:
+                        self.batched_gts += len(gts_reqs)
+                        ts = self.upstream.call(
+                            op="gts_batch", n=len(gts_reqs))["ts"]
+                        for p, t in zip(gts_reqs, ts):
+                            p.resp = {"ts": t}
+                except Exception as e:
+                    for p in gts_reqs:
+                        if p.resp is None:
+                            p.resp = {"error": str(e)}
+                for p in gts_reqs:
+                    p.event.set()
+            for p in others:
+                try:
+                    self.upstream_calls += 1
+                    p.resp = self.upstream.call(**p.msg)
+                except Exception as e:
+                    p.resp = {"error": str(e)}
+                p.event.set()
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self._srv_thread.start()
+        self._pump_thread.start()
+        return self
+
+    def stop(self):
+        self._stopping = True
+        self._server.shutdown()
+        self._server.server_close()
+        # let the pump finish its in-flight upstream call, then fail any
+        # stragglers so no handler blocks forever on event.wait()
+        self._pump_thread.join(timeout=5.0)
+        while True:
+            try:
+                p = self._q.get_nowait()
+            except queue.Empty:
+                break
+            p.resp = {"error": "proxy shutting down"}
+            p.event.set()
+        self.upstream.close()

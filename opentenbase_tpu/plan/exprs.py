@@ -321,7 +321,8 @@ class Cast(Expr):
 
 AGG_FUNCS = ("sum", "count", "avg", "min", "max")
 
-WINDOW_FUNCS = ("row_number", "rank", "dense_rank") + AGG_FUNCS
+WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "lag",
+                "lead") + AGG_FUNCS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -334,6 +335,8 @@ class WindowCall(Expr):
     arg: Optional[Expr]
     partition: tuple[Expr, ...]
     order: tuple[tuple[Expr, bool], ...]   # (expr, desc)
+    offset: int = 1                        # lag/lead row offset
+    default: Optional[Expr] = None         # lag/lead: None = SQL NULL
 
     def __post_init__(self):
         if self.func not in WINDOW_FUNCS:

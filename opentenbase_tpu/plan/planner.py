@@ -95,7 +95,9 @@ def rewrite(e: E.Expr, fn) -> E.Expr:
             return E.WindowCall(
                 x.func, rec(x.arg) if x.arg is not None else None,
                 tuple(rec(p) for p in x.partition),
-                tuple((rec(o), d) for o, d in x.order))
+                tuple((rec(o), d) for o, d in x.order),
+                x.offset,
+                rec(x.default) if x.default is not None else None)
         if isinstance(x, E.Coalesce):
             return E.Coalesce(tuple(rec(a) for a in x.args), x.out_type)
         if isinstance(x, E.NullIf):

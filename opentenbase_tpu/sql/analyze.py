@@ -694,9 +694,28 @@ class Binder:
             if name not in E.WINDOW_FUNCS:
                 raise BindError(f"window function {name!r} unsupported")
             arg = None
+            offset, default = 1, None
             if node.star and name != "count":
                 raise BindError(f"{name}(*) is not allowed")
-            if name in E.AGG_FUNCS and not node.star:
+            if name in ("lag", "lead"):
+                if not 1 <= len(node.args) <= 3:
+                    raise BindError(f"{name} takes 1-3 arguments")
+                arg = b(node.args[0])
+                if len(node.args) > 1:
+                    off = b(node.args[1])
+                    if not (isinstance(off, E.Lit)
+                            and isinstance(off.value, int)):
+                        raise BindError(
+                            f"{name} offset must be an integer literal")
+                    offset = int(off.value)
+                if len(node.args) > 2:
+                    default = b(node.args[2])
+                    if isinstance(default, E.Lit) and default.is_null:
+                        default = None
+                    elif default.type.kind != arg.type.kind or \
+                            default.type.scale != arg.type.scale:
+                        default = E.Cast(default, arg.type)
+            elif name in E.AGG_FUNCS and not node.star:
                 if len(node.args) != 1:
                     raise BindError(f"{name} takes one argument")
                 arg = b(node.args[0])
@@ -705,7 +724,7 @@ class Binder:
             part = tuple(b(p) for p in node.over.partition_by)
             order = tuple((b(si.expr), bool(si.desc))
                           for si in node.over.order_by)
-            return E.WindowCall(name, arg, part, order)
+            return E.WindowCall(name, arg, part, order, offset, default)
         if name in E.AGG_FUNCS:
             if node.star:
                 return E.AggCall("count", None)

@@ -100,6 +100,13 @@ class TestMeshParity:
         cs.execute("insert into t values (901, 0, 1.00, 'g0')")
         assert cs.query("select count(*) from t")[0][0] == before + 1
 
+    def test_window_local_partition_via_mesh(self, cs):
+        # partitioned by the dist key: the Window node stays in the DN
+        # fragment and traces into the shard_map program
+        got = both(cs, "select k, row_number() over (partition by k "
+                       "order by v) from t where k < 5 order by k")
+        assert [r[1] for r in got] == [1] * len(got)
+
     def test_unsupported_falls_back(self, cs):
         # DISTINCT aggregate is host-tier only: must still answer
         cs.execute("set enable_mesh_exchange = on")

@@ -78,6 +78,39 @@ class TestWindows:
             "where rn = 1 order by g")
         assert got == [("a", 1), ("b", 5)]
 
+    def test_lag_lead(self, sess):
+        got = sess.query("select g, x, lag(v) over (partition by g "
+                         "order by x), lead(v) over (partition by g "
+                         "order by x) from t order by g, x, 2")
+        assert got[0] == ("a", 1, None, 20.0)
+        assert got[-1] == ("b", 7, 1.5, None)
+
+    def test_lag_offset_and_default(self, sess):
+        got = sess.query("select g, x, lag(x, 2, 0) over "
+                         "(partition by g order by x) from t "
+                         "order by g, x, 3")
+        # only the third row of partition 'a' has a row two back
+        assert [r[2] for r in got] == [0, 0, 1, 0, 0]
+
+    def test_lag_expr_default_row_aligned(self, sess):
+        # a non-literal default must evaluate against the SAME row the
+        # frame-head output belongs to (sorted-order alignment)
+        got = sess.query("select x, lag(v, 1, x) over (order by x desc) "
+                         "from t order by x")
+        assert got[-1] == (7, 7.0)
+
+    def test_lag_text_column(self, sess):
+        got = sess.query("select x, lag(g) over (order by x) from t "
+                         "order by x")
+        assert [r[1] for r in got][:4] == [None, "a", "a", "a"]
+
+    def test_lead_null_source_stays_null(self, sess):
+        sess.execute("insert into t values ('a', 9, null)")
+        got = sess.query("select x, lead(v) over (order by x) from t "
+                         "where g = 'a' order by x")
+        # the row before x=9 leads into the NULL value, not a default
+        assert got[-2][1] is None
+
     def test_window_distributed_gather(self, cs):
         got = cs.query("select k, rank() over (order by v desc) from t "
                        "order by 2 limit 3")
