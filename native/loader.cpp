@@ -78,6 +78,13 @@ long long otb_parse(const char* path, char delim, int ncols,
             char* fieldEnd = p;
             while (fieldEnd < end && *fieldEnd != delim &&
                    *fieldEnd != '\n') fieldEnd++;
+            if (memchr(p, '\\', (size_t)(fieldEnd - p))) {
+                // backslash: \N NULL marker or escaped text (the COPY
+                // text format) — this fast path is NULL/escape-free;
+                // refuse so the caller uses the general loader
+                free(data);
+                return -4;
+            }
             switch (kinds[c]) {
             case 0: {   // int64
                 ((int64_t*)outs[c])[row] = strtoll(p, nullptr, 10);

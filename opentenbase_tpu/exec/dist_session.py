@@ -388,9 +388,17 @@ class ClusterSession:
 
     def _exec_copy(self, stmt: A.CopyStmt) -> Result:
         td = self.cluster.catalog.table(stmt.table)
-        if stmt.direction != "from":
-            raise ExecError("COPY TO unsupported yet")
         delim = str(stmt.options.get("delimiter", "|"))
+        if stmt.direction == "to":
+            # gather the table through the normal distributed read path
+            # and write it coordinator-side (reference: COPY OUT merge,
+            # execRemote.c DataNodeCopyOut)
+            from .session import copy_rows_to_file, copy_to_select
+            cols = stmt.columns or td.column_names
+            rows = self._exec_select(copy_to_select(stmt.table,
+                                                    cols)).rows
+            n = copy_rows_to_file(stmt.filename, rows, delim)
+            return Result("COPY", rowcount=n)
         cols = stmt.columns or td.column_names
         from ..storage.loader import load_tbl
         coldata = load_tbl(stmt.filename, td, cols, delim)

@@ -54,6 +54,34 @@ def _text_log_array(v) -> np.ndarray:
     return np.asarray([str(x) for x in v])
 
 
+def copy_rows_to_file(path: str, rows, delim: str) -> int:
+    """COPY ... TO: delimiter-separated text, NULL spelled \\N, with
+    backslash/delimiter/newline escaping so any value round-trips (the
+    reference's text format, commands/copy.c CopyAttributeOutText)."""
+    def esc(v):
+        if v is None:
+            return "\\N"
+        s = str(v)
+        return (s.replace("\\", "\\\\").replace(delim, "\\" + delim)
+                 .replace("\n", "\\n"))
+
+    n = 0
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(delim.join(esc(v) for v in row))
+            f.write("\n")
+            n += 1
+    return n
+
+
+def copy_to_select(table: str, cols) -> A.SelectStmt:
+    """The SELECT a COPY TO reads through (shared by the single-node
+    and cluster sessions)."""
+    return A.SelectStmt(
+        items=[A.SelectItem(A.ColRef((c,))) for c in cols],
+        from_=[A.TableRef(table)])
+
+
 class TxnState:
     def __init__(self, txid: int, snapshot_ts: int):
         self.txid = txid
@@ -519,10 +547,13 @@ class Session:
     def _exec_copy(self, stmt: A.CopyStmt) -> Result:
         td = self.node.catalog.table(stmt.table)
         st = self.node.stores[stmt.table]
-        if stmt.direction != "from":
-            raise ExecError("COPY TO unsupported yet")
         delim = str(stmt.options.get("delimiter", "|"))
         cols = stmt.columns or td.column_names
+        if stmt.direction == "to":
+            rows = self._exec_select(copy_to_select(stmt.table,
+                                                    cols)).rows
+            n = copy_rows_to_file(stmt.filename, rows, delim)
+            return Result("COPY", rowcount=n)
         from ..storage.loader import load_tbl
         coldata = load_tbl(stmt.filename, td, cols, delim)
         n = len(next(iter(coldata.values())))

@@ -50,6 +50,10 @@ class GtmProxy:
                         return
                     if msg is None:
                         return
+                    if proxy._stopping:
+                        send_msg(self.request,
+                                 {"error": "proxy shutting down"})
+                        return
                     p = _Pending(msg)
                     proxy._q.put(p)
                     p.event.wait()
@@ -122,13 +126,18 @@ class GtmProxy:
         self._server.shutdown()
         self._server.server_close()
         # let the pump finish its in-flight upstream call, then fail any
-        # stragglers so no handler blocks forever on event.wait()
+        # stragglers so no handler blocks forever on event.wait().  The
+        # handler rejects new work once _stopping is set; the second
+        # drain pass catches anything that slipped past both checks
         self._pump_thread.join(timeout=5.0)
-        while True:
-            try:
-                p = self._q.get_nowait()
-            except queue.Empty:
-                break
-            p.resp = {"error": "proxy shutting down"}
-            p.event.set()
+        import time as _time
+        for _ in range(2):
+            while True:
+                try:
+                    p = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                p.resp = {"error": "proxy shutting down"}
+                p.event.set()
+            _time.sleep(0.05)
         self.upstream.close()

@@ -712,6 +712,13 @@ class Binder:
                     default = b(node.args[2])
                     if isinstance(default, E.Lit) and default.is_null:
                         default = None
+                    elif arg.type.kind == TypeKind.TEXT:
+                        # the output shares the source column's decode
+                        # dictionary; an arbitrary default string has no
+                        # code there
+                        raise BindError(
+                            f"{name} over a text column supports only "
+                            "a NULL default")
                     elif default.type.kind != arg.type.kind or \
                             default.type.scale != arg.type.scale:
                         default = E.Cast(default, arg.type)

@@ -71,8 +71,77 @@ def load_tbl(path: str, td: TableDef, columns: list[str],
     values, missing compiler)."""
     out = _load_native(path, td, columns, delimiter)
     if out is None:
-        out = _load_pandas(path, td, columns, delimiter)
+        # the native parser refuses backslashes (\N NULLs / escapes of
+        # the COPY text format) along with its other unsupported inputs;
+        # files carrying them take the escape-aware python path
+        if _file_has_backslash(path):
+            out = _load_text_escaped(path, td, columns, delimiter)
+        else:
+            out = _load_pandas(path, td, columns, delimiter)
     return out
+
+
+def _file_has_backslash(path: str) -> bool:
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return False
+            if b"\\" in chunk:
+                return True
+
+
+def _load_text_escaped(path: str, td: TableDef, columns: list[str],
+                       delimiter: str) -> dict:
+    """COPY text-format reader: honors backslash escapes and the \\N
+    NULL marker (commands/copy.c CopyReadAttributesText analog; the
+    slow path — only files containing backslashes come here)."""
+    cols: dict[str, list] = {c: [] for c in columns}
+    with open(path, "r") as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            # split on UNESCAPED delimiters, keeping raw field text
+            raw_fields, cur, esc = [], [], False
+            for ch in line:
+                if esc:
+                    cur.append("\\" + ch)
+                    esc = False
+                elif ch == "\\":
+                    esc = True
+                elif ch == delimiter:
+                    raw_fields.append("".join(cur))
+                    cur = []
+                else:
+                    cur.append(ch)
+            raw_fields.append("".join(cur))
+            for c, raw in zip(columns, raw_fields):
+                if raw == "\\N":
+                    cols[c].append(None)
+                    continue
+                # unescape: \\ -> \, \n -> newline, \<d> -> d
+                out, esc = [], False
+                for ch in raw:
+                    if esc:
+                        out.append("\n" if ch == "n" else ch)
+                        esc = False
+                    elif ch == "\\":
+                        esc = True
+                    else:
+                        out.append(ch)
+                s = "".join(out)
+                k = td.column(c).type.kind
+                if k in (TypeKind.INT32, TypeKind.INT64):
+                    cols[c].append(int(s))
+                elif k == TypeKind.FLOAT64:
+                    cols[c].append(float(s))
+                elif k == TypeKind.BOOL:
+                    cols[c].append(s.strip().lower() in
+                                   ("t", "true", "1"))
+                else:
+                    cols[c].append(s)   # decimal/date/text: raw string
+    return cols
 
 
 def _load_pandas(path: str, td: TableDef, columns: list[str],
@@ -82,10 +151,18 @@ def _load_pandas(path: str, td: TableDef, columns: list[str],
         raise FileNotFoundError(path)
     df = pd.read_csv(path, sep=delimiter, header=None,
                      names=columns + ["__trail"], index_col=False,
-                     engine="c")
+                     engine="c", na_values=["\\N"],
+                     keep_default_na=False)
     if df["__trail"].isna().all():
         df = df.drop(columns="__trail")
-    return {c: df[c].tolist() for c in columns}
+    out = {}
+    for c in columns:
+        s = df[c]
+        if s.isna().any():
+            out[c] = [None if pd.isna(v) else v for v in s.tolist()]
+        else:
+            out[c] = s.tolist()
+    return out
 
 
 def _load_native(path: str, td: TableDef, columns: list[str],
