@@ -22,6 +22,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# The package probes the tunnel at import; give the bench a longer budget
+# than the library default (must be set before the import below).
+os.environ.setdefault("OTB_TPU_PROBE_TIMEOUT", "90")
+
 from opentenbase_tpu.utils.backend import ensure_alive_backend  # noqa: E402
 
 requested_tpu = os.environ.get("JAX_PLATFORMS", "") != "cpu"

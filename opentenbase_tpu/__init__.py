@@ -20,14 +20,25 @@ Layout (≈ reference layer map, SURVEY.md §1):
 - plan/      logical+physical planner, FQS, distribution (ref optimizer, pgxc/plan)
 - exec/      host-side fragment executor over device kernels (ref executor)
 - ops/       JAX/Pallas kernel library (ref execExprInterp/nodeHash/nodeAgg hot loops)
-- parallel/  shard map, locator, mesh/exchange collectives (ref pgxc/locator, forward)
-- txn/       GTS/CSN MVCC, snapshots, 2PC (ref access/transam, tqual.c)
-- gtm/       timestamp-oracle service (ref src/gtm)
+- parallel/  shard map, locator, cluster 2PC, mesh collectives (ref
+             pgxc/locator, forward, execRemote.c remote-2PC)
+- gtm/       timestamp-oracle service (ref src/gtm); distributed MVCC
+             (GTS visibility, ref access/transam + tqual.c) lives in
+             storage/ + ops/kernels.py as fused scan kernels
 - net/       control-plane RPC between CN/DN processes (ref pooler/pgxcnode)
 - cli/       psql-analog shell + cluster ctl (ref src/bin, contrib/pgxc_ctl)
 """
 
-import jax
+# Select a live backend BEFORE any jax computation can run: if the axon
+# TPU tunnel is wedged, the first jnp op in ANY process with the plugin
+# registered blocks forever.  connect() probes in a subprocess (cached,
+# cross-process) and falls back to CPU — a plain library consumer must
+# never hang at import or first use.
+from opentenbase_tpu.utils.backend import connect as _connect
+
+_connect()
+
+import jax  # noqa: E402
 
 # The engine is a database: 64-bit keys (e.g. TPC-H orderkey at SF100 exceeds
 # int32) and exact int64 decimal arithmetic are part of the storage contract.

@@ -5,28 +5,84 @@ relay; when that tunnel is wedged, *any* jax backend init blocks forever
 (even under JAX_PLATFORMS=cpu, because the plugin is force-registered by
 sitecustomize).  Probing in a subprocess with a timeout keeps the engine's
 own process safe, then either keeps the TPU or falls back to CPU.
+
+`connect()` is called from `opentenbase_tpu/__init__.py` so that a plain
+library consumer (`python my_driver.py` with any JAX_PLATFORMS value) can
+never hang at the first jnp op.  The probe verdict is cached across
+processes in a temp file so a test run of many interpreters pays for at
+most one probe per TTL window.
+
+Env knobs:
+- OTB_TPU_PROBE_TIMEOUT  seconds for the subprocess probe (default 60)
+- OTB_SKIP_BACKEND_PROBE set to 1 to trust the environment as-is
 """
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
+import tempfile
+import time
 
 _PROBE = ("import jax; d = jax.devices(); "
           "print(d[0].platform if d else 'none')")
 
+_CACHE_PATH = os.path.join(
+    tempfile.gettempdir(), "otb_tpu_probe.%d.json" % os.getuid())
+# Only NEGATIVE verdicts are cached: a healthy tunnel answers the probe in
+# seconds, so re-probing is cheap, and trusting a stale "healthy" would
+# re-introduce the indefinite hang this module exists to prevent.  A
+# wedged tunnel is what makes probes expensive (full timeout), so that
+# verdict is reused for a short window.
+_CACHE_TTL_DOWN_S = 300.0   # wedged tunnel: re-probe every 5 min
 
-def probe_tpu(timeout_s: float = 60.0) -> bool:
+# Process-level memo: backend choice is permanent once jax is configured.
+_selected: str | None = None
+
+
+def _cached_down() -> bool:
+    """True when a recent probe already found the tunnel wedged."""
+    try:
+        with open(_CACHE_PATH) as f:
+            rec = json.load(f)
+        age = time.time() - float(rec["ts"])
+        return not rec["ok"] and 0 <= age < _CACHE_TTL_DOWN_S
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+
+
+def _store_verdict(ok: bool) -> None:
+    try:
+        tmp = _CACHE_PATH + ".tmp.%d" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump({"ok": ok, "ts": time.time()}, f)
+        os.replace(tmp, _CACHE_PATH)
+    except OSError:
+        pass
+
+
+def probe_tpu(timeout_s: float = 60.0, use_cache: bool = True) -> bool:
     """True if the default (axon/TPU) backend initializes in time."""
+    if use_cache and _cached_down():
+        return False
+    env = os.environ.copy()
+    env.pop("JAX_PLATFORMS", None)  # probe the default (axon) backend
     try:
         out = subprocess.run(
             [sys.executable, "-c", _PROBE],
             capture_output=True, timeout=timeout_s, text=True,
-            cwd="/", env=os.environ.copy())
-        return out.returncode == 0
+            cwd="/", env=env)
+        # The child must actually have initialized the accelerator: with
+        # JAX_PLATFORMS unset, a failed-fast plugin makes jax fall back
+        # to CPU and exit 0, which is NOT a healthy tunnel.
+        ok = (out.returncode == 0
+              and out.stdout.strip() not in ("", "none", "cpu"))
     except subprocess.TimeoutExpired:
-        return False
+        ok = False
+    _store_verdict(ok)
+    return ok
 
 
 def force_cpu():
@@ -38,13 +94,41 @@ def force_cpu():
     jax.config.update("jax_platforms", "cpu")
 
 
+def connect(timeout_s: float | None = None) -> str:
+    """Idempotent backend selection; safe (non-hanging) at import time.
+
+    Returns the selected platform label: "tpu" or "cpu".  The decision is
+    memoized for the process lifetime (jax cannot be re-pointed once
+    configured), so `timeout_s` is honored only by the FIRST call — which
+    is normally the package import; set OTB_TPU_PROBE_TIMEOUT to control
+    that one.
+    """
+    global _selected
+    if _selected is not None:
+        return _selected
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # Even under JAX_PLATFORMS=cpu the registered axon factory is
+        # initialized by backends(); always unregister it.
+        force_cpu()
+        _selected = "cpu"
+        return _selected
+    if os.environ.get("OTB_SKIP_BACKEND_PROBE", "") not in ("", "0"):
+        _selected = "tpu"  # trust the environment: no probe, no fallback
+        return _selected
+    if timeout_s is None:
+        try:
+            timeout_s = float(os.environ.get("OTB_TPU_PROBE_TIMEOUT", "60"))
+        except ValueError:  # runs on the import path: a typo must not crash
+            timeout_s = 60.0
+    if probe_tpu(timeout_s):
+        _selected = "tpu"
+    else:
+        force_cpu()
+        _selected = "cpu"
+    return _selected
+
+
 def ensure_alive_backend(timeout_s: float = 60.0) -> str:
     """Probe the TPU; fall back to CPU if the tunnel is down.  Returns the
     selected platform name.  Must be called before any jax computation."""
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        force_cpu()
-        return "cpu"
-    if probe_tpu(timeout_s):
-        return "tpu"
-    force_cpu()
-    return "cpu"
+    return connect(timeout_s)
