@@ -1,18 +1,22 @@
-"""Benchmark driver: TPC-H through the engine on the real chip.
+"""Benchmark driver: the BASELINE.md measurement ladder through the engine.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-- value: Q1 throughput in Mrows/s of lineitem scanned (engine device path)
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "ladder"}.
+- headline value: TPC-H Q1 throughput in Mrows/s of lineitem scanned on the
+  device-mesh data plane (or single-node fused when only one config runs)
 - vs_baseline: speedup over the CPU control arm (pandas, BASELINE.md's
   "CPU DataNode" stand-in) on the same machine & data
+- ladder: per-config results — Q1 single-node fused (BASELINE config 1)
+  plus Q1/Q3/Q5 through the mesh tier (config 2: joins + all_to_all
+  redistribution as ONE shard_map program per query)
 - tpu_unavailable: true when the axon tunnel was down and the run fell
-  back to CPU (the number is then NOT a TPU measurement)
+  back to CPU (the numbers are then NOT TPU measurements)
 
 Modes via env:
 - BENCH_SF (default 1.0), BENCH_REPEAT (default 5)
-- BENCH_MODE=single (default): single-node Q1 through the fused engine
-- BENCH_MODE=mesh: distributed Q1 over an in-process cluster whose
-  datanode fragments + exchanges run as ONE shard_map program per query
-  on a mesh of all visible devices (exec/mesh_exec.py)
+- BENCH_MODE=ladder (default) | single | mesh — single/mesh run only that
+  one arm (the r1/r2 behavior) for quick checks
+- BENCH_OLTP=1: additionally measure the point-op latency path (FQS
+  INSERT/SELECT p50) — the reference's execLight.c OLTP story
 """
 
 import json
@@ -36,33 +40,85 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
 
-def _pandas_q1(tbl, repeat):
-    import pandas as pd
-    li = pd.DataFrame({k: tbl[k] for k in
-                       ("l_returnflag", "l_linestatus", "l_quantity",
-                        "l_extendedprice", "l_discount", "l_tax",
-                        "l_shipdate")})
-    cutoff = 10471  # 1998-09-02
-    ptimes = []
-    for _ in range(max(2, repeat // 2)):
-        t2 = time.perf_counter()
-        df = li[li.l_shipdate <= cutoff]
-        dp = df.l_extendedprice * (1 - df.l_discount)
-        ch = dp * (1 + df.l_tax)
-        df.assign(dp=dp, ch=ch).groupby(
-            ["l_returnflag", "l_linestatus"]).agg(
-            sq=("l_quantity", "sum"), sp=("l_extendedprice", "sum"),
-            sdp=("dp", "sum"), sch=("ch", "sum"),
-            aq=("l_quantity", "mean"), ap=("l_extendedprice", "mean"),
-            ad=("l_discount", "mean"), n=("l_quantity", "count"))
-        ptimes.append(time.perf_counter() - t2)
-    return min(ptimes)
+def _d(iso):
+    return int((np.datetime64(iso, "D")
+                - np.datetime64("1970-01-01", "D")).astype(np.int64))
+
+
+def _pandas_q1(dfs):
+    li = dfs["lineitem"]
+    df = li[li.l_shipdate <= _d("1998-09-02")]
+    dp = df.l_extendedprice * (1 - df.l_discount)
+    ch = dp * (1 + df.l_tax)
+    df.assign(dp=dp, ch=ch).groupby(
+        ["l_returnflag", "l_linestatus"]).agg(
+        sq=("l_quantity", "sum"), sp=("l_extendedprice", "sum"),
+        sdp=("dp", "sum"), sch=("ch", "sum"),
+        aq=("l_quantity", "mean"), ap=("l_extendedprice", "mean"),
+        ad=("l_discount", "mean"), n=("l_quantity", "count"))
+
+
+def _pandas_q3(dfs):
+    c, o, li = dfs["customer"], dfs["orders"], dfs["lineitem"]
+    df = c[c.c_mktsegment == "BUILDING"].merge(
+        o, left_on="c_custkey", right_on="o_custkey")
+    df = df[df.o_orderdate < _d("1995-03-15")]
+    df = df.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    df = df[df.l_shipdate > _d("1995-03-15")]
+    df = df.assign(rev=df.l_extendedprice * (1 - df.l_discount))
+    df.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])[
+        "rev"].sum().reset_index().sort_values(
+        ["rev", "o_orderdate"], ascending=[False, True]).head(10)
+
+
+def _pandas_q5(dfs):
+    t = dfs
+    df = t["customer"].merge(t["orders"], left_on="c_custkey",
+                             right_on="o_custkey")
+    df = df.merge(t["lineitem"], left_on="o_orderkey",
+                  right_on="l_orderkey")
+    df = df.merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+    df = df[df.c_nationkey == df.s_nationkey]
+    df = df.merge(t["nation"], left_on="s_nationkey",
+                  right_on="n_nationkey")
+    df = df.merge(t["region"], left_on="n_regionkey",
+                  right_on="r_regionkey")
+    df = df[(df.r_name == "ASIA") & (df.o_orderdate >= _d("1994-01-01"))
+            & (df.o_orderdate < _d("1995-01-01"))]
+    df.assign(rev=df.l_extendedprice * (1 - df.l_discount)).groupby(
+        "n_name")["rev"].sum().reset_index().sort_values(
+        "rev", ascending=False)
+
+
+def _time(fn, repeat):
+    fn()  # warm (compile + staging)
+    times = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def _oltp_latencies(s, n=200):
+    """FQS point-op p50 (ms): single-shard INSERT and dist-key SELECT."""
+    s.execute("create table if not exists bench_kv (k bigint primary key, "
+              "v bigint) distribute by shard(k)")
+    ins, sel = [], []
+    for i in range(n):
+        t0 = time.perf_counter()
+        s.execute(f"insert into bench_kv values ({i}, {i * 7})")
+        ins.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        s.query(f"select v from bench_kv where k = {i}")
+        sel.append(time.perf_counter() - t0)
+    return (float(np.median(ins) * 1e3), float(np.median(sel) * 1e3))
 
 
 def main():
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     repeat = int(os.environ.get("BENCH_REPEAT", "5"))
-    mode = os.environ.get("BENCH_MODE", "single")
+    mode = os.environ.get("BENCH_MODE", "ladder")
 
     from opentenbase_tpu.tpch import datagen
     from opentenbase_tpu.tpch.queries import Q
@@ -70,55 +126,79 @@ def main():
 
     t0 = time.time()
     data = datagen.generate(sf=sf)
-    tbl = data["lineitem"]
-    n_rows = len(tbl["l_orderkey"])
+    dfs = datagen.as_dataframes(data)
+    n_rows = len(data["lineitem"]["l_orderkey"])
+    gen_s = time.time() - t0
 
-    if mode == "mesh":
+    ladder = []
+    notes = []
+
+    # ---- config 1: Q1 single node (fused scan+agg kernel path) ----
+    if mode in ("ladder", "single"):
+        from opentenbase_tpu.exec.session import LocalNode, Session
+        node = LocalNode()
+        s1 = Session(node)
+        s1.execute(SCHEMA)
+        td = node.catalog.table("lineitem")
+        s1._insert_rows(td, node.stores["lineitem"], data["lineitem"],
+                        n_rows)
+        eng = _time(lambda: s1.query(Q[1]), repeat)
+        ctl = _time(lambda: _pandas_q1(dfs), max(2, repeat // 2))
+        ladder.append({"config": "Q1 single", "engine_ms": eng * 1e3,
+                       "mrows_s": n_rows / eng / 1e6,
+                       "vs_pandas": ctl / eng})
+        del s1, node
+
+    # ---- config 2: Q1/Q3/Q5 through the device-mesh data plane ----
+    mesh_q1 = None
+    if mode in ("ladder", "mesh"):
         from opentenbase_tpu.exec.dist_session import ClusterSession
         from opentenbase_tpu.parallel.cluster import Cluster
         ndn = max(len(jax.devices()), 1)
-        s = ClusterSession(Cluster(n_datanodes=ndn))
-        s.execute(SCHEMA)
-        td = s.cluster.catalog.table("lineitem")
-        s._insert_rows(td, tbl, n_rows)
-        s.execute("set enable_mesh_exchange = on")
-        run = lambda: s.query(Q[1])
-        label = f"mesh x{ndn}"
-    else:
-        from opentenbase_tpu.exec.session import LocalNode, Session
-        node = LocalNode()
-        s = Session(node)
-        s.execute(SCHEMA)
-        td = node.catalog.table("lineitem")
-        st = node.stores["lineitem"]
-        s._insert_rows(td, st, tbl, n_rows)
-        run = lambda: s.query(Q[1])
-        label = "single"
-    gen_s = time.time() - t0
+        s2 = ClusterSession(Cluster(n_datanodes=ndn))
+        s2.execute(SCHEMA)
+        for tname in ("region", "nation", "supplier", "customer", "part",
+                      "partsupp", "orders", "lineitem"):
+            td = s2.cluster.catalog.table(tname)
+            n = len(next(iter(data[tname].values())))
+            s2._insert_rows(td, data[tname], n)
+        controls = {1: _pandas_q1, 3: _pandas_q3, 5: _pandas_q5}
+        for qn in (1, 3, 5):
+            eng = _time(lambda: s2.query(Q[qn]), repeat)
+            ctl = _time(lambda: controls[qn](dfs), max(2, repeat // 2))
+            entry = {"config": f"Q{qn} mesh x{ndn}",
+                     "engine_ms": eng * 1e3,
+                     "mrows_s_chip": n_rows / eng / 1e6 / ndn,
+                     "vs_pandas": ctl / eng,
+                     "tier": s2.last_tier}
+            if s2.last_tier != "mesh":
+                entry["fallback"] = s2.last_fallback
+            ladder.append(entry)
+            if qn == 1:
+                mesh_q1 = entry
+        if os.environ.get("BENCH_OLTP"):
+            ins_p50, sel_p50 = _oltp_latencies(s2)
+            ladder.append({"config": "point ops (FQS)",
+                           "insert_p50_ms": ins_p50,
+                           "select_p50_ms": sel_p50})
 
-    run()  # warm (compile + device staging)
-    times = []
-    for _ in range(repeat):
-        t1 = time.perf_counter()
-        run()
-        times.append(time.perf_counter() - t1)
-    engine_s = min(times)
-
-    pandas_s = _pandas_q1(tbl, repeat)
-
-    mrows = n_rows / engine_s / 1e6
+    head = mesh_q1 or ladder[0]
     out = {
-        "metric": f"TPC-H Q1 SF{sf:g} throughput ({platform}, {label})",
-        "value": round(mrows, 3),
+        "metric": f"TPC-H Q1 SF{sf:g} throughput "
+                  f"({platform}, {head['config']})",
+        "value": round(head.get("mrows_s", head.get("mrows_s_chip", 0))
+                       * (1 if "mrows_s" in head
+                          else max(len(jax.devices()), 1)), 3),
         "unit": "Mrows/s",
-        "vs_baseline": round(pandas_s / engine_s, 3),
+        "vs_baseline": round(head["vs_pandas"], 3),
+        "ladder": [{k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in e.items()} for e in ladder],
     }
     if tpu_unavailable:
         out["tpu_unavailable"] = True
     print(json.dumps(out))
-    print(f"# rows={n_rows} engine={engine_s*1e3:.1f}ms "
-          f"pandas={pandas_s*1e3:.1f}ms datagen={gen_s:.1f}s "
-          f"platform={platform} mode={mode}", file=sys.stderr)
+    print(f"# rows={n_rows} datagen={gen_s:.1f}s platform={platform} "
+          f"mode={mode}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -130,6 +130,12 @@ class DistExecutor:
         # distributed-EXPLAIN instrumentation the reference ships DN->CN
         # (commands/explain_dist.c)
         self.stats: dict = {}
+        # which data plane actually ran, surfaced by EXPLAIN (reference:
+        # the FN-vs-PQ protocol choice in execFragment.c): 'mesh' (one
+        # shard_map program), 'fqs' (whole query on one DN), or 'host';
+        # when the mesh tier declined, fallback_reason says why
+        self.tier: str = ""
+        self.fallback_reason: str = ""
 
     # ------------------------------------------------------------------
     def run(self, dp: DistPlan) -> DBatch:
@@ -154,21 +160,28 @@ class DistExecutor:
             # shard_map program (all_to_all/all_gather over the mesh)
             from .mesh_exec import MeshUnsupported, mesh_runner_for
             runner = mesh_runner_for(self.cluster)
-            if runner is not None:
+            if runner is None:
+                self.fallback_reason = self.fallback_reason or \
+                    "cluster not mesh-capable"
+            else:
                 try:
                     gathered = runner.run(dp, self.snapshot_ts, self.txid,
                                           self.params)
-                    gex = next(ex.index for ex in dp.exchanges
-                               if ex.kind in ("gather", "gather_one"))
                     top = dp.fragments[dp.top_fragment]
+                    self.tier = "mesh"   # overwritten by later subplans:
+                    # the LAST _run_distplan call is the main plan, so the
+                    # recorded tier is always the main plan's
                     return self._exec_fragment_on(
-                        top, dp, "cn", {(gex, "cn"): gathered})
-                except MeshUnsupported:
-                    pass  # host-mediated tier handles everything else
+                        top, dp, "cn",
+                        {(gi, "cn"): b for gi, b in gathered.items()})
+                except MeshUnsupported as e:
+                    # host-mediated tier handles everything else
+                    self.fallback_reason = str(e)
         if dp.fqs_node is not None:
             # whole-query shipped to one datanode (FQS).  An in-process
             # datanode returns the device batch directly (no host
             # round-trip on the OLTP fast path).
+            self.tier = "fqs"
             dn = self.cluster.datanodes[dp.fqs_node]
             frag = dp.fragments[dp.top_fragment]
             if hasattr(dn, "exec_plan_device"):
@@ -178,6 +191,7 @@ class DistExecutor:
                                            self.txid, self.params, {}))
         # exchange outputs, keyed (exchange_index, dest) where dest is a
         # dn index or 'cn'
+        self.tier = "host"
         ex_out: dict = {}
         # execute fragments bottom-up (they were appended children-first)
         for frag in dp.fragments:

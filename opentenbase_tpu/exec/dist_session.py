@@ -41,6 +41,15 @@ class ClusterSession:
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
         self.txn: Optional[ClusterTxn] = None
+        # data plane of the last SELECT (surfaced in EXPLAIN ANALYZE and
+        # asserted by the mesh CI suite): 'mesh' | 'fqs' | 'host'
+        self.last_tier = ""
+        self.last_fallback = ""
+        # cumulative tier usage + fallback reasons: the CI proof that the
+        # device data plane carries the benchmark suites with no silent
+        # host fallbacks
+        self.tier_counts: dict[str, int] = {}
+        self.fallbacks: list[str] = []
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> list[Result]:
@@ -219,18 +228,26 @@ class ClusterSession:
         if queue is not None:
             queue.acquire()
         try:
+            # the device-mesh data plane is the default (reference: the FN
+            # plane is the default tuple transport); 'off' forces the
+            # host-mediated tier
             ex = DistExecutor(self.cluster, t.snapshot_ts, t.txid,
                               instrument=instrument,
                               use_mesh=self.cluster.gucs.get(
-                                  "enable_mesh_exchange") == "on")
+                                  "enable_mesh_exchange", "on") != "off")
             batch = ex.run(dp)
         finally:
             if queue is not None:
                 queue.release()
         names, rows = materialize(batch, dp.output_names)
         res = Result("SELECT", names=names, rows=rows, rowcount=len(rows))
+        self.last_tier = ex.tier
+        self.last_fallback = ex.fallback_reason
+        self.tier_counts[ex.tier] = self.tier_counts.get(ex.tier, 0) + 1
+        if ex.tier == "host" and ex.fallback_reason:
+            self.fallbacks.append(ex.fallback_reason)
         if instrument:
-            return res, ex.stats, dp
+            return res, ex, dp
         return res
 
     # ---- writes ----
@@ -445,11 +462,17 @@ class ClusterSession:
         text = "\n".join(lines)
         if stmt.analyze:
             t0 = time.perf_counter()
-            _, stats, dp2 = self._exec_select(stmt.stmt, instrument=True)
+            _, ex, dp2 = self._exec_select(stmt.stmt, instrument=True)
             total = (time.perf_counter() - t0) * 1e3
+            # the data plane that actually carried the query + why the
+            # device tier declined, if it did (reference: FN vs PQ
+            # protocol choice surfaced per fragment)
+            text += f"\nData Plane: {ex.tier}"
+            if ex.tier != "mesh" and ex.fallback_reason:
+                text += f" (mesh fallback: {ex.fallback_reason})"
             # per-fragment DN instrumentation shipped back to the CN
             # (reference: commands/explain_dist.c)
-            for (fidx, where), st in sorted(stats.items(),
+            for (fidx, where), st in sorted(ex.stats.items(),
                                             key=lambda kv: kv[0][0]):
                 loc = "CN" if where == "cn" else f"dn{where}"
                 text += (f"\n  Fragment {fidx} @ {loc}: "

@@ -147,9 +147,14 @@ class ExecContext:
     # the device cache inside a jitted fragment program (exec/fused.py).
     # n may itself be traced (per-shard row counts under shard_map).
     join_size_factor: int = 1
-    # traced joins can't sync their output size: out_size = probe padded
-    # * factor; the mesh runner doubles the factor and re-traces when a
-    # join reports overflow (the size-class ladder, SURVEY §7.3)
+    # traced joins can't sync their output size: out_size =
+    # max(probe, build) padded * factor; the mesh runner doubles the
+    # factor of exactly the joins that report overflow and re-traces
+    # (the size-class ladder, SURVEY §7.3).  join_factors maps a stable
+    # join id (fragment tag, sequence within fragment) -> factor so a
+    # small-probe/large-output join can grow without inflating every
+    # other join's buffers.
+    join_factors: Optional[dict] = None
 
 
 class Executor:
@@ -157,11 +162,14 @@ class Executor:
     #: count()-sized output classes switch to static worst-case shapes
     _traced = False
 
-    def __init__(self, ctx: ExecContext):
+    def __init__(self, ctx: ExecContext, frag_tag=None):
         self.ctx = ctx
-        # traced-join overflow telemetry: (required_rows, out_size) per
-        # join, checked host-side after the program runs (mesh runner)
+        # traced-join overflow telemetry: (join id, required_rows,
+        # out_size) per join, checked host-side after the program runs
+        # (mesh runner doubles that join's factor on overflow)
         self.join_required: list = []
+        self.frag_tag = frag_tag
+        self._join_seq = 0
 
     # ------------------------------------------------------------------
     def run(self, planned: PlannedStmt):
@@ -503,9 +511,15 @@ class Executor:
             if left_outer else jnp.sum(counts)
         if self._traced:
             # no host sync inside a compiled (shard_map) program: static
-            # probe-proportional out_size; overflow reported for retry
-            out_size = left.padded * self.ctx.join_size_factor
-            self.join_required.append((total, out_size))
+            # size proportional to the LARGER input (a small probe side
+            # joining a big build emits ~build-many rows — FK joins);
+            # overflow reported per join id for a targeted retry
+            jid = (self.frag_tag, self._join_seq)
+            self._join_seq += 1
+            factor = (self.ctx.join_factors or {}).get(
+                jid, self.ctx.join_size_factor)
+            out_size = max(left.padded, right.padded) * factor
+            self.join_required.append((jid, total, out_size))
         else:
             out_size = next_pow2(max(int(total), 1))
         pi, bi, tot = K.join_expand(lo, counts, perm, out_size,
@@ -950,11 +964,14 @@ class Executor:
             out_valid = jnp.ones(1, dtype=bool)
             gkey_out = []
         else:
-            max_groups = next_pow2(max(b.count(), 1))
+            max_groups = b.padded if self._traced else \
+                next_pow2(max(b.count(), 1))
             gkeys, outs, ng = K.grouped_agg_sort(
                 self._grouping_arrays(key_arrs, key_nulls), b.valid,
                 tuple(inputs), max_groups, tuple(kinds))
-            out_valid = jnp.arange(max_groups) < int(ng)
+            if not self._traced:
+                ng = int(ng)
+            out_valid = jnp.arange(max_groups) < ng
             gkey_out = list(gkeys[:len(key_arrs)])
             extra = list(gkeys[len(key_arrs):])
             for i, nm in enumerate(key_nulls):
@@ -975,7 +992,8 @@ class Executor:
         columns with the same validity, so group ordering is identical
         and per-pass outputs align positionally."""
         gkeys_full = self._grouping_arrays(key_arrs, key_nulls)
-        max_g = next_pow2(max(b.count(), 1))
+        max_g = b.padded if self._traced else \
+            next_pow2(max(b.count(), 1))
         n_gk = len(gkeys_full)
 
         out_cols: dict = {}
@@ -996,10 +1014,12 @@ class Executor:
             gkeys_p, outs, ng = K.grouped_agg_sort(
                 gkeys_full or (jnp.zeros(b.padded, jnp.int64),),
                 b.valid, tuple(inputs), max_g, tuple(kinds))
+            if not self._traced:
+                ng = int(ng)
             pb = self._assemble_agg_output(
                 pseudo, list(gkeys_p[:len(key_arrs)]), key_types,
                 key_dicts, outs, out_specs,
-                jnp.arange(max_g) < (int(ng) if key_arrs else 1),
+                jnp.arange(max_g) < (ng if key_arrs else 1),
                 knulls_from(gkeys_p))
             base = pb
             for n_, _ac in plain:
@@ -1027,7 +1047,8 @@ class Executor:
             # KEEP their group alive so passes stay aligned
             enc = jnp.where(nn, 0, enc)
             keys1 = gkeys_full + (enc, nn.astype(jnp.int64))
-            g1_pad = next_pow2(max(b.count(), 1))
+            g1_pad = b.padded if self._traced else \
+                next_pow2(max(b.count(), 1))
             gkeys1, _, ng1 = K.grouped_agg_sort(
                 keys1, b.valid, (b.valid.astype(jnp.int64),), g1_pad,
                 ("count",))
@@ -1067,12 +1088,14 @@ class Executor:
                 tuple(gkeys1[:n_gk]) if n_gk else
                 (jnp.zeros(g1_pad, jnp.int64),),
                 valid1, ins2, max_g, kinds2)
+            if not self._traced:
+                ng2 = int(ng2)
             if base is None:
                 base = self._assemble_agg_output(
                     dataclasses.replace(node, aggs=[]),
                     list(gkeys2[:len(key_arrs)]), key_types, key_dicts,
                     [], [],
-                    jnp.arange(max_g) < (int(ng2) if key_arrs else 1),
+                    jnp.arange(max_g) < (ng2 if key_arrs else 1),
                     knulls_from(gkeys2))
             if ac.func == "count":
                 out_cols[name] = outs2[0]

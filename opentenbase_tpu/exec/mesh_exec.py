@@ -130,15 +130,6 @@ class MeshRunner:
             self._screen_node(node.left)
             self._screen_node(node.right)
             return
-        if isinstance(node, P.Agg):
-            if any(ac.distinct for _, ac in node.aggs):
-                raise MeshUnsupported("DISTINCT aggregate")
-            for _, ke in node.group_keys:
-                for x in E.walk(ke):
-                    if isinstance(x, E.TextExpr) and x.transforms:
-                        # transformed dictionaries can over-split groups
-                        # and need the host re-merge pass
-                        raise MeshUnsupported("transformed TEXT group key")
         if isinstance(node, P.SeqScan) and node.table.name.startswith(
                 "otb_"):
             raise MeshUnsupported("stat view scan")
@@ -322,9 +313,10 @@ class MeshRunner:
         return clone
 
     def run(self, dp: DistPlan, snapshot_ts: int, txid: int,
-            params: dict):
-        """Execute the DN side of `dp` on the mesh; returns the CN-side
-        top-fragment output DBatch (host-reachable arrays)."""
+            params: dict) -> dict:
+        """Execute the DN side of `dp` on the mesh; returns a dict of
+        {gather exchange index: DBatch} — every CN-bound exchange output,
+        host-reachable."""
         from .executor import DBatch, ExecContext, Executor
 
         self._screen(dp)
@@ -355,12 +347,12 @@ class MeshRunner:
         buckets = {ex.index: max(64, base_pad //
                                  max(self.cluster.ndn // 2, 1))
                    for ex in dp.exchanges if ex.kind == "redistribute"}
-        factor = 1
-        for _attempt in range(8):
+        factors: dict = {}
+        for _attempt in range(12):
             try:
-                out, meta, join_over, a2a_over = self._execute(
-                    dp, staged, snapshot_ts, txid, params, factor,
-                    dict(buckets))
+                out, meta, over_jids, a2a_over = self._execute(
+                    dp, staged, snapshot_ts, txid, params,
+                    dict(factors), dict(buckets))
             except (jax.errors.TracerBoolConversionError,
                     jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError) as e:
@@ -370,18 +362,23 @@ class MeshRunner:
                 for i in buckets:
                     buckets[i] *= 2
                 grew = True
-            if join_over:
-                factor *= 2
+            for jid in over_jids:
+                factors[jid] = factors.get(jid, 1) * 2
+                if factors[jid] > 4096:
+                    raise MeshUnsupported("join size ladder exhausted")
                 grew = True
             if not grew:
-                cols, valid, nulls = out
-                return DBatch(
-                    {n: jnp.asarray(np.asarray(a))
-                     for n, a in cols.items()},
-                    jnp.asarray(np.asarray(valid)),
-                    dict(meta["types"]), dict(meta["dicts"]),
-                    {n: jnp.asarray(np.asarray(a))
-                     for n, a in nulls.items()})
+                result = {}
+                for gi, (cols, valid, nulls) in out.items():
+                    gmeta = meta[gi]
+                    result[gi] = DBatch(
+                        {n: jnp.asarray(np.asarray(a))
+                         for n, a in cols.items()},
+                        jnp.asarray(np.asarray(valid)),
+                        dict(gmeta["types"]), dict(gmeta["dicts"]),
+                        {n: jnp.asarray(np.asarray(a))
+                         for n, a in nulls.items()})
+                return result
         raise MeshUnsupported("size-class ladder exhausted")
 
     @staticmethod
@@ -417,16 +414,16 @@ class MeshRunner:
                     MeshRunner._plan_key(node.child))
         raise MeshUnsupported(t)
 
-    def _execute(self, dp, staged, snapshot_ts, txid, params, factor,
+    def _execute(self, dp, staged, snapshot_ts, txid, params, factors,
                  buckets):
         from .executor import ExecContext, Executor
 
         table_names = sorted(staged)
         gather_ex = [ex for ex in dp.exchanges
                      if ex.kind in ("gather", "gather_one")]
-        if len(gather_ex) != 1:
-            raise MeshUnsupported(
-                f"{len(gather_ex)} gather exchanges (need exactly 1)")
+        if not gather_ex:
+            raise MeshUnsupported("no gather exchange")
+        gather_idx = [ex.index for ex in gather_ex]
 
         try:
             prog_key = hash((
@@ -439,7 +436,8 @@ class MeshRunner:
                        tuple(sorted((c, len(d.values)) for c, d in
                              staged[t].view.dicts.items())))
                       for t in table_names),
-                factor, tuple(sorted(buckets.items())),
+                tuple(sorted(factors.items())),
+                tuple(sorted(buckets.items())),
                 tuple(sorted((k, v) for k, (v, _t) in params.items())),
             ))
         except TypeError:
@@ -448,8 +446,8 @@ class MeshRunner:
         cached = self._programs.get(prog_key)
         if cached is not None:
             fn, meta = cached
-            return self._call_program(fn, meta, staged, table_names,
-                                      snapshot_ts, txid)
+            return self._call_program(fn, meta, gather_idx, staged,
+                                      table_names, snapshot_ts, txid)
 
         meta: dict = {}
 
@@ -467,16 +465,16 @@ class MeshRunner:
                 snapshot_ts=snap, txid=txn, cache=None,
                 params=dict(params),
                 staged=arrs_by_table,
-                join_size_factor=factor)
+                join_factors=dict(factors))
             ex_batches: dict = {}
             overflows = []
             join_reqs = []
-            top_out = None
+            gather_out: dict = {}
             for frag in dp.fragments:
                 if frag.index == dp.top_fragment:
                     continue
                 plan = self._bind(frag.plan, ex_batches)
-                exe = Executor(ctx)
+                exe = Executor(ctx, frag_tag=frag.index)
                 exe._traced = True
                 b = exe.exec_node(plan)
                 join_reqs.extend(exe.join_required)
@@ -496,25 +494,34 @@ class MeshRunner:
                             keep1 = jax.lax.axis_index(self.axis) == 0
                             ob = dataclasses.replace(
                                 ob, valid=ob.valid & keep1)
-                        meta["types"] = ob.types
-                        meta["dicts"] = ob.dicts
-                        top_out = (ob.cols, ob.valid, ob.nulls)
-            if top_out is None:
-                raise MeshUnsupported("no gather output")
+                        meta[ex.index] = {"types": ob.types,
+                                          "dicts": ob.dicts}
+                        gather_out[ex.index] = (ob.cols, ob.valid,
+                                                ob.nulls)
+            missing = [gi for gi in gather_idx if gi not in gather_out]
+            if missing:
+                raise MeshUnsupported(f"gather {missing} not produced")
             a2a_over = sum(overflows) if overflows else jnp.int64(0)
-            join_over = jnp.int64(0)
-            for req, cap in join_reqs:
-                join_over = join_over + jax.lax.psum(
-                    (req > cap).astype(jnp.int64), self.axis)
-            return top_out, a2a_over, join_over
+            meta["jid_order"] = [jid for jid, _r, _c in join_reqs]
+            if join_reqs:
+                join_over = jnp.stack([
+                    jax.lax.psum((req > cap).astype(jnp.int64),
+                                 self.axis)
+                    for _jid, req, cap in join_reqs])
+            else:
+                join_over = jnp.zeros(0, jnp.int64)
+            return (tuple(gather_out[gi] for gi in gather_idx),
+                    a2a_over, join_over)
 
         in_specs = [PS(), PS()]
         for t in table_names:
             in_specs.extend([PS(self.axis)] * (len(staged[t].arrs) + 1))
 
         kwargs = dict(mesh=self.mesh, in_specs=tuple(in_specs),
-                      out_specs=((PS(self.axis), PS(self.axis),
-                                  PS(self.axis)), PS(), PS()))
+                      out_specs=(tuple((PS(self.axis), PS(self.axis),
+                                        PS(self.axis))
+                                       for _ in gather_idx),
+                                 PS(), PS()))
         try:
             smapped = shard_map(prog, check_vma=False, **kwargs)
         except TypeError:
@@ -526,19 +533,22 @@ class MeshRunner:
         self._programs[prog_key] = (fn, meta)
         if len(self._programs) > 128:
             self._programs.pop(next(iter(self._programs)))
-        return self._call_program(fn, meta, staged, table_names,
-                                  snapshot_ts, txid)
+        return self._call_program(fn, meta, gather_idx, staged,
+                                  table_names, snapshot_ts, txid)
 
-    def _call_program(self, fn, meta, staged, table_names, snapshot_ts,
-                      txid):
+    def _call_program(self, fn, meta, gather_idx, staged, table_names,
+                      snapshot_ts, txid):
         flat_args = [jnp.int64(snapshot_ts), jnp.int64(txid)]
         for t in table_names:
             for n in sorted(staged[t].arrs):
                 flat_args.append(staged[t].arrs[n])
             flat_args.append(staged[t].nrows)
-        (cols, valid, nulls), a2a_over, join_over = fn(*flat_args)
-        return ((cols, valid, nulls), meta,
-                int(jax.device_get(join_over)) > 0,
+        outs, a2a_over, join_over = fn(*flat_args)
+        over_vec = np.asarray(jax.device_get(join_over))
+        over_jids = sorted({jid for jid, ov in
+                            zip(meta.get("jid_order", ()), over_vec)
+                            if ov > 0})
+        return (dict(zip(gather_idx, outs)), meta, over_jids,
                 int(jax.device_get(a2a_over)) > 0)
 
 

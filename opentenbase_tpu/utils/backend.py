@@ -8,9 +8,12 @@ own process safe, then either keeps the TPU or falls back to CPU.
 
 `connect()` is called from `opentenbase_tpu/__init__.py` so that a plain
 library consumer (`python my_driver.py` with any JAX_PLATFORMS value) can
-never hang at the first jnp op.  The probe verdict is cached across
-processes in a temp file so a test run of many interpreters pays for at
-most one probe per TTL window.
+never hang at the first jnp op.  Only the NEGATIVE verdict is cached
+across processes (temp file): when the tunnel is wedged, a run of many
+interpreters pays for at most one full-timeout probe per TTL window.  A
+healthy tunnel answers in seconds, so positive verdicts are deliberately
+re-probed every time — trusting a stale "healthy" would reintroduce the
+indefinite hang this module exists to prevent.
 
 Env knobs:
 - OTB_TPU_PROBE_TIMEOUT  seconds for the subprocess probe (default 60)

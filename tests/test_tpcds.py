@@ -228,3 +228,11 @@ class TestTpcdsStarter:
 
     def test_q54_cte_agg_join(self, sess, frames):
         rows_equal(sess.query(Q[54]), self._q54(frames))
+
+
+def test_distributed_queries_ran_on_the_mesh(cs):
+    """All distributed TPC-DS runs above must have used the shard_map
+    device tier (mesh default-on; zero silent host fallbacks)."""
+    assert cs.fallbacks == [], f"silent host fallbacks: {cs.fallbacks}"
+    assert cs.tier_counts.get("host", 0) == 0, cs.tier_counts
+    assert cs.tier_counts.get("mesh", 0) >= 4, cs.tier_counts

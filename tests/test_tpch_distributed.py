@@ -1,7 +1,10 @@
 """Distributed TPC-H: the same 22-query oracle suite as test_tpch.py,
-executed on a 4-datanode cluster (fragments + exchanges + FQS).  The
-analog of the reference's multi-node regression tier
-(src/test/opentenbase_test — real mini-cluster on one machine)."""
+executed on a 4-datanode cluster (fragments + exchanges + FQS) with the
+device-mesh data plane ON (the default): every non-FQS query must compile
+through ONE shard_map program (exec/mesh_exec.py) with ZERO silent host
+fallbacks — the CI proof that the flagship tier carries the whole
+benchmark suite.  The analog of the reference's multi-node regression
+tier (src/test/opentenbase_test — real mini-cluster on one machine)."""
 
 import pytest
 
@@ -41,3 +44,14 @@ def test_data_is_sharded(env):
     # replicated dims are whole on every node
     for dn in s.cluster.datanodes:
         assert dn.stores["nation"].row_count() == 25
+
+
+def test_all_22_queries_ran_on_the_mesh(env):
+    """Runs AFTER the 22-query class above (pytest definition order):
+    every distributed plan must have executed through the shard_map
+    device tier — 22/22, no silent fallbacks (VERDICT r2 item #1)."""
+    s, _ = env
+    assert s.fallbacks == [], f"silent host fallbacks: {s.fallbacks}"
+    assert s.tier_counts.get("host", 0) == 0, s.tier_counts
+    # 22 queries, some with extra mesh-run subplans (Q11/Q15/Q22)
+    assert s.tier_counts.get("mesh", 0) >= 22, s.tier_counts
