@@ -101,24 +101,39 @@ def _time(fn, repeat):
 
 
 def _oltp_latencies(s, n=200):
-    """FQS point-op p50 (ms): single-shard INSERT and dist-key SELECT."""
+    """Point-op p50 (ms): single-shard INSERT, raw-literal SELECT (replan
+    + recompile per value), and PREPAREd SELECT (plan cache + light
+    coordinator — the execLight.c OLTP fast path)."""
     s.execute("create table if not exists bench_kv (k bigint primary key, "
               "v bigint) distribute by shard(k)")
-    ins, sel = [], []
+    s.execute("prepare __bget (bigint) as "
+              "select v from bench_kv where k = $1")
+    s.execute("prepare __bins (bigint, bigint) as "
+              "insert into bench_kv values ($1, $2)")
+    ins, raw, prep = [], [], []
     for i in range(n):
         t0 = time.perf_counter()
-        s.execute(f"insert into bench_kv values ({i}, {i * 7})")
+        s.execute(f"execute __bins ({i}, {i * 7})")
         ins.append(time.perf_counter() - t0)
+        if i < 30:   # the slow arm: cap its share of bench wall-clock
+            t0 = time.perf_counter()
+            s.query(f"select v from bench_kv where k = {i}")
+            raw.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        s.query(f"select v from bench_kv where k = {i}")
-        sel.append(time.perf_counter() - t0)
-    return (float(np.median(ins) * 1e3), float(np.median(sel) * 1e3))
+        s.query(f"execute __bget ({i})")
+        prep.append(time.perf_counter() - t0)
+    return (float(np.median(ins) * 1e3), float(np.median(raw) * 1e3),
+            float(np.median(prep) * 1e3))
 
 
 def main():
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     repeat = int(os.environ.get("BENCH_REPEAT", "5"))
     mode = os.environ.get("BENCH_MODE", "ladder")
+    if mode not in ("ladder", "single", "mesh"):
+        print(f"unknown BENCH_MODE={mode!r} (ladder|single|mesh)",
+              file=sys.stderr)
+        sys.exit(2)
 
     from opentenbase_tpu.tpch import datagen
     from opentenbase_tpu.tpch.queries import Q
@@ -177,10 +192,11 @@ def main():
             if qn == 1:
                 mesh_q1 = entry
         if os.environ.get("BENCH_OLTP"):
-            ins_p50, sel_p50 = _oltp_latencies(s2)
-            ladder.append({"config": "point ops (FQS)",
+            ins_p50, raw_p50, prep_p50 = _oltp_latencies(s2)
+            ladder.append({"config": "point ops",
                            "insert_p50_ms": ins_p50,
-                           "select_p50_ms": sel_p50})
+                           "select_raw_p50_ms": raw_p50,
+                           "select_prepared_p50_ms": prep_p50})
 
     head = mesh_q1 or ladder[0]
     out = {

@@ -37,6 +37,16 @@ class Catalog:
         # columns (reference: pg_index; the planner consults this for
         # index-scan eligibility, store-level structures live per DN)
         self.btree_cols: dict[str, set] = {}
+        # global secondary indexes: table -> {col -> {"map": mapping
+        # table, "name": index name, "unique": bool}} (reference:
+        # cross-node global indexes, optimizer gate
+        # indxpath.c:4331 allow_global_index_path; the mapping table is
+        # the SHARD-distributed key->owner-shardid relation)
+        self.global_indexes: dict[str, dict] = {}
+        # named local (per-DN) indexes: name -> {"table", "cols",
+        # "method"} so DROP INDEX can resolve them (reference: pg_index
+        # names; structures live in each DN's store)
+        self.local_indexes: dict[str, dict] = {}
         # ANALYZE output: table -> {"rows", "cols": {col: {"ndv", "min",
         # "max"}}} (reference: pg_statistic, consumed by costsize.c)
         self.stats: dict[str, dict] = {}
@@ -117,6 +127,8 @@ class Catalog:
                 "shard_map": self.shard_map.tolist(),
                 "btree_cols": {t: sorted(cs)
                                for t, cs in self.btree_cols.items()},
+                "global_indexes": self.global_indexes,
+                "local_indexes": self.local_indexes,
                 "stats": self.stats,
                 "next_oid": self._next_oid,
             }
@@ -142,6 +154,8 @@ class Catalog:
         cat.shard_map = np.asarray(blob["shard_map"], dtype=np.int32)
         cat.btree_cols = {t: set(cs) for t, cs in
                           blob.get("btree_cols", {}).items()}
+        cat.global_indexes = blob.get("global_indexes", {})
+        cat.local_indexes = blob.get("local_indexes", {})
         cat.stats = blob.get("stats", {})
         cat._next_oid = blob.get("next_oid", 16384)
         return cat

@@ -184,6 +184,27 @@ def cmd_shell(args):
             print(f"ERROR: {type(e).__name__}: {e}")
 
 
+def cmd_restore(args):
+    """Restore the whole cluster to a named barrier (reference: PITR to
+    a CREATE BARRIER point, pgxc/barrier/barrier.c).  Run against a
+    STOPPED cluster dir (embedded mode re-attaches the datadirs)."""
+    from ..parallel.cluster import Cluster
+    cluster = Cluster(datadir=args.dir)
+    cluster.restore_barrier(args.barrier)
+    cluster.checkpoint()
+    print(f"cluster {args.dir} restored to barrier {args.barrier!r}")
+
+
+def cmd_barriers(args):
+    from ..parallel.cluster import Cluster
+    cluster = Cluster(datadir=args.dir)
+    bl = cluster.gtm.barrier_list()
+    if not bl:
+        print("no barriers")
+    for name, info in sorted(bl.items(), key=lambda kv: kv[1]["gts"]):
+        print(f"{name}\tgts={info['gts']}")
+
+
 def cmd_status(args):
     addrpath = os.path.join(args.dir, "addresses.json")
     if not os.path.exists(addrpath):
@@ -226,6 +247,13 @@ def main(argv=None):
     p = sub.add_parser("status")
     p.add_argument("dir")
     p.set_defaults(fn=cmd_status)
+    p = sub.add_parser("restore")
+    p.add_argument("dir")
+    p.add_argument("--barrier", required=True)
+    p.set_defaults(fn=cmd_restore)
+    p = sub.add_parser("barriers")
+    p.add_argument("dir")
+    p.set_defaults(fn=cmd_barriers)
     args = ap.parse_args(argv)
     args.fn(args)
 

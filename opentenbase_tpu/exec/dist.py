@@ -180,8 +180,9 @@ class DistExecutor:
         if dp.fqs_node is not None:
             # whole-query shipped to one datanode (FQS).  An in-process
             # datanode returns the device batch directly (no host
-            # round-trip on the OLTP fast path).
-            self.tier = "fqs"
+            # round-trip on the OLTP fast path).  'gidx' = the node was
+            # pinned through a global-index lookup rather than dist keys.
+            self.tier = "gidx" if getattr(dp, "via_gidx", "") else "fqs"
             dn = self.cluster.datanodes[dp.fqs_node]
             frag = dp.fragments[dp.top_fragment]
             if hasattr(dn, "exec_plan_device"):

@@ -14,11 +14,15 @@ from typing import Optional
 
 import numpy as np
 
+import copy
+
+from ..catalog import types as T
 from ..catalog.schema import DistType, TableDef
 from ..catalog.types import TypeKind
 from ..parallel.cluster import Cluster
 from ..plan import physical as P
-from ..plan.distribute import DistPlan, Distributor
+from ..plan.distribute import (DistPlan, Distributor, Fragment,
+                               fqs_param_router)
 from ..plan.planner import PlannedStmt, Planner
 from ..sql import ast as A
 from ..sql.analyze import Binder
@@ -27,6 +31,49 @@ from ..sql.parser import parse_sql
 from .dist import DistExecutor
 from .executor import ExecContext, ExecError, Executor, materialize
 from .session import Result
+
+
+@dataclasses.dataclass
+class Prepared:
+    """A named prepared statement (reference: CachedPlanSource,
+    tcop/postgres.c:2411 + commands/prepare.c).
+
+    mode 'plan': the statement was bound ONCE with $n as runtime-parameter
+    columns; EXECUTE seeds the executor's param dict and reuses the same
+    physical plan — and, through the fused/mesh tiers' traced-parameter
+    inputs, the same compiled XLA program — for every binding.  A router
+    (the light-coordinator analog, execLight.c:34) ships dist-key-pinned
+    statements whole to one datanode.
+
+    mode 'ast': binding with abstract params failed (e.g. TEXT params in
+    dictionary predicates); EXECUTE substitutes argument literals into
+    the stored parse tree and replans — still skipping the parse.
+    """
+    stmt: A.Node
+    param_types: dict
+    mode: str = "ast"
+    planned: object = None        # pristine PlannedStmt (FQS fragment)
+    dp: object = None             # generic distributed DistPlan
+    router: object = None         # params -> datanode index | None
+    ddl_gen: int = -1
+
+
+def _subst_params(obj, args: list):
+    """Rebuild an AST with $n replaced by the EXECUTE argument literals
+    (the custom-plan path: re-bound per execution)."""
+    if isinstance(obj, A.Param):
+        if obj.index - 1 >= len(args):
+            raise ExecError(f"no value for parameter ${obj.index}")
+        return copy.deepcopy(args[obj.index - 1])
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return type(obj)(**{f.name: _subst_params(getattr(obj, f.name),
+                                                  args)
+                            for f in dataclasses.fields(obj)})
+    if isinstance(obj, list):
+        return [_subst_params(x, args) for x in obj]
+    if isinstance(obj, tuple):
+        return tuple(_subst_params(x, args) for x in obj)
+    return obj
 
 
 class ClusterTxn:
@@ -41,6 +88,7 @@ class ClusterSession:
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
         self.txn: Optional[ClusterTxn] = None
+        self.txn_aborted = False
         # data plane of the last SELECT (surfaced in EXPLAIN ANALYZE and
         # asserted by the mesh CI suite): 'mesh' | 'fqs' | 'host'
         self.last_tier = ""
@@ -50,6 +98,9 @@ class ClusterSession:
         # host fallbacks
         self.tier_counts: dict[str, int] = {}
         self.fallbacks: list[str] = []
+        # named prepared statements + plan-cache telemetry
+        self.prepared: dict[str, Prepared] = {}
+        self.plan_cache_hits = 0
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> list[Result]:
@@ -58,9 +109,21 @@ class ClusterSession:
             if self.cluster.gucs.get("audit_enabled", "off") == "on" \
             else None
         for s in parse_sql(sql):
+            if self.txn is not None and self.txn_aborted \
+                    and not isinstance(s, A.TxnStmt):
+                # PG semantics: after an error the txn is poisoned —
+                # only COMMIT (which rolls back) or ROLLBACK may follow
+                raise ExecError(
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block")
             try:
                 r = self._exec_stmt(s)
             except Exception as e:
+                if self.txn is not None:
+                    # a failed statement aborts the explicit txn: its
+                    # earlier (and possibly partially-staged) writes
+                    # must never COMMIT (PG: aborted-transaction state)
+                    self.txn_aborted = True
                 if audit:
                     audit.record(type(s).__name__, str(e), ok=False)
                 raise
@@ -102,6 +165,13 @@ class ClusterSession:
             c.gtm.seq_create(sd.name, sd.start, sd.increment)
             return Result("CREATE SEQUENCE")
         if isinstance(stmt, A.CreateIndexStmt):
+            if stmt.global_:
+                from ..parallel import gindex
+                try:
+                    gindex.create(self, stmt)
+                except gindex.GIndexError as e:
+                    raise ExecError(str(e)) from None
+                return Result("CREATE INDEX")
             if stmt.method == "ivfflat":
                 td = c.catalog.table(stmt.table)
                 col = stmt.columns[0]
@@ -134,8 +204,38 @@ class ClusterSession:
                     raise ExecError(str(e)) from None
                 c.catalog.btree_cols.setdefault(
                     stmt.table, set()).update(stmt.columns)
-                c._save_catalog()
+            c.catalog.local_indexes[stmt.name] = {
+                "table": stmt.table, "cols": list(stmt.columns),
+                "method": stmt.method or "btree"}
+            c._save_catalog()
+            # cached plans must replan to see the new access path
+            c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
             return Result("CREATE INDEX")
+        if isinstance(stmt, A.DropIndexStmt):
+            from ..parallel import gindex
+            try:
+                if gindex.drop(self, stmt.name, if_exists=True):
+                    return Result("DROP INDEX")
+            except gindex.GIndexError as e:
+                raise ExecError(str(e)) from None
+            li = c.catalog.local_indexes.pop(stmt.name, None)
+            if li is None:
+                if stmt.if_exists:
+                    return Result("DROP INDEX")
+                raise ExecError(f"index {stmt.name!r} does not exist")
+            if li["method"] == "btree":
+                # deregister from the planner; other named indexes on
+                # the same (table, col) keep it eligible
+                still = {c2 for n2, e2 in c.catalog.local_indexes.items()
+                         if e2["table"] == li["table"]
+                         and e2["method"] == "btree"
+                         for c2 in e2["cols"]}
+                cols = c.catalog.btree_cols.get(li["table"], set())
+                c.catalog.btree_cols[li["table"]] = cols & still | \
+                    (cols - set(li["cols"]))
+            c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
+            c._save_catalog()
+            return Result("DROP INDEX")
         if isinstance(stmt, A.InsertStmt):
             return self._exec_insert(stmt)
         if isinstance(stmt, A.DeleteStmt):
@@ -178,22 +278,152 @@ class ClusterSession:
             c._save_catalog()
             return Result("ANALYZE")
         if isinstance(stmt, A.BarrierStmt):
-            # 2-phase cluster-wide consistency point (reference:
-            # pgxc/barrier/barrier.c): block new txns implicitly by
-            # checkpointing every node at one GTS
-            c.checkpoint()
+            # 2-phase cluster-wide restore point (reference:
+            # pgxc/barrier/barrier.c): barrier WAL records on every DN +
+            # retained artifacts + GTM registration; restore via
+            # `ctl restore --barrier` / Cluster.restore_barrier
+            if not c.create_barrier(stmt.name):
+                raise ExecError("BARRIER refused: transactions in flight")
             return Result("BARRIER")
         if isinstance(stmt, A.ExecuteDirectStmt):
             return self._exec_direct(stmt)
+        if isinstance(stmt, A.PrepareStmt):
+            return self._exec_prepare(stmt)
+        if isinstance(stmt, A.ExecuteStmt):
+            return self._exec_execute(stmt)
+        if isinstance(stmt, A.DeallocateStmt):
+            if stmt.name is None:
+                self.prepared.clear()
+            elif self.prepared.pop(stmt.name, None) is None:
+                raise ExecError(
+                    f"prepared statement {stmt.name!r} does not exist")
+            return Result("DEALLOCATE")
         raise ExecError(f"unsupported statement {type(stmt).__name__}")
 
+    # ---- prepared statements / OLTP fast path ----
+    def _ddl_gen(self) -> int:
+        return getattr(self.cluster, "ddl_gen", 0)
+
+    def _exec_prepare(self, stmt: A.PrepareStmt) -> Result:
+        ptypes = {i + 1: T.type_from_name(nm, targs)
+                  for i, (nm, targs) in enumerate(stmt.types)}
+        self.prepared[stmt.name] = self._build_prepared(stmt.stmt, ptypes)
+        return Result("PREPARE")
+
+    def _build_prepared(self, inner: A.Node, ptypes: dict) -> Prepared:
+        from ..sql.analyze import BindError
+        prep = Prepared(inner, ptypes, ddl_gen=self._ddl_gen())
+        if isinstance(inner, A.SelectStmt):
+            try:
+                binder = Binder(self.cluster.catalog, param_types=ptypes)
+                bq = binder.bind_select(inner)
+                planned = Planner(self.cluster.catalog).plan(bq)
+                # distribute() rewrites the tree in place: keep a pristine
+                # copy as the whole-statement (FQS/light) fragment
+                pristine = copy.deepcopy(planned)
+                d = Distributor(self.cluster.catalog, self.cluster.ndn)
+                prep.dp = d.distribute(planned, None)
+                prep.planned = pristine
+                prep.router = fqs_param_router(bq, self.cluster.catalog)
+                prep.mode = "plan"
+            except BindError as e:
+                if "substitution path" not in str(e):
+                    # invalid statement: error at PREPARE time (PG does)
+                    raise ExecError(str(e)) from None
+                # TEXT params inside dictionary predicates: fall back to
+                # literal substitution + replan per EXECUTE
+                # (PostgreSQL's custom-plan path)
+                prep.mode = "ast"
+            except ValueError:
+                # binds fine but this shape can't pre-plan with abstract
+                # params (e.g. a bare-param projection): substitute
+                prep.mode = "ast"
+        return prep
+
+    def _bind_arg(self, node: A.Node, t) -> object:
+        """EXECUTE argument literal -> storage-representation value
+        matching the declared type (scaled int for DECIMAL, days for
+        DATE) — the form E.Lit carries."""
+        if isinstance(node, A.UnaryOp) and node.op == "-":
+            v = self._bind_arg(node.arg, t)
+            if isinstance(v, (int, float)):
+                return -v
+            raise ExecError("cannot negate a non-numeric argument")
+        if isinstance(node, A.TypedConst) and node.type_name == "date":
+            return T.date_to_days(node.value)
+        if not isinstance(node, A.Const):
+            raise ExecError("EXECUTE arguments must be literals")
+        v = node.value
+        k = t.kind
+        if k == TypeKind.DECIMAL:
+            return T.decimal_to_int(str(v), t.scale)
+        if k == TypeKind.DATE:
+            return T.date_to_days(str(v))
+        if k == TypeKind.FLOAT64:
+            return float(v)
+        if k == TypeKind.TEXT:
+            return str(v)
+        if k == TypeKind.BOOL:
+            return bool(v)
+        return int(v)
+
+    def _exec_execute(self, stmt: A.ExecuteStmt) -> Result:
+        prep = self.prepared.get(stmt.name)
+        if prep is None:
+            raise ExecError(
+                f"prepared statement {stmt.name!r} does not exist")
+        if prep.ddl_gen != self._ddl_gen():
+            # DDL since PREPARE: replan against the current catalog
+            prep = self._build_prepared(prep.stmt, prep.param_types)
+            self.prepared[stmt.name] = prep
+        if prep.mode != "plan":
+            sub = _subst_params(prep.stmt, stmt.args)
+            return self._exec_stmt(sub)
+        if len(stmt.args) != len(prep.param_types):
+            raise ExecError(
+                f"wrong number of parameters: got {len(stmt.args)}, "
+                f"need {len(prep.param_types)}")
+        params = {}
+        for i, arg in enumerate(stmt.args, start=1):
+            t = prep.param_types[i]
+            params[f"__bindparam{i}"] = (self._bind_arg(arg, t), t)
+        self.plan_cache_hits += 1
+        self._refresh_stat_views(prep.stmt)
+        t, implicit = self._begin_implicit()
+        node = prep.router(params) if prep.router is not None else None
+        if node is not None:
+            # light-coordinator path: the whole statement runs on ONE
+            # datanode with bound params (reference: execLight.c:34-59)
+            dp = DistPlan([Fragment(0, prep.planned.plan, "dn")], [], 0,
+                          prep.planned.init_plans,
+                          prep.planned.output_names, fqs_node=node)
+        else:
+            dp = prep.dp
+        res, _ex = self._run_select_dp(dp, t, params)
+        return res
+
     # ---- SELECT ----
-    def _plan_distributed(self, stmt: A.SelectStmt) -> DistPlan:
+    def _plan_distributed(self, stmt: A.SelectStmt,
+                          txn: "ClusterTxn" = None) -> DistPlan:
         binder = Binder(self.cluster.catalog)
         bq = binder.bind_select(stmt)
         planned = Planner(self.cluster.catalog).plan(bq)
         fqs_enabled = self.cluster.gucs.get(
             "enable_fast_query_shipping", "on") != "off"
+        gidx_enabled = self.cluster.gucs.get(
+            "enable_global_indexscan", "on") != "off"
+        if fqs_enabled and gidx_enabled and txn is not None \
+                and self.cluster.catalog.global_indexes:
+            from ..parallel import gindex
+            from ..plan.distribute import fqs_target_node
+            if fqs_target_node(bq, self.cluster.catalog) is None:
+                hit = gindex.route(self, bq, txn.snapshot_ts, txn.txid)
+                if hit is not None:
+                    node, via = hit
+                    return DistPlan([Fragment(0, planned.plan, "dn")],
+                                    [], 0, planned.init_plans,
+                                    planned.output_names, fqs_node=node,
+                                    via_gidx=via)
         d = Distributor(self.cluster.catalog, self.cluster.ndn)
         return d.distribute(planned, bq if fqs_enabled else None)
 
@@ -219,33 +449,41 @@ class ClusterSession:
         if wanted:
             statviews.refresh(self.cluster, wanted)
 
-    def _exec_select(self, stmt: A.SelectStmt,
-                     instrument: bool = False) -> tuple:
-        self._refresh_stat_views(stmt)
-        dp = self._plan_distributed(stmt)
-        t, implicit = self._begin_implicit()
+    def _run_select_dp(self, dp: DistPlan, txn: ClusterTxn,
+                       params: dict = None, instrument: bool = False):
+        """Run a SELECT DistPlan under admission control and record the
+        data-plane telemetry — shared by plain SELECT and EXECUTE.  The
+        device-mesh data plane is the default (reference: the FN plane is
+        the default tuple transport); 'off' forces the host tier."""
         queue = self.cluster.resource_queue()
         if queue is not None:
             queue.acquire()
         try:
-            # the device-mesh data plane is the default (reference: the FN
-            # plane is the default tuple transport); 'off' forces the
-            # host-mediated tier
-            ex = DistExecutor(self.cluster, t.snapshot_ts, t.txid,
+            ex = DistExecutor(self.cluster, txn.snapshot_ts, txn.txid,
                               instrument=instrument,
                               use_mesh=self.cluster.gucs.get(
                                   "enable_mesh_exchange", "on") != "off")
+            if params:
+                ex.params.update(params)
             batch = ex.run(dp)
         finally:
             if queue is not None:
                 queue.release()
         names, rows = materialize(batch, dp.output_names)
-        res = Result("SELECT", names=names, rows=rows, rowcount=len(rows))
         self.last_tier = ex.tier
         self.last_fallback = ex.fallback_reason
         self.tier_counts[ex.tier] = self.tier_counts.get(ex.tier, 0) + 1
         if ex.tier == "host" and ex.fallback_reason:
             self.fallbacks.append(ex.fallback_reason)
+        return Result("SELECT", names=names, rows=rows,
+                      rowcount=len(rows)), ex
+
+    def _exec_select(self, stmt: A.SelectStmt,
+                     instrument: bool = False) -> tuple:
+        self._refresh_stat_views(stmt)
+        t, implicit = self._begin_implicit()
+        dp = self._plan_distributed(stmt, txn=t)
+        res, ex = self._run_select_dp(dp, t, instrument=instrument)
         if instrument:
             return res, ex, dp
         return res
@@ -287,12 +525,240 @@ class ClusterSession:
         missing = [cn for cn in td.column_names if cn not in coldata]
         if missing:
             raise ExecError(f"INSERT missing columns {missing}")
+        if stmt.on_conflict is not None:
+            return self._exec_upsert(td, stmt.on_conflict, coldata,
+                                     len(rows))
         n = self._insert_rows(td, coldata, len(rows))
         return Result("INSERT", rowcount=n)
+
+    # ---- UPSERT (reference: the select/insert/update legs built by
+    # pgxc_build_upsert_statement, pgxc/plan/planner.c:1070, executed by
+    # nodeRemoteModifyTable.c) ----
+    def _key_quals(self, td: TableDef, target: list, keys: set) -> list:
+        """Device-evaluable quals selecting rows whose key is in `keys`
+        (single-column targets; multi-column callers filter host-side)."""
+        from ..parallel import gindex
+        if len(target) != 1 or not keys:
+            return []
+        cname = target[0]
+        return gindex.key_quals(td, cname, f"{td.name}.{cname}",
+                                [k[0] for k in keys])
+
+    def _exec_upsert(self, td: TableDef, oc: A.OnConflict, coldata: dict,
+                     n: int) -> Result:
+        from ..parallel import gindex
+        c = self.cluster
+        target = list(oc.columns) or list(td.distribution.dist_cols)
+        if not target:
+            raise ExecError("ON CONFLICT requires a conflict target "
+                            "column list on this table")
+        if td.distribution.dist_type != DistType.REPLICATED and \
+                not set(td.distribution.dist_cols) <= set(target):
+            raise ExecError(
+                "ON CONFLICT target must include the distribution key")
+        for cn in target:
+            if cn not in coldata:
+                raise ExecError(
+                    f"ON CONFLICT target column {cn!r} not inserted")
+        if oc.action == "update":
+            # validate the SET list BEFORE any destructive leg runs
+            bad = [cn for cn, _ in oc.assignments
+                   if not td.has_column(cn)]
+            if bad:
+                raise ExecError(
+                    f"unknown columns in DO UPDATE SET: {bad}")
+            if {cn for cn, _ in oc.assignments} & set(target):
+                raise ExecError(
+                    "DO UPDATE may not change the conflict target")
+
+        key_cols = {}
+        for cn in target:
+            ks = gindex.storage_keys(td, cn, coldata[cn])
+            if any(k is None for k in ks):
+                raise ExecError("ON CONFLICT key value may not be NULL")
+            key_cols[cn] = ks
+        in_keys = [tuple(key_cols[cn][i] for cn in target)
+                   for i in range(n)]
+        # batch-internal duplicates: PG errors for DO UPDATE ("cannot
+        # affect row a second time"); DO NOTHING keeps the first
+        seen: dict = {}
+        keep_rows = []
+        for i, k in enumerate(in_keys):
+            if k in seen:
+                if oc.action == "update":
+                    raise ExecError("ON CONFLICT DO UPDATE command cannot "
+                                    "affect row a second time")
+                continue
+            seen[k] = i
+            keep_rows.append(i)
+
+        t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
+            c.active_txns.add(t.txid)
+        try:
+            # the SELECT leg: existing visible rows matching incoming keys
+            from ..plan import exprs as E
+            quals = self._key_quals(td, target, set(in_keys))
+            plan = P.SeqScan(
+                td, td.name, quals,
+                [(f"{td.name}.{col.name}",
+                  E.Col(f"{td.name}.{col.name}", col.type))
+                 for col in td.columns])
+            existing: dict = {}   # key tuple -> (row dict, null set)
+            match_counts: dict = {}
+            if td.distribution.dist_type == DistType.REPLICATED:
+                dns = c.datanodes[:1]
+            else:
+                # the conflict target covers the dist key, so matching
+                # rows can only live on the incoming rows' owner nodes —
+                # no full fan-out on the OLTP path
+                route_cols = {dc: np.asanyarray(
+                    [0 if v is None else v for v in coldata[dc]])
+                    for dc in td.distribution.dist_cols}
+                owner = c.locator.route_rows(td, route_cols, n)
+                dns = [c.datanodes[i] for i in sorted(set(owner.tolist()))]
+            for dn in dns:
+                hb = dn.exec_plan(plan, t.snapshot_ts, t.txid, {}, {})
+                kcols = [hb.cols[f"{td.name}.{cn}"] for cn in target]
+                for ri in range(hb.nrows):
+                    k = tuple(kc[ri].item() if hasattr(kc[ri], "item")
+                              else kc[ri] for kc in kcols)
+                    if k in seen:
+                        match_counts[k] = match_counts.get(k, 0) + 1
+                        row = {cn: hb.cols[f"{td.name}.{cn}"][ri]
+                               for cn in td.column_names}
+                        nulls = {cn for cn in td.column_names
+                                 if f"{td.name}.{cn}" in hb.nulls
+                                 and hb.nulls[f"{td.name}.{cn}"][ri]}
+                        existing[k] = (row, nulls)
+            if oc.action == "update":
+                # the arbiter must identify ONE row per key: a duplicate
+                # match would be silently collapsed by delete+reinsert
+                # (PostgreSQL requires a unique arbiter index for the
+                # same reason)
+                multi = [k for k, cnt in match_counts.items() if cnt > 1]
+                if multi:
+                    raise ExecError(
+                        "ON CONFLICT DO UPDATE requires the conflict "
+                        f"target to be unique; key {multi[0]!r} matches "
+                        f"{match_counts[multi[0]]} rows")
+
+            conflict_rows = [i for i in keep_rows
+                             if in_keys[i] in existing]
+            fresh_rows = [i for i in keep_rows
+                          if in_keys[i] not in existing]
+
+            inserted = updated = 0
+            if fresh_rows:
+                sub = {cn: [coldata[cn][i] for i in fresh_rows]
+                       for cn in coldata}
+                inserted = self._insert_rows(td, sub, len(fresh_rows))
+            if conflict_rows and oc.action == "update":
+                # the UPDATE leg: delete conflicting rows, re-insert with
+                # assignments applied (MVCC update = delete + insert)
+                ckeys = {in_keys[i] for i in conflict_rows}
+                dquals = self._key_quals(td, target, ckeys)
+                if not dquals:
+                    raise ExecError("multi-column ON CONFLICT DO UPDATE "
+                                    "is not supported yet")
+                ddns = c.datanodes if td.distribution.dist_type == \
+                    DistType.REPLICATED else dns
+                for dn in ddns:
+                    nd = dn.delete_where(td.name, dquals, t.snapshot_ts,
+                                         t.txid)
+                    if nd:
+                        t.written_dns.add(dn.index)
+                greg = gindex.indexes_on(c.catalog, td.name)
+                if greg:
+                    # drop the deleted rows' mapping entries BEFORE the
+                    # replacement insert re-adds (and unique-checks) them
+                    affected = {}
+                    for gcol in greg:
+                        ks = set()
+                        for i in conflict_rows:
+                            row, nulls = existing[in_keys[i]]
+                            if gcol in nulls:
+                                continue
+                            v = row[gcol]
+                            ks.add(v.item() if hasattr(v, "item") else v)
+                        affected[gcol] = ks
+                    gindex.resync_keys(self, td, affected, t)
+                assigned = {cn: e for cn, e in oc.assignments}
+                newdata: dict = {}
+                for cn in td.column_names:
+                    col = td.column(cn)
+                    dec_carry = col.type.kind == TypeKind.DECIMAL
+                    vals = []
+                    for i in conflict_rows:
+                        row, nulls = existing[in_keys[i]]
+                        if cn in assigned:
+                            vals.append(self._eval_upsert_assign(
+                                assigned[cn], td, coldata, i, row, nulls))
+                        elif cn in nulls:
+                            vals.append(None)
+                        else:
+                            v = row[cn]
+                            v = v.item() if hasattr(v, "item") else v
+                            if dec_carry:
+                                # carried DECIMALs are storage-scaled:
+                                # exact decimal strings survive re-encode
+                                # (and mix freely with None)
+                                from ..storage.store import _decimal_str
+                                v = _decimal_str(int(v), col.type.scale)
+                            vals.append(v)
+                    newdata[cn] = vals
+                updated = self._insert_rows(td, newdata,
+                                            len(conflict_rows))
+        except Exception:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit(t)
+        return Result("INSERT", rowcount=inserted + updated)
+
+    def _eval_upsert_assign(self, node: A.Node, td: TableDef,
+                            coldata: dict, row_i: int, existing_row: dict,
+                            existing_nulls: set):
+        """DO UPDATE SET expression for one row: literals, excluded.col
+        (the incoming row), or an existing column value."""
+        if isinstance(node, A.Const):
+            return node.value
+        if isinstance(node, A.TypedConst) and node.type_name == "date":
+            return node.value
+        if isinstance(node, A.UnaryOp) and node.op == "-":
+            v = self._eval_upsert_assign(node.arg, td, coldata, row_i,
+                                         existing_row, existing_nulls)
+            return None if v is None else -v
+        if isinstance(node, A.ColRef):
+            parts = node.parts
+            if len(parts) == 2 and parts[0] == "excluded":
+                return coldata[parts[1]][row_i]
+            name = parts[-1]
+            if td.has_column(name) and \
+                    td.column(name).type.kind == TypeKind.DECIMAL:
+                # existing DECIMAL values are storage-scaled; re-encoding
+                # them as raw would double-scale — not supported yet
+                raise ExecError("DO UPDATE SET from an existing DECIMAL "
+                                "column is not supported; use "
+                                "excluded.col or a literal")
+            if name in existing_nulls:
+                return None
+            v = existing_row[name]
+            return v.item() if hasattr(v, "item") else v
+        raise ExecError("ON CONFLICT DO UPDATE supports literals, "
+                        "excluded.col, and plain column references")
 
     def _insert_rows(self, td: TableDef, coldata: dict, n: int) -> int:
         c = self.cluster
         t, implicit = self._begin_implicit()
+        if implicit:
+            # expose the txn so nested writes (global-index maintenance)
+            # join it instead of committing independently
+            self.txn = t
         c.active_txns.add(t.txid)
         try:
             if td.distribution.dist_type == DistType.REPLICATED:
@@ -332,18 +798,31 @@ class ClusterSession:
                 c.datanodes[dn_idx].insert_raw(td.name, sub, len(idx),
                                                t.txid, sub_sid)
                 t.written_dns.add(dn_idx)
+            if sid is not None:
+                from ..parallel import gindex
+                if gindex.indexes_on(c.catalog, td.name):
+                    try:
+                        gindex.maintain_insert(self, td, coldata, n, sid,
+                                               t)
+                    except gindex.GIndexError as e:
+                        raise ExecError(str(e)) from None
         except Exception:
             if implicit:
+                self.txn = None
                 self._abort(t)
             raise
         if implicit:
+            self.txn = None
             self._commit(t)
         return n
 
     def _exec_delete(self, stmt: A.DeleteStmt) -> Result:
+        from ..parallel import gindex
         c = self.cluster
         td = c.catalog.table(stmt.table)
         t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
         c.active_txns.add(t.txid)
         binder = Binder(c.catalog)
         quals = []
@@ -352,18 +831,26 @@ class ClusterSession:
                                from_=[A.TableRef(stmt.table)],
                                where=stmt.where)
             quals = binder.bind_select(sel).where
+        has_gidx = bool(gindex.indexes_on(c.catalog, td.name))
         n_deleted = 0
         try:
+            affected = gindex.affected_keys(self, td, quals, t) \
+                if has_gidx else None
             for dn in c.datanodes:
                 nd = dn.delete_where(td.name, quals, t.snapshot_ts, t.txid)
                 if nd:
                     t.written_dns.add(dn.index)
                 n_deleted += nd
+            if has_gidx and n_deleted:
+                # mapping entries follow the base rows in the SAME txn
+                gindex.resync_keys(self, td, affected, t)
         except Exception:
             if implicit:
+                self.txn = None
                 self._abort(t)
             raise
         if implicit:
+            self.txn = None
             self._commit(t)
         # replicated deletes count each copy once
         if td.distribution.dist_type == DistType.REPLICATED and c.ndn:
@@ -430,24 +917,36 @@ class ClusterSession:
                 self.txn = ClusterTxn(self.cluster.gtm.next_txid(),
                                       self.cluster.gtm.next_gts())
                 self.txn.explicit = True
+                self.txn_aborted = False
                 self.cluster.active_txns.add(self.txn.txid)
             return Result("BEGIN")
         if stmt.op == "commit":
             if self.txn is not None:
+                if self.txn_aborted:
+                    # COMMIT of an aborted txn rolls back (PG behavior)
+                    self._abort(self.txn)
+                    self.txn = None
+                    self.txn_aborted = False
+                    return Result("ROLLBACK")
                 self._commit(self.txn)
                 self.txn = None
             return Result("COMMIT")
         if self.txn is not None:
             self._abort(self.txn)
             self.txn = None
+        self.txn_aborted = False
         return Result("ROLLBACK")
 
     def _exec_explain(self, stmt: A.ExplainStmt) -> Result:
         if not isinstance(stmt.stmt, A.SelectStmt):
             raise ExecError("EXPLAIN supports SELECT only")
-        dp = self._plan_distributed(stmt.stmt)
+        t, _ = self._begin_implicit()
+        dp = self._plan_distributed(stmt.stmt, txn=t)
         lines = []
-        if dp.fqs_node is not None:
+        if dp.via_gidx:
+            lines.append(f"Global Index Route via {dp.via_gidx} "
+                         f"-> dn{dp.fqs_node}")
+        elif dp.fqs_node is not None:
             lines.append(f"Fast Query Shipping -> dn{dp.fqs_node}")
         for frag in reversed(dp.fragments):
             loc = "CN" if frag.index == dp.top_fragment \

@@ -1420,6 +1420,13 @@ def _dict_for_expr(e: E.Expr, dicts: dict):
         if base is None:
             return None
         return [e.apply(v) for v in base]
+    if isinstance(e, E.Lit) and e.lit_type.kind == TypeKind.TEXT \
+            and e.value is not None:
+        # projected TEXT literal: every row decodes to the one value
+        return [str(e.value)]
+    if isinstance(e, E.Case) and e.type.kind == TypeKind.TEXT:
+        from .expr_compile import case_text_dict
+        return case_text_dict(e)
     return None
 
 

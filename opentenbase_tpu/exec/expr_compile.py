@@ -71,6 +71,28 @@ def _rescale(fn, from_scale: int, to_scale: int):
                                                         jnp.int64(_d))
 
 
+def case_text_dict(e) -> "list | None":
+    """Branch dictionary for a TEXT-valued CASE whose THEN/ELSE values
+    are all literals: distinct non-null strings in first-occurrence
+    order (the codes the compiled expression emits index into it).
+    None when any branch is not a TEXT literal."""
+    branches = [v for _, v in e.whens]
+    if e.else_ is not None:
+        branches.append(e.else_)
+    values: list = []
+    for v in branches:
+        if not isinstance(v, E.Lit):
+            return None
+        if v.value is None:
+            continue
+        if v.lit_type.kind != TypeKind.TEXT:
+            return None
+        s = str(v.value)
+        if s not in values:
+            values.append(s)
+    return values or [""]
+
+
 def _strpred_colname(pred: E.StrPred) -> str:
     c = pred.col
     return c.col.name if isinstance(c, E.TextExpr) else c.name
@@ -202,6 +224,10 @@ def compile_pair(e: E.Expr, dicts: dict, nullable=frozenset()):
 
         if isinstance(x, E.Lit):
             t = x.lit_type
+            if t.kind == TypeKind.TEXT and x.value is not None:
+                # a projected TEXT literal: code 0 under a one-entry
+                # dictionary (the executor's _dict_for_expr supplies it)
+                return (lambda cols: jnp.asarray(0, dtype=jnp.int32)), None
             dt = _np_dtype(t)
             if x.value is None:
                 return (lambda cols: jnp.asarray(0, dtype=dt),
@@ -395,6 +421,44 @@ def compile_pair(e: E.Expr, dicts: dict, nullable=frozenset()):
             nf = (lambda env: ln(env) | eqt(env)) if ln is not None \
                 else eqt
             return lf, nf
+
+        if isinstance(x, E.Case) and x.type.kind == TypeKind.TEXT:
+            # TEXT result: branches must be literals; the value is a code
+            # into the shared branch dictionary (case_text_dict — the
+            # executor attaches it to the output column)
+            values = case_text_dict(x)
+            if values is None:
+                raise E.ExprError(
+                    "CASE over TEXT requires literal THEN/ELSE values")
+            index = {s: i for i, s in enumerate(values)}
+
+            def code_of(v):
+                return 0 if v.value is None else index[str(v.value)]
+
+            cond_truths = [_truth(*c(w[0]))[0] for w in x.whens]
+            when_codes = [code_of(v) for _, v in x.whens]
+            else_code = code_of(x.else_) if x.else_ is not None else 0
+
+            def casef(env):
+                out = jnp.asarray(else_code, dtype=jnp.int32)
+                for cond, wc in zip(reversed(cond_truths),
+                                    reversed(when_codes)):
+                    out = jnp.where(cond(env),
+                                    jnp.asarray(wc, jnp.int32), out)
+                return out
+
+            when_nulls = [v.value is None for _, v in x.whens]
+            else_is_null = x.else_ is None or x.else_.value is None
+            if not any(when_nulls) and not else_is_null:
+                return casef, None
+
+            def case_nf(env):
+                out = jnp.asarray(else_is_null)
+                for cond, bn in zip(reversed(cond_truths),
+                                    reversed(when_nulls)):
+                    out = jnp.where(cond(env), jnp.asarray(bn), out)
+                return out
+            return casef, case_nf
 
         if isinstance(x, E.Case):
             cond_truths = [_truth(*c(w[0]))[0] for w in x.whens]

@@ -182,14 +182,18 @@ def try_fused(executor, node) -> Optional[object]:
         meta: dict = {}
         traced_types = [ctx.params[k][1] for k in traced_names]
 
-        def run(arrs_in, snap, txid, pvals, n_static):
+        def run(arrs_in, snap, txid, pvals, n_live):
+            # n_live is TRACED: the row count changes with every write,
+            # and a static count would recompile the fragment per
+            # insert-then-read cycle (the OLTP pattern); only the padded
+            # shape (power-of-two) retraces
             sub_params = dict(baked)
             for name, pv, t in zip(traced_names, pvals, traced_types):
                 sub_params[name] = (pv, t)
             sub_ctx = ExecContext(
                 ctx.stores, snap, txid, ctx.cache,
                 params=sub_params,
-                staged={scan.table.name: (arrs_in, n_static)})
+                staged={scan.table.name: (arrs_in, n_live)})
             sub = Executor(sub_ctx)
             sub._traced = True
             b = sub.exec_node(node)
@@ -197,7 +201,7 @@ def try_fused(executor, node) -> Optional[object]:
             meta["dicts"] = b.dicts
             return b.cols, b.valid, b.nulls
 
-        fn = jax.jit(run, static_argnums=(4,))
+        fn = jax.jit(run)
         _CACHE[full_key] = hit = (fn, meta)
         if len(_CACHE) > _CACHE_LIMIT:
             _CACHE.pop(next(iter(_CACHE)))
@@ -207,7 +211,8 @@ def try_fused(executor, node) -> Optional[object]:
     pvals = tuple(jnp.asarray(ctx.params[k][0]) for k in traced_names)
     try:
         cols, valid, nulls = fn(arrs, jnp.int64(ctx.snapshot_ts),
-                                jnp.int64(ctx.txid), pvals, n)
+                                jnp.int64(ctx.txid), pvals,
+                                jnp.int64(n))
     except (jax.errors.TracerBoolConversionError,
             jax.errors.ConcretizationTypeError,
             jax.errors.TracerArrayConversionError):

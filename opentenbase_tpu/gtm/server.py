@@ -48,6 +48,10 @@ class GtmCore:
         self._txid = 1
         self._sequences: dict[str, dict] = {}
         self._prepared: dict[str, dict] = {}   # gid -> info (2PC registry)
+        # cluster barriers: name -> {gts, wall} (reference: the barrier
+        # records CREATE BARRIER leaves for PITR, pgxc/barrier/barrier.c;
+        # the GTM copy is the restore authority)
+        self._barriers: dict[str, dict] = {}
         self.store_path = store_path
         self._ship = ship
         self._sync_ship = sync_ship
@@ -63,13 +67,15 @@ class GtmCore:
             self._txid = st["reserved_txid"]
             self._sequences = st.get("sequences", {})
             self._prepared = st.get("prepared", {})
+            self._barriers = st.get("barriers", {})
         self._persist_locked()
 
     def _persist_locked(self):
         st = {"reserved_ts": self._ts + RESERVE,
               "reserved_txid": self._txid + RESERVE,
               "sequences": self._sequences,
-              "prepared": self._prepared}
+              "prepared": self._prepared,
+              "barriers": self._barriers}
         if self.store_path:
             tmp = self.store_path + ".tmp"
             with open(tmp, "w") as f:
@@ -167,6 +173,16 @@ class GtmCore:
         with self._lock:
             return dict(self._prepared)
 
+    # ---- barriers (restore points) ----
+    def barrier_create(self, name: str, gts: int):
+        with self._lock:
+            self._barriers[name] = {"gts": int(gts), "wall": time.time()}
+            self._persist_locked()
+
+    def barrier_list(self) -> dict:
+        with self._lock:
+            return dict(self._barriers)
+
     def stats(self) -> dict:
         """Read-only observability snapshot (no timestamp allocation)."""
         with self._lock:
@@ -233,6 +249,12 @@ class GtmServer:
                                 msg["gid"])}
                         elif op == "prepared_list":
                             resp = {"prepared": core_ref.prepared_list()}
+                        elif op == "barrier_create":
+                            core_ref.barrier_create(msg["name"],
+                                                    msg["gts"])
+                            resp = {"ok": True}
+                        elif op == "barrier_list":
+                            resp = {"barriers": core_ref.barrier_list()}
                         elif op == "stats":
                             resp = {"stats": core_ref.stats()}
                         elif op == "ping":
@@ -336,6 +358,12 @@ class GtmClient:
 
     def prepared_list(self) -> dict:
         return self.call(op="prepared_list")["prepared"]
+
+    def barrier_create(self, name, gts):
+        self.call(op="barrier_create", name=name, gts=int(gts))
+
+    def barrier_list(self) -> dict:
+        return self.call(op="barrier_list")["barriers"]
 
     def stats(self) -> dict:
         return self.call(op="stats")["stats"]

@@ -113,6 +113,13 @@ def _dispatch(node: DataNode, msg: dict):
         return node.build_btree_index(msg["table"], msg["cols"])
     if op == "analyze_table":
         return node.analyze_table(msg["table"])
+    if op == "extract_shards":
+        return node.extract_shards(msg["table"], msg["shard_ids"],
+                                   msg["txid"])
+    if op == "create_barrier":
+        return node.create_barrier(msg["name"], msg["gts"])
+    if op == "restore_barrier":
+        return node.restore_barrier(msg["name"], msg["tables"])
     if op == "build_hnsw_index":
         return node.build_hnsw_index(msg["table"], msg["col"],
                                      msg.get("m", 16),
@@ -213,6 +220,16 @@ class RemoteDataNode:
 
     def analyze_table(self, table):
         return self._call(op="analyze_table", table=table)
+
+    def extract_shards(self, table, shard_ids, txid):
+        return self._call(op="extract_shards", table=table,
+                          shard_ids=shard_ids, txid=txid)
+
+    def create_barrier(self, name, gts):
+        return self._call(op="create_barrier", name=name, gts=gts)
+
+    def restore_barrier(self, name, tables):
+        return self._call(op="restore_barrier", name=name, tables=tables)
 
     def build_hnsw_index(self, table, col, m=16, ef_construction=64,
                          metric="l2"):

@@ -176,6 +176,25 @@ class DataNode:
         from .statistics import analyze_store
         return analyze_store(self.stores[table])
 
+    def extract_shards(self, table: str, shard_ids: list, txid: int):
+        """Online shard movement, source side (reference: the COPY-based
+        data pull of pgxc/locator/redistrib.c): atomically read the live
+        rows of the given shard groups AND mark them deleted under
+        `txid` — one op so the rows read are exactly the rows deleted.
+        The txn's 2PC commit/abort finalizes or reverts the deletion."""
+        st = self.stores.get(table)
+        if st is None:
+            return {"columns": {}, "shardids": None, "n": 0}
+        ext = st.rows_of_shards(set(int(s) for s in shard_ids))
+        for ci, mask in ext.pop("masks"):
+            if mask.any():
+                span = st.mark_delete(ci, mask, txid)
+                self.txn_spans.setdefault(txid, []).append(
+                    ("del", table, span))
+                self.log({"op": "delete", "table": table, "chunk": ci,
+                          "mask": mask, "txid": txid})
+        return ext
+
     def build_btree_index(self, table: str, cols: list) -> int:
         """Build btree-equivalent sorted indexes on this node's shard."""
         total = 0
@@ -338,6 +357,60 @@ class DataNode:
             from ..storage.replication import checkpoint_files
             self._ship.checkpoint(checkpoint_files(self.datadir))
 
+    # ---- restorable barriers (reference: the two-phase barrier WAL
+    # records of pgxc/barrier/barrier.c:33-40 + PITR restore target) ----
+    def create_barrier(self, name: str, gts: int):
+        """Phase on this node: barrier_prepare WAL record -> full node
+        checkpoint (seal + truncate keeps replay layouts consistent) ->
+        retain the checkpoint artifacts under barriers/<name>/ ->
+        barrier WAL record at the head of the fresh log."""
+        import shutil
+        if not self.datadir:
+            raise RuntimeError("barriers require a datadir")
+        if self.txn_spans:
+            raise RuntimeError("transactions in flight")
+        self.log({"op": "barrier_prepare", "name": name,
+                  "gts": int(gts)}, sync=True)
+        self.checkpoint(None)
+        bdir = os.path.join(self.datadir, "barriers", name)
+        os.makedirs(bdir, exist_ok=True)
+        for tname in self.stores:
+            src = os.path.join(self.datadir, f"{tname}.ckpt")
+            if os.path.exists(src):
+                shutil.copy2(src, os.path.join(bdir, f"{tname}.ckpt"))
+        self.log({"op": "barrier", "name": name, "gts": int(gts)},
+                 sync=True)
+
+    def restore_barrier(self, name: str, tables: list):
+        """Rebuild this node's state exactly as retained at the barrier:
+        barrier artifacts become the current checkpoint, the WAL resets,
+        all later history is discarded."""
+        import shutil
+        if not self.datadir:
+            raise RuntimeError("barriers require a datadir")
+        bdir = os.path.join(self.datadir, "barriers", name)
+        if not os.path.isdir(bdir):
+            raise RuntimeError(f"no barrier {name!r} on dn{self.index}")
+        self.stores = {}
+        self.cache = DeviceTableCache()
+        self.txn_spans = {}
+        # current checkpoints are replaced by the barrier's; stray ckpts
+        # of tables created after the barrier are removed
+        for fn in os.listdir(self.datadir):
+            if fn.endswith(".ckpt"):
+                os.remove(os.path.join(self.datadir, fn))
+        for td in tables:
+            st = TableStore(td)
+            src = os.path.join(bdir, f"{td.name}.ckpt")
+            if os.path.exists(src):
+                shutil.copy2(src,
+                             os.path.join(self.datadir, f"{td.name}.ckpt"))
+                restore_store(st, src)
+            self.stores[td.name] = st
+        if self.wal:
+            self.wal.truncate()
+        self.log({"op": "barrier_restored", "name": name}, sync=True)
+
 
 class Cluster:
     """The whole deployment: catalog + shard map + GTM + datanodes.
@@ -447,6 +520,7 @@ class Cluster:
         td = self.catalog.create_table(td, if_not_exists)
         for dn in self.datanodes:
             dn.ddl_create(td)
+        self.ddl_gen = getattr(self, "ddl_gen", 0) + 1
         self._save_catalog()
         return td
 
@@ -454,6 +528,16 @@ class Cluster:
         self.catalog.drop_table(name, if_exists)
         for dn in self.datanodes:
             dn.ddl_drop(name)
+        # global indexes die with their base table: drop the mapping
+        # tables and the registry entries, or a recreated table would
+        # inherit stale routing and phantom unique violations
+        for cinfo in self.catalog.global_indexes.pop(name, {}).values():
+            mt = cinfo["map"]
+            if mt in self.catalog.tables:
+                self.catalog.drop_table(mt)
+                for dn in self.datanodes:
+                    dn.ddl_drop(mt)
+        self.ddl_gen = getattr(self, "ddl_gen", 0) + 1
         self._save_catalog()
 
     def checkpoint(self) -> bool:
@@ -464,6 +548,52 @@ class Cluster:
         for dn in self.datanodes:
             dn.checkpoint(self.catalog)
         return True
+
+    # ---- restorable barriers (reference: CREATE BARRIER two-phase WAL
+    # records + consistent PITR, pgxc/barrier/barrier.c:33-40) ----
+    def create_barrier(self, name: str) -> bool:
+        """Cluster-wide restore point at one GTS.  Phase 1: every DN
+        writes barrier_prepare + checkpoints + retains artifacts; phase
+        2: the GTM registers the barrier — the registration is the
+        commit point, so a crash mid-way leaves no half-barrier a
+        restore could pick."""
+        if self.active_txns:
+            return False
+        if not self.datadir:
+            # in-memory deployment: a consistent checkpoint is all that
+            # exists to retain
+            return self.checkpoint()
+        gts = int(self.gtm.next_gts())
+        bdir = os.path.join(self.datadir, "barriers", name)
+        os.makedirs(bdir, exist_ok=True)
+        self.catalog.save(os.path.join(bdir, "catalog.json"))
+        self.catalog.save(os.path.join(self.datadir, "catalog.json"))
+        for dn in self.datanodes:
+            dn.create_barrier(name, gts)
+        self.gtm.barrier_create(name, gts)
+        return True
+
+    def restore_barrier(self, name: str):
+        """Rebuild the whole cluster at the barrier: catalog + every
+        datanode's stores revert; later history is discarded.  The GTM
+        clock keeps running forward (timestamps are never reused)."""
+        barriers = self.gtm.barrier_list()
+        if name not in barriers:
+            raise KeyError(f"barrier {name!r} is not registered")
+        if not self.datadir:
+            raise RuntimeError("restore requires a datadir deployment")
+        bcat = os.path.join(self.datadir, "barriers", name, "catalog.json")
+        if os.path.exists(bcat):
+            self.catalog = Catalog.load(bcat)
+            self.catalog.save(os.path.join(self.datadir, "catalog.json"))
+        tables = list(self.catalog.tables.values())
+        for dn in self.datanodes:
+            dn.restore_barrier(name, tables)
+        self.active_txns.clear()
+        self.locator = Locator(self.catalog)
+        self.ddl_gen = getattr(self, "ddl_gen", 0) + 1
+        from . import statviews
+        statviews.register(self)
 
     # ---- distributed commit (reference: execRemote.c
     # pgxc_node_remote_prepare :3944 / pgxc_node_remote_commit :4883) ----

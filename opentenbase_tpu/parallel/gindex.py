@@ -1,0 +1,441 @@
+"""Global secondary indexes.
+
+Reference analog: OpenTenBase's cross-node global indexes — planner paths
+gated by `allow_global_index_path` (optimizer/path/indxpath.c:4331-4348),
+exec-time routing through the index relation's own distribution
+(pgxc/locator/locator.c:2396).  The design (PARITY.md): a SHARD-distributed
+**mapping table** `__gidx_<table>_<col>` holding (key value, owner shardid)
+one row per base row, written in the SAME transaction as the base write —
+so the usual implicit 2PC covers base+index atomicity, and crash recovery
+resolves both sides from the same GTM verdict.
+
+A point predicate `key = literal` on an indexed non-distribution column
+routes: literal -> mapping table's own SHARD distribution -> ONE datanode
+holds the mapping entries -> owner shardid(s) -> shard map -> base node.
+The query then ships whole to that node (FQS), touching at most 2
+datanodes instead of fanning out to all of them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..catalog.schema import (ColumnDef, Distribution, DistType, TableDef)
+from ..catalog.types import INT32, TypeKind
+from ..plan import exprs as E
+from ..plan import physical as P
+
+
+class GIndexError(Exception):
+    pass
+
+
+def mapping_name(table: str, col: str) -> str:
+    return f"__gidx_{table}_{col}"
+
+
+def mapping_tabledef(td: TableDef, col: str) -> TableDef:
+    c = td.column(col)
+    return TableDef(
+        mapping_name(td.name, col),
+        [ColumnDef("key", c.type, nullable=False),
+         ColumnDef("shardid", INT32, nullable=False)],
+        Distribution(DistType.SHARD, ["key"]))
+
+
+def create(session, stmt) -> None:
+    """CREATE [UNIQUE] GLOBAL INDEX name ON table (col): register, build
+    the mapping table, backfill from the base table's visible rows."""
+    c = session.cluster
+    if session.txn is not None:
+        # the catalog registration is not transactional: a ROLLBACK
+        # would discard the backfill but keep the index registered
+        # (same restriction shape as CREATE INDEX CONCURRENTLY)
+        raise GIndexError("CREATE GLOBAL INDEX cannot run inside a "
+                          "transaction block")
+    td = c.catalog.table(stmt.table)
+    if len(stmt.columns) != 1:
+        raise GIndexError("global indexes support exactly one column")
+    col = stmt.columns[0]
+    if not td.has_column(col):
+        raise GIndexError(f"no column {col!r} in {td.name!r}")
+    if td.distribution.dist_type != DistType.SHARD:
+        raise GIndexError("global indexes require a SHARD table")
+    if [col] == list(td.distribution.dist_cols):
+        raise GIndexError("the distribution key is already globally "
+                          "routable; no global index needed")
+    reg = c.catalog.global_indexes.setdefault(td.name, {})
+    if col in reg:
+        raise GIndexError(f"column {col!r} already has a global index")
+    for t, cols in c.catalog.global_indexes.items():
+        for cinfo in cols.values():
+            if cinfo["name"] == stmt.name:
+                raise GIndexError(f"index {stmt.name!r} already exists")
+
+    mtd = mapping_tabledef(td, col)
+    c.create_table(mtd)
+    reg[col] = {"map": mtd.name, "name": stmt.name,
+                "unique": bool(stmt.unique)}
+
+    # backfill under one txn: scan (key, dist cols) per DN, compute each
+    # row's shardid exactly as the insert path did, write mapping rows
+    t, implicit = session._begin_implicit()
+    if implicit:
+        session.txn = t
+        c.active_txns.add(t.txid)
+    try:
+        keys, sids = _derive_entries(session, td, col, [], t)
+        if stmt.unique and len(set(keys)) != len(keys):
+            raise GIndexError(
+                f"cannot create unique index {stmt.name!r}: "
+                "duplicate key values")
+        if keys:
+            session._insert_rows(
+                mtd, {"key": _as_route_array(td, col, keys),
+                      "shardid": sids}, len(keys))
+    except Exception:
+        reg.pop(col, None)
+        if not reg:
+            c.catalog.global_indexes.pop(td.name, None)
+        if implicit:
+            session.txn = None
+            session._abort(t)
+        c.drop_table(mtd.name, if_exists=True)
+        raise
+    if implicit:
+        session.txn = None
+        session._commit(t)
+    c._save_catalog()
+
+
+def drop(session, name: str, if_exists: bool) -> bool:
+    c = session.cluster
+    for t, cols in c.catalog.global_indexes.items():
+        for col, cinfo in cols.items():
+            if cinfo["name"] == name:
+                c.drop_table(cinfo["map"], if_exists=True)
+                del cols[col]
+                if not cols:
+                    del c.catalog.global_indexes[t]
+                c._save_catalog()
+                return True
+    if not if_exists:
+        raise GIndexError(f"index {name!r} does not exist")
+    return False
+
+
+def indexes_on(catalog, table: str) -> dict:
+    return catalog.global_indexes.get(table, {})
+
+
+# ---------------------------------------------------------------------------
+# write-path maintenance (same txn as the base write -> same 2PC)
+# ---------------------------------------------------------------------------
+
+def storage_keys(td: TableDef, col: str, values) -> list:
+    """Incoming raw values -> storage representation (None = SQL NULL)."""
+    from ..catalog.types import date_to_days, decimal_to_int
+    c = td.column(col)
+    k = c.type.kind
+    out = []
+    for v in values:
+        if v is None:
+            out.append(None)
+        elif k == TypeKind.TEXT:
+            out.append(str(v))
+        elif k == TypeKind.DECIMAL:
+            if isinstance(v, (int, np.integer)):
+                out.append(int(v) * 10 ** c.type.scale)
+            elif isinstance(v, float):
+                out.append(int(round(v * 10 ** c.type.scale)))
+            else:
+                out.append(decimal_to_int(str(v), c.type.scale))
+        elif k == TypeKind.DATE:
+            out.append(date_to_days(v) if isinstance(v, str) else int(v))
+        elif k == TypeKind.FLOAT64:
+            out.append(float(v))
+        else:
+            out.append(int(v))
+    return out
+
+
+def key_quals(mtd_or_td: TableDef, colname: str, qualcol: str,
+              keys) -> list:
+    """Quals selecting rows whose `qualcol` is in `keys` (storage rep)."""
+    col = mtd_or_td.column(colname)
+    qcol = E.Col(qualcol, col.type)
+    vals = tuple(sorted(set(keys)))
+    if not vals:
+        return []
+    if col.type.kind == TypeKind.TEXT:
+        return [E.StrPred(qcol, "in", vals)]
+    return [E.InList(qcol, vals)]
+
+
+def _as_route_array(td: TableDef, col: str, keys: list):
+    """Storage-rep key values -> array routable by the locator (DECIMAL
+    storage ints must not be re-scaled)."""
+    from ..storage.loader import _PreScaled
+    if td.column(col).type.kind == TypeKind.DECIMAL:
+        return np.asarray(keys, np.int64).view(_PreScaled)
+    return np.asanyarray(keys)
+
+
+def maintain_insert(session, td: TableDef, coldata: dict, n: int,
+                    sid: Optional[np.ndarray], txn) -> None:
+    """Add one mapping row per inserted base row; enforce UNIQUE."""
+    c = session.cluster
+    for col, cinfo in indexes_on(c.catalog, td.name).items():
+        mtd = c.catalog.table(cinfo["map"])
+        keys = storage_keys(td, col, coldata[col])
+        rows = [(k, int(sid[i])) for i, k in enumerate(keys)
+                if k is not None]
+        if not rows:
+            continue
+        kvals = _as_route_array(td, col, [k for k, _ in rows])
+        if cinfo["unique"]:
+            kset = [k for k, _ in rows]
+            if len(set(kset)) != len(kset):
+                raise GIndexError(
+                    f"duplicate key value violates unique index "
+                    f"{cinfo['name']!r}")
+            quals = key_quals(mtd, "key", f"{mtd.name}.key", kset)
+            plan = P.SeqScan(mtd, mtd.name, quals,
+                             [(f"{mtd.name}.key",
+                               E.Col(f"{mtd.name}.key",
+                                     mtd.column("key").type))])
+            # mapping rows for these keys can only live on their owner
+            # nodes (the mapping is SHARD by key): probe just those
+            owners = c.locator.route_rows(mtd, {"key": kvals},
+                                          len(rows))
+            for i in sorted(set(owners.tolist())):
+                hb = c.datanodes[i].exec_plan(plan, txn.snapshot_ts,
+                                              txn.txid, {}, {})
+                if hb.nrows:
+                    raise GIndexError(
+                        f"duplicate key value violates unique index "
+                        f"{cinfo['name']!r}")
+        session._insert_rows(mtd, {"key": kvals,
+                                   "shardid": [s for _, s in rows]},
+                             len(rows))
+
+
+def affected_keys(session, td: TableDef, quals: list, txn) -> dict:
+    """Distinct key values (storage rep) per indexed column among rows
+    the quals select — captured BEFORE the base delete."""
+    c = session.cluster
+    out = {}
+    for col in indexes_on(c.catalog, td.name):
+        plan = P.SeqScan(td, td.name, list(quals),
+                         [(f"{td.name}.{col}",
+                           E.Col(f"{td.name}.{col}",
+                                 td.column(col).type))])
+        keys = set()
+        for dn in c.datanodes:
+            hb = dn.exec_plan(plan, txn.snapshot_ts, txn.txid, {}, {})
+            karr = hb.cols[f"{td.name}.{col}"]
+            nm = hb.nulls.get(f"{td.name}.{col}")
+            for i in range(hb.nrows):
+                if nm is not None and nm[i]:
+                    continue
+                v = karr[i]
+                keys.add(v.item() if hasattr(v, "item") else v)
+        out[col] = keys
+    return out
+
+
+def _derive_entries(session, td: TableDef, col: str, quals: list,
+                    txn) -> tuple:
+    """Scan the base table's visible rows matching `quals` and derive
+    (keys, shardids) for the indexed column — exactly as the insert path
+    computes them (shared by backfill and post-delete resync).  NULL
+    keys are never pointed to."""
+    from ..storage.loader import _PreScaled
+    c = session.cluster
+    need = [col] + [dc for dc in td.distribution.dist_cols if dc != col]
+    plan = P.SeqScan(td, td.name, list(quals),
+                     [(f"{td.name}.{cn}",
+                       E.Col(f"{td.name}.{cn}", td.column(cn).type))
+                      for cn in need])
+    keys, sids = [], []
+    for dn in c.datanodes:
+        hb = dn.exec_plan(plan, txn.snapshot_ts, txn.txid, {}, {})
+        if hb.nrows == 0:
+            continue
+        route_cols = {}
+        for dc in td.distribution.dist_cols:
+            arr = hb.cols[f"{td.name}.{dc}"]
+            if td.column(dc).type.kind == TypeKind.DECIMAL:
+                arr = np.asarray(arr, np.int64).view(_PreScaled)
+            route_cols[dc] = arr
+        sid = c.locator.shard_ids_for_rows(td, route_cols)
+        karr = hb.cols[f"{td.name}.{col}"]
+        nm = hb.nulls.get(f"{td.name}.{col}")
+        for i in range(hb.nrows):
+            if nm is not None and nm[i]:
+                continue
+            v = karr[i]
+            keys.append(v.item() if hasattr(v, "item") else v)
+            sids.append(int(sid[i]))
+    return keys, sids
+
+
+def resync_keys(session, td: TableDef, affected: dict, txn) -> None:
+    """After a base delete: rebuild mapping entries for affected keys so
+    surviving duplicate-key rows keep their entries (delete-all +
+    re-derive, idempotent under MVCC)."""
+    c = session.cluster
+    for col, keys in affected.items():
+        if not keys:
+            continue
+        cinfo = indexes_on(c.catalog, td.name)[col]
+        mtd = c.catalog.table(cinfo["map"])
+        mquals = key_quals(mtd, "key", f"{mtd.name}.key", keys)
+        for dn in c.datanodes:
+            nd = dn.delete_where(mtd.name, mquals, txn.snapshot_ts,
+                                 txn.txid)
+            if nd:
+                txn.written_dns.add(dn.index)
+        # re-derive surviving rows for those keys from the base table
+        bquals = key_quals(td, col, f"{td.name}.{col}", keys)
+        kvals, sids = _derive_entries(session, td, col, bquals, txn)
+        if kvals:
+            session._insert_rows(
+                mtd, {"key": _as_route_array(td, col, kvals),
+                      "shardid": sids}, len(kvals))
+
+
+# ---------------------------------------------------------------------------
+# read-path routing (the allow_global_index_path analog)
+# ---------------------------------------------------------------------------
+
+def route(session, bq, snapshot_ts: int, txid: int):
+    """Single datanode that can answer the whole query via global-index
+    lookups, or None.  Every sharded table must be pinned either by its
+    dist key (plain FQS handles that first) or by `indexed_col = literal`;
+    returns (node, via_label) with via_label naming the mapping used."""
+    from ..plan.query import BoundQuery as BQ, SubLink
+    if not isinstance(bq, BQ):
+        return None
+    c = session.cluster
+    gall = c.catalog.global_indexes
+    if not gall:
+        return None
+    for _, e in bq.targets:
+        if any(isinstance(x, SubLink) for x in E.walk(e)):
+            return None
+    for q in bq.where:
+        if any(isinstance(x, SubLink) for x in E.walk(q)):
+            return None
+    target = None
+    via = []
+    for rte in bq.rtable:
+        if rte.kind != "table":
+            return None
+        dt = rte.table.distribution.dist_type
+        if dt == DistType.REPLICATED:
+            continue
+        if dt != DistType.SHARD:
+            return None
+        node = _pin_by_dist_key(session, rte, bq)
+        if node is None:
+            node, label = _pin_by_gindex(session, rte, bq, snapshot_ts,
+                                         txid)
+            if node is None:
+                return None
+            via.append(label)
+        if target is None:
+            target = node
+        elif target != node:
+            return None
+    if target is None or not via:
+        return None   # nothing used an index: plain FQS already covers it
+    return target, " + ".join(via)
+
+
+def _pin_by_dist_key(session, rte, bq) -> Optional[int]:
+    from ..plan.distribute import dist_key_pins
+    pins = dist_key_pins(rte, bq.where)
+    if pins is None:
+        return None
+    return session.cluster.locator.node_for_values(rte.table, pins)
+
+
+def _lit_storage(col: ColumnDef, lit):
+    """Binder literal (E.Lit / StrPred pattern) -> the COLUMN's storage
+    representation; None when unrepresentable at the column's scale
+    (mirrors locator._canon_point)."""
+    if isinstance(lit, str):
+        return lit
+    v, lt = lit.value, lit.lit_type
+    k = col.type.kind
+    if k == TypeKind.TEXT:
+        return str(v)
+    if k == TypeKind.DECIMAL:
+        cs = col.type.scale
+        if lt.kind == TypeKind.DECIMAL:
+            diff = cs - lt.scale
+            if diff >= 0:
+                return int(v) * 10 ** diff
+            if int(v) % 10 ** (-diff) == 0:
+                return int(v) // 10 ** (-diff)
+            return None
+        if isinstance(v, (int, np.integer)):
+            return int(v) * 10 ** cs
+        return None
+    if k == TypeKind.DATE:
+        from ..catalog.types import date_to_days
+        return date_to_days(v) if isinstance(v, str) else int(v)
+    if k == TypeKind.FLOAT64:
+        if lt.kind == TypeKind.DECIMAL:
+            return int(v) / 10 ** lt.scale
+        return float(v)
+    return int(v)
+
+
+def _pin_by_gindex(session, rte, bq, snapshot_ts, txid):
+    c = session.cluster
+    reg = indexes_on(c.catalog, rte.table.name)
+    for col, cinfo in reg.items():
+        qname = f"{rte.alias}.{col}"
+        lit = None
+        for q in bq.where:
+            if isinstance(q, E.Cmp) and q.op == "=" \
+                    and isinstance(q.left, E.Col) \
+                    and q.left.name == qname \
+                    and isinstance(q.right, E.Lit):
+                lit = q.right
+                break
+            if isinstance(q, E.StrPred) and q.kind == "eq" \
+                    and isinstance(q.col, E.Col) \
+                    and q.col.name == qname and len(q.patterns) == 1:
+                lit = q.patterns[0]
+                break
+        if lit is None:
+            continue
+        mtd = c.catalog.table(cinfo["map"])
+        mnode = c.locator.node_for_values(mtd, [lit])
+        if mnode is None:
+            continue
+        key = _lit_storage(rte.table.column(col), lit)
+        if key is None:
+            continue
+        quals = key_quals(mtd, "key", f"{mtd.name}.key", [key])
+        plan = P.SeqScan(mtd, mtd.name, quals,
+                         [(f"{mtd.name}.shardid",
+                           E.Col(f"{mtd.name}.shardid", INT32))])
+        hb = c.datanodes[mnode].exec_plan(plan, snapshot_ts, txid, {},
+                                          {})
+        sids = {int(s) for s in hb.cols[f"{mtd.name}.shardid"]
+                [:hb.nrows]} if hb.nrows else set()
+        if not sids:
+            # no entry: the query matches nothing — any single node can
+            # prove the empty result; pin to the mapping node
+            return mnode, f"{cinfo['name']}(empty)"
+        nodes = {int(c.catalog.shard_map[s]) for s in sids}
+        if len(nodes) != 1:
+            continue
+        return nodes.pop(), cinfo["name"]
+    return None, ""

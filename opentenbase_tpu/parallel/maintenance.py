@@ -47,17 +47,14 @@ def vacuum_cluster(cluster, table: Optional[str] = None) -> int:
 # ---------------------------------------------------------------------------
 
 def move_shards(cluster, shard_ids: list[int], to_dn: int) -> int:
-    """Move the given shard groups to a new owner datanode: copy live rows
-    of every SHARD table, delete at the source, update the shard map.
-    All under one cluster txn (2PC covers source+target)."""
+    """Move the given shard groups to a new owner datanode: every SHARD
+    table's live rows are extracted-and-deleted at their sources (one
+    atomic op per table per source, `extract_shards` — over the DN wire
+    protocol for remote deployments) and inserted at the target, all
+    under one cluster txn whose implicit 2PC covers source+target; the
+    shard map updates only after the commit."""
     from ..catalog.schema import DistType
-    if any(not hasattr(dn, "stores") for dn in cluster.datanodes):
-        # remote sources would be silently skipped, committing a map
-        # change with no data movement — refuse until the RPC surface
-        # grows a shard-extraction op
-        raise NotImplementedError(
-            "online shard movement requires in-process datanodes")
-    sids = set(int(s) for s in shard_ids)
+    sids = sorted(set(int(s) for s in shard_ids))
     txid = cluster.gtm.next_txid()
     moved = 0
     written = []
@@ -65,23 +62,17 @@ def move_shards(cluster, shard_ids: list[int], to_dn: int) -> int:
         for dn in cluster.datanodes:
             if dn.index == to_dn:
                 continue
-            for name, st in list(dn.stores.items()):
-                if st.td.distribution.dist_type != DistType.SHARD:
+            for name, td in list(cluster.catalog.tables.items()):
+                if td.distribution.dist_type != DistType.SHARD:
                     continue
-                ext = st.rows_of_shards(sids)
+                # extract+mark-delete at the source (WAL'd), insert at
+                # the target (WAL'd) — both finalize at commit
+                ext = dn.extract_shards(name, sids, txid)
                 if ext["n"] == 0:
                     continue
-                # insert at target (WAL'd), delete at source (WAL'd)
                 cluster.datanodes[to_dn].insert_raw(
                     name, ext["columns"], ext["n"], txid,
                     shardids=ext["shardids"])
-                for ci, mask in ext["masks"]:
-                    if mask.any():
-                        span = st.mark_delete(ci, mask, txid)
-                        dn.txn_spans.setdefault(txid, []).append(
-                            ("del", name, span))
-                        dn.log({"op": "delete", "table": name,
-                                "chunk": ci, "mask": mask, "txid": txid})
                 moved += ext["n"]
                 written.append(dn.index)
         written.append(to_dn)

@@ -81,6 +81,7 @@ class DistPlan:
     init_plans: list
     output_names: list[str]
     fqs_node: Optional[int] = None     # set => whole plan runs on one DN
+    via_gidx: str = ""                 # global index(es) that pinned it
 
 
 def _subtree_est(node) -> Optional[float]:
@@ -111,6 +112,40 @@ def _subtree_est(node) -> Optional[float]:
 # FQS analysis
 # ---------------------------------------------------------------------------
 
+def _has_sublinks(bq: BoundQuery) -> bool:
+    for _, e in bq.targets:
+        if any(isinstance(x, SubLink) for x in E.walk(e)):
+            return True
+    for q in bq.where:
+        if any(isinstance(x, SubLink) for x in E.walk(q)):
+            return True
+    return False
+
+
+def dist_key_pins(rte, where, allow_params: bool = False):
+    """The `dist col = <pin>` conjuncts for one range-table entry, or
+    None when not every dist col is pinned.  A pin is an E.Lit (point
+    routing canonicalizes it to the representation bulk routing used),
+    or — with allow_params — a '__bindparam' column name resolved at
+    EXECUTE time.  Shared by plain FQS, prepared-statement FQS, and
+    global-index routing so the three can never disagree."""
+    dist_cols = [f"{rte.alias}.{c}"
+                 for c in rte.table.distribution.dist_cols]
+    values = {}
+    for q in where:
+        if isinstance(q, E.Cmp) and q.op == "=" \
+                and isinstance(q.left, E.Col) \
+                and q.left.name in dist_cols:
+            if isinstance(q.right, E.Lit):
+                values[q.left.name] = q.right
+            elif allow_params and isinstance(q.right, E.Col) \
+                    and q.right.name.startswith("__bindparam"):
+                values[q.left.name] = q.right.name
+    if set(values) != set(dist_cols):
+        return None
+    return [values[c] for c in dist_cols]
+
+
 def fqs_target_node(bq: BoundQuery, catalog: Catalog) -> Optional[int]:
     """Single datanode that can answer the whole query, or None.
 
@@ -123,12 +158,8 @@ def fqs_target_node(bq: BoundQuery, catalog: Catalog) -> Optional[int]:
         return None   # set operations: no single-node shipping yet
     loc = Locator(catalog)
     target: Optional[int] = None
-    for _, e in bq.targets:
-        if any(isinstance(x, SubLink) for x in E.walk(e)):
-            return None
-    for q in bq.where:
-        if any(isinstance(x, SubLink) for x in E.walk(q)):
-            return None
+    if _has_sublinks(bq):
+        return None
     for rte in bq.rtable:
         if rte.kind != "table":
             return None
@@ -137,21 +168,10 @@ def fqs_target_node(bq: BoundQuery, catalog: Catalog) -> Optional[int]:
             continue
         if dt not in (DistType.SHARD, DistType.HASH, DistType.MODULO):
             return None
-        dist_cols = [f"{rte.alias}.{c}"
-                     for c in rte.table.distribution.dist_cols]
-        values = {}
-        for q in bq.where:
-            if isinstance(q, E.Cmp) and q.op == "=" \
-                    and isinstance(q.left, E.Col) \
-                    and isinstance(q.right, E.Lit) \
-                    and q.left.name in dist_cols:
-                # pass the full literal: point routing canonicalizes it
-                # to the same representation bulk routing used
-                values[q.left.name] = q.right
-        if set(values) != set(dist_cols):
+        pins = dist_key_pins(rte, bq.where)
+        if pins is None:
             return None
-        node = loc.node_for_values(
-            rte.table, [values[c] for c in dist_cols])
+        node = loc.node_for_values(rte.table, pins)
         if node is None:
             return None
         if target is None:
@@ -159,6 +179,58 @@ def fqs_target_node(bq: BoundQuery, catalog: Catalog) -> Optional[int]:
         elif target != node:
             return None
     return target
+
+
+def fqs_param_router(bq: BoundQuery, catalog: Catalog):
+    """FQS for PREPAREd statements: like fqs_target_node, but dist keys
+    may be pinned by `= $n` parameters whose values arrive at EXECUTE.
+    Returns a route(params: {name: (value, type)}) -> Optional[int]
+    closure, or None when the statement can never ship whole (reference:
+    the light-coordinator single-node resolution, execLight.c:34-59).
+    """
+    if not isinstance(bq, BoundQuery):
+        return None
+    loc = Locator(catalog)
+    if _has_sublinks(bq):
+        return None
+    # per sharded table: the pin expr (Lit or __bindparam name) per col
+    pinned: list[tuple] = []   # (TableDef, [E.Lit | param name])
+    for rte in bq.rtable:
+        if rte.kind != "table":
+            return None
+        dt = rte.table.distribution.dist_type
+        if dt == DistType.REPLICATED:
+            continue
+        if dt not in (DistType.SHARD, DistType.HASH, DistType.MODULO):
+            return None
+        pins = dist_key_pins(rte, bq.where, allow_params=True)
+        if pins is None:
+            return None
+        pinned.append((rte.table, pins))
+
+    def route(params: dict):
+        target = None
+        for td, specs in pinned:
+            vals = []
+            for s in specs:
+                if isinstance(s, str):
+                    if s not in params:
+                        return None
+                    v, vt = params[s]
+                    # wrap as a typed literal so point routing applies
+                    # the literal-scale canonicalization (a raw scaled
+                    # DECIMAL int would be re-scaled -> wrong node)
+                    vals.append(E.Lit(v, vt))
+                else:
+                    vals.append(s)
+            node = loc.node_for_values(td, vals)
+            if node is None or (target is not None and node != target):
+                return None
+            if target is None:
+                target = node
+        return target
+
+    return route
 
 
 # ---------------------------------------------------------------------------

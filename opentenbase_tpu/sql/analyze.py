@@ -44,8 +44,12 @@ class Scope:
 
 
 class Binder:
-    def __init__(self, catalog: Catalog):
+    def __init__(self, catalog: Catalog, param_types: dict = None):
         self.catalog = catalog
+        # $n -> SqlType, from PREPARE's declared type list: $n binds to a
+        # runtime parameter column (reference: ParamRef -> Param with
+        # paramtype from the prepared statement, parse_param.c)
+        self.param_types = param_types or {}
 
     # ------------------------------------------------------------------
     def bind_select(self, stmt: A.SelectStmt,
@@ -502,7 +506,21 @@ class Binder:
             return E.TextExpr(base, prior + (("substring", start, length),))
 
         if isinstance(node, A.Param):
-            raise BindError("parameters require a bound portal")
+            t = self.param_types.get(node.index)
+            if t is None:
+                raise BindError(
+                    f"parameter ${node.index} has no declared type "
+                    "(PREPARE name(type, ...) AS ...)")
+            if t.kind == TypeKind.TEXT:
+                # TEXT predicates resolve against dictionaries at compile
+                # time (StrPred) — a runtime TEXT value can't: the session
+                # falls back to literal substitution (custom-plan mode)
+                raise BindError("TEXT parameters require the "
+                                "substitution path")
+            # a runtime-parameter pseudo column: the executor substitutes
+            # the bound value from ctx.params (same mechanism init-plan
+            # results use), so one compiled program serves every binding
+            return E.Col(f"__bindparam{node.index}", t)
 
         raise BindError(f"cannot bind {type(node).__name__}")
 

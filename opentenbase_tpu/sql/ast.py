@@ -212,11 +212,22 @@ class SelectStmt(Node):
 # ---- DML ------------------------------------------------------------------
 
 @dataclasses.dataclass
+class OnConflict(Node):
+    """INSERT ... ON CONFLICT clause (reference: the UPSERT legs built by
+    pgxc_build_upsert_statement, pgxc/plan/planner.c:1070)."""
+    columns: list[str]                    # conflict target
+    action: str                           # 'nothing' | 'update'
+    assignments: list[tuple[str, Node]] = dataclasses.field(
+        default_factory=list)             # DO UPDATE SET col = expr
+
+
+@dataclasses.dataclass
 class InsertStmt(Node):
     table: str
     columns: list[str]
     values: Optional[list[list[Node]]]    # VALUES rows
     select: Optional[SelectStmt] = None
+    on_conflict: Optional[OnConflict] = None
 
 
 @dataclasses.dataclass
@@ -284,6 +295,13 @@ class CreateIndexStmt(Node):
     unique: bool = False
     method: str = ""                      # 'ivfflat' etc.
     options: dict = dataclasses.field(default_factory=dict)
+    global_: bool = False                 # CREATE GLOBAL INDEX
+
+
+@dataclasses.dataclass
+class DropIndexStmt(Node):
+    name: str
+    if_exists: bool = False
 
 
 @dataclasses.dataclass
@@ -328,3 +346,24 @@ class BarrierStmt(Node):
 class ExecuteDirectStmt(Node):
     node: str
     sql: str
+
+
+# ---- prepared statements (reference: PREPARE/EXECUTE + the extended-
+# protocol plan cache, tcop/postgres.c:2411 CreateCachedPlan) ----
+
+@dataclasses.dataclass
+class PrepareStmt(Node):
+    name: str
+    types: list[tuple[str, tuple[int, ...]]]   # declared $n types (ordered)
+    stmt: Node                                 # SELECT / INSERT / UPDATE / DELETE
+
+
+@dataclasses.dataclass
+class ExecuteStmt(Node):
+    name: str
+    args: list[Node]                           # literal argument exprs
+
+
+@dataclasses.dataclass
+class DeallocateStmt(Node):
+    name: Optional[str]                        # None = ALL

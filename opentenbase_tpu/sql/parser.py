@@ -80,6 +80,14 @@ class Parser:
         self.i += 1
         return t.value
 
+    def int_lit(self) -> int:
+        t = self.tok
+        if t.kind != Tok.NUM or not t.value.isdigit():
+            raise SqlSyntaxError(f"expected an integer, got {t.value!r}",
+                                 self.sql, t.pos)
+        self.i += 1
+        return int(t.value)
+
     # ------------------------------------------------------------------
     # statements
     # ------------------------------------------------------------------
@@ -167,17 +175,63 @@ class Parser:
             return A.AnalyzeStmt(tname)
         if v == "execute":
             self.advance()
-            self.expect_kw("direct")
-            self.expect_kw("on")
-            self.expect_op("(")
-            node = self.ident()
-            self.expect_op(")")
-            sqltext = self.advance()
-            if sqltext.kind != Tok.STR:
-                raise SqlSyntaxError("expected SQL string", self.sql,
-                                     sqltext.pos)
-            return A.ExecuteDirectStmt(node, sqltext.value)
+            if self.accept_kw("direct"):
+                self.expect_kw("on")
+                self.expect_op("(")
+                node = self.ident()
+                self.expect_op(")")
+                sqltext = self.advance()
+                if sqltext.kind != Tok.STR:
+                    raise SqlSyntaxError("expected SQL string", self.sql,
+                                         sqltext.pos)
+                return A.ExecuteDirectStmt(node, sqltext.value)
+            # EXECUTE name [(arg, ...)] — run a prepared statement
+            name = self.ident()
+            args = []
+            if self.accept_op("("):
+                args.append(self.expr())
+                while self.accept_op(","):
+                    args.append(self.expr())
+                self.expect_op(")")
+            return A.ExecuteStmt(name, args)
+        if v == "prepare":
+            return self.prepare_stmt()
+        if v == "deallocate":
+            self.advance()
+            self.accept_kw("prepare")
+            if self.accept_kw("all"):
+                return A.DeallocateStmt(None)
+            return A.DeallocateStmt(self.ident())
         raise SqlSyntaxError(f"unsupported statement {v!r}", self.sql, t.pos)
+
+    def prepare_stmt(self) -> A.PrepareStmt:
+        """PREPARE name [(type, ...)] AS statement (reference:
+        commands/prepare.c + the extended-protocol named-statement path,
+        tcop/postgres.c:2411)."""
+        self.expect_kw("prepare")
+        name = self.ident()
+        types: list[tuple[str, tuple[int, ...]]] = []
+        if self.accept_op("("):
+            while True:
+                tname = self.ident()
+                nxt = (self.tok.value if self.tok.kind == Tok.IDENT
+                       else None)
+                if nxt and (tname, nxt) in _MULTIWORD_TYPES:
+                    self.advance()
+                    tname = _MULTIWORD_TYPES[(tname, nxt)]
+                targs: tuple[int, ...] = ()
+                if self.accept_op("("):
+                    args = [self.int_lit()]
+                    while self.accept_op(","):
+                        args.append(self.int_lit())
+                    self.expect_op(")")
+                    targs = tuple(args)
+                types.append((tname, targs))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        self.expect_kw("as")
+        return A.PrepareStmt(name, types, self.statement())
 
     # ---- SELECT ----
     def select_stmt(self) -> A.SelectStmt:
@@ -385,9 +439,36 @@ class Parser:
             rows = [self._value_row()]
             while self.accept_op(","):
                 rows.append(self._value_row())
-            return A.InsertStmt(table, cols, rows)
+            return A.InsertStmt(table, cols, rows,
+                                on_conflict=self._on_conflict())
         sel = self.select_stmt()
-        return A.InsertStmt(table, cols, None, sel)
+        return A.InsertStmt(table, cols, None, sel,
+                            on_conflict=self._on_conflict())
+
+    def _on_conflict(self) -> Optional[A.OnConflict]:
+        """ON CONFLICT [(cols)] DO NOTHING | DO UPDATE SET col = expr..."""
+        if not self.accept_kw("on"):
+            return None
+        self.expect_kw("conflict")
+        cols: list[str] = []
+        if self.accept_op("("):
+            cols.append(self.ident())
+            while self.accept_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+        self.expect_kw("do")
+        if self.accept_kw("nothing"):
+            return A.OnConflict(cols, "nothing")
+        self.expect_kw("update")
+        self.expect_kw("set")
+        assigns = []
+        while True:
+            col = self.ident()
+            self.expect_op("=")
+            assigns.append((col, self.expr()))
+            if not self.accept_op(","):
+                break
+        return A.OnConflict(cols, "update", assigns)
 
     def _value_row(self) -> list[A.Node]:
         self.expect_op("(")
@@ -478,6 +559,7 @@ class Parser:
                     break
             return A.CreateSequenceStmt(name, start, inc)
         unique = self.accept_kw("unique")
+        global_ = self.accept_kw("global")
         if self.accept_kw("index"):
             name = self.ident()
             self.expect_kw("on")
@@ -501,7 +583,7 @@ class Parser:
                         break
                 self.expect_op(")")
             return A.CreateIndexStmt(name, table, cols, unique, method,
-                                     options)
+                                     options, global_)
         if self.accept_kw("barrier"):
             t = self.advance()
             return A.BarrierStmt(t.value)
@@ -591,6 +673,12 @@ class Parser:
 
     def drop_stmt(self) -> A.Node:
         self.expect_kw("drop")
+        if self.accept_kw("index"):
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropIndexStmt(self.ident(), if_exists)
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

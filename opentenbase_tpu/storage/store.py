@@ -36,6 +36,15 @@ NO_TXID = np.int64(0)
 CHUNK_CAP = 1 << 16
 
 
+def _decimal_str(v: int, scale: int) -> str:
+    """Storage-scaled int -> exact decimal string ('-3.25' for -325/2)."""
+    if scale == 0:
+        return str(v)
+    sign = "-" if v < 0 else ""
+    a = abs(v)
+    return f"{sign}{a // 10 ** scale}.{a % 10 ** scale:0{scale}d}"
+
+
 class WriteConflict(Exception):
     """Concurrent write-write conflict (first-deleter-wins)."""
 
@@ -371,8 +380,16 @@ class TableStore:
             if m.any():
                 for name in sel_cols:
                     vals = ch.columns[name][:n][m]
-                    if self.td.column(name).type.kind == TypeKind.TEXT:
+                    ct = self.td.column(name).type
+                    if ct.kind == TypeKind.TEXT:
                         out = self.dicts[name].decode(vals)
+                    elif ct.kind == TypeKind.DECIMAL:
+                        # exact decimal strings: the raw-insert path at
+                        # the destination re-scales python ints, which
+                        # would multiply stored (already-scaled) values
+                        # by 10^scale again
+                        out = [_decimal_str(int(v), ct.scale)
+                               for v in vals.tolist()]
                     else:
                         out = vals.tolist()
                     nm = ch.nulls.get(name)

@@ -263,11 +263,14 @@ class TestMaintenance:
         from opentenbase_tpu.parallel.maintenance import move_shards
         from opentenbase_tpu.parallel.locator import shard_ids_for_columns
         import numpy as np
+        before = sorted(cs.query("select k, v from t"))
         # move every shard currently owned by dn0 to dn1
         sids = np.nonzero(cs.cluster.catalog.shard_map == 0)[0].tolist()
         moved = move_shards(cs.cluster, sids, 1)
         assert moved > 0
         assert cs.query("select count(*) from t") == [(40,)]
+        # moved rows keep their exact values (DECIMAL must not re-scale)
+        assert sorted(cs.query("select k, v from t")) == before
         # dn0 holds no live rows of t anymore; routing follows the map
         cs.execute("vacuum t")
         assert cs.cluster.datanodes[0].stores["t"].row_count() == 0

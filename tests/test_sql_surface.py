@@ -31,6 +31,22 @@ def cs():
     return s
 
 
+class TestTextLiterals:
+    def test_projected_text_literal(self, sess):
+        assert sess.query("select 'lit' as c, x from t where x = 5") == \
+            [("lit", 5)]
+
+    def test_update_text_column_to_literal(self, cs):
+        cs.execute("update t set g = 'zz' where k = 7")
+        assert cs.query("select g from t where k = 7") == [("zz",)]
+        assert cs.query("select count(*) from t where g = 'zz'") == [(1,)]
+
+    def test_case_text_result(self, cs):
+        got = cs.query("select k, case when x > 3 then 'hi' else 'lo' "
+                       "end from t where k < 3 order by k")
+        assert all(v in ("hi", "lo") for _, v in got)
+
+
 class TestWindows:
     def test_row_number_rank_dense(self, sess):
         got = sess.query(
