@@ -1204,6 +1204,12 @@ class Executor:
                 [nxt[1:], jnp.asarray([n], jnp.int64)]) - 1
             pid = jnp.cumsum(p_bound.astype(jnp.int64)) - 1
             ob_cum = jnp.cumsum(o_bound.astype(jnp.int64))
+            # last VALID row of each partition: padding rows sort after
+            # every valid row, so a frame end must never reach into them
+            idxv = jnp.where(s_valid, iota, -1)
+            p_end = jax.ops.segment_max(idxv, pid.astype(jnp.int32),
+                                        num_segments=n)[pid]
+            peer_end_v = jnp.minimum(peer_end, p_end)
 
             def scatter(res):
                 return jnp.zeros(n, res.dtype).at[s_iota].set(res)
@@ -1257,7 +1263,12 @@ class Executor:
                     if d is not None:   # TEXT codes keep their decode
                         new_dicts[name] = d
                     continue
-                # aggregate over the frame
+                # aggregate / value function over the frame: every call
+                # reduces over per-row [fs, fe] (sorted-position bounds)
+                # — prefix sums for sum/count/avg, a log-doubling sparse
+                # table for min/max, a gather for first/last_value
+                # (reference: nodeWindowAgg.c eval_windowaggregates +
+                # WinGetFuncArgInFrame, generalized to vector form)
                 if wc.arg is not None:
                     a, anm = self._eval_pair(wc.arg, b)
                     a_s = a[s_iota]
@@ -1266,31 +1277,36 @@ class Executor:
                     a_s, anm_s = None, None
                 contrib = s_valid if anm_s is None else \
                     (s_valid & ~anm_s)
-                if wc.func in ("min", "max"):
-                    if order:
-                        raise ExecError(
-                            f"running {wc.func} OVER (ORDER BY) "
-                            "unsupported; omit the window ORDER BY")
-                    neutral = jnp.iinfo(jnp.int64).max \
-                        if wc.func == "min" else jnp.iinfo(jnp.int64).min
-                    if jnp.issubdtype(a_s.dtype, jnp.floating):
-                        neutral = np.inf if wc.func == "min" else -np.inf
-                    vals = jnp.where(contrib, a_s,
-                                     jnp.asarray(neutral, a_s.dtype))
-                    segf = jax.ops.segment_min if wc.func == "min" \
-                        else jax.ops.segment_max
-                    per = segf(vals, pid, num_segments=n)
-                    cnt = jax.ops.segment_sum(
-                        contrib.astype(jnp.int64), pid, num_segments=n)
-                    new_cols[name] = scatter(per[pid])
-                    new_nulls[name] = scatter(cnt[pid] == 0)
-                    continue
+                fs, fe = self._frame_bounds(wc.frame, bool(order), iota,
+                                            p_start, p_end, peer_start,
+                                            peer_end_v)
+                fsc = jnp.clip(fs, 0, n - 1)
+                fec = jnp.clip(fe, 0, n - 1)
+                empty = (fe < fs) | ~s_valid
                 cvals = contrib.astype(jnp.int64)
                 ccum = jnp.cumsum(cvals)
                 cex = ccum - cvals
-                rcount = ccum[peer_end] - cex[p_start]
+                rcount = jnp.where(empty, 0, ccum[fec] - cex[fsc])
                 if wc.func == "count":
                     new_cols[name] = scatter(rcount)
+                    continue
+                if wc.func in ("first_value", "last_value"):
+                    pos = fsc if wc.func == "first_value" else fec
+                    val = a_s[pos]
+                    nullm = empty
+                    if anm_s is not None:
+                        nullm = nullm | anm_s[pos]
+                    new_cols[name] = scatter(val)
+                    new_nulls[name] = scatter(nullm)
+                    d = _dict_for_expr(wc.arg, b.dicts)
+                    if d is not None:
+                        new_dicts[name] = d
+                    continue
+                if wc.func in ("min", "max"):
+                    res = self._range_minmax(a_s, contrib, fsc, fec,
+                                             wc.func == "min")
+                    new_cols[name] = scatter(res)
+                    new_nulls[name] = scatter(rcount == 0)
                     continue
                 if wc.func in ("sum", "avg"):
                     av = a_s.astype(jnp.float64) \
@@ -1298,7 +1314,7 @@ class Executor:
                     av = jnp.where(contrib, av, jnp.zeros((), av.dtype))
                     scum = jnp.cumsum(av)
                     sex = scum - av
-                    rsum = scum[peer_end] - sex[p_start]
+                    rsum = jnp.where(empty, 0, scum[fec] - sex[fsc])
                     if wc.func == "avg":
                         scale = wc.arg.type.scale \
                             if wc.arg.type.kind == TypeKind.DECIMAL else 0
@@ -1322,6 +1338,73 @@ class Executor:
         dicts = dict(b.dicts)
         dicts.update(new_dicts)
         return DBatch(cols, b.valid, types, dicts, nulls)
+
+    @staticmethod
+    def _frame_bounds(frame, has_order, iota, p_start, p_end,
+                      peer_start, peer_end_v):
+        """Per-row inclusive [fs, fe] sorted-position bounds of a window
+        frame.  Defaults: RANGE UNBOUNDED PRECEDING..CURRENT ROW with an
+        ORDER BY, the whole partition without (SQL92 / nodeWindowAgg.c
+        update_frameheadpos/update_frametailpos semantics)."""
+        if frame is None:
+            if has_order:
+                return p_start, peer_end_v
+            return p_start, p_end
+        mode, sb, eb = frame
+        if mode == "rows":
+            def rows_bound(bd):
+                kind, k = bd
+                if kind == "unbounded_preceding":
+                    return p_start
+                if kind == "unbounded_following":
+                    return p_end
+                if kind == "current":
+                    return iota
+                if kind == "preceding":
+                    return iota - k
+                return iota + k
+            fs = jnp.maximum(rows_bound(sb), p_start)
+            fe = jnp.minimum(rows_bound(eb), p_end)
+            return fs, fe
+        # RANGE: only unbounded / current-row bounds (peer-aligned)
+        fs = p_start if sb[0] == "unbounded_preceding" else peer_start
+        fe = p_end if eb[0] == "unbounded_following" else peer_end_v
+        return fs, fe
+
+    @staticmethod
+    def _range_minmax(a_s, contrib, fsc, fec, is_min):
+        """min/max over arbitrary inclusive ranges via a log-doubling
+        sparse table: level j holds the reduction of [i, i+2^j-1]; a
+        query [l, r] is the reduction of two (overlapping) power-of-two
+        spans.  O(n log n) build, fully vectorized — the TPU-friendly
+        replacement for nodeWindowAgg.c's per-row frame rescans."""
+        dtype = a_s.dtype
+        if jnp.issubdtype(dtype, jnp.floating):
+            neutral = jnp.asarray(np.inf if is_min else -np.inf, dtype)
+        else:
+            info = jnp.iinfo(dtype)
+            neutral = jnp.asarray(info.max if is_min else info.min, dtype)
+        op = jnp.minimum if is_min else jnp.maximum
+        n = a_s.shape[0]
+        v = jnp.where(contrib, a_s, neutral)
+        levels = [v]
+        j = 0
+        while (1 << (j + 1)) <= n:
+            half = 1 << j
+            prev = levels[-1]
+            shifted = jnp.concatenate(
+                [prev[half:], jnp.full((half,), neutral, dtype)])
+            levels.append(op(prev, shifted))
+            j += 1
+        st = jnp.stack(levels)                      # (L, n)
+        length = jnp.maximum(fec - fsc + 1, 1)
+        jq = jnp.floor(jnp.log2(length.astype(jnp.float64))).astype(
+            jnp.int32)
+        jq = jnp.clip(jq, 0, len(levels) - 1)
+        span = jnp.left_shift(jnp.int64(1), jq.astype(jnp.int64))
+        lo = st[jq, fsc]
+        hi = st[jq, jnp.maximum(fec - span + 1, 0)]
+        return op(lo, hi)
 
     # ---- sort / limit ----
     def _exec_sort(self, node: P.Sort) -> DBatch:

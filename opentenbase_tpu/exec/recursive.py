@@ -1,0 +1,195 @@
+"""WITH RECURSIVE: host-driven worktable iteration.
+
+Reference analog: nodeRecursiveunion.c + nodeWorktablescan.c — the
+executor there pumps the recursive term against a worktable tuplestore
+until it yields nothing.  Here the control loop is host-side (it is
+inherently sequential), but every iteration's recursive term runs as a
+normal engine statement — on the device data plane in cluster mode —
+against two materialized temp tables:
+
+  <t>      the accumulated result (what the outer query reads)
+  <t>__w   the working table (only the PREVIOUS iteration's new rows,
+           which is what the recursive self-reference must see)
+
+UNION (without ALL) dedupes host-side against the accumulated row set,
+matching the reference's hashed RecursiveUnion. The temp tables are
+REPLICATED so every datanode joins against them locally.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import itertools
+
+from ..catalog.types import TypeKind
+from ..sql import ast as A
+from ..sql.analyze import Binder
+from ..sql.rewrite import references_table, rename_tables
+
+_ctr = itertools.count()
+MAX_ITERATIONS = 1000
+
+
+class RecursionLimit(Exception):
+    pass
+
+
+def maybe_expand_recursive(sess, stmt):
+    """Materialize any recursive CTEs of `stmt` into temp tables and
+    return (rewritten statement, cleanup callable)."""
+    if not isinstance(stmt, A.SelectStmt) or not stmt.recursive \
+            or not any(references_table(sub, name)
+                       for name, _, sub in stmt.ctes):
+        return stmt, lambda: None
+    catalog = sess.node.catalog if hasattr(sess, "node") \
+        else sess.cluster.catalog
+    temp: list[str] = []
+
+    def cleanup():
+        for t in temp:
+            try:
+                sess._exec_stmt(A.DropTableStmt(t, if_exists=True))
+            except Exception:
+                pass
+
+    try:
+        mapping: dict[str, str] = {}
+        prior: list = []        # processed CTE entries, self-refs renamed
+        for name, aliases, sub in stmt.ctes:
+            sub = rename_tables(sub, mapping)
+            if not references_table(sub, name):
+                prior.append((name, aliases, sub))
+                continue
+            tname = f"__rcte{next(_ctr)}_{name}"
+            _materialize(sess, catalog, name, aliases, sub, list(prior),
+                         tname, temp)
+            mapping[name] = tname
+        out = rename_tables(
+            dataclasses.replace(stmt, recursive=False), mapping)
+        out.ctes = [(n, a, s) for n, a, s in out.ctes if n not in mapping]
+        return out, cleanup
+    except Exception:
+        cleanup()
+        raise
+
+
+def _with_prior(s: A.SelectStmt, prior) -> A.SelectStmt:
+    s = copy.deepcopy(s)
+    s.ctes = list(copy.deepcopy(prior)) + s.ctes
+    return s
+
+
+_TYPE_AST = {
+    TypeKind.INT64: ("bigint", ()),
+    TypeKind.INT32: ("int", ()),
+    TypeKind.FLOAT64: ("double precision", ()),
+    TypeKind.DATE: ("date", ()),
+    TypeKind.BOOL: ("boolean", ()),
+    TypeKind.TEXT: ("varchar", (255,)),
+}
+
+
+def _coldefs(names, types):
+    defs = []
+    for cname, t in zip(names, types):
+        if t.kind == TypeKind.DECIMAL:
+            tn, ta = "decimal", (30, t.scale)
+        elif t.kind in _TYPE_AST:
+            tn, ta = _TYPE_AST[t.kind]
+        else:               # all-NULL column: any carrier type works
+            tn, ta = "bigint", ()
+        defs.append(A.ColumnDefAst(cname, tn, ta))
+    return defs
+
+
+def _insert(sess, catalog, tname, names, rows):
+    if not rows:
+        return
+    td = catalog.table(tname)
+    coldata = {c: [r[i] for r in rows] for i, c in enumerate(names)}
+    if hasattr(sess, "node"):
+        sess._insert_rows(td, sess.node.stores[tname], coldata, len(rows))
+    else:
+        sess._insert_rows(td, coldata, len(rows))
+
+
+def _materialize(sess, catalog, name, aliases, body, prior, tname, temp):
+    from .executor import ExecError
+
+    # split the UNION chain into base and recursive branches
+    branches, union_all = [], True
+    cur = body
+    while True:
+        branches.append(dataclasses.replace(cur, setop=None,
+                                            parenthesized=False))
+        if cur.setop is None:
+            break
+        op, all_, rhs = cur.setop
+        if op != "union":
+            raise ExecError("recursive CTE requires UNION [ALL] between "
+                            "its base and recursive terms")
+        union_all = union_all and all_
+        cur = rhs
+    base_b = [x for x in branches if not references_table(x, name)]
+    rec_b = [x for x in branches if references_table(x, name)]
+    if not base_b:
+        raise ExecError(f"recursive CTE {name!r} has no non-recursive "
+                        "base term")
+
+    # output names/types from binding the base term
+    bq = Binder(catalog).bind_select(_with_prior(base_b[0], prior))
+    if hasattr(bq, "targets"):
+        names = [n for n, _ in bq.targets]
+        types = [e.type for _, e in bq.targets]
+    else:                   # base term is itself a set operation
+        names = list(bq.target_names)
+        types = list(bq.target_types)
+    if aliases:
+        if len(aliases) != len(names):
+            raise ExecError(f"CTE {name!r} column alias count mismatch")
+        names = list(aliases)
+
+    wname = tname + "__w"
+    for t in (tname, wname):
+        sess._exec_stmt(A.CreateTableStmt(
+            t, _coldefs(names, types), [], "replicated", []))
+        temp.append(t)
+
+    base_rows = []
+    for b in base_b:
+        base_rows.extend(sess._exec_stmt(_with_prior(b, prior)).rows)
+    seen = None
+    if not union_all:
+        seen = set()
+        uniq = []
+        for r in base_rows:
+            if r not in seen:
+                seen.add(r)
+                uniq.append(r)
+        base_rows = uniq
+    _insert(sess, catalog, tname, names, base_rows)
+
+    working = base_rows
+    iters = 0
+    while working:
+        iters += 1
+        if iters > MAX_ITERATIONS:
+            raise ExecError(
+                f"recursive CTE {name!r} exceeded {MAX_ITERATIONS} "
+                "iterations")
+        sess._exec_stmt(A.DeleteStmt(wname, None))
+        _insert(sess, catalog, wname, names, working)
+        new_rows = []
+        for rb in rec_b:
+            rb2 = rename_tables(_with_prior(rb, prior), {name: wname})
+            new_rows.extend(sess._exec_stmt(rb2).rows)
+        if not union_all:
+            uniq = []
+            for r in new_rows:
+                if r not in seen:
+                    seen.add(r)
+                    uniq.append(r)
+            new_rows = uniq
+        _insert(sess, catalog, tname, names, new_rows)
+        working = new_rows

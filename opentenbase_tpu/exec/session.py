@@ -370,6 +370,14 @@ class Session:
         return Planner(self.node.catalog).plan(bq)
 
     def _exec_select(self, stmt: A.SelectStmt) -> Result:
+        if stmt.recursive:
+            from .recursive import maybe_expand_recursive
+            stmt2, cleanup = maybe_expand_recursive(self, stmt)
+            if stmt2 is not stmt:
+                try:
+                    return self._exec_select(stmt2)
+                finally:
+                    cleanup()
         planned = self._plan_select(stmt)
         t, implicit = self._begin_implicit()
         batch = None

@@ -322,21 +322,24 @@ class Cast(Expr):
 AGG_FUNCS = ("sum", "count", "avg", "min", "max")
 
 WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "lag",
-                "lead") + AGG_FUNCS
+                "lead", "first_value", "last_value") + AGG_FUNCS
 
 
 @dataclasses.dataclass(frozen=True)
 class WindowCall(Expr):
-    """func(arg) OVER (PARTITION BY ... ORDER BY ...) — consumed by the
-    Window operator (reference: WindowFunc + nodeWindowAgg.c).  With an
-    ORDER BY, aggregate functions use the SQL default frame (RANGE
-    UNBOUNDED PRECEDING..CURRENT ROW): running values, peers equal."""
+    """func(arg) OVER (PARTITION BY ... ORDER BY ... [frame]) — consumed
+    by the Window operator (reference: WindowFunc + nodeWindowAgg.c).
+    With an ORDER BY and no explicit frame, aggregate functions use the
+    SQL default frame (RANGE UNBOUNDED PRECEDING..CURRENT ROW): running
+    values, peers equal.  frame = (mode, (kind, n), (kind, n)) parsed
+    from ROWS/RANGE BETWEEN (gram.y frame_clause)."""
     func: str
     arg: Optional[Expr]
     partition: tuple[Expr, ...]
     order: tuple[tuple[Expr, bool], ...]   # (expr, desc)
     offset: int = 1                        # lag/lead row offset
     default: Optional[Expr] = None         # lag/lead: None = SQL NULL
+    frame: Optional[tuple] = None
 
     def __post_init__(self):
         if self.func not in WINDOW_FUNCS:

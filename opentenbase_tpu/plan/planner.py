@@ -97,7 +97,8 @@ def rewrite(e: E.Expr, fn) -> E.Expr:
                 tuple(rec(p) for p in x.partition),
                 tuple((rec(o), d) for o, d in x.order),
                 x.offset,
-                rec(x.default) if x.default is not None else None)
+                rec(x.default) if x.default is not None else None,
+                x.frame)
         if isinstance(x, E.Coalesce):
             return E.Coalesce(tuple(rec(a) for a in x.args), x.out_type)
         if isinstance(x, E.NullIf):
@@ -181,7 +182,13 @@ class Planner:
             for i in range(len(names)):
                 e = E.Col(cnames[i], ctypes[i])
                 t = so.target_types[i]
-                if t.kind == ctypes[i].kind and \
+                if ctypes[i].kind == TypeKind.NULL \
+                        and t.kind != TypeKind.NULL:
+                    # an all-NULL branch column (grouping-sets expansion)
+                    # takes the combined type so TEXT decode/dict merge
+                    # and numeric widths line up across branches
+                    e = E.Lit(None, t)
+                elif t.kind == ctypes[i].kind and \
                         t.scale != ctypes[i].scale:
                     e = E.Cast(e, t)
                 outs.append((names[i], e))
@@ -626,7 +633,9 @@ class Planner:
                 if q.link_kind == "exists" and not q.query.correlated_cols:
                     new_where.append(uncorrelated_exists(q))
                     continue
-                semijoins.append(self._sublink_to_semijoin(q, init_plans))
+                sj = self._sublink_to_semijoin(q, init_plans)
+                semijoins.append(sj)
+                new_where.extend(sj.pop("extra_quals"))
                 continue
             new_where.append(rewrite_scalars(q))
 
@@ -642,6 +651,7 @@ class Planner:
         outer_keys: list[E.Expr] = []
         inner_keys: list[E.Expr] = []
         residual: list[E.Expr] = []
+        extra_quals: list[E.Expr] = []
 
         if sl.link_kind == "in":
             if sub.correlated_cols:
@@ -651,6 +661,28 @@ class Planner:
             tname, texpr = sub.targets[0]
             outer_keys.append(sl.test_expr)
             inner_keys.append(E.Col(f"__sub.{tname}", texpr.type))
+            if kind == "anti":
+                # SQL 3VL NOT IN: x NOT IN (S) is TRUE only when S is
+                # empty, or x IS NOT NULL ∧ S has no NULL ∧ no match
+                # (reference: the negated ANY sublink semantics of
+                # ExecScanSubPlan / nodeSubplan.c — a NULL on either
+                # side makes the result UNKNOWN, filtered like FALSE).
+                # Two scalar init plans probe |S| and |S ∩ NULL|; the
+                # anti join itself runs over the NULL-free inner rows so
+                # canonicalized NULL keys can never hash-match.
+                total = self._count_initplan(sub, tname, texpr.type,
+                                             only_null=False,
+                                             init_plans=init_plans)
+                nnull = self._count_initplan(sub, tname, texpr.type,
+                                             only_null=True,
+                                             init_plans=init_plans)
+                extra_quals.append(E.BoolOp("or", (
+                    E.Cmp("=", E.Col(total, T.INT64), E.Lit(0, T.INT64)),
+                    E.BoolOp("and", (
+                        E.IsNull(sl.test_expr, negated=True),
+                        E.Cmp("=", E.Col(nnull, T.INT64),
+                              E.Lit(0, T.INT64)))))))
+                sub = self._filter_null_keys(sub, tname, texpr.type)
             inner_plan = self._plan_query(sub, init_plans)
             inner_plan = _rename_outputs(inner_plan, sub, "__sub")
         else:  # exists
@@ -685,9 +717,46 @@ class Planner:
 
         return {"kind": kind, "plan": inner_plan,
                 "outer_keys": outer_keys, "inner_keys": inner_keys,
-                "residual": residual,
+                "residual": residual, "extra_quals": extra_quals,
                 "outer_cols": set().union(*(expr_cols(k)
                                             for k in outer_keys))}
+
+    def _derived_rte(self, sub: BoundQuery, alias: str) -> RTE:
+        return RTE(alias, "subquery", subquery=sub,
+                   columns={n: (f"{alias}.{n}", e.type)
+                            for n, e in sub.targets})
+
+    def _count_initplan(self, sub: BoundQuery, key: str, key_t,
+                        only_null: bool, init_plans) -> str:
+        """Scalar init plan counting the IN-subquery's rows (optionally
+        only its NULL keys), via a derived-table wrap so grouped
+        subqueries count groups, not input rows."""
+        import copy
+        alias = f"__nin{next(self._ip_counter)}"
+        rte = self._derived_rte(copy.deepcopy(sub), alias)
+        where = [E.IsNull(E.Col(f"{alias}.{key}", key_t))] \
+            if only_null else []
+        probe = BoundQuery(rtable=[rte], join_order=[JoinStep(0, "inner")],
+                           where=where,
+                           targets=[("__c", E.AggCall("count", None))],
+                           group_by=[], having=[], order_by=[])
+        name = f"__initplan{next(self._ip_counter)}"
+        init_plans.append(InitPlan(name, self._plan_query(probe,
+                                                          init_plans),
+                                   T.INT64))
+        return name
+
+    def _filter_null_keys(self, sub: BoundQuery, key: str,
+                          key_t) -> BoundQuery:
+        """NULL-free view of an IN subquery for the anti-join build side."""
+        alias = f"__ninf{next(self._ip_counter)}"
+        rte = self._derived_rte(sub, alias)
+        return BoundQuery(
+            rtable=[rte], join_order=[JoinStep(0, "inner")],
+            where=[E.IsNull(E.Col(f"{alias}.{key}", key_t),
+                            negated=True)],
+            targets=[(key, E.Col(f"{alias}.{key}", key_t))],
+            group_by=[], having=[], order_by=[])
 
     def _exists_targets(self, sub: BoundQuery, inner_keys, residual):
         """EXISTS subquery: project the join keys + any inner columns the

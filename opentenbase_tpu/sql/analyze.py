@@ -54,6 +54,9 @@ class Binder:
     # ------------------------------------------------------------------
     def bind_select(self, stmt: A.SelectStmt,
                     outer: list[Scope] = ()) -> BoundQuery:
+        if stmt.group_sets:
+            from .rewrite import expand_grouping_sets
+            return self.bind_select(expand_grouping_sets(stmt), outer)
         saved_ctes = getattr(self, "_ctes", {})
         if stmt.ctes:
             # non-recursive WITH: each CTE sees only the ones declared
@@ -740,6 +743,10 @@ class Binder:
                     elif default.type.kind != arg.type.kind or \
                             default.type.scale != arg.type.scale:
                         default = E.Cast(default, arg.type)
+            elif name in ("first_value", "last_value"):
+                if len(node.args) != 1:
+                    raise BindError(f"{name} takes one argument")
+                arg = b(node.args[0])
             elif name in E.AGG_FUNCS and not node.star:
                 if len(node.args) != 1:
                     raise BindError(f"{name} takes one argument")
@@ -749,7 +756,18 @@ class Binder:
             part = tuple(b(p) for p in node.over.partition_by)
             order = tuple((b(si.expr), bool(si.desc))
                           for si in node.over.order_by)
-            return E.WindowCall(name, arg, part, order, offset, default)
+            frame = node.over.frame
+            if frame is not None:
+                mode, fs, fe = frame
+                if mode == "range" and (fs[1] is not None
+                                        or fe[1] is not None):
+                    raise BindError("RANGE with a numeric offset is "
+                                    "unsupported (use ROWS BETWEEN)")
+                if name not in E.AGG_FUNCS and \
+                        name not in ("first_value", "last_value"):
+                    frame = None   # ranking funcs ignore the frame (PG)
+            return E.WindowCall(name, arg, part, order, offset, default,
+                                frame)
         if name in E.AGG_FUNCS:
             if node.star:
                 return E.AggCall("count", None)

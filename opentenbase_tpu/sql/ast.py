@@ -80,6 +80,10 @@ class FuncCall(Node):
 class WindowDef(Node):
     partition_by: list[Node] = dataclasses.field(default_factory=list)
     order_by: list["SortItem"] = dataclasses.field(default_factory=list)
+    # explicit frame clause: (mode, start, end) where mode is
+    # 'rows' | 'range' and each bound is (kind, n) with kind in
+    # unbounded_preceding|preceding|current|following|unbounded_following
+    frame: Optional[tuple] = None
 
 
 @dataclasses.dataclass
@@ -206,7 +210,12 @@ class SelectStmt(Node):
     setop: Optional[tuple[str, bool, "SelectStmt"]] = None  # (op, all, rhs)
     ctes: list = dataclasses.field(default_factory=list)
     # WITH clause: [(name, col_aliases|None, SelectStmt)]
+    recursive: bool = False       # WITH RECURSIVE
     parenthesized: bool = False   # was written as (SELECT ...)
+    # GROUPING SETS / ROLLUP / CUBE: list of grouping sets, each a list
+    # of exprs; plain GROUP BY items (group_by) prepend to every set
+    # (reference: gram.y group_by_list -> GroupingSet nodes)
+    group_sets: Optional[list[list[Node]]] = None
 
 
 # ---- DML ------------------------------------------------------------------

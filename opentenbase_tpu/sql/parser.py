@@ -236,7 +236,9 @@ class Parser:
     # ---- SELECT ----
     def select_stmt(self) -> A.SelectStmt:
         ctes = []
+        recursive = False
         if self.accept_kw("with"):
+            recursive = self.accept_kw("recursive")
             while True:
                 name = self.ident()
                 col_aliases = None
@@ -259,6 +261,7 @@ class Parser:
         # so every set-op branch sees them; a parenthesized inner WITH
         # keeps its own entries (declared after, so they may shadow)
         stmt.ctes = ctes + stmt.ctes
+        stmt.recursive = stmt.recursive or recursive
         while self.at_kw("union", "except", "intersect"):
             op = self.advance().value
             all_ = self.accept_kw("all")
@@ -317,18 +320,80 @@ class Parser:
                 from_.append(self.table_ref())
         where = self.expr() if self.accept_kw("where") else None
         group_by: list[A.Node] = []
+        group_sets: Optional[list[list[A.Node]]] = None
         if self.accept_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.expr())
-            while self.accept_op(","):
-                group_by.append(self.expr())
+            while True:
+                sets = self._group_sets_item()
+                if sets is not None:
+                    if group_sets is not None:
+                        raise SqlSyntaxError(
+                            "only one ROLLUP/CUBE/GROUPING SETS per "
+                            "GROUP BY", self.sql, self.tok.pos)
+                    group_sets = sets
+                else:
+                    group_by.append(self.expr())
+                if not self.accept_op(","):
+                    break
         having = self.expr() if self.accept_kw("having") else None
         stmt = A.SelectStmt(items=items, from_=from_, where=where,
                             group_by=group_by, having=having,
-                            distinct=distinct)
+                            distinct=distinct, group_sets=group_sets)
         if consume_tails:
             self._tail_clauses(stmt)
         return stmt
+
+    def _group_sets_item(self) -> Optional[list[list[A.Node]]]:
+        """ROLLUP (..) | CUBE (..) | GROUPING SETS ((..), ..) -> list of
+        grouping sets, or None when the next item is a plain expression
+        (reference: gram.y group_by_item / transformGroupingSet)."""
+        nxt_is_paren = (self.peek().kind == Tok.OP
+                        and self.peek().value == "(")
+        if self.at_kw("rollup") and nxt_is_paren:
+            self.advance()
+            exprs = self._paren_expr_list()
+            return [exprs[:k] for k in range(len(exprs), -1, -1)]
+        if self.at_kw("cube") and nxt_is_paren:
+            self.advance()
+            exprs = self._paren_expr_list()
+            out = []
+            for mask in range(1 << len(exprs)):
+                out.append([e for i, e in enumerate(exprs)
+                            if mask & (1 << i) == 0])
+            return out
+        if self.at_kw("grouping") and self.peek().kind == Tok.IDENT \
+                and self.peek().value == "sets":
+            self.advance()
+            self.advance()
+            self.expect_op("(")
+            sets = []
+            while True:
+                if self.at_op("("):
+                    # a parenthesized set — possibly empty: ()
+                    self.advance()
+                    if self.accept_op(")"):
+                        sets.append([])
+                    else:
+                        es = [self.expr()]
+                        while self.accept_op(","):
+                            es.append(self.expr())
+                        self.expect_op(")")
+                        sets.append(es)
+                else:
+                    sets.append([self.expr()])
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return sets
+        return None
+
+    def _paren_expr_list(self) -> list[A.Node]:
+        self.expect_op("(")
+        out = [self.expr()]
+        while self.accept_op(","):
+            out.append(self.expr())
+        self.expect_op(")")
+        return out
 
     def _tail_clauses(self, stmt: A.SelectStmt):
         if self.accept_kw("order"):
@@ -943,9 +1008,36 @@ class Parser:
             wd.order_by.append(self.sort_item())
             while self.accept_op(","):
                 wd.order_by.append(self.sort_item())
+        if self.at_kw("rows", "range"):
+            mode = self.advance().value
+            if self.accept_kw("between"):
+                start = self._frame_bound()
+                self.expect_kw("and")
+                end = self._frame_bound()
+            else:
+                start = self._frame_bound()
+                end = ("current", None)
+            wd.frame = (mode, start, end)
         self.expect_op(")")
         fc.over = wd
         return fc
+
+    def _frame_bound(self) -> tuple:
+        """UNBOUNDED PRECEDING | n PRECEDING | CURRENT ROW |
+        n FOLLOWING | UNBOUNDED FOLLOWING (gram.y frame_bound)."""
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return ("unbounded_preceding", None)
+            self.expect_kw("following")
+            return ("unbounded_following", None)
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return ("current", None)
+        n = self.int_lit()
+        if self.accept_kw("preceding"):
+            return ("preceding", n)
+        self.expect_kw("following")
+        return ("following", n)
 
     def case_expr(self) -> A.CaseExpr:
         self.expect_kw("case")

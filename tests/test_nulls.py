@@ -253,3 +253,52 @@ class TestDistributedNulls:
         s2 = ClusterSession(Cluster(datadir=str(tmp_path / "cl")))
         got = s2.query("select k from t where v is null order by k")
         assert got == [(2,), (5,)]
+
+
+class TestNotInNull3VL:
+    """x NOT IN (S): UNKNOWN (filtered) when S contains NULL or x is
+    NULL and S non-empty; TRUE for every x when S is empty (reference:
+    negated ANY sublink 3VL, nodeSubplan.c ExecScanSubPlan).  Closes
+    the deviation previously documented in PARITY.md."""
+
+    @pytest.fixture()
+    def s(self, sess):
+        sess.execute("create table nin_t (a bigint, b bigint)")
+        sess.execute("create table nin_u (x bigint)")
+        sess.execute("insert into nin_t values (1, 10), (2, 20), "
+                     "(3, null)")
+        return sess
+
+    def test_inner_null_poisons_not_in(self, s):
+        s.execute("insert into nin_u values (10), (null)")
+        assert s.query("select a from nin_t where b not in "
+                       "(select x from nin_u)") == []
+
+    def test_no_inner_null(self, s):
+        s.execute("insert into nin_u values (10)")
+        # b=20 passes; b=10 matches; b=NULL -> UNKNOWN
+        assert s.query("select a from nin_t where b not in "
+                       "(select x from nin_u)") == [(2,)]
+
+    def test_empty_subquery_everything_passes(self, s):
+        got = sorted(s.query("select a from nin_t where b not in "
+                             "(select x from nin_u)"))
+        assert got == [(1,), (2,), (3,)]
+
+    def test_positive_in_unaffected(self, s):
+        s.execute("insert into nin_u values (10), (null)")
+        assert s.query("select a from nin_t where b in "
+                       "(select x from nin_u)") == [(1,)]
+
+    def test_not_in_distributed(self, cs):
+        cs.execute("create table nin_d (k bigint, v bigint) "
+                   "distribute by shard(k)")
+        cs.execute("create table nin_e (w bigint) "
+                   "distribute by shard(w)")
+        cs.execute("insert into nin_d values (1, 5), (2, 6), (3, null)")
+        cs.execute("insert into nin_e values (5), (null)")
+        assert cs.query("select k from nin_d where v not in "
+                        "(select w from nin_e)") == []
+        cs.execute("delete from nin_e where w is null")
+        assert cs.query("select k from nin_d where v not in "
+                        "(select w from nin_e)") == [(2,)]

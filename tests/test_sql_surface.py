@@ -379,3 +379,148 @@ class TestOuterJoins:
                        "where k is null or k < 3 or rk is not null "
                        "order by k, rk")
         assert (None, 100) in got and (1, 1) in got
+
+
+class TestWindowFrames:
+    """Explicit ROWS/RANGE frame clauses (reference: nodeWindowAgg.c
+    update_frameheadpos/update_frametailpos; gram.y frame_clause)."""
+
+    def test_rows_sliding_sum(self, sess):
+        got = sess.query(
+            "select g, x, sum(v) over (partition by g order by x, v "
+            "rows between 1 preceding and 1 following) from t "
+            "order by g, x, v")
+        assert got == [("a", 1, 30.0), ("a", 2, 60.0), ("a", 2, 50.0),
+                       ("b", 5, 4.0), ("b", 7, 4.0)]
+
+    def test_rows_unbounded_following(self, sess):
+        got = sess.query(
+            "select g, x, sum(v) over (partition by g order by x, v "
+            "rows between current row and unbounded following) from t "
+            "order by g, x, v")
+        assert got == [("a", 1, 60.0), ("a", 2, 50.0), ("a", 2, 30.0),
+                       ("b", 5, 4.0), ("b", 7, 2.5)]
+
+    def test_running_min_max_with_order(self, sess):
+        got = sess.query(
+            "select x, min(v) over (order by x, v), "
+            "max(v) over (order by x, v) from t where g = 'a' "
+            "order by x, v")
+        assert got == [(1, 10.0, 10.0), (2, 10.0, 20.0),
+                       (2, 10.0, 30.0)]
+
+    def test_rows_min_window(self, sess):
+        got = sess.query(
+            "select x, v, min(v) over (order by x, v rows between "
+            "1 preceding and current row) from t where g = 'a' "
+            "order by x, v")
+        assert got == [(1, 10.0, 10.0), (2, 20.0, 10.0),
+                       (2, 30.0, 20.0)]
+
+    def test_first_last_value(self, sess):
+        got = sess.query(
+            "select g, x, first_value(v) over (partition by g "
+            "order by x, v), last_value(v) over (partition by g "
+            "order by x, v rows between unbounded preceding and "
+            "unbounded following) from t order by g, x, v")
+        assert got == [("a", 1, 10.0, 30.0), ("a", 2, 10.0, 30.0),
+                       ("a", 2, 10.0, 30.0), ("b", 5, 1.5, 2.5),
+                       ("b", 7, 1.5, 2.5)]
+
+    def test_range_default_vs_rows_current(self, sess):
+        # peers (x=2 twice with distinct v -> order on x only: the two
+        # v-rows are peers): RANGE default includes both peers, ROWS
+        # CURRENT ROW stops at the row itself
+        rng = sess.query("select x, sum(v) over (order by x) from t "
+                         "where g = 'a' order by x, v")
+        rows = sess.query("select x, v, sum(v) over (order by x "
+                          "rows between unbounded preceding and "
+                          "current row) from t where g = 'a' "
+                          "order by x, v")
+        assert rng == [(1, 10.0), (2, 60.0), (2, 60.0)]
+        assert rows == [(1, 10.0, 10.0), (2, 20.0, 30.0),
+                        (2, 30.0, 60.0)]
+
+    def test_frames_distributed(self, cs):
+        got = cs.query(
+            "select k, sum(x) over (order by k rows between "
+            "2 preceding and current row) from t where k < 6 "
+            "order by k")
+        xs = {k: k % 7 for k in range(6)}
+        want = [(k, sum(xs[j] for j in range(max(0, k - 2), k + 1)))
+                for k in range(6)]
+        assert got == want
+
+
+class TestGroupingSets:
+    """GROUP BY ROLLUP/CUBE/GROUPING SETS via UNION ALL expansion
+    (reference: parse_agg.c transformGroupingSet + nodeAgg.c phases)."""
+
+    def test_rollup(self, sess):
+        got = sess.query(
+            "select g, x, sum(v) as s from t group by rollup (g, x) "
+            "order by g nulls last, x nulls last")
+        assert got == [("a", 1, 10.0), ("a", 2, 50.0), ("a", None, 60.0),
+                       ("b", 5, 1.5), ("b", 7, 2.5), ("b", None, 4.0),
+                       (None, None, 64.0)]
+
+    def test_cube_count(self, sess):
+        got = sess.query("select g, x, count(*) as n from t "
+                         "group by cube (g, x)")
+        # 2 g-values x 3 x-values... cells: (g,x) pairs present: a1,a2,b5,b7
+        # + per-g (2) + per-x (4: 1,2,5,7) + grand (1) = 11
+        assert len(got) == 11
+
+    def test_grouping_sets_and_grouping_fn(self, sess):
+        got = sess.query(
+            "select g, grouping(g) as gg, sum(v) as s from t "
+            "group by grouping sets ((g), ()) "
+            "order by g nulls last")
+        assert got == [("a", 0, 60.0), ("b", 0, 4.0), (None, 1, 64.0)]
+
+    def test_rollup_distributed(self, cs):
+        got = cs.query("select g, count(*) as n from t "
+                       "group by rollup (g) order by g nulls last")
+        assert got == [("g0", 10), ("g1", 10), ("g2", 10), (None, 30)]
+
+
+class TestRecursiveCtes:
+    """WITH RECURSIVE (reference: nodeRecursiveunion.c +
+    nodeWorktablescan.c)."""
+
+    def test_series(self, sess):
+        got = sess.query("with recursive s (n) as (select 1 union all "
+                         "select n + 1 from s where n < 10) "
+                         "select sum(n), count(*) from s")
+        assert got == [(55, 10)]
+
+    def test_cycle_union_dedupe(self, sess):
+        sess.execute("create table e2 (src bigint, dst bigint)")
+        sess.execute("insert into e2 values (1,2),(2,3),(3,1),(3,4)")
+        got = sess.query(
+            "with recursive r (v) as (select 2 union "
+            "select e2.dst from r, e2 where e2.src = r.v) "
+            "select v from r order by v")
+        assert got == [(1,), (2,), (3,), (4,)]
+
+    def test_joins_against_base_tables(self, sess):
+        got = sess.query(
+            "with recursive s (n) as (select 1 union all "
+            "select n + 1 from s where n < 3) "
+            "select s.n, count(*) from s, t where t.x >= s.n "
+            "group by s.n order by s.n")
+        assert got == [(1, 5), (2, 4), (3, 2)]
+
+    def test_recursive_distributed(self, cs):
+        got = cs.query(
+            "with recursive s (n) as (select 0 union all "
+            "select n + 1 from s where n < 6) "
+            "select count(*) from s, t where t.x = s.n")
+        assert got == [(30,)]
+
+    def test_iteration_guard(self, sess):
+        import pytest as _pytest
+        from opentenbase_tpu.exec.executor import ExecError
+        with _pytest.raises(ExecError, match="iterations"):
+            sess.query("with recursive s (n) as (select 1 union all "
+                       "select n + 1 from s) select count(*) from s")
