@@ -152,6 +152,14 @@ class ClusterSession:
     # ------------------------------------------------------------------
     def _exec_stmt(self, stmt: A.Node) -> Result:
         c = self.cluster
+        if isinstance(stmt, (A.SelectStmt, A.InsertStmt, A.ExplainStmt)):
+            from .recursive import expand_in_stmt
+            stmt2, cleanup = expand_in_stmt(self, stmt)
+            if stmt2 is not stmt:
+                try:
+                    return self._exec_stmt(stmt2)
+                finally:
+                    cleanup()
         if isinstance(stmt, A.SelectStmt):
             return self._exec_select(stmt)
         if isinstance(stmt, A.CreateTableStmt):
@@ -480,14 +488,6 @@ class ClusterSession:
 
     def _exec_select(self, stmt: A.SelectStmt,
                      instrument: bool = False) -> tuple:
-        if stmt.recursive:
-            from .recursive import maybe_expand_recursive
-            stmt2, cleanup = maybe_expand_recursive(self, stmt)
-            if stmt2 is not stmt:
-                try:
-                    return self._exec_select(stmt2, instrument)
-                finally:
-                    cleanup()
         self._refresh_stat_views(stmt)
         t, implicit = self._begin_implicit()
         dp = self._plan_distributed(stmt, txn=t)

@@ -1303,8 +1303,25 @@ class Executor:
                         new_dicts[name] = d
                     continue
                 if wc.func in ("min", "max"):
-                    res = self._range_minmax(a_s, contrib, fsc, fec,
-                                             wc.func == "min")
+                    d = _dict_for_expr(wc.arg, b.dicts) \
+                        if wc.arg is not None else None
+                    if d is not None:
+                        # dictionary codes are unordered: reduce over
+                        # lexicographic ranks, then map the winning rank
+                        # back to its code (same trick as _win_key)
+                        dorder = np.argsort(np.asarray(d, dtype=object))
+                        rank = np.empty(max(len(d), 1), dtype=np.int32)
+                        rank[dorder] = np.arange(len(d), dtype=np.int32)
+                        ranked = jnp.asarray(rank)[
+                            jnp.clip(a_s, 0, len(d) - 1)]
+                        rr = self._range_minmax(ranked, contrib, fsc,
+                                                fec, wc.func == "min")
+                        res = jnp.asarray(dorder.astype(np.int32))[
+                            jnp.clip(rr, 0, len(d) - 1)]
+                        new_dicts[name] = d
+                    else:
+                        res = self._range_minmax(a_s, contrib, fsc, fec,
+                                                 wc.func == "min")
                     new_cols[name] = scatter(res)
                     new_nulls[name] = scatter(rcount == 0)
                     continue

@@ -35,6 +35,30 @@ class RecursionLimit(Exception):
     pass
 
 
+def expand_in_stmt(sess, stmt):
+    """Statement-level entry: expand recursive CTEs wherever a SELECT
+    can carry them — top-level SELECT, INSERT ... SELECT, EXPLAIN.
+    Returns (possibly rewritten stmt, cleanup)."""
+    if isinstance(stmt, A.SelectStmt):
+        return maybe_expand_recursive(sess, stmt)
+    if isinstance(stmt, A.InsertStmt) and stmt.select is not None \
+            and stmt.select.recursive:
+        sel, cleanup = maybe_expand_recursive(sess, stmt.select)
+        if sel is not stmt.select:
+            return dataclasses.replace(stmt, select=sel), cleanup
+        return stmt, cleanup
+    if isinstance(stmt, A.ExplainStmt):
+        inner, cleanup = expand_in_stmt(sess, stmt.stmt)
+        if inner is not stmt.stmt:
+            # EXPLAIN then shows the rewritten query over the
+            # materialized worktables (the iteration itself is host
+            # control flow, not a plan node)
+            return A.ExplainStmt(inner, stmt.analyze, stmt.verbose), \
+                cleanup
+        return stmt, cleanup
+    return stmt, lambda: None
+
+
 def maybe_expand_recursive(sess, stmt):
     """Materialize any recursive CTEs of `stmt` into temp tables and
     return (rewritten statement, cleanup callable)."""

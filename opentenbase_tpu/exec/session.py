@@ -282,6 +282,14 @@ class Session:
 
     # ------------------------------------------------------------------
     def _exec_stmt(self, stmt: A.Node) -> Result:
+        if isinstance(stmt, (A.SelectStmt, A.InsertStmt, A.ExplainStmt)):
+            from .recursive import expand_in_stmt
+            stmt2, cleanup = expand_in_stmt(self, stmt)
+            if stmt2 is not stmt:
+                try:
+                    return self._exec_stmt(stmt2)
+                finally:
+                    cleanup()
         if isinstance(stmt, A.SelectStmt):
             return self._exec_select(stmt)
         if isinstance(stmt, A.CreateTableStmt):
@@ -370,14 +378,6 @@ class Session:
         return Planner(self.node.catalog).plan(bq)
 
     def _exec_select(self, stmt: A.SelectStmt) -> Result:
-        if stmt.recursive:
-            from .recursive import maybe_expand_recursive
-            stmt2, cleanup = maybe_expand_recursive(self, stmt)
-            if stmt2 is not stmt:
-                try:
-                    return self._exec_select(stmt2)
-                finally:
-                    cleanup()
         planned = self._plan_select(stmt)
         t, implicit = self._begin_implicit()
         batch = None

@@ -57,15 +57,15 @@ def rename_tables(node, mapping: dict[str, str]):
 
 
 def references_table(node, name: str) -> bool:
-    found = False
-
-    def fn(x):
-        nonlocal found
-        if isinstance(x, A.TableRef) and x.name == name:
-            found = True
-        return None
-    _transform(node, fn)
-    return found
+    """Read-only walk (early exit, no rebuilding)."""
+    if isinstance(node, A.TableRef):
+        return node.name == name
+    if isinstance(node, A.Node):
+        return any(references_table(getattr(node, f.name), name)
+                   for f in dataclasses.fields(node))
+    if isinstance(node, (list, tuple)):
+        return any(references_table(x, name) for x in node)
+    return False
 
 
 def _default_item_alias(expr: A.Node, i: int) -> str:
@@ -108,6 +108,13 @@ def expand_grouping_sets(stmt: A.SelectStmt) -> A.SelectStmt:
                     bits = (bits << 1) | (0 if any(a == e for e in _s)
                                           else 1)
                 return A.Const(bits, "int")
+            if isinstance(x, A.FuncCall) and x.over is None \
+                    and x.name in ("sum", "count", "avg", "min", "max"):
+                # aggregate arguments see INPUT rows, not the grouped
+                # output: sum(x) in a subtotal row still sums x (PG);
+                # only direct output references of absent grouping
+                # columns become NULL — stop the descent here
+                return x
             if any(x == c for c in _absent):
                 return A.Const(None, "null")
             return None
@@ -132,6 +139,24 @@ def expand_grouping_sets(stmt: A.SelectStmt) -> A.SelectStmt:
     out.recursive = recursive
     if not order_by and limit is None and offset is None:
         return out
+
+    # ORDER BY sum(v) etc.: fold any subexpression that structurally
+    # matches a select item onto that item's output alias, so it can
+    # bind against the union result (PG resolves these positionally in
+    # transformSortClause)
+    item_map = []
+    for i, it in enumerate(stmt.items):
+        alias = it.alias or _default_item_alias(it.expr, i)
+        item_map.append((it.expr, alias))
+
+    def to_alias(x):
+        for expr, alias in item_map:
+            if x == expr:
+                return A.ColRef((alias,))
+        return None
+
+    order_by = [A.SortItem(_transform(si.expr, to_alias), si.desc,
+                           si.nulls_first) for si in order_by]
 
     simple = all(isinstance(si.expr, A.ColRef) and len(si.expr.parts) == 1
                  or isinstance(si.expr, A.Const)
