@@ -155,6 +155,15 @@ class DistExecutor:
         return scalar_from_batch(b)
 
     def _run_distplan(self, dp: DistPlan) -> DBatch:
+        if dp.fqs_node is None and len(dp.fragments) == 1 \
+                and not dp.exchanges:
+            # CN-local statement: the main plan scans no tables (e.g. a
+            # SELECT of init-plan scalars).  Nothing to ship — this is
+            # not a data-plane fallback (reference: queries that never
+            # leave the coordinator, pgxc_query_needs_coord)
+            self.tier = "local"
+            return self._exec_fragment_on(dp.fragments[dp.top_fragment],
+                                          dp, "cn", {})
         if self.use_mesh and dp.fqs_node is None:
             # device data plane: DN fragments + exchanges compile into one
             # shard_map program (all_to_all/all_gather over the mesh)

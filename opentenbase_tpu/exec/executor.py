@@ -1132,6 +1132,8 @@ class Executor:
         grouping column, so NULL never collides with +inf/INT64_MAX
         values (PG sorts NULL as a distinct peer group)."""
         arr, nm = self._eval_pair(e, b)
+        if getattr(arr, "ndim", 1) == 0:   # constant key: broadcast
+            arr = jnp.broadcast_to(arr, b.valid.shape)
         d = _dict_for_expr(e, b.dicts)
         if d is not None and for_order:
             # dictionary codes are unordered: map code -> rank

@@ -476,6 +476,33 @@ class Binder:
         if isinstance(node, A.CaseExpr):
             whens = tuple((b(c), b(v)) for c, v in node.whens)
             else_ = b(node.else_) if node.else_ is not None else None
+            # constant-fold literal WHEN conditions (the grouping-sets
+            # expansion emits `when 0 = 0 then col` / `when 1 = 0 ...`;
+            # reference: eval_const_expressions)
+            kept = []
+            cut = None
+            for c, v in whens:
+                tv = self._const_truth(c)
+                if tv is False:
+                    continue
+                if tv is True:
+                    cut = v
+                    break
+                kept.append((c, v))
+            if cut is not None and not kept:
+                return cut
+            if cut is not None:
+                else_, whens = cut, tuple(kept)
+            elif len(kept) != len(whens):
+                if not kept:
+                    return else_ if else_ is not None \
+                        else E.Lit(None, T.NULLT)
+                whens = tuple(kept)
+            if all(v.type.kind == TypeKind.NULL for _, v in whens) and \
+                    (else_ is None or else_.type.kind == TypeKind.NULL):
+                # every branch is NULL (grouping-sets folding produces
+                # these): the whole CASE is a typed-null constant
+                return E.Lit(None, T.NULLT)
             t = self._common_case_type([v.type for _, v in whens]
                                        + ([else_.type] if else_ else []))
             whens, else_ = self._coerce_case(whens, else_, t)
@@ -546,6 +573,26 @@ class Binder:
         if node.kind == "null":
             return E.Lit(None, T.NULLT)
         raise BindError(f"bad const kind {node.kind}")
+
+    @staticmethod
+    def _const_truth(e: E.Expr):
+        """True/False when a bound predicate is a literal comparison;
+        None when not statically decidable."""
+        if isinstance(e, E.Lit):
+            return bool(e.value) if e.value is not None else False
+        if isinstance(e, E.Cmp) and isinstance(e.left, E.Lit) \
+                and isinstance(e.right, E.Lit) \
+                and e.left.value is not None \
+                and e.right.value is not None:
+            import operator
+            ops = {"=": operator.eq, "<>": operator.ne,
+                   "<": operator.lt, "<=": operator.le,
+                   ">": operator.gt, ">=": operator.ge}
+            try:
+                return bool(ops[e.op](e.left.value, e.right.value))
+            except TypeError:
+                return None
+        return None
 
     def _negate(self, e: E.Expr) -> E.Expr:
         if isinstance(e, E.StrPred):

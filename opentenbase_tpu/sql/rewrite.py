@@ -108,8 +108,9 @@ def expand_grouping_sets(stmt: A.SelectStmt) -> A.SelectStmt:
                     bits = (bits << 1) | (0 if any(a == e for e in _s)
                                           else 1)
                 return A.Const(bits, "int")
+            from ..plan.exprs import AGG_FUNCS
             if isinstance(x, A.FuncCall) and x.over is None \
-                    and x.name in ("sum", "count", "avg", "min", "max"):
+                    and x.name in AGG_FUNCS:
                 # aggregate arguments see INPUT rows, not the grouped
                 # output: sum(x) in a subtotal row still sums x (PG);
                 # only direct output references of absent grouping
@@ -144,9 +145,18 @@ def expand_grouping_sets(stmt: A.SelectStmt) -> A.SelectStmt:
     # matches a select item onto that item's output alias, so it can
     # bind against the union result (PG resolves these positionally in
     # transformSortClause)
+    # aliases must match the binder's uniquified output names (a second
+    # unaliased sum() becomes "sum_1" there — analyze.py uniq())
     item_map = []
+    used = set()
     for i, it in enumerate(stmt.items):
         alias = it.alias or _default_item_alias(it.expr, i)
+        if alias in used:
+            k = 1
+            while f"{alias}_{k}" in used:
+                k += 1
+            alias = f"{alias}_{k}"
+        used.add(alias)
         item_map.append((it.expr, alias))
 
     def to_alias(x):

@@ -1,7 +1,7 @@
 """Deterministic TPC-DS-shaped data generator (starter scale).
 
 Not dsdgen-conformant — a seeded synthetic population with the joins,
-skew, and NULL characteristics the starter queries exercise (dsdgen's
+skew, and NULL characteristics the query set exercises (dsdgen's
 output is only needed for published-result comparability; correctness
 is asserted against pandas oracles on THIS data)."""
 
@@ -14,6 +14,11 @@ CATEGORIES = ["Books", "Electronics", "Home", "Music", "Sports"]
 CLASSES = ["c1", "c2", "c3"]
 FIRST = ["ada", "bob", "carol", "dan", "eve", "frank"]
 LAST = ["smith", "jones", "lee", "patel", "kim"]
+STATES = ["TN", "GA", "OH", "TX", "CA", "WA", "NY", "FL"]
+CITIES = [f"city_{i}" for i in range(12)]
+COUNTIES = [f"county_{i}" for i in range(8)]
+EDUCATION = ["Primary", "Secondary", "College", "Advanced Degree"]
+BUY_POTENTIAL = ["0-500", "501-1000", "1001-5000", ">5000"]
 
 
 def generate(sf: float = 1.0, seed: int = 7) -> dict:
@@ -21,25 +26,31 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
     n_dates = 730                      # two years of days
     n_items = max(int(60 * sf), 20)
     n_cust = max(int(120 * sf), 30)
+    n_addr = max(int(60 * sf), 20)
     n_stores = 6
+    n_wh = 3
+    n_cd = 48
+    n_hd = 20
+    n_promo = 10
     n_ss = max(int(4000 * sf), 400)
     n_cs = max(int(1500 * sf), 150)
     n_ws = max(int(1500 * sf), 150)
 
     base = np.datetime64("1999-01-01")
+    days = [base + np.timedelta64(i, "D") for i in range(n_dates)]
     dates = {
         "d_date_sk": np.arange(1, n_dates + 1, dtype=np.int64),
-        "d_date": [str(base + np.timedelta64(i, "D"))
-                   for i in range(n_dates)],
+        "d_date": [str(d) for d in days],
         "d_year": np.asarray(
-            [(base + np.timedelta64(i, "D")).astype("datetime64[Y]")
-             .astype(int) + 1970 for i in range(n_dates)], np.int32),
-        "d_moy": np.asarray(
-            [int(str(base + np.timedelta64(i, "D"))[5:7])
-             for i in range(n_dates)], np.int32),
+            [d.astype("datetime64[Y]").astype(int) + 1970 for d in days],
+            np.int32),
+        "d_moy": np.asarray([int(str(d)[5:7]) for d in days], np.int32),
+        "d_dow": np.asarray(
+            [(d.astype("datetime64[D]").astype(int) + 4) % 7
+             for d in days], np.int32),          # 1970-01-01 = Thursday
         "d_month_seq": np.asarray(
-            [(base + np.timedelta64(i, "D")).astype("datetime64[M]")
-             .astype(int) for i in range(n_dates)], np.int32),
+            [d.astype("datetime64[M]").astype(int) for d in days],
+            np.int32),
     }
 
     items = {
@@ -48,6 +59,7 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
                                    n_items).astype(np.int32),
         "i_category_id": rng.integers(1, len(CATEGORIES) + 1,
                                       n_items).astype(np.int32),
+        "i_manufact_id": rng.integers(1, 12, n_items).astype(np.int32),
         "i_manager_id": rng.integers(1, 40, n_items).astype(np.int32),
         "i_current_price": np.round(
             rng.uniform(0.5, 99.0, n_items), 2),
@@ -61,6 +73,49 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
     stores = {
         "s_store_sk": np.arange(1, n_stores + 1, dtype=np.int64),
         "s_store_name": [f"store_{i}" for i in range(n_stores)],
+        "s_state": [STATES[i % 4] for i in range(n_stores)],
+        "s_county": [COUNTIES[i % 3] for i in range(n_stores)],
+    }
+
+    addr = {
+        "ca_address_sk": np.arange(1, n_addr + 1, dtype=np.int64),
+        "ca_state": [STATES[i % len(STATES)] for i in range(n_addr)],
+        "ca_city": [CITIES[i % len(CITIES)] for i in range(n_addr)],
+        "ca_county": [COUNTIES[i % len(COUNTIES)] for i in range(n_addr)],
+        "ca_gmt_offset": np.asarray([-5 - (i % 2) for i in range(n_addr)],
+                                    np.int32),
+    }
+
+    cd = {
+        "cd_demo_sk": np.arange(1, n_cd + 1, dtype=np.int64),
+        "cd_gender": ["M" if i % 2 else "F" for i in range(n_cd)],
+        "cd_marital_status": ["MSDWU"[i % 5] for i in range(n_cd)],
+        "cd_education_status": [EDUCATION[i % len(EDUCATION)]
+                                for i in range(n_cd)],
+        "cd_dep_count": np.asarray([i % 7 for i in range(n_cd)], np.int32),
+    }
+
+    hd = {
+        "hd_demo_sk": np.arange(1, n_hd + 1, dtype=np.int64),
+        "hd_buy_potential": [BUY_POTENTIAL[i % len(BUY_POTENTIAL)]
+                             for i in range(n_hd)],
+        "hd_dep_count": np.asarray([i % 6 for i in range(n_hd)], np.int32),
+        "hd_vehicle_count": np.asarray([i % 5 for i in range(n_hd)],
+                                       np.int32),
+    }
+
+    wh = {
+        "w_warehouse_sk": np.arange(1, n_wh + 1, dtype=np.int64),
+        "w_warehouse_name": [f"wh_{i}" for i in range(n_wh)],
+        "w_state": [STATES[i % 3] for i in range(n_wh)],
+    }
+
+    promo = {
+        "p_promo_sk": np.arange(1, n_promo + 1, dtype=np.int64),
+        "p_channel_email": ["Y" if i % 3 == 0 else "N"
+                            for i in range(n_promo)],
+        "p_channel_event": ["Y" if i % 4 == 0 else "N"
+                            for i in range(n_promo)],
     }
 
     cust = {
@@ -69,9 +124,15 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
         "c_last_name": [LAST[i % len(LAST)] for i in range(n_cust)],
         "c_birth_year": rng.integers(1940, 2000,
                                      n_cust).astype(np.int32),
+        "c_current_addr_sk": rng.integers(1, n_addr + 1,
+                                          n_cust).astype(np.int64),
+        "c_current_cdemo_sk": rng.integers(1, n_cd + 1,
+                                           n_cust).astype(np.int64),
+        "c_current_hdemo_sk": rng.integers(1, n_hd + 1,
+                                           n_cust).astype(np.int64),
     }
 
-    def sales(n, prefix, rng, with_store=False):
+    def sales(n, prefix):
         out = {
             f"{prefix}_sold_date_sk": rng.integers(
                 1, n_dates + 1, n).astype(np.int64),
@@ -81,27 +142,113 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
         }
         price = np.round(rng.uniform(1.0, 300.0, n), 2)
         out[f"{prefix}_ext_sales_price"] = price
+        out[f"{prefix}_sales_price"] = np.round(
+            price / out[f"{prefix}_quantity"], 2)
         return out
 
-    ss = sales(n_ss, "ss", rng)
-    ss["ss_ticket"] = np.arange(1, n_ss + 1, dtype=np.int32)
-    ss["ss_customer_sk"] = rng.integers(1, n_cust + 1,
-                                        n_ss).astype(np.int64)
-    ss["ss_store_sk"] = rng.integers(1, n_stores + 1,
+    ss = sales(n_ss, "ss")
+    # store tickets group several line items sharing customer, store,
+    # household, address, and date (TPC-DS ticket semantics — Q34/Q46
+    # count items per ticket)
+    n_tk = max(n_ss // 4, 1)
+    tk_cust = rng.integers(1, n_cust + 1, n_tk).astype(np.int64)
+    tk_hdemo = rng.integers(1, n_hd + 1, n_tk).astype(np.int64)
+    tk_addr = rng.integers(1, n_addr + 1, n_tk).astype(np.int64)
+    tk_store = rng.integers(1, n_stores + 1, n_tk).astype(np.int64)
+    tk_date = rng.integers(1, n_dates + 1, n_tk).astype(np.int64)
+    tid = rng.integers(0, n_tk, n_ss)
+    ss["ss_ticket"] = (tid + 1).astype(np.int32)
+    ss["ss_sold_date_sk"] = tk_date[tid]
+    ss["ss_customer_sk"] = tk_cust[tid]
+    ss["ss_cdemo_sk"] = rng.integers(1, n_cd + 1, n_ss).astype(np.int64)
+    ss["ss_hdemo_sk"] = tk_hdemo[tid]
+    ss["ss_addr_sk"] = tk_addr[tid]
+    ss["ss_store_sk"] = tk_store[tid]
+    ss["ss_promo_sk"] = rng.integers(1, n_promo + 1,
                                      n_ss).astype(np.int64)
+    ss["ss_list_price"] = np.round(
+        ss["ss_sales_price"] * rng.uniform(1.0, 1.5, n_ss), 2)
+    ss["ss_coupon_amt"] = np.round(
+        ss["ss_ext_sales_price"] * rng.uniform(0, 0.15, n_ss), 2)
     ss["ss_net_profit"] = np.round(
         ss["ss_ext_sales_price"] * rng.uniform(-0.2, 0.4, n_ss), 2)
 
-    cs = sales(n_cs, "cs", rng)
+    def returns(src, n_src, prefix, n_ret):
+        idx = rng.choice(n_src, size=n_ret, replace=False)
+        lag = rng.integers(1, 90, n_ret)
+        rdate = np.minimum(src[f"{prefix}_sold_date_sk"][idx] + lag,
+                           n_dates)
+        qty = np.maximum(src[f"{prefix}_quantity"][idx] // 2, 1)
+        amt = np.round(src[f"{prefix}_ext_sales_price"][idx]
+                       * rng.uniform(0.2, 1.0, n_ret), 2)
+        return idx, rdate, qty.astype(np.int32), amt
+
+    sr_idx, sr_date, sr_qty, sr_amt = returns(ss, n_ss, "ss",
+                                              n_ss // 4)
+    sr = {
+        "sr_ticket": ss["ss_ticket"][sr_idx],
+        "sr_item_sk": ss["ss_item_sk"][sr_idx],
+        "sr_returned_date_sk": sr_date,
+        "sr_customer_sk": ss["ss_customer_sk"][sr_idx],
+        "sr_store_sk": ss["ss_store_sk"][sr_idx],
+        "sr_return_quantity": sr_qty,
+        "sr_return_amt": sr_amt,
+    }
+
+    cs = sales(n_cs, "cs")
     cs["cs_order"] = np.arange(1, n_cs + 1, dtype=np.int32)
+    cs["cs_ship_date_sk"] = np.minimum(
+        cs["cs_sold_date_sk"] + rng.integers(1, 120, n_cs), n_dates)
     cs["cs_bill_customer_sk"] = rng.integers(
         1, n_cust + 1, n_cs).astype(np.int64)
+    cs["cs_bill_cdemo_sk"] = rng.integers(1, n_cd + 1,
+                                          n_cs).astype(np.int64)
+    cs["cs_warehouse_sk"] = rng.integers(1, n_wh + 1,
+                                         n_cs).astype(np.int64)
+    cs["cs_promo_sk"] = rng.integers(1, n_promo + 1,
+                                     n_cs).astype(np.int64)
+    cs["cs_net_profit"] = np.round(
+        cs["cs_ext_sales_price"] * rng.uniform(-0.2, 0.4, n_cs), 2)
 
-    ws = sales(n_ws, "ws", rng)
+    cr_idx, cr_date, cr_qty, cr_amt = returns(cs, n_cs, "cs",
+                                              n_cs // 4)
+    cr = {
+        "cr_order": cs["cs_order"][cr_idx],
+        "cr_item_sk": cs["cs_item_sk"][cr_idx],
+        "cr_returned_date_sk": cr_date,
+        "cr_returning_customer_sk": cs["cs_bill_customer_sk"][cr_idx],
+        "cr_return_quantity": cr_qty,
+        "cr_return_amount": cr_amt,
+    }
+
+    ws = sales(n_ws, "ws")
     ws["ws_order"] = np.arange(1, n_ws + 1, dtype=np.int32)
+    ws["ws_ship_date_sk"] = np.minimum(
+        ws["ws_sold_date_sk"] + rng.integers(1, 120, n_ws), n_dates)
     ws["ws_bill_customer_sk"] = rng.integers(
         1, n_cust + 1, n_ws).astype(np.int64)
+    ws["ws_promo_sk"] = rng.integers(1, n_promo + 1,
+                                     n_ws).astype(np.int64)
+    ws["ws_net_profit"] = np.round(
+        ws["ws_ext_sales_price"] * rng.uniform(-0.2, 0.4, n_ws), 2)
+
+    # inventory: monthly snapshots per (item, warehouse)
+    months = dates["d_date_sk"][np.asarray(
+        [i for i in range(n_dates) if str(days[i])[8:10] == "01"])]
+    ii, ww, mm = np.meshgrid(items["i_item_sk"], wh["w_warehouse_sk"],
+                             months, indexing="ij")
+    inv = {
+        "inv_item_sk": ii.ravel().astype(np.int64),
+        "inv_warehouse_sk": ww.ravel().astype(np.int64),
+        "inv_date_sk": mm.ravel().astype(np.int64),
+        "inv_quantity_on_hand": rng.integers(
+            0, 1000, ii.size).astype(np.int32),
+    }
 
     return {"date_dim": dates, "item": items, "store": stores,
-            "customer": cust, "store_sales": ss,
-            "catalog_sales": cs, "web_sales": ws}
+            "customer": cust, "customer_address": addr,
+            "customer_demographics": cd, "household_demographics": hd,
+            "warehouse": wh, "promotion": promo,
+            "store_sales": ss, "store_returns": sr,
+            "catalog_sales": cs, "catalog_returns": cr,
+            "web_sales": ws, "inventory": inv}

@@ -1,4 +1,4 @@
-"""TPC-DS starter queries (10), adapted to the trimmed starter schema.
+"""TPC-DS query set (35), adapted to the trimmed schema.
 
 Numbering follows the official templates they are shaped after
 (reference: the TPC-DS specification's query templates; OpenTenBase
@@ -140,4 +140,447 @@ select count(*) as n, sum(ss_ext_sales_price) as rev
 from store_sales, first_buy, date_dim
 where ss_customer_sk = first_buy.c
   and d_date_sk = first_buy.first_dsk and d_year = 1999
+"""
+
+# ---------------------------------------------------------------------
+# Round-3 expansion: 25 more templates over the widened schema
+# (returns, demographics, addresses, inventory, promotions,
+# warehouses).  Shapes follow the official templates; parameters are
+# literals and columns are the trimmed set.
+# ---------------------------------------------------------------------
+
+# Q1: customers returning more than 1.2x their store's average
+# (CTE + correlated scalar aggregate over the CTE)
+Q[1] = """
+with customer_total_return as (
+  select sr_customer_sk as ctr_customer_sk, sr_store_sk as ctr_store_sk,
+         sum(sr_return_amt) as ctr_total_return
+  from store_returns, date_dim
+  where sr_returned_date_sk = d_date_sk and d_year = 1999
+  group by sr_customer_sk, sr_store_sk
+)
+select c_customer_sk
+from customer_total_return ctr1, customer
+where ctr1.ctr_total_return > (
+        select avg(ctr_total_return) * 1.2
+        from customer_total_return ctr2
+        where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_sk
+limit 100
+"""
+
+# Q5-lite: profit by channel with a ROLLUP total (the official query
+# rolls up channel, id across three channel CTEs)
+Q[5] = """
+select channel, sum(sales) as sales, sum(profit) as profit
+from (
+  select 'store channel' as channel, ss_ext_sales_price as sales,
+         ss_net_profit as profit
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk and d_year = 1999
+  union all
+  select 'catalog channel' as channel, cs_ext_sales_price as sales,
+         cs_net_profit as profit
+  from catalog_sales, date_dim
+  where cs_sold_date_sk = d_date_sk and d_year = 1999
+  union all
+  select 'web channel' as channel, ws_ext_sales_price as sales,
+         ws_net_profit as profit
+  from web_sales, date_dim
+  where ws_sold_date_sk = d_date_sk and d_year = 1999
+) channels
+group by rollup (channel)
+order by channel nulls last
+"""
+
+# Q6: states where customers bought items priced >= 1.2x the category
+# average (correlated scalar over the dimension)
+Q[6] = """
+select ca_state, count(*) as cnt
+from customer_address, customer, store_sales, date_dim, item
+where ca_address_sk = c_current_addr_sk
+  and c_customer_sk = ss_customer_sk
+  and ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and d_year = 1999 and d_moy = 5
+  and i_current_price > 1.2 * (
+        select avg(j.i_current_price) from item j
+        where j.i_category = item.i_category)
+group by ca_state
+having count(*) >= 2
+order by cnt, ca_state
+limit 100
+"""
+
+# Q7: demographic average metrics with a no-promotion filter
+Q[7] = """
+select i_item_sk, avg(ss_quantity) as agg1,
+       avg(ss_list_price) as agg2, avg(ss_coupon_amt) as agg3,
+       avg(ss_sales_price) as agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'Secondary'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 1999
+group by i_item_sk
+order by i_item_sk
+limit 100
+"""
+
+# Q9-lite: quantity-bucket averages via scalar subqueries
+Q[9] = """
+select
+  (select avg(ss_ext_sales_price) from store_sales
+   where ss_quantity between 1 and 5) as b1,
+  (select avg(ss_ext_sales_price) from store_sales
+   where ss_quantity between 6 and 10) as b2,
+  (select avg(ss_ext_sales_price) from store_sales
+   where ss_quantity between 11 and 15) as b3,
+  (select avg(ss_ext_sales_price) from store_sales
+   where ss_quantity between 16 and 20) as b4,
+  (select count(*) from store_sales) as total
+"""
+
+# Q13: averages under OR'd demographic/address branches
+Q[13] = """
+select avg(ss_quantity) as avg_qty,
+       avg(ss_ext_sales_price) as avg_price,
+       sum(ss_net_profit) as profit
+from store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+where ss_store_sk = s_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 1999
+  and ss_cdemo_sk = cd_demo_sk and ss_hdemo_sk = hd_demo_sk
+  and ss_addr_sk = ca_address_sk
+  and ((cd_marital_status = 'M'
+        and cd_education_status = 'Advanced Degree'
+        and hd_dep_count = 3)
+    or (cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and hd_dep_count = 1))
+  and ca_state in ('TN', 'GA', 'OH')
+"""
+
+# Q15-lite: catalog revenue by customer state in one quarter
+Q[15] = """
+select ca_state, sum(cs_ext_sales_price) as total
+from catalog_sales, customer, customer_address, date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and cs_sold_date_sk = d_date_sk
+  and d_year = 1999 and d_moy in (1, 2, 3)
+group by ca_state
+order by ca_state
+"""
+
+# Q18-lite: catalog demographic averages over a geographic ROLLUP
+Q[18] = """
+select ca_state, ca_city, avg(cs_quantity) as q,
+       avg(cs_sales_price) as p
+from catalog_sales, customer_demographics, customer,
+     customer_address, date_dim
+where cs_sold_date_sk = d_date_sk
+  and cs_bill_cdemo_sk = cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and cd_education_status = 'College'
+  and d_year = 1999
+group by rollup (ca_state, ca_city)
+order by ca_state nulls last, ca_city nulls last
+limit 100
+"""
+
+# Q19: brand revenue for a manager slice, one month
+Q[19] = """
+select i_brand_id, i_brand, sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id between 5 and 15 and d_moy = 11 and d_year = 1999
+group by i_brand_id, i_brand
+order by ext_price desc, i_brand_id
+limit 100
+"""
+
+# Q22: inventory quantity-on-hand over a product ROLLUP
+Q[22] = """
+select i_category, i_brand, avg(inv_quantity_on_hand) as qoh
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk and inv_item_sk = i_item_sk
+  and d_month_seq between 348 and 359
+group by rollup (i_category, i_brand)
+order by qoh, i_category nulls last, i_brand nulls last
+limit 100
+"""
+
+# Q25-lite: bought in store, returned, re-bought by catalog
+Q[25] = """
+select i_item_sk, s_store_sk, sum(ss_net_profit) as store_profit,
+       sum(sr_return_amt) as returns_amt,
+       sum(cs_net_profit) as catalog_profit
+from store_sales, store_returns, catalog_sales, item, store
+where ss_ticket = sr_ticket and ss_item_sk = sr_item_sk
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and ss_item_sk = i_item_sk and ss_store_sk = s_store_sk
+group by i_item_sk, s_store_sk
+order by i_item_sk, s_store_sk
+limit 100
+"""
+
+# Q34-lite: bulk tickets (per-ticket item counts) by buy potential,
+# with purchaser names
+Q[34] = """
+select c_last_name, c_first_name, t, cnt
+from (
+  select ss_ticket as t, ss_customer_sk as csk, count(*) as cnt
+  from store_sales, household_demographics
+  where ss_hdemo_sk = hd_demo_sk
+    and hd_buy_potential = '1001-5000'
+  group by ss_ticket, ss_customer_sk
+) dn, customer
+where csk = c_customer_sk and cnt between 2 and 10
+order by c_last_name, c_first_name, t
+limit 100
+"""
+
+# Q36: gross margin over a category ROLLUP with intra-level ranking
+# (grouping() + window over the grouping-sets result)
+Q[36] = """
+select sum(ss_net_profit) / sum(ss_ext_sales_price) as gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) as lochierarchy,
+       rank() over (
+         partition by grouping(i_category) + grouping(i_class),
+                      case when grouping(i_class) = 0
+                           then i_category end
+         order by sum(ss_net_profit) / sum(ss_ext_sales_price)
+       ) as rank_within_parent
+from store_sales, date_dim, item, store
+where d_year = 1999 and ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk and ss_store_sk = s_store_sk
+group by rollup (i_category, i_class)
+order by lochierarchy desc, i_category nulls last,
+         i_class nulls last, rank_within_parent
+"""
+
+# Q37-lite: items in a price band with mid inventory, sold by catalog
+Q[37] = """
+select i_item_sk, i_current_price
+from item, inventory, date_dim, catalog_sales
+where i_current_price between 20 and 50
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_month_seq between 348 and 353
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_sk, i_current_price
+order by i_item_sk
+limit 100
+"""
+
+# Q40-lite: warehouse net sales before/after a cutoff, returns netted
+# (LEFT JOIN to returns + date CASE split)
+Q[40] = """
+select w_state, i_item_sk,
+       sum(case when d_date < date '1999-06-01'
+                then cs_sales_price - coalesce(cr_return_amount, 0.0)
+                else 0.0 end) as sales_before,
+       sum(case when d_date >= date '1999-06-01'
+                then cs_sales_price - coalesce(cr_return_amount, 0.0)
+                else 0.0 end) as sales_after
+from catalog_sales left join catalog_returns
+       on cs_order = cr_order and cs_item_sk = cr_item_sk,
+     warehouse, item, date_dim
+where i_current_price between 10 and 60
+  and cs_item_sk = i_item_sk
+  and cs_warehouse_sk = w_warehouse_sk
+  and cs_sold_date_sk = d_date_sk
+group by w_state, i_item_sk
+order by w_state, i_item_sk
+limit 100
+"""
+
+# Q43-lite: store sales pivoted by day-of-week
+Q[43] = """
+select s_store_name,
+       sum(case when d_dow = 0 then ss_ext_sales_price else 0.0 end)
+         as sun_sales,
+       sum(case when d_dow = 1 then ss_ext_sales_price else 0.0 end)
+         as mon_sales,
+       sum(case when d_dow = 5 then ss_ext_sales_price else 0.0 end)
+         as fri_sales,
+       sum(case when d_dow = 6 then ss_ext_sales_price else 0.0 end)
+         as sat_sales
+from date_dim, store_sales, store
+where d_date_sk = ss_sold_date_sk and ss_store_sk = s_store_sk
+  and d_year = 1999
+group by s_store_name
+order by s_store_name
+"""
+
+# Q46-lite: per-ticket coupon/profit for dep-count or vehicle-count
+# households, with purchaser names
+Q[46] = """
+select c_last_name, c_first_name, t, amt, profit
+from (
+  select ss_ticket as t, ss_customer_sk as csk,
+         sum(ss_coupon_amt) as amt, sum(ss_net_profit) as profit
+  from store_sales, household_demographics, store
+  where ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+    and (hd_dep_count = 4 or hd_vehicle_count = 3)
+  group by ss_ticket, ss_customer_sk
+) dn, customer
+where csk = c_customer_sk
+order by c_last_name, c_first_name, t
+limit 100
+"""
+
+# Q48: quantity sum under OR'd demographic and address bands
+Q[48] = """
+select sum(ss_quantity) as q
+from store_sales, store, customer_demographics,
+     customer_address, date_dim
+where ss_store_sk = s_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 1999
+  and ss_cdemo_sk = cd_demo_sk and ss_addr_sk = ca_address_sk
+  and ((cd_marital_status = 'M'
+        and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 10.00 and 150.00)
+    or (cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and ss_sales_price between 5.00 and 100.00))
+  and ca_state in ('TN', 'GA', 'OH', 'TX')
+"""
+
+# Q50-lite: return-latency buckets per store (surrogate date keys are
+# day-sequential, so the lag is a key difference)
+Q[50] = """
+select s_store_name,
+       sum(case when sr_returned_date_sk - ss_sold_date_sk <= 30
+                then 1 else 0 end) as d30,
+       sum(case when sr_returned_date_sk - ss_sold_date_sk > 30
+                 and sr_returned_date_sk - ss_sold_date_sk <= 60
+                then 1 else 0 end) as d60,
+       sum(case when sr_returned_date_sk - ss_sold_date_sk > 60
+                then 1 else 0 end) as d90plus
+from store_sales, store_returns, store, date_dim
+where ss_ticket = sr_ticket and ss_item_sk = sr_item_sk
+  and sr_returned_date_sk = d_date_sk and d_year = 1999
+  and ss_store_sk = s_store_sk
+group by s_store_name
+order by s_store_name
+"""
+
+# Q53-lite: manufacturers whose monthly sales deviate >10% from their
+# average (window over grouped sums)
+Q[53] = """
+select mid, moy, sum_sales, avg_monthly
+from (
+  select i_manufact_id as mid, d_moy as moy,
+         sum(ss_sales_price) as sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_manufact_id)
+           as avg_monthly
+  from item, store_sales, date_dim
+  where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    and d_year = 1999 and i_category in ('Books', 'Music', 'Sports')
+  group by i_manufact_id, d_moy
+) t
+where sum_sales - avg_monthly > 0.1 * avg_monthly
+   or avg_monthly - sum_sales > 0.1 * avg_monthly
+order by mid, moy
+limit 100
+"""
+
+# Q61-lite: promoted vs total revenue (two scalar channel probes)
+Q[61] = """
+select
+  (select sum(ss_ext_sales_price)
+   from store_sales, promotion, date_dim
+   where ss_promo_sk = p_promo_sk and ss_sold_date_sk = d_date_sk
+     and d_year = 1999
+     and (p_channel_email = 'Y' or p_channel_event = 'Y'))
+  as promotions,
+  (select sum(ss_ext_sales_price)
+   from store_sales, date_dim
+   where ss_sold_date_sk = d_date_sk and d_year = 1999)
+  as total
+"""
+
+# Q65-lite: store/item pairs whose revenue is at most 10% of the
+# store's average item revenue (chained CTEs)
+Q[65] = """
+with sa as (
+  select ss_store_sk as sk, ss_item_sk as ik,
+         sum(ss_sales_price) as revenue
+  from store_sales, date_dim
+  where ss_sold_date_sk = d_date_sk
+    and d_month_seq between 348 and 359
+  group by ss_store_sk, ss_item_sk
+), sb as (
+  select sk, avg(revenue) as ave from sa group by sk
+)
+select s_store_name, i_item_sk, revenue
+from sa, sb, store, item
+where sa.sk = sb.sk and revenue <= 0.1 * ave
+  and sa.sk = s_store_sk and sa.ik = i_item_sk
+order by s_store_name, i_item_sk
+limit 100
+"""
+
+# Q70: profit over a geography ROLLUP with intra-level ranking
+Q[70] = """
+select sum(ss_net_profit) as total_sum, s_state, s_county,
+       grouping(s_state) + grouping(s_county) as lochierarchy,
+       rank() over (
+         partition by grouping(s_state) + grouping(s_county),
+                      case when grouping(s_county) = 0
+                           then s_state end
+         order by sum(ss_net_profit) desc
+       ) as rank_within_parent
+from store_sales, date_dim, store
+where d_year = 1999 and ss_sold_date_sk = d_date_sk
+  and ss_store_sk = s_store_sk
+group by rollup (s_state, s_county)
+order by lochierarchy desc, s_state nulls last,
+         s_county nulls last, rank_within_parent
+"""
+
+# Q81-lite: catalog returners above 1.2x their state's average
+# (the Q1 shape on the catalog channel + addresses)
+Q[81] = """
+with customer_total_return as (
+  select cr_returning_customer_sk as ctr_customer_sk,
+         ca_state as ctr_state,
+         sum(cr_return_amount) as ctr_total_return
+  from catalog_returns, date_dim, customer, customer_address
+  where cr_returned_date_sk = d_date_sk and d_year = 1999
+    and cr_returning_customer_sk = c_customer_sk
+    and c_current_addr_sk = ca_address_sk
+  group by cr_returning_customer_sk, ca_state
+)
+select ctr_customer_sk, ctr_total_return
+from customer_total_return ctr1
+where ctr1.ctr_total_return > (
+        select avg(ctr_total_return) * 1.2
+        from customer_total_return ctr2
+        where ctr1.ctr_state = ctr2.ctr_state)
+order by ctr_customer_sk
+limit 100
+"""
+
+# Q98-lite: store revenue share of class within category (the Q12
+# shape on the store channel)
+Q[98] = """
+select i_category, i_class, sum(ss_ext_sales_price) as itemrevenue,
+       sum(ss_ext_sales_price) * 100.0 /
+       sum(sum(ss_ext_sales_price)) over (partition by i_category)
+       as revenueratio
+from store_sales, item, date_dim
+where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+  and i_category in ('Books', 'Home', 'Sports')
+  and d_year = 1999
+group by i_category, i_class
+order by i_category, i_class
 """

@@ -236,3 +236,605 @@ def test_distributed_queries_ran_on_the_mesh(cs):
     assert cs.fallbacks == [], f"silent host fallbacks: {cs.fallbacks}"
     assert cs.tier_counts.get("host", 0) == 0, cs.tier_counts
     assert cs.tier_counts.get("mesh", 0) >= 4, cs.tier_counts
+
+
+def _rank_min(vals, desc=False):
+    """SQL rank() (ties share the min rank) over a list of values."""
+    order = sorted(vals, reverse=desc)
+    return [order.index(v) + 1 for v in vals]
+
+
+def _nl(v):
+    """Sort key: NULLS LAST."""
+    return (v is None, v)
+
+
+class TestTpcdsExpansion:
+    """Round-3 query set: returns, demographics, addresses, inventory,
+    promotions, correlated-scalar rewrites, ROLLUP+grouping()+rank."""
+
+    # -- Q1: returners above 1.2x their store's average ----------------
+    def _q1(self, f):
+        sr = f["store_returns"].merge(
+            f["date_dim"], left_on="sr_returned_date_sk",
+            right_on="d_date_sk")
+        sr = sr[sr.d_year == 1999]
+        ctr = sr.groupby(["sr_customer_sk", "sr_store_sk"],
+                         as_index=False).agg(tot=("sr_return_amt", "sum"))
+        avg = ctr.groupby("sr_store_sk")["tot"].transform("mean")
+        sel = ctr[ctr.tot > 1.2 * avg]
+        return [(int(c),) for c in sorted(sel.sr_customer_sk)[:100]]
+
+    def test_q1(self, sess, frames):
+        rows_equal(sess.query(Q[1]), self._q1(frames))
+
+    def test_q1_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[1]), self._q1(frames))
+
+    # -- Q5: channel rollup --------------------------------------------
+    def _q5(self, f):
+        def chan(df, dcol, scol, pcol, label):
+            m = df.merge(f["date_dim"], left_on=dcol,
+                         right_on="d_date_sk")
+            m = m[m.d_year == 1999]
+            return (label, m[scol].sum(), m[pcol].sum())
+        rows = sorted([
+            chan(f["store_sales"], "ss_sold_date_sk",
+                 "ss_ext_sales_price", "ss_net_profit", "store channel"),
+            chan(f["catalog_sales"], "cs_sold_date_sk",
+                 "cs_ext_sales_price", "cs_net_profit",
+                 "catalog channel"),
+            chan(f["web_sales"], "ws_sold_date_sk",
+                 "ws_ext_sales_price", "ws_net_profit", "web channel")])
+        total = (None, sum(r[1] for r in rows),
+                 sum(r[2] for r in rows))
+        return [(r[0], _r2(r[1]), _r2(r[2])) for r in rows + [total]]
+
+    def test_q5(self, sess, frames):
+        rows_equal(sess.query(Q[5]), self._q5(frames))
+
+    def test_q5_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[5]), self._q5(frames))
+
+    # -- Q6: states buying premium items -------------------------------
+    def _q6(self, f):
+        it = f["item"].copy()
+        cavg = it.groupby("i_category")["i_current_price"].transform(
+            "mean")
+        it = it[it.i_current_price > 1.2 * cavg]
+        m = f["store_sales"].merge(
+            f["date_dim"], left_on="ss_sold_date_sk",
+            right_on="d_date_sk")
+        m = m[(m.d_year == 1999) & (m.d_moy == 5)]
+        m = (m.merge(f["customer"], left_on="ss_customer_sk",
+                     right_on="c_customer_sk")
+             .merge(f["customer_address"], left_on="c_current_addr_sk",
+                    right_on="ca_address_sk")
+             .merge(it, left_on="ss_item_sk", right_on="i_item_sk"))
+        g = m.groupby("ca_state").size().reset_index(name="cnt")
+        g = g[g.cnt >= 2].sort_values(["cnt", "ca_state"]).head(100)
+        return [(r.ca_state, int(r.cnt)) for r in g.itertuples()]
+
+    def test_q6(self, sess, frames):
+        rows_equal(sess.query(Q[6]), self._q6(frames))
+
+    # -- Q7: demographic averages --------------------------------------
+    def _q7(self, f):
+        m = (f["store_sales"]
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk")
+             .merge(f["customer_demographics"], left_on="ss_cdemo_sk",
+                    right_on="cd_demo_sk")
+             .merge(f["promotion"], left_on="ss_promo_sk",
+                    right_on="p_promo_sk"))
+        m = m[(m.cd_gender == "M") & (m.cd_marital_status == "S")
+              & (m.cd_education_status == "Secondary")
+              & ((m.p_channel_email == "N") | (m.p_channel_event == "N"))
+              & (m.d_year == 1999)]
+        g = (m.groupby("i_item_sk", as_index=False)
+             .agg(a1=("ss_quantity", "mean"),
+                  a2=("ss_list_price", "mean"),
+                  a3=("ss_coupon_amt", "mean"),
+                  a4=("ss_sales_price", "mean"))
+             .sort_values("i_item_sk").head(100))
+        return [(int(r.i_item_sk), r.a1, r.a2, r.a3, r.a4)
+                for r in g.itertuples()]
+
+    def test_q7(self, sess, frames):
+        rows_equal(sess.query(Q[7]), self._q7(frames))
+
+    # -- Q9: bucket averages via scalar subqueries ---------------------
+    def _q9(self, f):
+        ss = f["store_sales"]
+        out = []
+        for lo, hi in ((1, 5), (6, 10), (11, 15), (16, 20)):
+            out.append(ss[(ss.ss_quantity >= lo)
+                          & (ss.ss_quantity <= hi)]
+                       .ss_ext_sales_price.mean())
+        out.append(len(ss))
+        return [tuple(out)]
+
+    def test_q9(self, sess, frames):
+        rows_equal(sess.query(Q[9]), self._q9(frames))
+
+    def test_q9_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[9]), self._q9(frames))
+
+    # -- Q13: OR'd demographic bands -----------------------------------
+    def _q13(self, f):
+        m = (f["store_sales"]
+             .merge(f["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk")
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["customer_demographics"], left_on="ss_cdemo_sk",
+                    right_on="cd_demo_sk")
+             .merge(f["household_demographics"], left_on="ss_hdemo_sk",
+                    right_on="hd_demo_sk")
+             .merge(f["customer_address"], left_on="ss_addr_sk",
+                    right_on="ca_address_sk"))
+        m = m[m.d_year == 1999]
+        m = m[((m.cd_marital_status == "M")
+               & (m.cd_education_status == "Advanced Degree")
+               & (m.hd_dep_count == 3))
+              | ((m.cd_marital_status == "S")
+                 & (m.cd_education_status == "College")
+                 & (m.hd_dep_count == 1))]
+        m = m[m.ca_state.isin(["TN", "GA", "OH"])]
+        return [(m.ss_quantity.mean(), m.ss_ext_sales_price.mean(),
+                 _r2(m.ss_net_profit.sum()))]
+
+    def test_q13(self, sess, frames):
+        rows_equal(sess.query(Q[13]), self._q13(frames))
+
+    # -- Q15: catalog revenue by state ---------------------------------
+    def _q15(self, f):
+        m = (f["catalog_sales"]
+             .merge(f["customer"], left_on="cs_bill_customer_sk",
+                    right_on="c_customer_sk")
+             .merge(f["customer_address"], left_on="c_current_addr_sk",
+                    right_on="ca_address_sk")
+             .merge(f["date_dim"], left_on="cs_sold_date_sk",
+                    right_on="d_date_sk"))
+        m = m[(m.d_year == 1999) & (m.d_moy.isin([1, 2, 3]))]
+        g = (m.groupby("ca_state", as_index=False)
+             .agg(total=("cs_ext_sales_price", "sum"))
+             .sort_values("ca_state"))
+        return [(r.ca_state, _r2(r.total)) for r in g.itertuples()]
+
+    def test_q15(self, sess, frames):
+        rows_equal(sess.query(Q[15]), self._q15(frames))
+
+    def test_q15_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[15]), self._q15(frames))
+
+    # -- Q18: geographic rollup of demographic averages ----------------
+    def _q18(self, f):
+        m = (f["catalog_sales"]
+             .merge(f["date_dim"], left_on="cs_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["customer_demographics"],
+                    left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+             .merge(f["customer"], left_on="cs_bill_customer_sk",
+                    right_on="c_customer_sk")
+             .merge(f["customer_address"], left_on="c_current_addr_sk",
+                    right_on="ca_address_sk"))
+        m = m[(m.cd_education_status == "College") & (m.d_year == 1999)]
+        rows = []
+        g0 = m.groupby(["ca_state", "ca_city"], as_index=False).agg(
+            q=("cs_quantity", "mean"), p=("cs_sales_price", "mean"))
+        rows += [(r.ca_state, r.ca_city, r.q, r.p)
+                 for r in g0.itertuples()]
+        g1 = m.groupby("ca_state", as_index=False).agg(
+            q=("cs_quantity", "mean"), p=("cs_sales_price", "mean"))
+        rows += [(r.ca_state, None, r.q, r.p) for r in g1.itertuples()]
+        rows.append((None, None, m.cs_quantity.mean(),
+                     m.cs_sales_price.mean()))
+        rows.sort(key=lambda r: (_nl(r[0]), _nl(r[1])))
+        return rows[:100]
+
+    def test_q18(self, sess, frames):
+        rows_equal(sess.query(Q[18]), self._q18(frames))
+
+    # -- Q19: manager-slice brand revenue ------------------------------
+    def _q19(self, f):
+        m = (f["store_sales"]
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk"))
+        m = m[(m.i_manager_id >= 5) & (m.i_manager_id <= 15)
+              & (m.d_moy == 11) & (m.d_year == 1999)]
+        g = (m.groupby(["i_brand_id", "i_brand"], as_index=False)
+             .agg(p=("ss_ext_sales_price", "sum")))
+        g = g.sort_values(["p", "i_brand_id"],
+                          ascending=[False, True]).head(100)
+        return [(int(r.i_brand_id), r.i_brand, _r2(r.p))
+                for r in g.itertuples()]
+
+    def test_q19(self, sess, frames):
+        rows_equal(sess.query(Q[19]), self._q19(frames))
+
+    # -- Q22: inventory rollup -----------------------------------------
+    def _q22(self, f):
+        m = (f["inventory"]
+             .merge(f["date_dim"], left_on="inv_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["item"], left_on="inv_item_sk",
+                    right_on="i_item_sk"))
+        m = m[(m.d_month_seq >= 348) & (m.d_month_seq <= 359)]
+        rows = []
+        g0 = m.groupby(["i_category", "i_brand"], as_index=False).agg(
+            qoh=("inv_quantity_on_hand", "mean"))
+        rows += [(r.i_category, r.i_brand, r.qoh)
+                 for r in g0.itertuples()]
+        g1 = m.groupby("i_category", as_index=False).agg(
+            qoh=("inv_quantity_on_hand", "mean"))
+        rows += [(r.i_category, None, r.qoh) for r in g1.itertuples()]
+        rows.append((None, None, m.inv_quantity_on_hand.mean()))
+        rows.sort(key=lambda r: (r[2], _nl(r[0]), _nl(r[1])))
+        return rows[:100]
+
+    def test_q22(self, sess, frames):
+        rows_equal(sess.query(Q[22]), self._q22(frames))
+
+    def test_q22_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[22]), self._q22(frames))
+
+    # -- Q25: store buy -> return -> catalog re-buy --------------------
+    def _q25(self, f):
+        m = (f["store_sales"]
+             .merge(f["store_returns"],
+                    left_on=["ss_ticket", "ss_item_sk"],
+                    right_on=["sr_ticket", "sr_item_sk"])
+             .merge(f["catalog_sales"],
+                    left_on=["sr_customer_sk", "sr_item_sk"],
+                    right_on=["cs_bill_customer_sk", "cs_item_sk"])
+             .merge(f["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk")
+             .merge(f["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk"))
+        g = (m.groupby(["i_item_sk", "s_store_sk"], as_index=False)
+             .agg(sp=("ss_net_profit", "sum"),
+                  ra=("sr_return_amt", "sum"),
+                  cp=("cs_net_profit", "sum"))
+             .sort_values(["i_item_sk", "s_store_sk"]).head(100))
+        return [(int(r.i_item_sk), int(r.s_store_sk), _r2(r.sp),
+                 _r2(r.ra), _r2(r.cp)) for r in g.itertuples()]
+
+    def test_q25(self, sess, frames):
+        rows_equal(sess.query(Q[25]), self._q25(frames))
+
+    # -- Q34: bulk tickets by buy potential ----------------------------
+    def _q34(self, f):
+        m = f["store_sales"].merge(
+            f["household_demographics"], left_on="ss_hdemo_sk",
+            right_on="hd_demo_sk")
+        m = m[m.hd_buy_potential == "1001-5000"]
+        g = (m.groupby(["ss_ticket", "ss_customer_sk"])
+             .size().reset_index(name="cnt"))
+        g = g[(g.cnt >= 2) & (g.cnt <= 10)]
+        g = g.merge(f["customer"], left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+        g = g.sort_values(["c_last_name", "c_first_name",
+                           "ss_ticket"]).head(100)
+        return [(r.c_last_name, r.c_first_name, int(r.ss_ticket),
+                 int(r.cnt)) for r in g.itertuples()]
+
+    def test_q34(self, sess, frames):
+        rows_equal(sess.query(Q[34]), self._q34(frames))
+
+    def test_q34_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[34]), self._q34(frames))
+
+    # -- Q36: margin rollup + rank-within-parent -----------------------
+    def _q36(self, f):
+        m = (f["store_sales"]
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk")
+             .merge(f["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk"))
+        m = m[m.d_year == 1999]
+        rows = []
+        g0 = m.groupby(["i_category", "i_class"], as_index=False).agg(
+            p=("ss_net_profit", "sum"), s=("ss_ext_sales_price", "sum"))
+        for cat, sub in g0.groupby("i_category"):
+            margins = list(sub.p / sub.s)
+            ranks = _rank_min(margins)
+            for (r, rk) in zip(sub.itertuples(), ranks):
+                rows.append((r.p / r.s, cat, r.i_class, 0, rk))
+        g1 = m.groupby("i_category", as_index=False).agg(
+            p=("ss_net_profit", "sum"), s=("ss_ext_sales_price", "sum"))
+        margins = list(g1.p / g1.s)
+        ranks = _rank_min(margins)
+        for (r, rk) in zip(g1.itertuples(), ranks):
+            rows.append((r.p / r.s, r.i_category, None, 1, rk))
+        rows.append((m.ss_net_profit.sum() / m.ss_ext_sales_price.sum(),
+                     None, None, 2, 1))
+        rows.sort(key=lambda r: (-r[3], _nl(r[1]), _nl(r[2]), r[4]))
+        return rows
+
+    def test_q36(self, sess, frames):
+        rows_equal(sess.query(Q[36]), self._q36(frames))
+
+    def test_q36_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[36]), self._q36(frames))
+
+    # -- Q37: price-band items with mid inventory ----------------------
+    def _q37(self, f):
+        it = f["item"]
+        it = it[(it.i_current_price >= 20) & (it.i_current_price <= 50)]
+        inv = (f["inventory"]
+               .merge(f["date_dim"], left_on="inv_date_sk",
+                      right_on="d_date_sk"))
+        inv = inv[(inv.d_month_seq >= 348) & (inv.d_month_seq <= 353)
+                  & (inv.inv_quantity_on_hand >= 100)
+                  & (inv.inv_quantity_on_hand <= 500)]
+        m = (it.merge(inv, left_on="i_item_sk", right_on="inv_item_sk")
+             .merge(f["catalog_sales"], left_on="i_item_sk",
+                    right_on="cs_item_sk"))
+        g = (m.groupby(["i_item_sk", "i_current_price"], as_index=False)
+             .size().sort_values("i_item_sk").head(100))
+        return [(int(r.i_item_sk), r.i_current_price)
+                for r in g.itertuples()]
+
+    def test_q37(self, sess, frames):
+        rows_equal(sess.query(Q[37]), self._q37(frames))
+
+    # -- Q40: warehouse net sales around a cutoff ----------------------
+    def _q40(self, f):
+        m = f["catalog_sales"].merge(
+            f["catalog_returns"][["cr_order", "cr_item_sk",
+                                  "cr_return_amount"]],
+            left_on=["cs_order", "cs_item_sk"],
+            right_on=["cr_order", "cr_item_sk"], how="left")
+        m = (m.merge(f["warehouse"], left_on="cs_warehouse_sk",
+                     right_on="w_warehouse_sk")
+             .merge(f["item"], left_on="cs_item_sk",
+                    right_on="i_item_sk")
+             .merge(f["date_dim"], left_on="cs_sold_date_sk",
+                    right_on="d_date_sk"))
+        m = m[(m.i_current_price >= 10) & (m.i_current_price <= 60)]
+        net = m.cs_sales_price - m.cr_return_amount.fillna(0)
+        m = m.assign(before=net.where(m.d_date < "1999-06-01", 0.0),
+                     after=net.where(m.d_date >= "1999-06-01", 0.0))
+        g = (m.groupby(["w_state", "i_item_sk"], as_index=False)
+             .agg(b=("before", "sum"), a=("after", "sum"))
+             .sort_values(["w_state", "i_item_sk"]).head(100))
+        return [(r.w_state, int(r.i_item_sk), _r2(r.b), _r2(r.a))
+                for r in g.itertuples()]
+
+    def test_q40(self, sess, frames):
+        rows_equal(sess.query(Q[40]), self._q40(frames))
+
+    # -- Q43: day-of-week pivot ----------------------------------------
+    def _q43(self, f):
+        m = (f["store_sales"]
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk"))
+        m = m[m.d_year == 1999]
+        out = []
+        for name, sub in m.groupby("s_store_name"):
+            def dsum(d):
+                return _r2(sub.ss_ext_sales_price.where(
+                    sub.d_dow == d, 0.0).sum())
+            out.append((name, dsum(0), dsum(1), dsum(5), dsum(6)))
+        return out
+
+    def test_q43(self, sess, frames):
+        rows_equal(sess.query(Q[43]), self._q43(frames))
+
+    # -- Q46: per-ticket amounts for dep/vehicle households ------------
+    def _q46(self, f):
+        m = (f["store_sales"]
+             .merge(f["household_demographics"], left_on="ss_hdemo_sk",
+                    right_on="hd_demo_sk")
+             .merge(f["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk"))
+        m = m[(m.hd_dep_count == 4) | (m.hd_vehicle_count == 3)]
+        g = (m.groupby(["ss_ticket", "ss_customer_sk"], as_index=False)
+             .agg(amt=("ss_coupon_amt", "sum"),
+                  profit=("ss_net_profit", "sum")))
+        g = g.merge(f["customer"], left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+        g = g.sort_values(["c_last_name", "c_first_name",
+                           "ss_ticket"]).head(100)
+        return [(r.c_last_name, r.c_first_name, int(r.ss_ticket),
+                 _r2(r.amt), _r2(r.profit)) for r in g.itertuples()]
+
+    def test_q46(self, sess, frames):
+        rows_equal(sess.query(Q[46]), self._q46(frames))
+
+    # -- Q48: OR'd quantity bands --------------------------------------
+    def _q48(self, f):
+        m = (f["store_sales"]
+             .merge(f["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk")
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["customer_demographics"], left_on="ss_cdemo_sk",
+                    right_on="cd_demo_sk")
+             .merge(f["customer_address"], left_on="ss_addr_sk",
+                    right_on="ca_address_sk"))
+        m = m[m.d_year == 1999]
+        m = m[((m.cd_marital_status == "M")
+               & (m.cd_education_status == "Advanced Degree")
+               & (m.ss_sales_price >= 10.00)
+               & (m.ss_sales_price <= 150.00))
+              | ((m.cd_marital_status == "S")
+                 & (m.cd_education_status == "College")
+                 & (m.ss_sales_price >= 5.00)
+                 & (m.ss_sales_price <= 100.00))]
+        m = m[m.ca_state.isin(["TN", "GA", "OH", "TX"])]
+        return [(int(m.ss_quantity.sum()),)]
+
+    def test_q48(self, sess, frames):
+        rows_equal(sess.query(Q[48]), self._q48(frames))
+
+    # -- Q50: return-latency buckets -----------------------------------
+    def _q50(self, f):
+        m = (f["store_sales"]
+             .merge(f["store_returns"],
+                    left_on=["ss_ticket", "ss_item_sk"],
+                    right_on=["sr_ticket", "sr_item_sk"])
+             .merge(f["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk")
+             .merge(f["date_dim"], left_on="sr_returned_date_sk",
+                    right_on="d_date_sk"))
+        m = m[m.d_year == 1999]
+        lag = m.sr_returned_date_sk - m.ss_sold_date_sk
+        m = m.assign(d30=(lag <= 30).astype(int),
+                     d60=((lag > 30) & (lag <= 60)).astype(int),
+                     d90=(lag > 60).astype(int))
+        g = (m.groupby("s_store_name", as_index=False)
+             .agg(a=("d30", "sum"), b=("d60", "sum"), c=("d90", "sum"))
+             .sort_values("s_store_name"))
+        return [(r.s_store_name, int(r.a), int(r.b), int(r.c))
+                for r in g.itertuples()]
+
+    def test_q50(self, sess, frames):
+        rows_equal(sess.query(Q[50]), self._q50(frames))
+
+    def test_q50_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[50]), self._q50(frames))
+
+    # -- Q53: manufacturers deviating from their monthly average -------
+    def _q53(self, f):
+        m = (f["store_sales"]
+             .merge(f["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk")
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk"))
+        m = m[(m.d_year == 1999)
+              & (m.i_category.isin(["Books", "Music", "Sports"]))]
+        g = (m.groupby(["i_manufact_id", "d_moy"], as_index=False)
+             .agg(s=("ss_sales_price", "sum")))
+        g["avg"] = g.groupby("i_manufact_id")["s"].transform("mean")
+        g = g[abs(g.s - g["avg"]) > 0.1 * g["avg"]]
+        g = g.sort_values(["i_manufact_id", "d_moy"]).head(100)
+        return [(int(r.i_manufact_id), int(r.d_moy), _r2(r.s), r.avg)
+                for r in g.itertuples()]
+
+    def test_q53(self, sess, frames):
+        rows_equal(sess.query(Q[53]), self._q53(frames))
+
+    # -- Q61: promoted vs total revenue --------------------------------
+    def _q61(self, f):
+        base = f["store_sales"].merge(
+            f["date_dim"], left_on="ss_sold_date_sk",
+            right_on="d_date_sk")
+        base = base[base.d_year == 1999]
+        promo = base.merge(f["promotion"], left_on="ss_promo_sk",
+                           right_on="p_promo_sk")
+        promo = promo[(promo.p_channel_email == "Y")
+                      | (promo.p_channel_event == "Y")]
+        return [(_r2(promo.ss_ext_sales_price.sum()),
+                 _r2(base.ss_ext_sales_price.sum()))]
+
+    def test_q61(self, sess, frames):
+        rows_equal(sess.query(Q[61]), self._q61(frames))
+
+    # -- Q65: low-revenue store items ----------------------------------
+    def _q65(self, f):
+        m = f["store_sales"].merge(
+            f["date_dim"], left_on="ss_sold_date_sk",
+            right_on="d_date_sk")
+        m = m[(m.d_month_seq >= 348) & (m.d_month_seq <= 359)]
+        sa = (m.groupby(["ss_store_sk", "ss_item_sk"], as_index=False)
+              .agg(rev=("ss_sales_price", "sum")))
+        sa["ave"] = sa.groupby("ss_store_sk")["rev"].transform("mean")
+        sel = sa[sa.rev <= 0.1 * sa.ave]
+        sel = (sel.merge(f["store"], left_on="ss_store_sk",
+                         right_on="s_store_sk")
+               .merge(f["item"], left_on="ss_item_sk",
+                      right_on="i_item_sk"))
+        sel = sel.sort_values(["s_store_name", "i_item_sk"]).head(100)
+        return [(r.s_store_name, int(r.i_item_sk), _r2(r.rev))
+                for r in sel.itertuples()]
+
+    def test_q65(self, sess, frames):
+        rows_equal(sess.query(Q[65]), self._q65(frames))
+
+    def test_q65_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[65]), self._q65(frames))
+
+    # -- Q70: profit rollup over geography + rank ----------------------
+    def _q70(self, f):
+        m = (f["store_sales"]
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk"))
+        m = m[m.d_year == 1999]
+        rows = []
+        g0 = m.groupby(["s_state", "s_county"], as_index=False).agg(
+            p=("ss_net_profit", "sum"))
+        for st, sub in g0.groupby("s_state"):
+            ranks = _rank_min(list(sub.p), desc=True)
+            for r, rk in zip(sub.itertuples(), ranks):
+                rows.append((_r2(r.p), st, r.s_county, 0, rk))
+        g1 = m.groupby("s_state", as_index=False).agg(
+            p=("ss_net_profit", "sum"))
+        ranks = _rank_min(list(g1.p), desc=True)
+        for r, rk in zip(g1.itertuples(), ranks):
+            rows.append((_r2(r.p), r.s_state, None, 1, rk))
+        rows.append((_r2(m.ss_net_profit.sum()), None, None, 2, 1))
+        rows.sort(key=lambda r: (-r[3], _nl(r[1]), _nl(r[2]), r[4]))
+        return rows
+
+    def test_q70(self, sess, frames):
+        rows_equal(sess.query(Q[70]), self._q70(frames))
+
+    def test_q70_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[70]), self._q70(frames))
+
+    # -- Q81: catalog returners above their state's average ------------
+    def _q81(self, f):
+        m = (f["catalog_returns"]
+             .merge(f["date_dim"], left_on="cr_returned_date_sk",
+                    right_on="d_date_sk")
+             .merge(f["customer"], left_on="cr_returning_customer_sk",
+                    right_on="c_customer_sk")
+             .merge(f["customer_address"], left_on="c_current_addr_sk",
+                    right_on="ca_address_sk"))
+        m = m[m.d_year == 1999]
+        ctr = (m.groupby(["cr_returning_customer_sk", "ca_state"],
+                         as_index=False)
+               .agg(tot=("cr_return_amount", "sum")))
+        avg = ctr.groupby("ca_state")["tot"].transform("mean")
+        sel = ctr[ctr.tot > 1.2 * avg].sort_values(
+            "cr_returning_customer_sk").head(100)
+        return [(int(r.cr_returning_customer_sk), _r2(r.tot))
+                for r in sel.itertuples()]
+
+    def test_q81(self, sess, frames):
+        rows_equal(sess.query(Q[81]), self._q81(frames))
+
+    def test_q81_distributed(self, cs, frames):
+        rows_equal(cs.query(Q[81]), self._q81(frames))
+
+    # -- Q98: class revenue share within category ----------------------
+    def _q98(self, f):
+        m = (f["store_sales"]
+             .merge(f["item"], left_on="ss_item_sk",
+                    right_on="i_item_sk")
+             .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                    right_on="d_date_sk"))
+        m = m[(m.d_year == 1999)
+              & (m.i_category.isin(["Books", "Home", "Sports"]))]
+        g = (m.groupby(["i_category", "i_class"], as_index=False)
+             .agg(rev=("ss_ext_sales_price", "sum")))
+        g["ratio"] = g.rev * 100.0 / g.groupby("i_category")[
+            "rev"].transform("sum")
+        g = g.sort_values(["i_category", "i_class"])
+        return [(r.i_category, r.i_class, _r2(r.rev), r.ratio)
+                for r in g.itertuples()]
+
+    def test_q98(self, sess, frames):
+        rows_equal(sess.query(Q[98]), self._q98(frames))
