@@ -50,6 +50,9 @@ class Catalog:
         # ANALYZE output: table -> {"rows", "cols": {col: {"ndv", "min",
         # "max"}}} (reference: pg_statistic, consumed by costsize.c)
         self.stats: dict[str, dict] = {}
+        # views: name -> SELECT text, expanded at bind time (reference:
+        # pg_rewrite view rules; text-stored so persistence is trivial)
+        self.views: dict[str, str] = {}
         self._next_oid = 16384
 
     # ---- tables ----
@@ -59,6 +62,8 @@ class Catalog:
                 if if_not_exists:
                     return self.tables[td.name]
                 raise CatalogError(f"table {td.name!r} already exists")
+            if td.name in self.views:
+                raise CatalogError(f"{td.name!r} is a view")
             seen = set()
             for c in td.columns:
                 if c.name in seen:
@@ -86,6 +91,25 @@ class Catalog:
         if td is None:
             raise CatalogError(f"table {name!r} does not exist")
         return td
+
+    # ---- views ----
+    def create_view(self, name: str, text: str,
+                    or_replace: bool = False):
+        with self._lock:
+            if name in self.tables:
+                raise CatalogError(
+                    f"{name!r} is a table, cannot be a view")
+            if name in self.views and not or_replace:
+                raise CatalogError(f"view {name!r} already exists")
+            self.views[name] = text
+
+    def drop_view(self, name: str, if_exists: bool = False):
+        with self._lock:
+            if name not in self.views:
+                if if_exists:
+                    return
+                raise CatalogError(f"view {name!r} does not exist")
+            del self.views[name]
 
     # ---- nodes / shard map ----
     def register_node(self, nd: NodeDef):
@@ -130,6 +154,7 @@ class Catalog:
                 "global_indexes": self.global_indexes,
                 "local_indexes": self.local_indexes,
                 "stats": self.stats,
+                "views": self.views,
                 "next_oid": self._next_oid,
             }
         tmp = path + ".tmp"
@@ -157,5 +182,6 @@ class Catalog:
         cat.global_indexes = blob.get("global_indexes", {})
         cat.local_indexes = blob.get("local_indexes", {})
         cat.stats = blob.get("stats", {})
+        cat.views = blob.get("views", {})
         cat._next_oid = blob.get("next_oid", 16384)
         return cat

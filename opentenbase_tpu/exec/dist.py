@@ -39,6 +39,17 @@ from ..utils.hashing import hash_columns_np, hash_string
 from .executor import DBatch, ExecContext, ExecError, Executor, materialize
 
 
+def _walk_plan(node):
+    yield node
+    for attr in ("child", "left", "right"):
+        c = getattr(node, attr, None)
+        if c is not None and hasattr(c, "__dataclass_fields__"):
+            yield from _walk_plan(c)
+    for c in getattr(node, "inputs", None) or []:
+        if hasattr(c, "__dataclass_fields__"):
+            yield from _walk_plan(c)
+
+
 @dataclasses.dataclass
 class HostBatch:
     """Exchange wire format: host numpy columns, TEXT as decoded values,
@@ -154,6 +165,27 @@ class DistExecutor:
         from .executor import scalar_from_batch
         return scalar_from_batch(b)
 
+    def _scan_exceeds_budget(self, dp, budget: int) -> bool:
+        """Does any per-DN scan of this plan exceed the work_mem
+        budget?  Remote datanodes (no local stores) are conservatively
+        treated as over budget — the DN side re-checks and only spills
+        what actually overflows."""
+        from ..plan import physical as P
+        tables = set()
+        for frag in dp.fragments:
+            for nd in _walk_plan(frag.plan):
+                if isinstance(nd, P.SeqScan):
+                    tables.add(nd.table.name)
+        for t in tables:
+            for dn in self.cluster.datanodes:
+                stores = getattr(dn, "stores", None)
+                if stores is None:
+                    return True
+                st = stores.get(t)
+                if st is not None and st.row_count() > budget:
+                    return True
+        return False
+
     def _run_distplan(self, dp: DistPlan) -> DBatch:
         if dp.fqs_node is None and len(dp.fragments) == 1 \
                 and not dp.exchanges:
@@ -164,7 +196,18 @@ class DistExecutor:
             self.tier = "local"
             return self._exec_fragment_on(dp.fragments[dp.top_fragment],
                                           dp, "cn", {})
-        if self.use_mesh and dp.fqs_node is None:
+        wm_raw = self.cluster.gucs.get("work_mem_rows", "")
+        budget = int(wm_raw) if wm_raw.isdigit() else 0
+        if budget > 0 and self._scan_exceeds_budget(dp, budget):
+            # budgeted execution AND a scanned table is actually over
+            # budget: the mesh tier stages whole tables to device HBM,
+            # so route through the host tier whose DN fragments spill
+            # (slab/grace multi-pass).  Queries under the budget keep
+            # the device data plane.
+            self.params.setdefault("__work_mem_rows", (budget, None))
+            self.fallback_reason = self.fallback_reason or \
+                "work_mem_rows budget (spill tier)"
+        elif self.use_mesh and dp.fqs_node is None:
             # device data plane: DN fragments + exchanges compile into one
             # shard_map program (all_to_all/all_gather over the mesh)
             from .mesh_exec import MeshUnsupported, mesh_runner_for

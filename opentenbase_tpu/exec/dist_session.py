@@ -219,6 +219,54 @@ class ClusterSession:
             # cached plans must replan to see the new access path
             c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
             return Result("CREATE INDEX")
+        if isinstance(stmt, A.CreateViewStmt):
+            from ..catalog.catalog import CatalogError
+            try:
+                c.catalog.create_view(stmt.name, stmt.text,
+                                      stmt.or_replace)
+            except CatalogError as e:
+                raise ExecError(str(e)) from None
+            c._save_catalog()
+            c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
+            return Result("CREATE VIEW")
+        if isinstance(stmt, A.DropViewStmt):
+            from ..catalog.catalog import CatalogError
+            try:
+                c.catalog.drop_view(stmt.name, stmt.if_exists)
+            except CatalogError as e:
+                raise ExecError(str(e)) from None
+            c._save_catalog()
+            c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
+            return Result("DROP VIEW")
+        if isinstance(stmt, A.AlterTableStmt):
+            return self._exec_alter(stmt)
+        if isinstance(stmt, A.CreatePublicationStmt):
+            from ..catalog.catalog import CatalogError
+            try:
+                c.logical_publisher().create_publication(stmt.name,
+                                                         stmt.tables)
+            except (KeyError, CatalogError) as e:
+                raise ExecError(str(e)) from None
+            return Result("CREATE PUBLICATION")
+        if isinstance(stmt, A.DropPublicationStmt):
+            c.logical_publisher().drop_publication(stmt.name)
+            return Result("DROP PUBLICATION")
+        if isinstance(stmt, A.CreateSubscriptionStmt):
+            from ..storage.logical import Subscription
+            if stmt.name in c.subscriptions:
+                raise ExecError(
+                    f"subscription {stmt.name!r} already exists")
+            try:
+                c.subscriptions[stmt.name] = Subscription(
+                    stmt.name, c, stmt.conninfo, stmt.publication)
+            except (KeyError, ValueError, ConnectionError, OSError) as e:
+                raise ExecError(f"CREATE SUBSCRIPTION: {e}") from None
+            return Result("CREATE SUBSCRIPTION")
+        if isinstance(stmt, A.DropSubscriptionStmt):
+            sub = c.subscriptions.pop(stmt.name, None)
+            if sub is not None:
+                sub.stop()
+            return Result("DROP SUBSCRIPTION")
         if isinstance(stmt, A.DropIndexStmt):
             from ..parallel import gindex
             try:
@@ -495,6 +543,51 @@ class ClusterSession:
         if instrument:
             return res, ex, dp
         return res
+
+    # ---- ALTER TABLE: catalog change + DDL fan-out to every DN
+    # (reference: utility.c remote DDL broadcast of ATExecCmd) ----
+    def _exec_alter(self, stmt: A.AlterTableStmt) -> Result:
+        from .session import Session
+        c = self.cluster
+        Session._alter_guards(c.catalog, stmt)
+        rec = {"table": stmt.table, "action": stmt.action,
+               "column": (stmt.column.name, stmt.column.type_name,
+                          list(stmt.column.type_args))
+               if stmt.column else None,
+               "name": stmt.name, "new_name": stmt.new_name}
+        if stmt.action == "rename_table":
+            c.catalog.tables[stmt.new_name] = \
+                c.catalog.tables.pop(stmt.table)
+            c.catalog.tables[stmt.new_name].name = stmt.new_name
+            c.catalog.btree_cols.pop(stmt.table, None)
+        else:
+            # apply the schema change to the CN catalog explicitly —
+            # remote (TCP) datanodes hold their OWN TableDef copies, so
+            # the shared-object mutation in-proc DNs perform never
+            # reaches this catalog; every edit is idempotent for when
+            # the objects ARE shared
+            td = c.catalog.table(stmt.table)
+            if stmt.action == "add_column" and \
+                    not td.has_column(stmt.column.name):
+                from ..catalog import types as T
+                from ..catalog.schema import ColumnDef
+                td.columns.append(ColumnDef(
+                    stmt.column.name,
+                    T.type_from_name(stmt.column.type_name,
+                                     stmt.column.type_args)))
+            elif stmt.action == "drop_column":
+                td.columns = [cc for cc in td.columns
+                              if cc.name != stmt.name]
+            elif stmt.action == "rename_column":
+                for cc in td.columns:
+                    if cc.name == stmt.name:
+                        cc.name = stmt.new_name
+        for dn in c.datanodes:
+            dn.alter_table(dict(rec))
+        c.catalog.stats.pop(stmt.table, None)
+        c._save_catalog()
+        c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
+        return Result("ALTER TABLE")
 
     # ---- writes ----
     def _exec_insert(self, stmt: A.InsertStmt) -> Result:

@@ -347,12 +347,20 @@ class MeshRunner:
         buckets = {ex.index: max(64, base_pad //
                                  max(self.cluster.ndn // 2, 1))
                    for ex in dp.exchanges if ex.kind == "redistribute"}
+        # per-gather output size classes: traced fragment outputs are
+        # worst-case padded (a partial aggregate's buffer is its input
+        # size), but the rows that actually cross to the CN are usually
+        # few — start small, compact in-program, grow on overflow (the
+        # same ladder joins and redistributes ride)
+        gathers = {ex.index: min(base_pad, 1 << 16)
+                   for ex in dp.exchanges
+                   if ex.kind in ("gather", "gather_one")}
         factors: dict = {}
         for _attempt in range(12):
             try:
-                out, meta, over_jids, a2a_over = self._execute(
+                out, meta, over_jids, a2a_over, g_over = self._execute(
                     dp, staged, snapshot_ts, txid, params,
-                    dict(factors), dict(buckets))
+                    dict(factors), dict(buckets), dict(gathers))
             except (jax.errors.TracerBoolConversionError,
                     jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError) as e:
@@ -367,6 +375,9 @@ class MeshRunner:
                 if factors[jid] > 4096:
                     raise MeshUnsupported("join size ladder exhausted")
                 grew = True
+            for gi in g_over:
+                gathers[gi] *= 2
+                grew = True
             if not grew:
                 result = {}
                 for gi, (cols, valid, nulls) in out.items():
@@ -380,6 +391,30 @@ class MeshRunner:
                          for n, a in nulls.items()})
                 return result
         raise MeshUnsupported("size-class ladder exhausted")
+
+    @staticmethod
+    def _compact_local(b, gsz: int):
+        """Inside the traced program: compress a fragment's output to
+        its live prefix in a (static) gather-class buffer of gsz rows
+        per shard.  Returns (cols, valid, nulls, overflowed?) — only
+        these gsz rows cross device->host at the CN gather, instead of
+        the worst-case padded buffer (at SF1 that was ~0.5 GB/query).
+        Gather formulation: output slot j takes the input position
+        where the live count first reaches j+1."""
+        padded = int(b.valid.shape[0])
+        csum = jnp.cumsum(b.valid.astype(jnp.int64))
+        n_live = csum[-1]
+        idx = jnp.clip(
+            jnp.searchsorted(csum, jnp.arange(1, gsz + 1)), 0,
+            padded - 1)
+
+        def take(a):
+            return a[idx]
+
+        valid = jnp.arange(gsz) < n_live
+        over = (n_live > gsz).astype(jnp.int64)
+        return ({n: take(a) for n, a in b.cols.items()}, valid,
+                {n: take(a) for n, a in b.nulls.items()}, over)
 
     @staticmethod
     def _plan_key(node):
@@ -415,7 +450,7 @@ class MeshRunner:
         raise MeshUnsupported(t)
 
     def _execute(self, dp, staged, snapshot_ts, txid, params, factors,
-                 buckets):
+                 buckets, gathers):
         from .executor import ExecContext, Executor
 
         table_names = sorted(staged)
@@ -438,6 +473,7 @@ class MeshRunner:
                       for t in table_names),
                 tuple(sorted(factors.items())),
                 tuple(sorted(buckets.items())),
+                tuple(sorted(gathers.items())),
                 tuple(sorted((k, v) for k, (v, _t) in params.items())),
             ))
         except TypeError:
@@ -470,6 +506,8 @@ class MeshRunner:
             overflows = []
             join_reqs = []
             gather_out: dict = {}
+            gather_over: list = []
+            meta["gi_order"] = []
             for frag in dp.fragments:
                 if frag.index == dp.top_fragment:
                     continue
@@ -496,8 +534,12 @@ class MeshRunner:
                                 ob, valid=ob.valid & keep1)
                         meta[ex.index] = {"types": ob.types,
                                           "dicts": ob.dicts}
-                        gather_out[ex.index] = (ob.cols, ob.valid,
-                                                ob.nulls)
+                        cols, valid, nulls, gov = self._compact_local(
+                            ob, gathers[ex.index])
+                        gather_out[ex.index] = (cols, valid, nulls)
+                        meta["gi_order"].append(ex.index)
+                        gather_over.append(
+                            jax.lax.psum(gov, self.axis))
             missing = [gi for gi in gather_idx if gi not in gather_out]
             if missing:
                 raise MeshUnsupported(f"gather {missing} not produced")
@@ -510,8 +552,10 @@ class MeshRunner:
                     for _jid, req, cap in join_reqs])
             else:
                 join_over = jnp.zeros(0, jnp.int64)
+            g_over = jnp.stack(gather_over) if gather_over \
+                else jnp.zeros(0, jnp.int64)
             return (tuple(gather_out[gi] for gi in gather_idx),
-                    a2a_over, join_over)
+                    a2a_over, join_over, g_over)
 
         in_specs = [PS(), PS()]
         for t in table_names:
@@ -521,7 +565,7 @@ class MeshRunner:
                       out_specs=(tuple((PS(self.axis), PS(self.axis),
                                         PS(self.axis))
                                        for _ in gather_idx),
-                                 PS(), PS()))
+                                 PS(), PS(), PS()))
         try:
             smapped = shard_map(prog, check_vma=False, **kwargs)
         except TypeError:
@@ -543,13 +587,16 @@ class MeshRunner:
             for n in sorted(staged[t].arrs):
                 flat_args.append(staged[t].arrs[n])
             flat_args.append(staged[t].nrows)
-        outs, a2a_over, join_over = fn(*flat_args)
+        outs, a2a_over, join_over, g_over_vec = fn(*flat_args)
         over_vec = np.asarray(jax.device_get(join_over))
         over_jids = sorted({jid for jid, ov in
                             zip(meta.get("jid_order", ()), over_vec)
                             if ov > 0})
+        gv = np.asarray(jax.device_get(g_over_vec))
+        g_over = sorted({gi for gi, ov in
+                         zip(meta.get("gi_order", ()), gv) if ov > 0})
         return (dict(zip(gather_idx, outs)), meta, over_jids,
-                int(jax.device_get(a2a_over)) > 0)
+                int(jax.device_get(a2a_over)) > 0, g_over)
 
 
 def mesh_runner_for(cluster) -> Optional[MeshRunner]:

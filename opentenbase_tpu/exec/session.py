@@ -54,6 +54,47 @@ def _text_log_array(v) -> np.ndarray:
     return np.asarray([str(x) for x in v])
 
 
+def replay_alter(catalog, stores: dict, rec: dict) -> None:
+    """WAL replay of an ALTER TABLE record (shared by the single-node
+    and datanode recovery paths)."""
+    table = rec["table"]
+    act = rec["action"]
+    st = stores.get(table)
+    if act == "rename_table":
+        if catalog is not None and table in catalog.tables:
+            catalog.tables[rec["new_name"]] = catalog.tables.pop(table)
+            catalog.tables[rec["new_name"]].name = rec["new_name"]
+        if table in stores:
+            stores[rec["new_name"]] = stores.pop(table)
+        return
+    if st is None:
+        return
+    if act == "add_column":
+        from ..catalog import types as T
+        from ..catalog.schema import ColumnDef
+        name, tname, targs = rec["column"]
+        st.alter_add_column(
+            ColumnDef(name, T.type_from_name(tname, tuple(targs))))
+    elif act == "drop_column":
+        st.alter_drop_column(rec["name"])
+    elif act == "rename_column":
+        st.alter_rename_column(rec["name"], rec["new_name"])
+
+
+def conform_replay_columns(st, enc: dict, n: int, nulls):
+    """An insert WAL record written before an ALTER may lack new
+    columns (-> all-NULL fill) or carry dropped ones (-> ignore)."""
+    enc = {c: v for c, v in enc.items() if st.td.has_column(c)}
+    missing = [c for c in st.td.columns if c.name not in enc]
+    if missing:
+        nulls = dict(nulls or {})
+        for c in missing:
+            enc[c.name] = np.zeros((n, *c.type.shape_suffix),
+                                   c.type.np_dtype)
+            nulls[c.name] = np.ones(n, dtype=bool)
+    return enc, (nulls or None)
+
+
 def copy_rows_to_file(path: str, rows, delim: str) -> int:
     """COPY ... TO: delimiter-separated text, NULL spelled \\N, with
     backslash/delimiter/newline escaping so any value round-trips (the
@@ -150,6 +191,9 @@ class LocalNode:
                 ckpt = os.path.join(self.datadir, f"{name}.ckpt")
                 if os.path.exists(ckpt):
                     restore_store(st, ckpt)
+                    # checkpoint older than ALTER ADD COLUMN: reconcile
+                    for c in td.columns:
+                        st.alter_add_column(c)
                 self.stores[name] = st
         walpath = os.path.join(self.datadir, "wal.log")
         replayed: dict[int, list] = {}
@@ -174,6 +218,8 @@ class LocalNode:
             st = self.stores[rec["table"]]
             enc = {}
             for cname, v in rec["columns"].items():
+                if not st.td.has_column(cname):
+                    continue      # column dropped after this record
                 arr = np.asarray(v)
                 if arr.dtype.kind == "S":
                     enc[cname] = st.encode_column(cname, arr)
@@ -185,10 +231,12 @@ class LocalNode:
                     # all other columns were logged in storage
                     # representation — re-encoding would double-scale
                     # decimals
-                    enc[cname] = arr.astype(
-                        st.td.column(cname).type.np_dtype)
-            spans = st.insert(enc, rec["n"], rec["txid"],
-                              nulls=rec.get("nulls"))
+                    if st.td.has_column(cname):
+                        enc[cname] = arr.astype(
+                            st.td.column(cname).type.np_dtype)
+            enc, nulls = conform_replay_columns(st, enc, rec["n"],
+                                                rec.get("nulls"))
+            spans = st.insert(enc, rec["n"], rec["txid"], nulls=nulls)
             pending.setdefault(rec["txid"], []).append(("ins", st, spans))
         elif op == "delete":
             st = self.stores[rec["table"]]
@@ -210,6 +258,12 @@ class LocalNode:
                     st.abort_insert(sp)
                 else:
                     st.revert_delete([sp])
+        elif op == "create_view":
+            self.catalog.views[rec["name"]] = rec["text"]
+        elif op == "drop_view":
+            self.catalog.views.pop(rec["name"], None)
+        elif op == "alter_table":
+            replay_alter(self.catalog, self.stores, rec)
 
     def checkpoint(self) -> bool:
         if not self.datadir:
@@ -296,14 +350,16 @@ class Session:
             td = table_def_from_ast(stmt)
             self.node.catalog.create_table(td, stmt.if_not_exists)
             self.node.stores.setdefault(td.name, TableStore(td))
-            self.node._log({"op": "create_table", "table": td.to_json()})
+            self.node._log({"op": "create_table", "table": td.to_json()},
+                           sync=True)
             return Result("CREATE TABLE")
         if isinstance(stmt, A.DropTableStmt):
             self.node.catalog.drop_table(stmt.name, stmt.if_exists)
             st = self.node.stores.pop(stmt.name, None)
             if st is not None:
                 self.node.cache.invalidate(st)
-            self.node._log({"op": "drop_table", "name": stmt.name})
+            self.node._log({"op": "drop_table", "name": stmt.name},
+                           sync=True)
             return Result("DROP TABLE")
         if isinstance(stmt, A.CreateSequenceStmt):
             self.node.catalog.create_sequence(sequence_def_from_ast(stmt))
@@ -335,6 +391,24 @@ class Session:
                 self.node.catalog.btree_cols.setdefault(
                     stmt.table, set()).update(stmt.columns)
             return Result("CREATE INDEX")
+        if isinstance(stmt, A.CreateViewStmt):
+            try:
+                self.node.catalog.create_view(stmt.name, stmt.text,
+                                              stmt.or_replace)
+            except CatalogError as e:
+                raise ExecError(str(e)) from None
+            self.node._log({"op": "create_view", "name": stmt.name,
+                            "text": stmt.text}, sync=True)
+            return Result("CREATE VIEW")
+        if isinstance(stmt, A.DropViewStmt):
+            try:
+                self.node.catalog.drop_view(stmt.name, stmt.if_exists)
+            except CatalogError as e:
+                raise ExecError(str(e)) from None
+            self.node._log({"op": "drop_view", "name": stmt.name}, sync=True)
+            return Result("DROP VIEW")
+        if isinstance(stmt, A.AlterTableStmt):
+            return self._exec_alter(stmt)
         if isinstance(stmt, A.InsertStmt):
             return self._exec_insert(stmt)
         if isinstance(stmt, A.DeleteStmt):
@@ -371,6 +445,73 @@ class Session:
             return Result("BARRIER")
         raise ExecError(f"unsupported statement {type(stmt).__name__}")
 
+    # ---- ALTER TABLE (reference: tablecmds.c ATExecCmd subset) ----
+    @staticmethod
+    def _alter_guards(catalog, stmt: A.AlterTableStmt):
+        """Shared validation: a dist key or indexed column cannot be
+        dropped/renamed; returns the TableDef."""
+        td = catalog.table(stmt.table)
+        if stmt.action in ("drop_column", "rename_column"):
+            if stmt.name in td.distribution.dist_cols:
+                raise ExecError(
+                    f"cannot alter distribution column {stmt.name!r}")
+            if not td.has_column(stmt.name):
+                raise ExecError(f"column {stmt.name!r} does not exist")
+            idx_cols = catalog.btree_cols.get(stmt.table, set())
+            gidx = catalog.global_indexes.get(stmt.table, {})
+            if stmt.name in idx_cols or stmt.name in gidx:
+                raise ExecError(
+                    f"column {stmt.name!r} is indexed; drop the index "
+                    "first")
+        if stmt.action == "add_column" and \
+                td.has_column(stmt.column.name):
+            raise ExecError(
+                f"column {stmt.column.name!r} already exists")
+        if stmt.action == "rename_column" and \
+                td.has_column(stmt.new_name):
+            raise ExecError(
+                f"column {stmt.new_name!r} already exists")
+        if stmt.action == "rename_table":
+            if stmt.new_name in catalog.tables:
+                raise ExecError(
+                    f"table {stmt.new_name!r} already exists")
+            if catalog.global_indexes.get(stmt.table):
+                raise ExecError("cannot rename a table with global "
+                                "indexes; drop them first")
+        return td
+
+    def _exec_alter(self, stmt: A.AlterTableStmt) -> Result:
+        cat = self.node.catalog
+        td = self._alter_guards(cat, stmt)
+        st = self.node.stores[stmt.table]
+        if stmt.action == "add_column":
+            from ..catalog import types as T
+            from ..catalog.schema import ColumnDef
+            c = stmt.column
+            cd = ColumnDef(c.name,
+                           T.type_from_name(c.type_name, c.type_args))
+            st.alter_add_column(cd)
+        elif stmt.action == "drop_column":
+            st.alter_drop_column(stmt.name)
+        elif stmt.action == "rename_column":
+            st.alter_rename_column(stmt.name, stmt.new_name)
+        elif stmt.action == "rename_table":
+            cat.tables[stmt.new_name] = cat.tables.pop(stmt.table)
+            cat.tables[stmt.new_name].name = stmt.new_name
+            self.node.stores[stmt.new_name] = \
+                self.node.stores.pop(stmt.table)
+            cat.btree_cols.pop(stmt.table, None)
+        self.node.cache.invalidate(st)
+        cat.stats.pop(stmt.table, None)
+        self.node._log({"op": "alter_table", "table": stmt.table,
+                        "action": stmt.action,
+                        "column": (stmt.column.name, stmt.column.type_name,
+                                   list(stmt.column.type_args))
+                        if stmt.column else None,
+                        "name": stmt.name, "new_name": stmt.new_name},
+                       sync=True)
+        return Result("ALTER TABLE")
+
     # ---- SELECT ----
     def _plan_select(self, stmt: A.SelectStmt) -> PlannedStmt:
         binder = Binder(self.node.catalog)
@@ -388,7 +529,23 @@ class Session:
             from .spill import SpillDriver
             drv = SpillDriver(self.node.stores, self.node.cache,
                               t.snapshot_ts, t.txid, int(raw_budget))
-            batch = drv.try_run(planned)
+            # init plans must run first so their scalars reach the
+            # slab/partition passes (the in-memory path does this in
+            # Executor.run)
+            planned_spill = planned
+            if planned.init_plans:
+                ctx0 = ExecContext(self.node.stores, t.snapshot_ts,
+                                   t.txid, self.node.cache)
+                ex0 = Executor(ctx0)
+                for ip in planned.init_plans:
+                    b0 = ex0.exec_node(ip.plan)
+                    from .executor import scalar_from_batch
+                    ctx0.params[ip.name] = (scalar_from_batch(b0),
+                                            ip.type)
+                drv.params = dict(ctx0.params)
+                planned_spill = PlannedStmt(planned.plan, [],
+                                            planned.output_names)
+            batch = drv.try_run(planned_spill)
         if batch is None:
             ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
                               self.node.cache)

@@ -100,11 +100,12 @@ class SpillDriver:
     """Plan-shape matcher + multi-pass executor for one session node."""
 
     def __init__(self, stores: dict, cache, snapshot_ts: int, txid: int,
-                 budget: int):
+                 budget: int, params: dict = None):
         self.stores = stores
         self.cache = cache
         self.snapshot_ts = snapshot_ts
         self.txid = txid
+        self.params = dict(params or {})
         self.budget = max(int(budget), 1024)
         self.passes = 0   # instrumentation: device passes executed
         self._host_cache: dict = {}  # (id(store), version) -> host cols
@@ -128,20 +129,31 @@ class SpillDriver:
         spill-eligible (caller uses the in-memory path)."""
         if planned.init_plans:
             return None
-        plan = planned.plan
+        return self.try_run_plan(planned.plan)
+
+    def try_run_plan(self, plan) -> Optional[object]:
         infos = self._scan_infos(plan)
         if not infos:
             return None
         if max(i.rows for i in infos) <= self.budget:
             return None
+        names = [i.node.table.name for i in infos]
+        if len(set(names)) != len(names):
+            return None   # self-joins: staging is keyed by table name
         joins = [nd for nd in _walk_nodes(plan)
                  if isinstance(nd, P.HashJoin)]
         aggs = [nd for nd in _walk_nodes(plan) if isinstance(nd, P.Agg)]
-        if len(aggs) > 1 or any(a.mode != "single" for a in aggs):
+        # 'single' aggs slab in partial mode and re-merge in final mode;
+        # a 'partial' agg (the DN side of a distributed split) slabs
+        # as-is and CONCATENATES -- the CN's final aggregate merges the
+        # slab partials exactly as it merges per-DN partials
+        if len(aggs) > 1 or any(a.mode not in ("single", "partial")
+                                for a in aggs):
             return None
         if any(any(ac.distinct for _, ac in a.aggs) for a in aggs):
             return None
         agg = aggs[0] if aggs else None
+        over = [i for i in infos if i.rows > self.budget]
         if not joins:
             if len(infos) != 1 or agg is None:
                 return None
@@ -149,17 +161,37 @@ class SpillDriver:
         if len(joins) == 1 and joins[0].kind == "cross" \
                 and len(infos) == 2:
             return self._run_block_cross(plan, joins[0], agg, infos)
-        if len(joins) == 1 and joins[0].kind in ("inner", "left",
-                                                 "semi", "anti") \
-                and len(infos) == 2:
-            return self._run_grace_join(plan, joins[0], agg, infos)
+        if len(over) == 1:
+            # one over-budget table in an arbitrary join tree (the star
+            # shape: fact + dims): row-range slabs of the big table, the
+            # whole subtree per slab, dims staged whole from the cache.
+            # When slabbing is invalid (big on the null-extended side of
+            # an outer join), fall through to grace-partitioning the
+            # join that touches it — partition-aligned slicing preserves
+            # outer semantics on both sides.
+            out = self._run_slabbed_tree(plan, joins, agg, over[0])
+            if out is not None:
+                return out
+        if 1 <= len(over) <= 2:
+            # grace-partition an equi join with an over-budget side;
+            # each partition pass runs the whole subtree with both
+            # partitioned sides sliced and dims staged whole
+            return self._run_grace_tree(plan, joins, agg, infos, over)
         return None
+
+    @staticmethod
+    def _has_order_sensitive(subtree) -> bool:
+        """A Limit or Sort INSIDE the per-pass subtree would re-apply
+        per slab/partition — those plans are not slice-decomposable."""
+        return any(isinstance(nd, (P.Limit, P.Sort))
+                   for nd in _walk_nodes(subtree))
 
     # -- execution helpers --------------------------------------------
     def _exec_with_staged(self, plan, staged):
         from .executor import ExecContext, Executor
         ctx = ExecContext(self.stores, self.snapshot_ts, self.txid,
-                          self.cache, staged=staged)
+                          self.cache, staged=staged,
+                          params=dict(self.params))
         self.passes += 1
         return Executor(ctx).exec_node(plan)
 
@@ -187,14 +219,21 @@ class SpillDriver:
 
     # -- shapes --------------------------------------------------------
     def _run_slabbed_agg(self, plan, agg, info: _ScanInfo):
-        """scan→agg: row-range slabs in partial mode + one final."""
-        partial = dataclasses.replace(agg, mode="partial")
+        """scan→agg: row-range slabs in partial mode + one final (a
+        'partial' fragment agg concatenates for the CN's final)."""
+        finalize = agg.mode == "single"
+        partial = dataclasses.replace(agg, mode="partial") if finalize \
+            else agg
+        if self._has_order_sensitive(partial):
+            return None
         partials = []
         for lo in range(0, info.rows, self.budget):
             sel = slice(lo, min(lo + self.budget, info.rows))
             staged = self._stage_for(partial, {info: sel})
             partials.append(self._exec_with_staged(partial, staged))
         combined = self._combine_host(partials)
+        if not finalize:
+            return self._finish_with(plan, agg, BatchSource(combined))
         final = P.Agg(BatchSource(combined),
                       [(n, E.Col(n, ke.type))
                        for n, ke in agg.group_keys], agg.aggs, "final")
@@ -204,50 +243,14 @@ class SpillDriver:
         rest = _clone_replacing(plan, target, replacement_node)
         from .executor import ExecContext, Executor
         ctx = ExecContext(self.stores, self.snapshot_ts, self.txid,
-                          self.cache)
+                          self.cache, params=dict(self.params))
         return Executor(ctx).exec_node(rest)
 
-    def _join_partition_plan(self, plan, join, agg):
-        """The subtree to execute per partition: the join, wrapped in a
-        partial aggregate when the plan aggregates above it."""
-        if agg is not None:
-            sub = dataclasses.replace(agg, mode="partial")
-            return sub, agg
-        return join, join
-
-    def _run_grace_join(self, plan, join, agg, infos):
-        lkeys, rkeys = join.left_keys, join.right_keys
-        left_info = self._info_for_side(join.left, infos)
-        right_info = self._info_for_side(join.right, infos)
-        if left_info is None or right_info is None:
-            return None
-        lh = self._side_hash(left_info, lkeys)
-        rh = self._side_hash(right_info, rkeys)
-        if lh is None or rh is None:
-            return None
-        nparts = max(1, 2 ** math.ceil(math.log2(max(
-            1, math.ceil(max(left_info.rows, right_info.rows)
-                         / self.budget)))))
-        per_plan, replace_target = self._join_partition_plan(plan, join,
-                                                             agg)
-        outs = []
-        lp = (lh % np.uint64(nparts)).astype(np.int64)
-        rp = (rh % np.uint64(nparts)).astype(np.int64)
-        for p in range(nparts):
-            lsel = np.nonzero(lp == p)[0]
-            rsel = np.nonzero(rp == p)[0]
-            if join.kind in ("inner", "semi") and \
-                    (len(lsel) == 0 or len(rsel) == 0):
-                continue
-            if len(lsel) == 0:
-                continue
-            staged = self._stage_for(per_plan, {left_info: lsel,
-                                                right_info: rsel})
-            outs.append(self._exec_with_staged(per_plan, staged))
-        if not outs:
-            return None  # degenerate; let the in-memory path handle it
-        combined = self._combine_host(outs)
-        if agg is not None:
+    def _finalize(self, plan, replace_target, agg, finalize, combined):
+        """Shared tail of every shape runner: final-merge the combined
+        partials (or hand the concatenation straight to the rest of the
+        plan for a 'partial' fragment agg)."""
+        if agg is not None and finalize:
             final = P.Agg(BatchSource(combined),
                           [(n, E.Col(n, ke.type))
                            for n, ke in agg.group_keys], agg.aggs,
@@ -256,13 +259,27 @@ class SpillDriver:
         return self._finish_with(plan, replace_target,
                                  BatchSource(combined))
 
+    def _per_pass_plan(self, plan, joins, agg):
+        """(subtree to run per slice, node it replaces, finalize?).
+        A 'single' agg slabs in partial mode and re-merges under a
+        final aggregate; a 'partial' agg (DN fragment) runs as-is and
+        its slab outputs concatenate for the CN's final merge."""
+        if agg is not None and agg.mode == "single":
+            return dataclasses.replace(agg, mode="partial"), agg, True
+        if agg is not None:
+            return agg, agg, False
+        top = self._top_join(plan, joins)
+        return top, top, False
+
     def _run_block_cross(self, plan, join, agg, infos):
         left_info = self._info_for_side(join.left, infos)
         right_info = self._info_for_side(join.right, infos)
         if left_info is None or right_info is None:
             return None
-        per_plan, replace_target = self._join_partition_plan(plan, join,
-                                                             agg)
+        per_plan, replace_target, finalize = self._per_pass_plan(
+            plan, [join], agg)
+        if self._has_order_sensitive(per_plan):
+            return None
         outs = []
         # bound the cross PRODUCT per pass (the padded pair expansion is
         # the memory cost), not just the left staging
@@ -276,14 +293,8 @@ class SpillDriver:
                                                 right_info: rsel})
             outs.append(self._exec_with_staged(per_plan, staged))
         combined = self._combine_host(outs)
-        if agg is not None:
-            final = P.Agg(BatchSource(combined),
-                          [(n, E.Col(n, ke.type))
-                           for n, ke in agg.group_keys], agg.aggs,
-                          "final")
-            return self._finish_with(plan, replace_target, final)
-        return self._finish_with(plan, replace_target,
-                                 BatchSource(combined))
+        return self._finalize(plan, replace_target, agg, finalize,
+                              combined)
 
     def _info_for_side(self, side_plan, infos) -> Optional[_ScanInfo]:
         scans = [nd for nd in _walk_nodes(side_plan)
@@ -294,6 +305,114 @@ class SpillDriver:
             if i.node is scans[0]:
                 return i
         return None
+
+    @staticmethod
+    def _contains(node, target) -> bool:
+        return any(nd is target for nd in _walk_nodes(node))
+
+    def _sliced_side_ok(self, plan, big_nodes, exclude=None) -> bool:
+        """A sliced table must sit on the preserved/probe side of every
+        outer/semi/anti join above it: slicing the null-extended or
+        lookup side would emit unmatched rows once per slice.  The
+        grace-partitioned join itself is excluded — partitioning by its
+        OWN key hash keeps matches partition-aligned, so its join
+        semantics survive on both sides (reference: the hybrid hash
+        join's nbatch partitioning, nodeHash.c)."""
+        for nd in _walk_nodes(plan):
+            if not isinstance(nd, P.HashJoin) or nd is exclude:
+                continue
+            if nd.kind == "full" and any(
+                    self._contains(nd, b) for b in big_nodes):
+                return False
+            if nd.kind in ("left", "semi", "anti") and any(
+                    self._contains(nd.right, b) for b in big_nodes):
+                return False
+        return True
+
+    def _top_join(self, plan, joins):
+        for nd in _walk_nodes(plan):
+            if isinstance(nd, P.HashJoin):
+                return nd
+        return joins[0]
+
+    def _run_slabbed_tree(self, plan, joins, agg, big: _ScanInfo):
+        """Arbitrary join tree with ONE over-budget scan: row-range
+        slabs of the big table; per slab the whole subtree executes with
+        the dims fully staged (they fit the budget and stay cached
+        across passes); partial-aggregate slabs merge in final mode."""
+        if not self._sliced_side_ok(plan, (big.node,)):
+            return None
+        per_plan, replace_target, finalize = self._per_pass_plan(
+            plan, joins, agg)
+        if not self._contains(per_plan, big.node) \
+                or self._has_order_sensitive(per_plan):
+            return None
+        outs = []
+        for lo in range(0, big.rows, self.budget):
+            sel = slice(lo, min(lo + self.budget, big.rows))
+            staged = self._stage_for(per_plan, {big: sel})
+            outs.append(self._exec_with_staged(per_plan, staged))
+        combined = self._combine_host(outs)
+        return self._finalize(plan, replace_target, agg, finalize,
+                              combined)
+
+    def _run_grace_tree(self, plan, joins, agg, infos, over):
+        """Grace-partition an equi join with over-budget side(s): both
+        sides slice by the join-key hash, the whole subtree runs per
+        partition (dims staged whole).  Covers two-big-table joins AND
+        the one-big-table shapes slabbing must refuse (big on the
+        null-extended side of the join — partition-aligned slicing
+        keeps outer semantics)."""
+        over_set = set(over)
+        gjoin = None
+        for j in joins:
+            if j.kind not in ("inner", "left", "semi", "anti"):
+                continue
+            li = self._info_for_side(j.left, infos)
+            ri = self._info_for_side(j.right, infos)
+            if li is not None and ri is not None and li is not ri \
+                    and (li in over_set or ri in over_set) \
+                    and over_set <= {li, ri}:
+                gjoin = (j, li, ri)
+                break
+        if gjoin is None:
+            return None
+        join, left_info, right_info = gjoin
+        big_nodes = (left_info.node, right_info.node)
+        if not self._sliced_side_ok(plan, big_nodes, exclude=join):
+            return None
+        lh = self._side_hash(left_info, join.left_keys)
+        rh = self._side_hash(right_info, join.right_keys)
+        if lh is None or rh is None:
+            return None
+        per_plan, replace_target, finalize = self._per_pass_plan(
+            plan, joins, agg)
+        if not (self._contains(per_plan, left_info.node)
+                and self._contains(per_plan, right_info.node)) \
+                or self._has_order_sensitive(per_plan):
+            return None
+        nparts = max(1, 2 ** math.ceil(math.log2(max(
+            1, math.ceil(max(left_info.rows, right_info.rows)
+                         / self.budget)))))
+        lp = (lh % np.uint64(nparts)).astype(np.int64)
+        rp = (rh % np.uint64(nparts)).astype(np.int64)
+        outs = []
+        for p in range(nparts):
+            lsel = np.nonzero(lp == p)[0]
+            rsel = np.nonzero(rp == p)[0]
+            if len(lsel) == 0:
+                continue
+            if join.kind in ("inner", "semi") and len(rsel) == 0:
+                continue
+            staged = self._stage_for(per_plan, {left_info: lsel,
+                                                right_info: rsel})
+            outs.append(self._exec_with_staged(per_plan, staged))
+        if not outs:
+            return None
+        combined = self._combine_host(outs)
+        return self._finalize(plan, replace_target, agg, finalize,
+                              combined)
+
 
     def _side_hash(self, info: _ScanInfo, keys) -> Optional[np.ndarray]:
         hs = []

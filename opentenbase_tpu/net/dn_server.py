@@ -100,6 +100,8 @@ def _dispatch(node: DataNode, msg: dict):
     if op == "delete_where":
         return node.delete_where(msg["table"], msg["quals"],
                                  msg["snapshot_ts"], msg["txid"])
+    if op == "alter_table":
+        return node.alter_table(msg["rec"])
     if op == "exec_plan":
         return node.exec_plan(msg["plan"], msg["snapshot_ts"],
                               msg["txid"], msg.get("params", {}),
@@ -210,6 +212,9 @@ class RemoteDataNode:
         return self._call(op="exec_plan", plan=plan,
                           snapshot_ts=snapshot_ts, txid=txid,
                           params=params, sources=sources)
+
+    def alter_table(self, rec):
+        return self._call(op="alter_table", rec=rec)
 
     def build_ann_index(self, table, col, lists=0, metric="l2", nprobe=0):
         return self._call(op="build_ann_index", table=table, col=col,

@@ -41,6 +41,9 @@ class DataNode:
         self.datadir = datadir
         self.wal: Optional[Wal] = None
         self.txn_spans: dict[int, list] = {}  # txid -> [(kind, table, span)]
+        # logical decoding hook (storage/logical.py LogicalDecoder),
+        # attached by a LogicalPublisher
+        self.decoder = None
         # streaming replication (storage/replication.py WalShip); set via
         # attach_standby BEFORE open_wal
         self._ship = None
@@ -112,6 +115,8 @@ class DataNode:
         spans = st.insert(enc, n, txid, shardids=shardids,
                           nulls=masks or None)
         self.txn_spans.setdefault(txid, []).append(("ins", table, spans))
+        if self.decoder is not None and not self._unlogged(table):
+            self.decoder.on_insert(table, st, enc, masks, n, txid)
         return n
 
     def delete_where(self, table: str, quals: list, snapshot_ts: int,
@@ -128,6 +133,9 @@ class DataNode:
                     mask = mask & np.asarray(
                         compile_pred(q, dicts, nullable)(env))
             if mask.any():
+                if self.decoder is not None and not self._unlogged(table):
+                    # capture replica-identity rows BEFORE mark_delete
+                    self.decoder.on_delete(table, st, ch, mask, txid)
                 span = st.mark_delete(ci, mask, txid)
                 self.txn_spans.setdefault(txid, []).append(
                     ("del", table, span))
@@ -140,13 +148,41 @@ class DataNode:
                          params: dict, sources: dict):
         """In-process fast path: run a fragment and return the device
         batch directly (no host materialization) — used for FQS where the
-        coordinator and datanode share the process."""
+        coordinator and datanode share the process.
+
+        A '__work_mem_rows' pseudo-param (the reference ships work_mem
+        inside every RemoteStmt, include/pgxc/execRemote.h) activates
+        the spill tier for this fragment: scans larger than the budget
+        execute as multi-pass slab/grace plans instead of staging whole
+        tables to device HBM."""
         from ..exec.dist import _bind_sources_host
         from ..exec.executor import ExecContext, Executor
+        params = dict(params)
+        wm = params.pop("__work_mem_rows", None)
         bound = _bind_sources_host(plan, sources)
+        if wm:
+            from ..exec.spill import SpillDriver
+            drv = SpillDriver(self.stores, self.cache, snapshot_ts,
+                              txid, int(wm[0]), params=params)
+            out = drv.try_run_plan(bound)
+            if out is not None:
+                self.last_spill_passes = drv.passes
+                return out
         ctx = ExecContext(self.stores, snapshot_ts, txid, self.cache,
-                          params=dict(params))
+                          params=params)
         return Executor(ctx).exec_node(bound)
+
+    def alter_table(self, rec: dict) -> None:
+        """Apply an ALTER TABLE action to this node's store + WAL
+        (reference: the DDL fan-out executing ATExecCmd per node)."""
+        from ..exec.session import replay_alter
+        replay_alter(None, self.stores, rec)
+        self.log({"op": "alter_table", **rec}, sync=True)
+        target = rec["new_name"] if rec["action"] == "rename_table" \
+            else rec["table"]
+        st = self.stores.get(target)
+        if st is not None:
+            self.cache.invalidate(st)
 
     def exec_plan(self, plan, snapshot_ts: int, txid: int,
                   params: dict, sources: dict):
@@ -232,6 +268,8 @@ class DataNode:
                 st.backfill_insert(sp, np.int64(ts))
             else:
                 st.backfill_delete([sp], np.int64(ts))
+        if self.decoder is not None:
+            self.decoder.on_commit(txid, ts)
 
     def abort(self, txid: int):
         ops = self.txn_spans.pop(txid, [])
@@ -245,6 +283,8 @@ class DataNode:
                 st.abort_insert(sp)
             else:
                 st.revert_delete([sp])
+        if self.decoder is not None:
+            self.decoder.on_abort(txid)
 
     def wrote_in(self, txid: int) -> bool:
         return bool(self.txn_spans.get(txid))
@@ -268,6 +308,11 @@ class DataNode:
             ckpt = os.path.join(self.datadir, f"{name}.ckpt")
             if os.path.exists(ckpt):
                 restore_store(st, ckpt)
+                # a checkpoint older than an ALTER .. ADD COLUMN lacks
+                # the column's arrays; reconcile to the catalog schema
+                # (idempotent per-chunk fill)
+                for c in td.columns:
+                    st.alter_add_column(c)
             self.stores[name] = st
         pending: dict[int, list] = {}
         gid_of: dict[int, str] = {}
@@ -283,6 +328,8 @@ class DataNode:
                     continue
                 enc = {}
                 for cname, v in rec["columns"].items():
+                    if not st.td.has_column(cname):
+                        continue   # column dropped after this record
                     arr = np.asarray(v)
                     if arr.dtype.kind == "S":
                         enc[cname] = st.encode_column(cname, arr)
@@ -291,9 +338,12 @@ class DataNode:
                     else:
                         enc[cname] = arr.astype(
                             st.td.column(cname).type.np_dtype)
+                from ..exec.session import conform_replay_columns
+                enc, rnulls = conform_replay_columns(
+                    st, enc, rec["n"], rec.get("nulls"))
                 spans = st.insert(enc, rec["n"], rec["txid"],
                                   shardids=rec.get("shardids"),
-                                  nulls=rec.get("nulls"))
+                                  nulls=rnulls)
                 pending.setdefault(rec["txid"], []).append(
                     ("ins", st, spans))
             elif op == "delete":
@@ -304,6 +354,9 @@ class DataNode:
                                       rec["txid"])
                 pending.setdefault(rec["txid"], []).append(
                     ("del", st, span))
+            elif op == "alter_table":
+                from ..exec.session import replay_alter
+                replay_alter(None, self.stores, rec)
             elif op == "prepare":
                 gid_of[rec["txid"]] = rec["gid"]
             elif op == "commit":
@@ -443,6 +496,10 @@ class Cluster:
             for i in range(n_datanodes)]
         self.locator = Locator(self.catalog)
         self.active_txns: set[int] = set()
+        # txids created by logical-replication apply on THIS cluster —
+        # the decoder drops them so multi-active A<->B subscriptions do
+        # not loop (reference: replication origins)
+        self.replication_origin_txids: set[int] = set()
         self.gucs: dict[str, str] = {"enable_fast_query_shipping": "on"}
         for dn in self.datanodes:
             if recovered and dn.datadir:
@@ -501,6 +558,7 @@ class Cluster:
                           for i, (h, p) in enumerate(dn_addrs)]
         self.locator = Locator(self.catalog)
         self.active_txns = set()
+        self.replication_origin_txids = set()
         self.gucs = {"enable_fast_query_shipping": "on"}
         from . import statviews
         statviews.register(self)
@@ -609,6 +667,7 @@ class Cluster:
             for i in dns:
                 self.datanodes[i].commit(txid, ts)
             self.active_txns.discard(txid)
+            self.replication_origin_txids.discard(txid)
             return ts
 
         # implicit 2PC
@@ -629,6 +688,9 @@ class Cluster:
         fault_point("BEFORE_GTM_FORGET")
         self.gtm.forget_txn(gid)
         self.active_txns.discard(txid)
+        # the decoders have seen this commit by now: the origin tag has
+        # served its purpose (bounded set, not a leak)
+        self.replication_origin_txids.discard(txid)
         return ts
 
     def abort_txn(self, txid: int, dns: Optional[set] = None):
@@ -636,6 +698,25 @@ class Cluster:
             if dns is None or dn.index in dns:
                 dn.abort(txid)
         self.active_txns.discard(txid)
+        self.replication_origin_txids.discard(txid)
+
+    # ---- logical replication (reference: logical/worker.c,
+    # contrib/opentenbase_subscription) ----
+    def logical_publisher(self):
+        """Lazy LogicalPublisher: attaches decoders to every datanode
+        and registers this cluster for local: subscriptions."""
+        if getattr(self, "_logical_pub", None) is None:
+            from ..storage.logical import (LogicalPublisher,
+                                           register_local_publisher)
+            self._logical_pub = LogicalPublisher(self)
+            register_local_publisher(f"{id(self):x}", self._logical_pub)
+        return self._logical_pub
+
+    @property
+    def subscriptions(self) -> dict:
+        if not hasattr(self, "_subscriptions"):
+            self._subscriptions = {}
+        return self._subscriptions
 
     # ---- failover (reference: pg_ctl promote + pgxc_ctl failover) ----
     def promote_standby(self, dn_index: int, standby_datadir: str):

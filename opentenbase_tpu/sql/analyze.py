@@ -52,6 +52,16 @@ class Binder:
         self.param_types = param_types or {}
 
     # ------------------------------------------------------------------
+    def _append_subquery_rte(self, rtable, sub, alias: str):
+        """Common tail for CTE / view / derived-table references."""
+        self._check_dup_alias(rtable, alias)
+        if isinstance(sub, BoundQuery):
+            cols = {n: (f"{alias}.{n}", e.type) for n, e in sub.targets}
+        else:                      # set-operation body
+            cols = {n: (f"{alias}.{n}", t)
+                    for n, t in zip(sub.target_names, sub.target_types)}
+        rtable.append(RTE(alias, "subquery", subquery=sub, columns=cols))
+
     def bind_select(self, stmt: A.SelectStmt,
                     outer: list[Scope] = ()) -> BoundQuery:
         if stmt.group_sets:
@@ -107,17 +117,38 @@ class Binder:
                             raise BindError(
                                 f"CTE {item.name!r} column alias count")
                         sub.target_names = list(col_aliases)
-                alias = item.alias or item.name
-                self._check_dup_alias(rtable, alias)
-                if isinstance(sub, BoundQuery):
-                    cols = {name: (f"{alias}.{name}", e.type)
-                            for name, e in sub.targets}
-                else:
-                    cols = {name: (f"{alias}.{name}", t)
-                            for name, t in zip(sub.target_names,
-                                               sub.target_types)}
-                rtable.append(RTE(alias, "subquery", subquery=sub,
-                                  columns=cols))
+                self._append_subquery_rte(rtable, sub,
+                                          item.alias or item.name)
+            elif isinstance(item, A.TableRef) and \
+                    item.name in self.catalog.views and \
+                    item.name not in self.catalog.tables:
+                # view expansion (reference: the rewriter inlining the
+                # view rule, rewriteHandler.c): parse the stored text,
+                # bind as an independent subquery under the reference's
+                # alias
+                stack = getattr(self, "_view_stack", ())
+                if item.name in stack:
+                    raise BindError(
+                        f"infinite recursion in view {item.name!r}")
+                from .parser import parse_one
+                try:
+                    vstmt = parse_one(self.catalog.views[item.name])
+                except Exception as e:
+                    raise BindError(
+                        f"view {item.name!r} is invalid: {e}") from None
+                # a view's references were fixed at definition time:
+                # the caller's WITH names must not capture them (PG:
+                # view rules expand against base relations)
+                hold_ctes = getattr(self, "_ctes", {})
+                self._view_stack = (*stack, item.name)
+                self._ctes = {}
+                try:
+                    sub = self.bind_select(vstmt)
+                finally:
+                    self._view_stack = stack
+                    self._ctes = hold_ctes
+                self._append_subquery_rte(rtable, sub,
+                                          item.alias or item.name)
             elif isinstance(item, A.TableRef):
                 td = self._table(item.name)
                 alias = item.alias or item.name
@@ -127,17 +158,7 @@ class Binder:
                 rtable.append(RTE(alias, "table", table=td, columns=cols))
             elif isinstance(item, A.SubqueryRef):
                 sub = self.bind_select(item.subquery, outer=scopes)
-                alias = item.alias
-                self._check_dup_alias(rtable, alias)
-                if isinstance(sub, BoundQuery):
-                    cols = {name: (f"{alias}.{name}", expr.type)
-                            for name, expr in sub.targets}
-                else:  # set operation body
-                    cols = {name: (f"{alias}.{name}", t)
-                            for name, t in zip(sub.target_names,
-                                               sub.target_types)}
-                rtable.append(RTE(alias, "subquery", subquery=sub,
-                                  columns=cols))
+                self._append_subquery_rte(rtable, sub, item.alias)
             else:
                 raise BindError(f"unsupported FROM item {type(item).__name__}")
             idx = len(rtable) - 1

@@ -314,6 +314,59 @@ class DropIndexStmt(Node):
 
 
 @dataclasses.dataclass
+class CreateViewStmt(Node):
+    """CREATE [OR REPLACE] VIEW name AS select (reference:
+    view.c DefineView; stored as SQL text, expanded at bind time)."""
+    name: str
+    select: "SelectStmt"          # parsed for validation
+    text: str                     # original SELECT text (persisted)
+    or_replace: bool = False
+
+
+@dataclasses.dataclass
+class DropViewStmt(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class AlterTableStmt(Node):
+    """ALTER TABLE: add/drop/rename column, rename table (reference:
+    tablecmds.c ATExecCmd subset)."""
+    table: str
+    action: str        # add_column | drop_column | rename_column | rename_table
+    column: Optional[ColumnDefAst] = None
+    name: str = ""
+    new_name: str = ""
+
+
+@dataclasses.dataclass
+class CreatePublicationStmt(Node):
+    """CREATE PUBLICATION name FOR TABLE t1, t2 (reference:
+    contrib/opentenbase_subscription + publicationcmds.c)."""
+    name: str
+    tables: list[str]
+
+
+@dataclasses.dataclass
+class DropPublicationStmt(Node):
+    name: str
+
+
+@dataclasses.dataclass
+class CreateSubscriptionStmt(Node):
+    """CREATE SUBSCRIPTION name CONNECTION 'conninfo' PUBLICATION pub."""
+    name: str
+    conninfo: str
+    publication: str
+
+
+@dataclasses.dataclass
+class DropSubscriptionStmt(Node):
+    name: str
+
+
+@dataclasses.dataclass
 class TxnStmt(Node):
     op: str                           # begin|commit|rollback
 

@@ -124,6 +124,8 @@ class Parser:
             return self.delete_stmt()
         if v == "create":
             return self.create_stmt()
+        if v == "alter":
+            return self.alter_stmt()
         if v == "drop":
             return self.drop_stmt()
         if v == "copy":
@@ -609,6 +611,24 @@ class Parser:
         self.expect_kw("create")
         if self.accept_kw("table"):
             return self.create_table_tail()
+        or_replace = False
+        if self.at_kw("or"):
+            save = self.i
+            self.advance()
+            if self.accept_kw("replace"):
+                or_replace = True
+            else:
+                self.i = save
+        if self.accept_kw("view"):
+            name = self.ident()
+            self.expect_kw("as")
+            start = self.tok.pos
+            sel = self.select_stmt()
+            end = self.tok.pos if self.tok.kind != Tok.EOF \
+                else len(self.sql)
+            return A.CreateViewStmt(name, sel,
+                                    self.sql[start:end].strip(),
+                                    or_replace)
         if self.accept_kw("sequence"):
             name = self.ident()
             start, inc = 1, 1
@@ -652,6 +672,24 @@ class Parser:
         if self.accept_kw("barrier"):
             t = self.advance()
             return A.BarrierStmt(t.value)
+        if self.accept_kw("publication"):
+            name = self.ident()
+            self.expect_kw("for")
+            self.expect_kw("table")
+            tables = [self.ident()]
+            while self.accept_op(","):
+                tables.append(self.ident())
+            return A.CreatePublicationStmt(name, tables)
+        if self.accept_kw("subscription"):
+            name = self.ident()
+            self.expect_kw("connection")
+            conn = self.advance()
+            if conn.kind != Tok.STR:
+                raise SqlSyntaxError("expected connection string",
+                                     self.sql, conn.pos)
+            self.expect_kw("publication")
+            pub = self.ident()
+            return A.CreateSubscriptionStmt(name, conn.value, pub)
         raise SqlSyntaxError("unsupported CREATE", self.sql, self.tok.pos)
 
     def create_table_tail(self) -> A.CreateTableStmt:
@@ -736,8 +774,42 @@ class Parser:
                 break
         return A.ColumnDefAst(name, tname, targs, not_null, primary)
 
+    def alter_stmt(self) -> A.AlterTableStmt:
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        table = self.ident()
+        if self.accept_kw("rename"):
+            if self.accept_kw("to"):
+                return A.AlterTableStmt(table, "rename_table",
+                                        new_name=self.ident())
+            self.accept_kw("column")
+            old = self.ident()
+            self.expect_kw("to")
+            return A.AlterTableStmt(table, "rename_column", name=old,
+                                    new_name=self.ident())
+        if self.accept_kw("add"):
+            self.accept_kw("column")
+            return A.AlterTableStmt(table, "add_column",
+                                    column=self.column_def())
+        if self.accept_kw("drop"):
+            self.accept_kw("column")
+            return A.AlterTableStmt(table, "drop_column",
+                                    name=self.ident())
+        raise SqlSyntaxError("unsupported ALTER TABLE action", self.sql,
+                             self.tok.pos)
+
     def drop_stmt(self) -> A.Node:
         self.expect_kw("drop")
+        if self.accept_kw("publication"):
+            return A.DropPublicationStmt(self.ident())
+        if self.accept_kw("subscription"):
+            return A.DropSubscriptionStmt(self.ident())
+        if self.accept_kw("view"):
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropViewStmt(self.ident(), if_exists)
         if self.accept_kw("index"):
             if_exists = False
             if self.accept_kw("if"):

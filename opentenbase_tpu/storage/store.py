@@ -323,6 +323,55 @@ class TableStore:
             self.chunks[ci].xmax_txid[idx] = NO_TXID
 
     # ------------------------------------------------------------------
+    # ALTER TABLE column surgery (reference: tablecmds.c ATExecAddColumn
+    # / ATExecDropColumn / renameatt — here columnar, so a column op is
+    # a per-chunk array-dict edit, never a rewrite)
+    def alter_add_column(self, cd) -> None:
+        """Existing rows read NULL in the new column (typed zero fill +
+        all-set null bitmap, the t_bits analog)."""
+        if not self.td.has_column(cd.name):
+            self.td.columns.append(cd)
+        from ..catalog.types import TypeKind as _TK
+        if cd.type.kind == _TK.TEXT and cd.name not in self.dicts:
+            self.dicts[cd.name] = StringDict()
+        filled = False
+        for ch in self.chunks:
+            if cd.name not in ch.columns:
+                ch.columns[cd.name] = np.zeros(
+                    (ch.cap, *cd.type.shape_suffix),
+                    dtype=cd.type.np_dtype)
+                ch.nulls[cd.name] = np.ones(ch.cap, dtype=bool)
+                filled = True
+        if filled:
+            self.null_columns.add(cd.name)
+        self.version = next(_VERSION_COUNTER)
+
+    def alter_drop_column(self, name: str) -> None:
+        self.td.columns = [c for c in self.td.columns if c.name != name]
+        for ch in self.chunks:
+            ch.columns.pop(name, None)
+            ch.nulls.pop(name, None)
+        self.dicts.pop(name, None)
+        self.null_columns.discard(name)
+        self.version = next(_VERSION_COUNTER)
+
+    def alter_rename_column(self, old: str, new: str) -> None:
+        for c in self.td.columns:
+            if c.name == old:
+                c.name = new
+        for ch in self.chunks:
+            if old in ch.columns:
+                ch.columns[new] = ch.columns.pop(old)
+            if old in ch.nulls:
+                ch.nulls[new] = ch.nulls.pop(old)
+        if old in self.dicts:
+            self.dicts[new] = self.dicts.pop(old)
+        if old in self.null_columns:
+            self.null_columns.discard(old)
+            self.null_columns.add(new)
+        self.version = next(_VERSION_COUNTER)
+
+    # ------------------------------------------------------------------
     def scan_chunks(self) -> Iterator[tuple[int, Chunk]]:
         for i, ch in enumerate(self.chunks):
             if ch.nrows:
