@@ -53,6 +53,11 @@ class Catalog:
         # views: name -> SELECT text, expanded at bind time (reference:
         # pg_rewrite view rules; text-stored so persistence is trivial)
         self.views: dict[str, str] = {}
+        # declarative partitioning: parent -> {"method": range|list,
+        # "key": col, "parts": [{"name", "from", "to"} | {"name",
+        # "values"}]} (reference: pg_partitioned_table + pg_class
+        # relispartition; pruning happens at bind time)
+        self.partitioned: dict[str, dict] = {}
         self._next_oid = 16384
 
     # ---- tables ----
@@ -155,6 +160,7 @@ class Catalog:
                 "local_indexes": self.local_indexes,
                 "stats": self.stats,
                 "views": self.views,
+                "partitioned": self.partitioned,
                 "next_oid": self._next_oid,
             }
         tmp = path + ".tmp"
@@ -183,5 +189,6 @@ class Catalog:
         cat.local_indexes = blob.get("local_indexes", {})
         cat.stats = blob.get("stats", {})
         cat.views = blob.get("views", {})
+        cat.partitioned = blob.get("partitioned", {})
         cat._next_oid = blob.get("next_oid", 16384)
         return cat

@@ -164,9 +164,47 @@ class ClusterSession:
             return self._exec_select(stmt)
         if isinstance(stmt, A.CreateTableStmt):
             c.create_table(table_def_from_ast(stmt), stmt.if_not_exists)
+            if stmt.partition_by:
+                from ..parallel.partition import (PartitionError,
+                                                  register_parent)
+                try:
+                    register_parent(c.catalog, stmt)
+                except PartitionError as e:
+                    raise ExecError(str(e)) from None
+                c._save_catalog()
+            return Result("CREATE TABLE")
+        if isinstance(stmt, A.CreatePartitionStmt):
+            from ..catalog.schema import ColumnDef, Distribution
+            from ..parallel.partition import (PartitionError,
+                                              partition_bounds)
+            try:
+                ptd, rec = partition_bounds(c.catalog, stmt)
+            except PartitionError as e:
+                raise ExecError(str(e)) from None
+            child = TableDef(
+                stmt.name,
+                [ColumnDef(cc.name, cc.type, cc.nullable)
+                 for cc in ptd.columns],
+                Distribution(ptd.distribution.dist_type,
+                             list(ptd.distribution.dist_cols),
+                             ptd.distribution.group))
+            c.create_table(child)
+            c.catalog.partitioned[stmt.parent]["parts"].append(rec)
+            c._save_catalog()
+            c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
             return Result("CREATE TABLE")
         if isinstance(stmt, A.DropTableStmt):
+            pinfo = c.catalog.partitioned.get(stmt.name)
+            if pinfo is not None:
+                for p in list(pinfo["parts"]):
+                    c.drop_table(p["name"], if_exists=True)
+                del c.catalog.partitioned[stmt.name]
+            else:
+                for pi in c.catalog.partitioned.values():
+                    pi["parts"] = [p for p in pi["parts"]
+                                   if p["name"] != stmt.name]
             c.drop_table(stmt.name, stmt.if_exists)
+            c._save_catalog()
             return Result("DROP TABLE")
         if isinstance(stmt, A.CreateSequenceStmt):
             sd = sequence_def_from_ast(stmt)
@@ -626,11 +664,80 @@ class ClusterSession:
         missing = [cn for cn in td.column_names if cn not in coldata]
         if missing:
             raise ExecError(f"INSERT missing columns {missing}")
+        if stmt.table in self.cluster.catalog.partitioned:
+            if stmt.on_conflict is not None:
+                raise ExecError("ON CONFLICT through a partitioned "
+                                "parent is not supported")
+            return self._insert_partitioned(stmt.table, coldata,
+                                            len(rows))
         if stmt.on_conflict is not None:
             return self._exec_upsert(td, stmt.on_conflict, coldata,
                                      len(rows))
         n = self._insert_rows(td, coldata, len(rows))
         return Result("INSERT", rowcount=n)
+
+    def _insert_partitioned(self, parent: str, coldata: dict,
+                            n: int) -> Result:
+        """Route rows to partitions in one (2PC when multi-DN) txn."""
+        from ..parallel.partition import PartitionError, split_insert
+        c = self.cluster
+        t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
+        total = 0
+        try:
+            for child, sub, cn in split_insert(c.catalog, parent,
+                                               coldata, n):
+                total += self._insert_rows(c.catalog.table(child),
+                                           sub, cn)
+        except PartitionError as e:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise ExecError(str(e)) from None
+        except Exception:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit(t)
+        return Result("INSERT", rowcount=total)
+
+    def _partition_dml_fanout(self, stmt) -> Result:
+        """UPDATE/DELETE on a partitioned parent (see the single-node
+        session's twin)."""
+        from ..parallel.partition import prune_partitions
+        c = self.cluster
+        pinfo = c.catalog.partitioned[stmt.table]
+        key_t = c.catalog.table(stmt.table).column(pinfo["key"]).type
+        is_update = isinstance(stmt, A.UpdateStmt)
+        if is_update and any(col == pinfo["key"]
+                             for col, _ in stmt.assignments):
+            raise ExecError("updating the partition key is not "
+                            "supported (no row movement)")
+        names = prune_partitions(pinfo, key_t, stmt.where, stmt.table)
+        t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
+        total = 0
+        try:
+            for nm in names:
+                child_stmt = A.UpdateStmt(nm, stmt.assignments,
+                                          stmt.where) if is_update \
+                    else A.DeleteStmt(nm, stmt.where)
+                total += self._exec_stmt(child_stmt).rowcount
+        except Exception:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit(t)
+        return Result("UPDATE" if is_update else "DELETE",
+                      rowcount=total)
 
     # ---- UPSERT (reference: the select/insert/update legs built by
     # pgxc_build_upsert_statement, pgxc/plan/planner.c:1070, executed by
@@ -920,6 +1027,8 @@ class ClusterSession:
     def _exec_delete(self, stmt: A.DeleteStmt) -> Result:
         from ..parallel import gindex
         c = self.cluster
+        if stmt.table in c.catalog.partitioned:
+            return self._partition_dml_fanout(stmt)
         td = c.catalog.table(stmt.table)
         t, implicit = self._begin_implicit()
         if implicit:
@@ -959,6 +1068,8 @@ class ClusterSession:
         return Result("DELETE", rowcount=n_deleted)
 
     def _exec_update(self, stmt: A.UpdateStmt) -> Result:
+        if stmt.table in self.cluster.catalog.partitioned:
+            return self._partition_dml_fanout(stmt)
         td = self.cluster.catalog.table(stmt.table)
         assigned = {cn: e for cn, e in stmt.assignments}
         sel_items = [A.SelectItem(assigned.get(col.name,

@@ -214,6 +214,10 @@ class LocalNode:
         elif op == "drop_table":
             self.catalog.drop_table(rec["name"], if_exists=True)
             self.stores.pop(rec["name"], None)
+            self.catalog.partitioned.pop(rec["name"], None)
+            for pi in self.catalog.partitioned.values():
+                pi["parts"] = [p for p in pi["parts"]
+                               if p["name"] != rec["name"]]
         elif op == "insert":
             st = self.stores[rec["table"]]
             enc = {}
@@ -258,6 +262,12 @@ class LocalNode:
                     st.abort_insert(sp)
                 else:
                     st.revert_delete([sp])
+        elif op == "partition_parent":
+            self.catalog.partitioned[rec["table"]] = {
+                "method": rec["method"], "key": rec["key"], "parts": []}
+        elif op == "create_partition":
+            self.catalog.partitioned[rec["parent"]]["parts"].append(
+                rec["rec"])
         elif op == "create_view":
             self.catalog.views[rec["name"]] = rec["text"]
         elif op == "drop_view":
@@ -348,12 +358,61 @@ class Session:
             return self._exec_select(stmt)
         if isinstance(stmt, A.CreateTableStmt):
             td = table_def_from_ast(stmt)
+            if stmt.partition_by and not any(
+                    c.name == stmt.partition_by[1] for c in td.columns):
+                raise ExecError(f"partition key "
+                                f"{stmt.partition_by[1]!r} not in table")
             self.node.catalog.create_table(td, stmt.if_not_exists)
             self.node.stores.setdefault(td.name, TableStore(td))
             self.node._log({"op": "create_table", "table": td.to_json()},
                            sync=True)
+            if stmt.partition_by:
+                from ..parallel.partition import (PartitionError,
+                                                  register_parent)
+                try:
+                    register_parent(self.node.catalog, stmt)
+                except PartitionError as e:
+                    raise ExecError(str(e)) from None
+                self.node._log({"op": "partition_parent",
+                                "table": td.name,
+                                "method": stmt.partition_by[0],
+                                "key": stmt.partition_by[1]}, sync=True)
+            return Result("CREATE TABLE")
+        if isinstance(stmt, A.CreatePartitionStmt):
+            from ..catalog.schema import ColumnDef, Distribution
+            from ..parallel.partition import (PartitionError,
+                                              partition_bounds)
+            try:
+                ptd, rec = partition_bounds(self.node.catalog, stmt)
+            except PartitionError as e:
+                raise ExecError(str(e)) from None
+            child = TableDef(
+                stmt.name,
+                [ColumnDef(c.name, c.type, c.nullable)
+                 for c in ptd.columns],
+                Distribution(ptd.distribution.dist_type,
+                             list(ptd.distribution.dist_cols),
+                             ptd.distribution.group))
+            self.node.catalog.create_table(child)
+            self.node.stores[child.name] = TableStore(child)
+            self.node._log({"op": "create_table",
+                            "table": child.to_json()}, sync=True)
+            self.node.catalog.partitioned[stmt.parent]["parts"].append(
+                rec)
+            self.node._log({"op": "create_partition",
+                            "parent": stmt.parent, "rec": rec},
+                           sync=True)
             return Result("CREATE TABLE")
         if isinstance(stmt, A.DropTableStmt):
+            pinfo = self.node.catalog.partitioned.get(stmt.name)
+            if pinfo is not None:
+                for p in list(pinfo["parts"]):
+                    self._exec_stmt(A.DropTableStmt(p["name"], True))
+                del self.node.catalog.partitioned[stmt.name]
+            else:
+                for parent, pi in self.node.catalog.partitioned.items():
+                    pi["parts"] = [p for p in pi["parts"]
+                                   if p["name"] != stmt.name]
             self.node.catalog.drop_table(stmt.name, stmt.if_exists)
             st = self.node.stores.pop(stmt.name, None)
             if st is not None:
@@ -482,6 +541,21 @@ class Session:
 
     def _exec_alter(self, stmt: A.AlterTableStmt) -> Result:
         cat = self.node.catalog
+        if stmt.table in cat.partitioned:
+            if stmt.action == "rename_table":
+                raise ExecError("renaming a partitioned table is not "
+                                "supported")
+            # DDL recurses to every partition (reference: ATExecCmd
+            # recursing over inheritance children)
+            r = self._exec_alter_one(stmt)
+            for part in cat.partitioned[stmt.table]["parts"]:
+                self._exec_alter_one(
+                    dataclasses.replace(stmt, table=part["name"]))
+            return r
+        return self._exec_alter_one(stmt)
+
+    def _exec_alter_one(self, stmt: A.AlterTableStmt) -> Result:
+        cat = self.node.catalog
         td = self._alter_guards(cat, stmt)
         st = self.node.stores[stmt.table]
         if stmt.action == "add_column":
@@ -591,8 +665,90 @@ class Session:
         if missing:
             raise ExecError(f"INSERT missing columns {missing} "
                             "(defaults unsupported)")
+        if stmt.table in self.node.catalog.partitioned:
+            return self._insert_partitioned(stmt.table, coldata,
+                                            len(rows))
+        self._check_partition_bound(stmt.table, coldata, len(rows))
         return Result("INSERT",
                       rowcount=self._insert_rows(td, st, coldata, len(rows)))
+
+    def _check_partition_bound(self, table: str, coldata: dict, n: int):
+        from ..parallel.partition import (PartitionError,
+                                          check_child_bounds)
+        try:
+            check_child_bounds(self.node.catalog, table, coldata, n)
+        except PartitionError as e:
+            raise ExecError(str(e)) from None
+
+    def _insert_partitioned(self, parent: str, coldata: dict,
+                            n: int) -> Result:
+        """Route inserted rows to their partitions, one transaction
+        (reference: ExecFindPartition per row, here batched)."""
+        from ..parallel.partition import PartitionError, split_insert
+        t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
+        total = 0
+        try:
+            for child, sub, cn in split_insert(self.node.catalog,
+                                               parent, coldata, n):
+                ctd = self.node.catalog.table(child)
+                total += self._insert_rows(ctd, self.node.stores[child],
+                                           sub, cn)
+        except PartitionError as e:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise ExecError(str(e)) from None
+        except Exception:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit(t)
+        return Result("INSERT", rowcount=total)
+
+    def _partition_dml_fanout(self, stmt) -> Result:
+        """UPDATE/DELETE on a partitioned parent: fan out per surviving
+        child in one transaction; updating the partition key is
+        rejected (reference: pre-v11 behavior, no row movement)."""
+        from ..parallel.partition import prune_partitions
+        cat = self.node.catalog
+        pinfo = cat.partitioned[stmt.table]
+        key_t = cat.table(stmt.table).column(pinfo["key"]).type
+        is_update = isinstance(stmt, A.UpdateStmt)
+        if is_update and any(col == pinfo["key"]
+                             for col, _ in stmt.assignments):
+            raise ExecError("updating the partition key is not "
+                            "supported (no row movement)")
+        names = prune_partitions(pinfo, key_t, stmt.where, stmt.table)
+        t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
+        total = 0
+        try:
+            from ..parallel.partition import rewrite_parent_refs
+            for nm in names:
+                w = rewrite_parent_refs(stmt.where, stmt.table, nm)
+                if is_update:
+                    asg = [(cn, rewrite_parent_refs(e, stmt.table, nm))
+                           for cn, e in stmt.assignments]
+                    child_stmt = A.UpdateStmt(nm, asg, w)
+                else:
+                    child_stmt = A.DeleteStmt(nm, w)
+                total += self._exec_stmt(child_stmt).rowcount
+        except Exception:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit(t)
+        return Result("UPDATE" if is_update else "DELETE",
+                      rowcount=total)
 
     def _insert_rows(self, td: TableDef, st: TableStore,
                      coldata: dict, n: int) -> int:
@@ -629,6 +785,8 @@ class Session:
         return n
 
     def _exec_delete(self, stmt: A.DeleteStmt) -> Result:
+        if stmt.table in self.node.catalog.partitioned:
+            return self._partition_dml_fanout(stmt)
         td = self.node.catalog.table(stmt.table)
         st = self.node.stores[stmt.table]
         t, implicit = self._begin_implicit()
@@ -672,6 +830,8 @@ class Session:
     def _exec_update(self, stmt: A.UpdateStmt) -> Result:
         # MVCC update = delete + insert of new row versions (the reference
         # heap does the same at tuple level)
+        if stmt.table in self.node.catalog.partitioned:
+            return self._partition_dml_fanout(stmt)
         td = self.node.catalog.table(stmt.table)
         sel_items = []
         assigned = {c: e for c, e in stmt.assignments}
@@ -722,6 +882,10 @@ class Session:
         from ..storage.loader import load_tbl
         coldata = load_tbl(stmt.filename, td, cols, delim)
         n = len(next(iter(coldata.values())))
+        if stmt.table in self.node.catalog.partitioned:
+            r = self._insert_partitioned(stmt.table, coldata, n)
+            return Result("COPY", rowcount=r.rowcount)
+        self._check_partition_bound(stmt.table, coldata, n)
         return Result("COPY", rowcount=self._insert_rows(td, st, coldata, n))
 
     # ---- txn / explain ----

@@ -149,6 +149,42 @@ class Binder:
                     self._ctes = hold_ctes
                 self._append_subquery_rte(rtable, sub,
                                           item.alias or item.name)
+            elif isinstance(item, A.TableRef) and \
+                    item.name in self.catalog.partitioned:
+                # partitioned parent: bind-time pruning (reference:
+                # partprune.c, here as static partition elimination).
+                # One survivor binds as a plain table — the FQS and
+                # device-mesh fast paths stay available; several bind
+                # as a UNION ALL over the children.
+                from ..parallel.partition import prune_partitions
+                pinfo = self.catalog.partitioned[item.name]
+                ptd = self._table(item.name)
+                key_t = ptd.column(pinfo["key"]).type
+                alias = item.alias or item.name
+                names = prune_partitions(pinfo, key_t, stmt.where,
+                                         alias)
+                if len(names) == 1:
+                    td = self._table(names[0])
+                    self._check_dup_alias(rtable, alias)
+                    cols = {c.name: (f"{alias}.{c.name}", c.type)
+                            for c in td.columns}
+                    rtable.append(RTE(alias, "table", table=td,
+                                      columns=cols))
+                elif not names:
+                    # nothing survives: the (empty) parent store scans
+                    self._check_dup_alias(rtable, alias)
+                    cols = {c.name: (f"{alias}.{c.name}", c.type)
+                            for c in ptd.columns}
+                    rtable.append(RTE(alias, "table", table=ptd,
+                                      columns=cols))
+                else:
+                    branches = [A.SelectStmt(
+                        items=[A.SelectItem(A.Star())],
+                        from_=[A.TableRef(nm)]) for nm in names]
+                    for cur, nxt in zip(branches, branches[1:]):
+                        cur.setop = ("union", True, nxt)
+                    sub = self.bind_select(branches[0])
+                    self._append_subquery_rte(rtable, sub, alias)
             elif isinstance(item, A.TableRef):
                 td = self._table(item.name)
                 alias = item.alias or item.name

@@ -281,6 +281,19 @@ class CreateTableStmt(Node):
     dist_cols: list[str] = dataclasses.field(default_factory=list)
     group: Optional[str] = None
     if_not_exists: bool = False
+    # PARTITION BY RANGE|LIST (col) — reference: pg_partitioned_table
+    partition_by: Optional[tuple[str, str]] = None   # (method, col)
+
+
+@dataclasses.dataclass
+class CreatePartitionStmt(Node):
+    """CREATE TABLE name PARTITION OF parent FOR VALUES
+    FROM (lit) TO (lit) | IN (lit, ...)."""
+    name: str
+    parent: str
+    from_value: Optional[Node] = None
+    to_value: Optional[Node] = None
+    in_values: Optional[list[Node]] = None
 
 
 @dataclasses.dataclass

@@ -692,13 +692,36 @@ class Parser:
             return A.CreateSubscriptionStmt(name, conn.value, pub)
         raise SqlSyntaxError("unsupported CREATE", self.sql, self.tok.pos)
 
-    def create_table_tail(self) -> A.CreateTableStmt:
+    def create_table_tail(self) -> A.Node:
         if_not_exists = False
         if self.accept_kw("if"):
             self.expect_kw("not")
             self.expect_kw("exists")
             if_not_exists = True
         name = self.ident()
+        if self.accept_kw("partition"):
+            # CREATE TABLE name PARTITION OF parent FOR VALUES ...
+            self.expect_kw("of")
+            parent = self.ident()
+            self.expect_kw("for")
+            self.expect_kw("values")
+            if self.accept_kw("from"):
+                self.expect_op("(")
+                fv = self.expr()
+                self.expect_op(")")
+                self.expect_kw("to")
+                self.expect_op("(")
+                tv = self.expr()
+                self.expect_op(")")
+                return A.CreatePartitionStmt(name, parent, fv, tv)
+            self.expect_kw("in")
+            self.expect_op("(")
+            vals = [self.expr()]
+            while self.accept_op(","):
+                vals.append(self.expr())
+            self.expect_op(")")
+            return A.CreatePartitionStmt(name, parent,
+                                         in_values=vals)
         self.expect_op("(")
         columns: list[A.ColumnDefAst] = []
         pk: list[str] = []
@@ -736,6 +759,18 @@ class Parser:
         if self.accept_kw("to"):
             self.expect_kw("group")
             group = self.ident()
+        partition_by = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            method = self.ident()
+            if method not in ("range", "list"):
+                raise SqlSyntaxError(
+                    f"unsupported partition method {method!r}",
+                    self.sql, self.tok.pos)
+            self.expect_op("(")
+            pcol = self.ident()
+            self.expect_op(")")
+            partition_by = (method, pcol)
         if not pk:
             pk = [c.name for c in columns if c.primary_key]
         if not dist_cols and dist_type in ("shard", "hash", "modulo"):
@@ -744,7 +779,7 @@ class Parser:
             dist_cols = [pk[0]] if pk else \
                 ([columns[0].name] if columns else [])
         return A.CreateTableStmt(name, columns, pk, dist_type, dist_cols,
-                                 group, if_not_exists)
+                                 group, if_not_exists, partition_by)
 
     def column_def(self) -> A.ColumnDefAst:
         name = self.ident()
