@@ -71,6 +71,10 @@ class TableDef:
     columns: list[ColumnDef]
     distribution: Distribution
     oid: int = 0
+    # CHECK constraint expression texts (reference: pg_constraint 'c')
+    checks: list = dataclasses.field(default_factory=list)
+    # foreign keys: {"cols": [...], "ref_table": str, "ref_cols": [...]}
+    fks: list = dataclasses.field(default_factory=list)
 
     def column(self, name: str) -> ColumnDef:
         for c in self.columns:
@@ -88,14 +92,16 @@ class TableDef:
     def to_json(self):
         return {"name": self.name, "oid": self.oid,
                 "columns": [c.to_json() for c in self.columns],
-                "distribution": self.distribution.to_json()}
+                "distribution": self.distribution.to_json(),
+                "checks": list(self.checks), "fks": list(self.fks)}
 
     @staticmethod
     def from_json(d):
         return TableDef(d["name"],
                         [ColumnDef.from_json(c) for c in d["columns"]],
                         Distribution.from_json(d["distribution"]),
-                        d.get("oid", 0))
+                        d.get("oid", 0), list(d.get("checks", [])),
+                        list(d.get("fks", [])))
 
 
 @dataclasses.dataclass

@@ -23,14 +23,17 @@ import sys
 def cmd_init(args):
     os.makedirs(args.dir, exist_ok=True)
     cfg = {"datanodes": args.datanodes, "gtm_port": args.gtm_port,
-           "dn_base_port": args.dn_base_port}
+           "dn_base_port": args.dn_base_port, "cn_port": args.cn_port}
     with open(os.path.join(args.dir, "cluster.json"), "w") as f:
         json.dump(cfg, f, indent=2)
     # build the initial catalog (node registry + shard map)
     from ..parallel.cluster import Cluster
     Cluster(n_datanodes=args.datanodes, datadir=args.dir).checkpoint()
+    from ..net.cn_server import default_users_path, write_users
+    write_users(default_users_path(args.dir),
+                {args.user: args.password})
     print(f"initialized cluster dir {args.dir} "
-          f"({args.datanodes} datanodes)")
+          f"({args.datanodes} datanodes, sql user {args.user!r})")
 
 
 def _load_cfg(d):
@@ -61,8 +64,21 @@ def cmd_start(args):
         srv = factories[i]()
         servers.append(srv)
         print(f"dn{i} listening on {srv.host}:{srv.port}")
+    # client-facing SQL listener over the started TCP datanodes
+    from ..exec.dist_session import ClusterSession
+    from ..net.cn_server import CnServer, default_users_path
+    from ..parallel.cluster import Cluster
+    cluster = Cluster.connect(catalog_path,
+                              [(s.host, s.port) for s in servers],
+                              (gtm.host, gtm.port))
+    users = default_users_path(args.dir)
+    cn = CnServer(lambda: ClusterSession(cluster),
+                  users_path=users if os.path.exists(users) else None,
+                  port=cfg.get("cn_port", 7900)).start()
+    print(f"cn listening on {cn.host}:{cn.port}")
     addrs = {"gtm": [gtm.host, gtm.port],
-             "datanodes": [[s.host, s.port] for s in servers]}
+             "datanodes": [[s.host, s.port] for s in servers],
+             "cn": [cn.host, cn.port]}
     with open(os.path.join(args.dir, "addresses.json"), "w") as f:
         json.dump(addrs, f)
     print("cluster up (supervised); ^C to stop")
@@ -152,6 +168,8 @@ def _connect(args):
 
 
 def cmd_shell(args):
+    if getattr(args, "connect", None):
+        return _remote_shell(args)
     s = _connect(args)
     print("opentenbase_tpu shell — \\q to quit")
     buf = []
@@ -182,6 +200,47 @@ def cmd_shell(args):
                           + (f" {r.rowcount}" if r.rowcount else ""))
         except Exception as e:
             print(f"ERROR: {type(e).__name__}: {e}")
+
+
+def _remote_shell(args):
+    """Wire-protocol client shell: connects to a CN server like psql
+    connects to a backend (reference: src/bin/psql over libpq)."""
+    from ..net.cn_server import CnClient
+    host, port = args.connect.rsplit(":", 1)
+    c = CnClient(host, int(port), user=args.user,
+                 password=args.password)
+    print(f"connected to {args.connect} as {args.user} — \\q to quit")
+    buf = []
+    while True:
+        try:
+            line = input("otb=# " if not buf else "otb-# ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            c.close()
+            return
+        if line.strip() in ("\\q", "exit", "quit"):
+            c.close()
+            return
+        buf.append(line)
+        if not line.rstrip().endswith(";"):
+            continue
+        sql = "\n".join(buf)
+        buf = []
+        try:
+            for r in c.execute(sql):
+                if r["names"]:
+                    print(" | ".join(r["names"]))
+                    print("-+-".join("-" * len(n) for n in r["names"]))
+                    for row in r["rows"]:
+                        print(" | ".join(str(v) for v in row))
+                    print(f"({len(r['rows'])} row"
+                          f"{'s' if len(r['rows']) != 1 else ''})")
+                else:
+                    print(r["command"]
+                          + (f" {r['rowcount']}" if r["rowcount"]
+                             else ""))
+        except RuntimeError as e:
+            print(f"ERROR: {e}")
 
 
 def cmd_restore(args):
@@ -237,12 +296,18 @@ def main(argv=None):
     p.add_argument("--datanodes", type=int, default=2)
     p.add_argument("--gtm-port", type=int, default=7777)
     p.add_argument("--dn-base-port", type=int, default=7800)
+    p.add_argument("--cn-port", type=int, default=7900)
+    p.add_argument("--user", default="otb")
+    p.add_argument("--password", default="otb")
     p.set_defaults(fn=cmd_init)
     p = sub.add_parser("start")
     p.add_argument("dir")
     p.set_defaults(fn=cmd_start)
     p = sub.add_parser("shell")
-    p.add_argument("dir")
+    p.add_argument("dir", nargs="?", default=".")
+    p.add_argument("--connect", help="host:port of a running CN server")
+    p.add_argument("--user", default="otb")
+    p.add_argument("--password", default="otb")
     p.set_defaults(fn=cmd_shell)
     p = sub.add_parser("status")
     p.add_argument("dir")

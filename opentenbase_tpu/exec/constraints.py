@@ -1,0 +1,188 @@
+"""Constraint enforcement: NOT NULL, CHECK, FOREIGN KEY.
+
+Reference analog: ExecConstraints (executor/execMain.c) for NOT
+NULL/CHECK and RI_FKey_check triggers (utils/adt/ri_triggers.c) for
+foreign keys.
+
+TPU-first shape: instead of per-tuple trigger firings, validation is
+SET-BASED — one engine query per constraint per statement, running
+inside the writing transaction (it sees the txn's own rows through
+normal MVCC).  A CHECK is `count(rows where NOT expr)`; a FOREIGN KEY
+is one anti-join (`child LEFT JOIN parent ... WHERE parent IS NULL`).
+Both compile onto the same device data plane as user queries, so
+constraint checking is columnar and batched, not per-row host work.
+NULL CHECK results pass (SQL: only definite FALSE violates); NULL FK
+values pass (MATCH SIMPLE).
+"""
+
+from __future__ import annotations
+
+from ..sql import ast as A
+from ..sql.parser import Parser
+from .executor import ExecError
+
+
+class ConstraintViolation(ExecError):
+    pass
+
+
+_check_cache: dict[tuple, A.Node] = {}
+
+
+def _parse_check(table: str, src: str) -> A.Node:
+    key = (table, src)
+    expr = _check_cache.get(key)
+    if expr is None:
+        expr = Parser(src).expr()
+        _check_cache[key] = expr
+        if len(_check_cache) > 512:
+            _check_cache.pop(next(iter(_check_cache)))
+    return expr
+
+
+def check_not_null(td, coldata: dict, n: int):
+    """Host-side scan of the incoming column data (the one per-value
+    pass that cannot be a query — the rows aren't stored yet)."""
+    import numpy as np
+    for c in td.columns:
+        if c.nullable or c.name not in coldata:
+            continue
+        vals = coldata[c.name]
+        if isinstance(vals, np.ndarray):
+            bad = vals.dtype == object and any(v is None for v in vals)
+        else:
+            bad = any(v is None for v in vals)
+        if bad:
+            raise ConstraintViolation(
+                f"null value in column {c.name!r} of relation "
+                f"{td.name!r} violates not-null constraint")
+
+
+def validate_after_write(run_query, catalog, table: str,
+                         kind: str = "insert"):
+    """Run every CHECK and FK that a write of `kind` to `table` could
+    violate, via `run_query(select_stmt) -> rows` executing INSIDE the
+    writing transaction.  An INSERT can break the table's own CHECKs
+    and its child-role FKs; a DELETE can only orphan OTHER tables'
+    rows (parent-role).  UPDATE runs both legs through its
+    delete+insert decomposition."""
+    td = catalog.table(table)
+    if kind == "insert":
+        for src in td.checks:
+            expr = _parse_check(td.name, src)
+            sel = A.SelectStmt(
+                items=[A.SelectItem(
+                    A.FuncCall("count", [], star=True))],
+                from_=[A.TableRef(td.name)],
+                where=A.UnaryOp("not", expr))
+            bad = run_query(sel)[0][0]
+            if bad:
+                raise ConstraintViolation(
+                    f"new row for relation {td.name!r} violates check "
+                    f"constraint ({src}) [{bad} row(s)]")
+        # FKs where `table` is the child
+        _fk_orphan_checks(run_query, catalog, td, td.fks)
+        return
+    # delete: FKs where `table` is the referenced parent
+    for other in catalog.tables.values():
+        refs = [fk for fk in other.fks if fk["ref_table"] == table]
+        if refs and other.name != table:
+            _fk_orphan_checks(run_query, catalog, other, refs)
+
+
+def _fk_orphan_checks(run_query, catalog, child_td, fks):
+    for fk in fks:
+        if fk["ref_table"] not in catalog.tables:
+            raise ConstraintViolation(
+                f"referenced table {fk['ref_table']!r} does not exist")
+        eqs = [A.BinOp("=", A.ColRef(("__c", fc)),
+                       A.ColRef(("__p", rc)))
+               for fc, rc in zip(fk["cols"], fk["ref_cols"])]
+        on = eqs[0] if len(eqs) == 1 else A.BoolExpr("and", eqs)
+        conds = [A.NullTest(A.ColRef(("__c", fc)), False)
+                 for fc in fk["cols"]]
+        # orphans: child rows with non-NULL keys and no parent match
+        conds.append(A.NullTest(A.ColRef(("__p", fk["ref_cols"][0])),
+                                True))
+        where = conds[0] if len(conds) == 1 \
+            else A.BoolExpr("and", conds)
+        sel = A.SelectStmt(
+            items=[A.SelectItem(A.FuncCall("count", [], star=True))],
+            from_=[A.JoinRef(
+                "left",
+                A.TableRef(child_td.name, alias="__c"),
+                A.TableRef(fk["ref_table"], alias="__p"),
+                on)],
+            where=where)
+        orphans = run_query(sel)[0][0]
+        if orphans:
+            raise ConstraintViolation(
+                f"insert or update on table {child_td.name!r} "
+                f"violates foreign key constraint: {orphans} row(s) "
+                f"reference missing {fk['ref_table']}"
+                f"({', '.join(fk['ref_cols'])})")
+
+
+def tables_needing_validation(catalog, table: str,
+                              kind: str = "insert") -> bool:
+    """Fast gate: does a write of `kind` to `table` require any
+    query-based validation at all?  (The common constraint-free path
+    must not pay a catalog scan per insert.)"""
+    td = catalog.table(table)
+    if kind == "insert":
+        return bool(td.checks or td.fks)
+    return any(fk["ref_table"] == table
+               for other in catalog.tables.values()
+               for fk in other.fks)
+
+
+def drop_guards(catalog, table: str):
+    """DROP TABLE of an FK-referenced parent would poison every later
+    write to the children (reference: dependency.c DEPENDENCY_NORMAL
+    restrict)."""
+    for other in catalog.tables.values():
+        if other.name != table and any(
+                fk["ref_table"] == table for fk in other.fks):
+            raise ConstraintViolation(
+                f"cannot drop table {table!r}: referenced by a "
+                f"foreign key on {other.name!r}")
+
+
+def column_drop_guards(catalog, table: str, column: str):
+    """A column used by a CHECK or FOREIGN KEY cannot be dropped or
+    renamed (no DROP CONSTRAINT surface to recover with)."""
+    td = catalog.table(table)
+    for src in td.checks:
+        expr = _parse_check(td.name, src)
+        cols = {c.split(".", 1)[-1] for c in _expr_col_names(expr)}
+        if column in cols:
+            raise ConstraintViolation(
+                f"cannot drop column {column!r}: used by check "
+                f"constraint ({src})")
+    for fk in td.fks:
+        if column in fk["cols"]:
+            raise ConstraintViolation(
+                f"cannot drop column {column!r}: part of a foreign "
+                "key")
+    for other in catalog.tables.values():
+        for fk in other.fks:
+            if fk["ref_table"] == table and column in fk["ref_cols"]:
+                raise ConstraintViolation(
+                    f"cannot drop column {column!r}: referenced by a "
+                    f"foreign key on {other.name!r}")
+
+
+def _expr_col_names(node) -> set:
+    out = set()
+    stack = [node]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, A.ColRef):
+            out.add(x.parts[-1])
+            continue
+        if hasattr(x, "__dataclass_fields__"):
+            for f in x.__dataclass_fields__:
+                stack.append(getattr(x, f))
+        elif isinstance(x, (list, tuple)):
+            stack.extend(x)
+    return out

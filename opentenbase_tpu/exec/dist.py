@@ -130,8 +130,12 @@ def _to_device(hb: HostBatch) -> DBatch:
 
 class DistExecutor:
     def __init__(self, cluster: Cluster, snapshot_ts: int, txid: int,
-                 instrument: bool = False, use_mesh: bool = False):
+                 instrument: bool = False, use_mesh: bool = False,
+                 cancel_check=None):
         self.cluster = cluster
+        # statement-cancel probe (reference: CHECK_FOR_INTERRUPTS at the
+        # executor's safe points) — raises when the client canceled
+        self.cancel_check = cancel_check
         self.snapshot_ts = snapshot_ts
         self.txid = txid
         self.params: dict[str, tuple] = {}
@@ -150,6 +154,8 @@ class DistExecutor:
 
     # ------------------------------------------------------------------
     def run(self, dp: DistPlan) -> DBatch:
+        if self.cancel_check is not None:
+            self.cancel_check()
         for ip in dp.init_plans:
             # init plans are whole little queries: distribute + run them
             from ..plan.distribute import Distributor
@@ -250,6 +256,8 @@ class DistExecutor:
         for frag in dp.fragments:
             if frag.index == dp.top_fragment:
                 continue
+            if self.cancel_check is not None:
+                self.cancel_check()
             self._feed_exchanges(frag, dp, ex_out)
         top = dp.fragments[dp.top_fragment]
         return self._exec_fragment_on(top, dp, "cn", ex_out)
@@ -262,6 +270,31 @@ class DistExecutor:
                      if ex.source_fragment == frag.index]
         only_one = consumers and all(ex.kind == "gather_one"
                                      for ex in consumers)
+        # a fragment whose inputs were GATHERED lives on the CN: run it
+        # once there and fan its output back out (reference: the CN
+        # materializing a step other fragments consume — e.g. a set-op
+        # combine feeding a redistribution, execRemote.c merge then
+        # re-ship).  Slower than a true per-DN pipeline but correct for
+        # every plan shape; the mesh tier declines these plans.
+        needed = {n.index for n in _walk_plan(frag.plan)
+                  if isinstance(n, ExchangeRef)}
+        cn_fed = needed and all((i, "cn") in ex_out for i in needed)
+        if cn_fed:
+            batch = self._exec_fragment_on(frag, dp, "cn", ex_out)
+            hb = _to_host(batch)
+            for ex in consumers:
+                if ex.kind in ("gather", "gather_one"):
+                    ex_out[(ex.index, "cn")] = hb
+                elif ex.kind == "broadcast":
+                    for d in range(self.cluster.ndn):
+                        ex_out[(ex.index, d)] = hb
+                elif ex.kind == "redistribute":
+                    routed = self._route([hb], ex.keys)
+                    for d in range(self.cluster.ndn):
+                        ex_out[(ex.index, d)] = routed[d]
+                else:
+                    raise ExecError(f"unknown exchange kind {ex.kind}")
+            return
         dn_range = [0] if only_one else list(range(self.cluster.ndn))
         remote = all(not hasattr(dn, "stores")
                      for dn in self.cluster.datanodes)

@@ -82,6 +82,7 @@ class ClusterTxn:
         self.snapshot_ts = snapshot_ts
         self.written_dns: set[int] = set()   # 2PC participant tracking
         self.explicit = False
+        self.savepoints: dict = {}      # name -> {dn_index: op mark}
 
 
 class ClusterSession:
@@ -101,6 +102,15 @@ class ClusterSession:
         # named prepared statements + plan-cache telemetry
         self.prepared: dict[str, Prepared] = {}
         self.plan_cache_hits = 0
+        # out-of-band statement cancel (set by the CN server's cancel
+        # protocol; reference: CHECK_FOR_INTERRUPTS / StatementCancel)
+        self.cancel_event = None
+
+    def _check_cancel(self):
+        ev = self.cancel_event
+        if ev is not None and ev.is_set():
+            ev.clear()
+            raise ExecError("canceling statement due to user request")
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> list[Result]:
@@ -109,21 +119,32 @@ class ClusterSession:
             if self.cluster.gucs.get("audit_enabled", "off") == "on" \
             else None
         for s in parse_sql(sql):
+            self._check_cancel()
             if self.txn is not None and self.txn_aborted \
-                    and not isinstance(s, A.TxnStmt):
+                    and not isinstance(s, A.TxnStmt) \
+                    and not (isinstance(s, A.SavepointStmt)
+                             and s.op == "rollback_to"):
                 # PG semantics: after an error the txn is poisoned —
                 # only COMMIT (which rolls back) or ROLLBACK may follow
                 raise ExecError(
                     "current transaction is aborted, commands ignored "
                     "until end of transaction block")
             try:
-                r = self._exec_stmt(s)
+                r = self._exec_retryable(s)
             except Exception as e:
-                if self.txn is not None:
-                    # a failed statement aborts the explicit txn: its
-                    # earlier (and possibly partially-staged) writes
-                    # must never COMMIT (PG: aborted-transaction state)
+                if self.txn is not None and not self.txn_aborted \
+                        and not isinstance(s, A.TxnStmt):
+                    # a failed statement aborts the explicit txn NOW —
+                    # writes revert and row locks release immediately
+                    # (PG: AbortCurrentTransaction on error); the
+                    # session stays poisoned until COMMIT/ROLLBACK.
+                    # A failure INSIDE commit/rollback is excluded
+                    # (2PC outcome belongs to recovery), and live
+                    # savepoints keep the txn alive for ROLLBACK TO
                     self.txn_aborted = True
+                    if not getattr(self.txn, "savepoints", None):
+                        self._abort(self.txn)
+                        self.txn.rolled_back = True
                 if audit:
                     audit.record(type(s).__name__, str(e), ok=False)
                 raise
@@ -134,6 +155,23 @@ class ClusterSession:
 
     def query(self, sql: str) -> list[tuple]:
         return self.execute(sql)[-1].rows
+
+    def _exec_retryable(self, s: A.Node) -> Result:
+        """READ COMMITTED re-check for implicit statements: a
+        concurrent committed writer triggers a whole-statement retry
+        under a FRESH snapshot; explicit (REPEATABLE READ-like) txns
+        surface PG's serialization error instead."""
+        from ..storage.store import SerializationConflict
+        for _attempt in range(100):
+            try:
+                return self._exec_stmt(s)
+            except SerializationConflict as e:
+                if self.txn is not None:
+                    raise ExecError(str(e)) from None
+                continue
+        raise ExecError(
+            "could not serialize access due to concurrent update "
+            "(retries exhausted)")
 
     # ---- txn helpers ----
     def _begin_implicit(self) -> tuple[ClusterTxn, bool]:
@@ -194,6 +232,9 @@ class ClusterSession:
             c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
             return Result("CREATE TABLE")
         if isinstance(stmt, A.DropTableStmt):
+            if stmt.name in c.catalog.tables:
+                from .constraints import drop_guards
+                drop_guards(c.catalog, stmt.name)
             pinfo = c.catalog.partitioned.get(stmt.name)
             if pinfo is not None:
                 for p in list(pinfo["parts"]):
@@ -392,7 +433,103 @@ class ClusterSession:
                 raise ExecError(
                     f"prepared statement {stmt.name!r} does not exist")
             return Result("DEALLOCATE")
+        if isinstance(stmt, A.TruncateStmt):
+            return self._exec_truncate(stmt)
+        if isinstance(stmt, A.SavepointStmt):
+            return self._exec_savepoint(stmt)
+        if isinstance(stmt, A.MergeStmt):
+            return self._exec_merge(stmt)
         raise ExecError(f"unsupported statement {type(stmt).__name__}")
+
+    # ---- TRUNCATE: DDL-style fan-out to every datanode ----
+    def _exec_truncate(self, stmt: A.TruncateStmt) -> Result:
+        c = self.cluster
+        c.catalog.table(stmt.table)
+        if self.txn is not None:
+            raise ExecError("TRUNCATE cannot run inside a transaction "
+                            "block (non-MVCC bulk clear)")
+        for other in c.catalog.tables.values():
+            if other.name != stmt.table and any(
+                    fk["ref_table"] == stmt.table for fk in other.fks):
+                raise ExecError(
+                    f"cannot truncate {stmt.table!r}: referenced by a "
+                    f"foreign key on {other.name!r}")
+        names = [stmt.table]
+        if stmt.table in c.catalog.partitioned:
+            names += [p["name"]
+                      for p in c.catalog.partitioned[stmt.table]["parts"]]
+        for nm in names:
+            for dn in c.datanodes:
+                dn.truncate(nm)
+        return Result("TRUNCATE TABLE")
+
+    # ---- SAVEPOINT / ROLLBACK TO / RELEASE: per-DN span markers
+    # (reference: subxact machinery, xact.c; the CN records each DN's
+    # op-list position, ROLLBACK TO reverts past it on every DN) ----
+    def _exec_savepoint(self, stmt: A.SavepointStmt) -> Result:
+        t = self.txn
+        if t is None or not t.explicit:
+            raise ExecError(f"{stmt.op.replace('_', ' ').upper()} can "
+                            "only be used in transaction blocks")
+        c = self.cluster
+        if not hasattr(t, "savepoints"):
+            t.savepoints = {}
+        if stmt.op == "savepoint":
+            t.savepoints[stmt.name] = {
+                dn.index: dn.savepoint_mark(t.txid)
+                for dn in c.datanodes}
+            return Result("SAVEPOINT")
+        if stmt.name not in t.savepoints:
+            raise ExecError(f"savepoint {stmt.name!r} does not exist")
+        if stmt.op == "release":
+            drop = False
+            for nm in list(t.savepoints):
+                if nm == stmt.name:
+                    drop = True
+                if drop:
+                    del t.savepoints[nm]
+            return Result("RELEASE")
+        marks = t.savepoints[stmt.name]
+        for dn in c.datanodes:
+            dn.rollback_to_mark(t.txid, marks[dn.index])
+        drop = False
+        for nm in list(t.savepoints):
+            if drop:
+                del t.savepoints[nm]
+            if nm == stmt.name:
+                drop = True
+        self.txn_aborted = False
+        return Result("ROLLBACK")
+
+    # ---- MERGE: the set-wise decomposition is shared with the
+    # single-node session (duck-typed on _exec_stmt/_merge_insert) ----
+    def _exec_merge(self, stmt: A.MergeStmt) -> Result:
+        from .session import Session
+        tgt, tkey, skey = Session._merge_parts(self, stmt)
+        t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
+        self.cluster.active_txns.add(t.txid)
+        total = 0
+        try:
+            total = Session._merge_steps(self, stmt, tgt, tkey, skey)
+        except Exception:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit(t)
+        return Result("MERGE", rowcount=total)
+
+    def _merge_insert(self, td, coldata, n, cols=None):
+        # partition-aware: route through the same paths INSERT uses
+        if td.name in self.cluster.catalog.partitioned:
+            self._insert_partitioned(td.name, coldata, n)
+            return
+        self._check_partition_bound(td.name, coldata, n)
+        self._insert_rows(td, coldata, n)
 
     # ---- prepared statements / OLTP fast path ----
     def _ddl_gen(self) -> int:
@@ -554,6 +691,7 @@ class ClusterSession:
             queue.acquire()
         try:
             ex = DistExecutor(self.cluster, txn.snapshot_ts, txn.txid,
+                              cancel_check=self._check_cancel,
                               instrument=instrument,
                               use_mesh=self.cluster.gucs.get(
                                   "enable_mesh_exchange", "on") != "off")
@@ -574,6 +712,8 @@ class ClusterSession:
 
     def _exec_select(self, stmt: A.SelectStmt,
                      instrument: bool = False) -> tuple:
+        if stmt.for_update:
+            return self._exec_select_for_update(stmt)
         self._refresh_stat_views(stmt)
         t, implicit = self._begin_implicit()
         dp = self._plan_distributed(stmt, txn=t)
@@ -582,9 +722,70 @@ class ClusterSession:
             return res, ex, dp
         return res
 
+    def _exec_select_for_update(self, stmt: A.SelectStmt) -> Result:
+        """Cluster SELECT ... FOR UPDATE [NOWAIT]: lock matching rows
+        on every datanode holding the table (lock_where RPC, waits
+        ride the DN lock managers), then read under the same snapshot
+        (reference: RowMarkClause shipped in the RemoteQuery,
+        nodeLockRows.c on each DN)."""
+        if (len(stmt.from_) != 1
+                or not isinstance(stmt.from_[0], A.TableRef)
+                or stmt.group_by or stmt.group_sets or stmt.setop
+                or stmt.distinct or stmt.ctes or stmt.having):
+            raise ExecError(
+                "FOR UPDATE is only supported on a single-table "
+                "SELECT without aggregation/set operations")
+        c = self.cluster
+        table = stmt.from_[0].name
+        td = c.catalog.table(table)
+        c.ensure_gdd()
+        quals = []
+        if stmt.where is not None:
+            quals = Binder(c.catalog).bind_select(
+                A.SelectStmt(items=[A.SelectItem(A.Star())],
+                             from_=[A.TableRef(table)],
+                             where=stmt.where)).where
+        t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
+        c.active_txns.add(t.txid)
+        try:
+            for dn in c.datanodes:
+                n = dn.lock_where(td.name, quals, t.snapshot_ts,
+                                  t.txid, stmt.for_update == "nowait")
+                if n:
+                    # lock spans must be cleared at txn end on that DN
+                    t.written_dns.add(dn.index)
+            r = self._exec_select(
+                dataclasses.replace(stmt, for_update=None))
+        except Exception:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit(t)
+        return r
+
     # ---- ALTER TABLE: catalog change + DDL fan-out to every DN
     # (reference: utility.c remote DDL broadcast of ATExecCmd) ----
     def _exec_alter(self, stmt: A.AlterTableStmt) -> Result:
+        c = self.cluster
+        if stmt.table in c.catalog.partitioned:
+            if stmt.action == "rename_table":
+                raise ExecError("renaming a partitioned table is not "
+                                "supported")
+            # DDL recurses to every partition (reference: ATExecCmd
+            # recursing over inheritance children)
+            r = self._exec_alter_one(stmt)
+            for part in c.catalog.partitioned[stmt.table]["parts"]:
+                self._exec_alter_one(
+                    dataclasses.replace(stmt, table=part["name"]))
+            return r
+        return self._exec_alter_one(stmt)
+
+    def _exec_alter_one(self, stmt: A.AlterTableStmt) -> Result:
         from .session import Session
         c = self.cluster
         Session._alter_guards(c.catalog, stmt)
@@ -634,8 +835,9 @@ class ClusterSession:
         if stmt.select is not None:
             dp = self._plan_distributed(stmt.select)
             t0, _ = self._begin_implicit()
-            batch = DistExecutor(self.cluster, t0.snapshot_ts,
-                                 t0.txid).run(dp)
+            batch = DistExecutor(
+                self.cluster, t0.snapshot_ts, t0.txid,
+                cancel_check=self._check_cancel).run(dp)
             _, rows = materialize(batch, dp.output_names)
         else:
             rows = []
@@ -670,11 +872,22 @@ class ClusterSession:
                                 "parent is not supported")
             return self._insert_partitioned(stmt.table, coldata,
                                             len(rows))
+        self._check_partition_bound(stmt.table, coldata, len(rows))
         if stmt.on_conflict is not None:
             return self._exec_upsert(td, stmt.on_conflict, coldata,
                                      len(rows))
         n = self._insert_rows(td, coldata, len(rows))
         return Result("INSERT", rowcount=n)
+
+    def _check_partition_bound(self, table: str, coldata: dict, n: int):
+        """Reject rows outside a partition child's declared bounds
+        (reference: ExecPartitionCheck; the single-node session's twin)."""
+        from ..parallel.partition import (PartitionError,
+                                          check_child_bounds)
+        try:
+            check_child_bounds(self.cluster.catalog, table, coldata, n)
+        except PartitionError as e:
+            raise ExecError(str(e)) from None
 
     def _insert_partitioned(self, parent: str, coldata: dict,
                             n: int) -> Result:
@@ -723,10 +936,15 @@ class ClusterSession:
             self.txn = t
         total = 0
         try:
+            from ..parallel.partition import rewrite_parent_refs
             for nm in names:
-                child_stmt = A.UpdateStmt(nm, stmt.assignments,
-                                          stmt.where) if is_update \
-                    else A.DeleteStmt(nm, stmt.where)
+                w = rewrite_parent_refs(stmt.where, stmt.table, nm)
+                if is_update:
+                    asg = [(cn, rewrite_parent_refs(e, stmt.table, nm))
+                           for cn, e in stmt.assignments]
+                    child_stmt = A.UpdateStmt(nm, asg, w)
+                else:
+                    child_stmt = A.DeleteStmt(nm, w)
                 total += self._exec_stmt(child_stmt).rowcount
         except Exception:
             if implicit:
@@ -960,7 +1178,27 @@ class ClusterSession:
         raise ExecError("ON CONFLICT DO UPDATE supports literals, "
                         "excluded.col, and plain column references")
 
+    def _run_check_query(self, sel: A.SelectStmt, t) -> list:
+        """Constraint-validation SELECT inside txn `t` (cluster twin of
+        the single-node session's helper)."""
+        dp = self._plan_distributed(sel, txn=t)
+        batch = DistExecutor(self.cluster, t.snapshot_ts, t.txid).run(dp)
+        _, rows = materialize(batch, dp.output_names)
+        return rows
+
+    def _validate_write(self, table: str, t, kind: str = "insert"):
+        from .constraints import (tables_needing_validation,
+                                  validate_after_write)
+        if not tables_needing_validation(self.cluster.catalog, table,
+                                         kind):
+            return
+        validate_after_write(
+            lambda sel: self._run_check_query(sel, t),
+            self.cluster.catalog, table, kind)
+
     def _insert_rows(self, td: TableDef, coldata: dict, n: int) -> int:
+        from .constraints import check_not_null
+        check_not_null(td, coldata, n)
         c = self.cluster
         t, implicit = self._begin_implicit()
         if implicit:
@@ -1014,6 +1252,7 @@ class ClusterSession:
                                                t)
                     except gindex.GIndexError as e:
                         raise ExecError(str(e)) from None
+            self._validate_write(td.name, t)
         except Exception:
             if implicit:
                 self.txn = None
@@ -1030,6 +1269,7 @@ class ClusterSession:
         if stmt.table in c.catalog.partitioned:
             return self._partition_dml_fanout(stmt)
         td = c.catalog.table(stmt.table)
+        c.ensure_gdd()
         t, implicit = self._begin_implicit()
         if implicit:
             self.txn = t
@@ -1054,6 +1294,8 @@ class ClusterSession:
             if has_gidx and n_deleted:
                 # mapping entries follow the base rows in the SAME txn
                 gindex.resync_keys(self, td, affected, t)
+            if n_deleted:
+                self._validate_write(td.name, t, kind="delete")
         except Exception:
             if implicit:
                 self.txn = None
@@ -1083,9 +1325,27 @@ class ClusterSession:
         if implicit:
             self.txn = t
         try:
+            # lock target rows FIRST so concurrent updaters queue on the
+            # row locks instead of optimistically racing the read-write
+            # window (reference: heap_update taking the tuple lock before
+            # constructing the new version) — this is what makes
+            # concurrent increments lose zero updates
+            c = self.cluster
+            c.ensure_gdd()
+            quals = []
+            if stmt.where is not None:
+                quals = Binder(c.catalog).bind_select(
+                    A.SelectStmt(items=[A.SelectItem(A.Star())],
+                                 from_=[A.TableRef(stmt.table)],
+                                 where=stmt.where)).where
+            for dn in c.datanodes:
+                if dn.lock_where(td.name, quals, t.snapshot_ts,
+                                 t.txid, False):
+                    t.written_dns.add(dn.index)
             dp = self._plan_distributed(sel)
-            batch = DistExecutor(self.cluster, t.snapshot_ts,
-                                 t.txid).run(dp)
+            batch = DistExecutor(
+                self.cluster, t.snapshot_ts, t.txid,
+                cancel_check=self._check_cancel).run(dp)
             names, rows = materialize(batch, dp.output_names)
             self._exec_delete(A.DeleteStmt(stmt.table, stmt.where))
             if rows:
@@ -1119,6 +1379,11 @@ class ClusterSession:
         from ..storage.loader import load_tbl
         coldata = load_tbl(stmt.filename, td, cols, delim)
         n = len(next(iter(coldata.values())))
+        if stmt.table in self.cluster.catalog.partitioned:
+            return dataclasses.replace(
+                self._insert_partitioned(stmt.table, coldata, n),
+                command="COPY")
+        self._check_partition_bound(stmt.table, coldata, n)
         n = self._insert_rows(td, coldata, n)
         return Result("COPY", rowcount=n)
 
@@ -1135,8 +1400,11 @@ class ClusterSession:
         if stmt.op == "commit":
             if self.txn is not None:
                 if self.txn_aborted:
-                    # COMMIT of an aborted txn rolls back (PG behavior)
-                    self._abort(self.txn)
+                    # COMMIT of an aborted txn rolls back (PG); the
+                    # abort already ran at error time unless savepoints
+                    # kept the txn alive for a possible ROLLBACK TO
+                    if not getattr(self.txn, "rolled_back", False):
+                        self._abort(self.txn)
                     self.txn = None
                     self.txn_aborted = False
                     return Result("ROLLBACK")
@@ -1144,7 +1412,8 @@ class ClusterSession:
                 self.txn = None
             return Result("COMMIT")
         if self.txn is not None:
-            self._abort(self.txn)
+            if not getattr(self.txn, "rolled_back", False):
+                self._abort(self.txn)
             self.txn = None
         self.txn_aborted = False
         return Result("ROLLBACK")

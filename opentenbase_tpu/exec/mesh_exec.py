@@ -91,8 +91,10 @@ _ALLOWED = (P.SeqScan, P.Filter, P.Project, P.HashJoin, P.Agg, P.Sort,
 class MeshRunner:
     def __init__(self, cluster):
         from ..parallel.mesh import make_mesh
-        if any(not hasattr(dn, "stores") for dn in cluster.datanodes):
-            raise MeshUnsupported("datanodes are not in-process")
+        if any(not hasattr(dn, "stores")
+               and not hasattr(dn, "stage_table")
+               for dn in cluster.datanodes):
+            raise MeshUnsupported("datanodes are not mesh-stageable")
         if len(jax.devices()) < cluster.ndn:
             raise MeshUnsupported(
                 f"{cluster.ndn} datanodes but only "
@@ -101,7 +103,9 @@ class MeshRunner:
         self.mesh = make_mesh(cluster.ndn)
         self.axis = self.mesh.axis_names[0]
         self._staged: dict = {}
+        self._snapshots: dict = {}   # (dn_index, table) -> snapshot
         self._programs: dict = {}
+        self._ladder: dict = {}
 
     # ------------------------------------------------------------------
     # plan screening
@@ -116,10 +120,31 @@ class MeshRunner:
             for k in ex.keys or []:
                 if not isinstance(k, (E.Col, E.TextExpr)):
                     raise MeshUnsupported("non-column exchange key")
+        gathers = {ex.index for ex in dp.exchanges
+                   if ex.kind in ("gather", "gather_one")}
         for frag in dp.fragments:
             if frag.index == dp.top_fragment:
                 continue  # CN fragment executes host-side
+            # a gather consumed by a DN fragment means the plan routes
+            # through CN materialization (a set-op combine feeding a
+            # redistribution) — the host tier's CN-mediated path owns
+            # that shape
+            for n in self._walk(frag.plan):
+                if isinstance(n, ExchangeRef) and n.index in gathers:
+                    raise MeshUnsupported(
+                        "gather feeds a non-top fragment")
             self._screen_node(frag.plan)
+
+    @staticmethod
+    def _walk(node):
+        yield node
+        for attr in ("child", "left", "right"):
+            c = getattr(node, attr, None)
+            if c is not None and hasattr(c, "__dataclass_fields__"):
+                yield from MeshRunner._walk(c)
+        for c in getattr(node, "inputs", None) or []:
+            if hasattr(c, "__dataclass_fields__"):
+                yield from MeshRunner._walk(c)
 
     def _screen_node(self, node):
         if not isinstance(node, _ALLOWED):
@@ -141,14 +166,47 @@ class MeshRunner:
     # ------------------------------------------------------------------
     # staging: per-DN host chunks -> sharded device arrays + union dicts
     # ------------------------------------------------------------------
+    def _snapshot(self, dn, name: str) -> dict:
+        """One DN's live columns + dictionaries at its current version —
+        direct for in-process stores, over the wire for TCP datanodes
+        (version-cached, so an unchanged table never re-ships)."""
+        if hasattr(dn, "stores"):
+            st = dn.stores.get(name)
+            if st is None:
+                raise MeshUnsupported(f"table {name} missing on dn")
+            cols = st.host_live_columns([c.name for c in st.td.columns])
+            n = len(next(iter(cols.values()))) if cols \
+                else st.row_count()
+            return {"version": st.version, "count": n, "cols": cols,
+                    "dicts": {c: d.values for c, d in st.dicts.items()},
+                    "null_columns": set(st.null_columns)}
+        key = (dn.index, name)
+        cached = self._snapshots.get(key)
+        ver = dn.table_version(name)
+        if ver is None:
+            raise MeshUnsupported(f"table {name} missing on "
+                                  f"dn{dn.index}")
+        if cached is not None and cached["version"] == ver:
+            return cached
+        snap = dn.stage_table(name)
+        if snap is None:
+            raise MeshUnsupported(f"table {name} missing on "
+                                  f"dn{dn.index}")
+        snap["null_columns"] = set(snap["null_columns"])
+        self._snapshots[key] = snap
+        if len(self._snapshots) > 256:
+            self._snapshots.pop(next(iter(self._snapshots)))
+        return snap
+
     def _stage_table(self, name: str) -> _StagedTable:
-        stores = [dn.stores[name] for dn in self.cluster.datanodes]
-        vkey = tuple(st.version for st in stores)
+        snaps = [self._snapshot(dn, name)
+                 for dn in self.cluster.datanodes]
+        vkey = tuple(s["version"] for s in snaps)
         hit = self._staged.get(name)
         if hit is not None and hit.vkey == vkey:
             return hit
-        td = stores[0].td
-        ndn = len(stores)
+        td = self.cluster.catalog.table(name)
+        ndn = len(snaps)
 
         # union dictionaries + per-store code LUTs
         union_dicts: dict[str, list] = {}
@@ -159,8 +217,8 @@ class MeshRunner:
             values: list[str] = []
             index: dict[str, int] = {}
             col_luts = []
-            for st in stores:
-                vals = st.dicts[c.name].values
+            for s in snaps:
+                vals = s["dicts"].get(c.name, [])
                 lut = np.empty(max(len(vals), 1), dtype=np.int32)
                 for i, v in enumerate(vals):
                     j = index.get(v)
@@ -174,17 +232,16 @@ class MeshRunner:
             luts[c.name] = col_luts
 
         null_columns = set()
-        for st in stores:
-            null_columns |= st.null_columns
+        for s in snaps:
+            null_columns |= s["null_columns"]
 
         per_dn: list[dict[str, np.ndarray]] = []
         counts = []
-        for si, st in enumerate(stores):
+        for si, s in enumerate(snaps):
             # shared host-staging source (storage/store.py), with this
             # node's TEXT codes remapped into the union dictionary
-            cols = st.host_live_columns([c.name for c in td.columns])
-            counts.append(len(next(iter(cols.values())))
-                          if cols else st.row_count())
+            cols = dict(s["cols"])
+            counts.append(s["count"])
             for c in td.columns:
                 if c.type.kind == TypeKind.TEXT and len(cols[c.name]):
                     cols[c.name] = luts[c.name][si][cols[c.name]]
@@ -245,41 +302,60 @@ class MeshRunner:
             h = combine_jax(h, x)
         return h
 
-    def _a2a_batch(self, b, keys, bucket: int):
+    def _a2a_batch(self, b, keys, mult: int):
         """Pack rows per destination + one all_to_all per column.
-        Returns (local redistributed DBatch, overflow scalar)."""
+        Returns (local redistributed DBatch, overflow scalar).
+
+        The per-destination bucket is sized from the SOURCE batch's
+        static padding (not the base table's): `src_pad/ndn * mult`,
+        where `mult` is this exchange's ladder value — 1 assumes a
+        uniform spread, overflow doubles it, and `next_pow2(src_pad)`
+        is an absolute cap at which overflow is impossible (a source
+        shard cannot send more rows than it has).  Packing computes
+        each row's slot with one cumsum per destination — no argsort —
+        and the scatter drops dead rows, so the exchange also compacts."""
         from .executor import DBatch
         ndn = self.cluster.ndn
+        if ndn == 1:
+            # single-node mesh: routing is the identity; no collective
+            return b, jnp.int64(0)
+        src_pad = int(b.valid.shape[0])
+        cap = next_pow2(src_pad)
+        bucket = min(cap, max(64, next_pow2(-(-src_pad // ndn)) * mult))
         h = self._route_hash(b, keys)
         sid = (h % jnp.uint64(NUM_SHARDS)).astype(jnp.int64)
         smap = jnp.asarray(
             np.asarray(self.cluster.catalog.shard_map, np.int32))
-        dest = smap[sid].astype(jnp.int32)
+        dest = jnp.where(b.valid, smap[sid].astype(jnp.int32), ndn)
 
-        valid = b.valid
-        order = jnp.argsort(jnp.where(valid, dest, ndn))
-        dst_s = jnp.where(valid, dest, ndn)[order]
-        start = jnp.searchsorted(dst_s, jnp.arange(ndn, dtype=dst_s.dtype))
-        slot = jnp.arange(dst_s.shape[0]) - start[jnp.clip(dst_s, 0,
-                                                           ndn - 1)]
-        keep = (slot < bucket) & (dst_s < ndn)
-        overflow = jnp.sum((slot >= bucket) & (dst_s < ndn))
-        pack_idx = jnp.clip(dst_s, 0, ndn - 1) * bucket + \
-            jnp.clip(slot, 0, bucket - 1)
+        # slot = rank of this row among live rows bound for the same
+        # destination (ndn cumsums, each a cheap scan)
+        slot = jnp.zeros(src_pad, jnp.int32)
+        for d in range(ndn):
+            m = dest == d
+            slot = jnp.where(m, jnp.cumsum(m.astype(jnp.int32)) - 1,
+                             slot)
+        live = dest < ndn
+        keep = (slot < bucket) & live
+        overflow = jnp.sum((slot >= bucket) & live)
+        oob = ndn * bucket
+        # dropped rows get distinct out-of-range indices so the scatter
+        # stays unique-indexed (mode="drop" discards them)
+        pack_idx = jnp.where(keep, dest * bucket + slot,
+                             oob + jnp.arange(src_pad, dtype=jnp.int32))
 
         def a2a(arr):
-            a_s = arr[order]
-            shape = (ndn * bucket, *arr.shape[1:])
-            kb = keep.reshape(-1, *([1] * (arr.ndim - 1)))
-            buf = jnp.zeros(shape, arr.dtype).at[pack_idx].set(
-                jnp.where(kb, a_s, jnp.zeros((), arr.dtype)))
+            buf = jnp.zeros((oob, *arr.shape[1:]), arr.dtype)
+            buf = buf.at[pack_idx].set(arr, mode="drop",
+                                       unique_indices=True)
             return jax.lax.all_to_all(
                 buf.reshape(ndn, bucket, *arr.shape[1:]),
-                self.axis, 0, 0).reshape(ndn * bucket, *arr.shape[1:])
+                self.axis, 0, 0).reshape(oob, *arr.shape[1:])
 
         cols = {n: a2a(a) for n, a in b.cols.items()}
         nulls = {n: a2a(a) for n, a in b.nulls.items()}
-        mask = jnp.zeros(ndn * bucket, jnp.bool_).at[pack_idx].set(keep)
+        mask = jnp.zeros(oob, jnp.bool_).at[pack_idx].set(
+            keep, mode="drop", unique_indices=True)
         new_valid = jax.lax.all_to_all(
             mask.reshape(ndn, bucket), self.axis, 0, 0).reshape(-1)
         return (DBatch(cols, new_valid, dict(b.types), dict(b.dicts),
@@ -335,7 +411,7 @@ class MeshRunner:
                         stack.append(c)
         for t in tables:
             for dn in self.cluster.datanodes:
-                if t not in dn.stores:
+                if hasattr(dn, "stores") and t not in dn.stores:
                     raise MeshUnsupported(f"table {t} missing on dn")
 
         for k, (v, _t) in params.items():
@@ -344,31 +420,46 @@ class MeshRunner:
 
         staged = {t: self._stage_table(t) for t in tables}
         base_pad = max((s.padded for s in staged.values()), default=64)
-        buckets = {ex.index: max(64, base_pad //
-                                 max(self.cluster.ndn // 2, 1))
-                   for ex in dp.exchanges if ex.kind == "redistribute"}
-        # per-gather output size classes: traced fragment outputs are
-        # worst-case padded (a partial aggregate's buffer is its input
-        # size), but the rows that actually cross to the CN are usually
-        # few — start small, compact in-program, grow on overflow (the
-        # same ladder joins and redistributes ride)
-        gathers = {ex.index: min(base_pad, 1 << 16)
-                   for ex in dp.exchanges
-                   if ex.kind in ("gather", "gather_one")}
-        factors: dict = {}
-        for _attempt in range(12):
+        # ladder values (join factors, exchange bucket multipliers,
+        # gather classes) LEARNED on a previous execution of the same
+        # plan shape are remembered, so steady state runs the compiled
+        # program exactly once — no overflow replay per query
+        lkey = self._ladder_key(dp, table_names := sorted(staged),
+                                staged)
+        remembered = self._ladder.get(lkey)
+        if remembered is not None:
+            factors, mults, gathers = (dict(remembered[0]),
+                                       dict(remembered[1]),
+                                       dict(remembered[2]))
+            for ex in dp.exchanges:
+                if ex.kind == "redistribute":
+                    mults.setdefault(ex.index, 1)
+                elif ex.kind in ("gather", "gather_one"):
+                    gathers.setdefault(ex.index, min(base_pad, 1 << 16))
+        else:
+            mults = {ex.index: 1 for ex in dp.exchanges
+                     if ex.kind == "redistribute"}
+            # per-gather output size classes: traced fragment outputs
+            # are worst-case padded (a partial aggregate's buffer is its
+            # input size), but the rows that actually cross to the CN
+            # are usually few — start small, compact in-program, grow on
+            # overflow (the same ladder joins and redistributes ride)
+            gathers = {ex.index: min(base_pad, 1 << 16)
+                       for ex in dp.exchanges
+                       if ex.kind in ("gather", "gather_one")}
+            factors = {}
+        for _attempt in range(24):
             try:
                 out, meta, over_jids, a2a_over, g_over = self._execute(
                     dp, staged, snapshot_ts, txid, params,
-                    dict(factors), dict(buckets), dict(gathers))
+                    dict(factors), dict(mults), dict(gathers))
             except (jax.errors.TracerBoolConversionError,
                     jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError) as e:
                 raise MeshUnsupported(f"host sync in plan: {e}") from None
             grew = False
-            if a2a_over:
-                for i in buckets:
-                    buckets[i] *= 2
+            for ei in a2a_over:
+                mults[ei] = mults.get(ei, 1) * 2
                 grew = True
             for jid in over_jids:
                 factors[jid] = factors.get(jid, 1) * 2
@@ -379,6 +470,10 @@ class MeshRunner:
                 gathers[gi] *= 2
                 grew = True
             if not grew:
+                self._ladder[lkey] = (dict(factors), dict(mults),
+                                      dict(gathers))
+                if len(self._ladder) > 256:
+                    self._ladder.pop(next(iter(self._ladder)))
                 result = {}
                 for gi, (cols, valid, nulls) in out.items():
                     gmeta = meta[gi]
@@ -391,6 +486,22 @@ class MeshRunner:
                          for n, a in nulls.items()})
                 return result
         raise MeshUnsupported("size-class ladder exhausted")
+
+    def _ladder_key(self, dp, table_names, staged):
+        """Identity of a plan shape + data scale, independent of the
+        ladder values themselves — the key under which learned join
+        factors / bucket multipliers / gather classes persist."""
+        try:
+            return hash((
+                tuple((f.index, self._plan_key(f.plan))
+                      for f in dp.fragments
+                      if f.index != dp.top_fragment),
+                tuple((ex.index, ex.kind, tuple(ex.keys or ()),
+                       ex.source_fragment) for ex in dp.exchanges),
+                tuple((t, staged[t].padded) for t in table_names),
+            ))
+        except TypeError:
+            raise MeshUnsupported("unhashable plan content") from None
 
     @staticmethod
     def _compact_local(b, gsz: int):
@@ -450,7 +561,7 @@ class MeshRunner:
         raise MeshUnsupported(t)
 
     def _execute(self, dp, staged, snapshot_ts, txid, params, factors,
-                 buckets, gathers):
+                 mults, gathers):
         from .executor import ExecContext, Executor
 
         table_names = sorted(staged)
@@ -472,7 +583,7 @@ class MeshRunner:
                              staged[t].view.dicts.items())))
                       for t in table_names),
                 tuple(sorted(factors.items())),
-                tuple(sorted(buckets.items())),
+                tuple(sorted(mults.items())),
                 tuple(sorted(gathers.items())),
                 tuple(sorted((k, v) for k, (v, _t) in params.items())),
             ))
@@ -504,6 +615,7 @@ class MeshRunner:
                 join_factors=dict(factors))
             ex_batches: dict = {}
             overflows = []
+            meta["ex_order"] = []
             join_reqs = []
             gather_out: dict = {}
             gather_over: list = []
@@ -520,9 +632,10 @@ class MeshRunner:
                     if ex.source_fragment != frag.index:
                         continue
                     if ex.kind == "redistribute":
-                        rb, over = self._a2a_batch(b, ex.keys,
-                                                   buckets[ex.index])
+                        rb, over = self._a2a_batch(
+                            b, ex.keys, mults.get(ex.index, 1))
                         ex_batches[ex.index] = rb
+                        meta["ex_order"].append(ex.index)
                         overflows.append(over)
                     elif ex.kind == "broadcast":
                         ex_batches[ex.index] = self._broadcast_batch(b)
@@ -543,7 +656,8 @@ class MeshRunner:
             missing = [gi for gi in gather_idx if gi not in gather_out]
             if missing:
                 raise MeshUnsupported(f"gather {missing} not produced")
-            a2a_over = sum(overflows) if overflows else jnp.int64(0)
+            a2a_over = jnp.stack(overflows) if overflows \
+                else jnp.zeros(0, jnp.int64)
             meta["jid_order"] = [jid for jid, _r, _c in join_reqs]
             if join_reqs:
                 join_over = jnp.stack([
@@ -587,16 +701,19 @@ class MeshRunner:
             for n in sorted(staged[t].arrs):
                 flat_args.append(staged[t].arrs[n])
             flat_args.append(staged[t].nrows)
-        outs, a2a_over, join_over, g_over_vec = fn(*flat_args)
+        outs, a2a_over_vec, join_over, g_over_vec = fn(*flat_args)
         over_vec = np.asarray(jax.device_get(join_over))
         over_jids = sorted({jid for jid, ov in
                             zip(meta.get("jid_order", ()), over_vec)
                             if ov > 0})
+        av = np.asarray(jax.device_get(a2a_over_vec))
+        a2a_over = sorted({ei for ei, ov in
+                           zip(meta.get("ex_order", ()), av) if ov > 0})
         gv = np.asarray(jax.device_get(g_over_vec))
         g_over = sorted({gi for gi, ov in
                          zip(meta.get("gi_order", ()), gv) if ov > 0})
         return (dict(zip(gather_idx, outs)), meta, over_jids,
-                int(jax.device_get(a2a_over)) > 0, g_over)
+                a2a_over, g_over)
 
 
 def mesh_runner_for(cluster) -> Optional[MeshRunner]:

@@ -180,6 +180,44 @@ def _materialize(sess, catalog, name, aliases, body, prior, tname, temp):
             t, _coldefs(names, types), [], "replicated", []))
         temp.append(t)
 
+    # Bind each recursive term against the worktable schema: a branch
+    # producing a wider type than the base term would be silently
+    # truncated by the worktable insert.  Reject, matching PostgreSQL's
+    # recursive-union column check (reference: parse_cte.c
+    # analyzeCTE "recursive query column has type ... overall").
+    _int_kinds = {TypeKind.INT32, TypeKind.INT64}
+    for rb in rec_b:
+        rbq = Binder(catalog).bind_select(
+            rename_tables(_with_prior(rb, prior), {name: wname}))
+        rtypes = ([e.type for _, e in rbq.targets]
+                  if hasattr(rbq, "targets")
+                  else list(rbq.target_types))
+        if len(rtypes) != len(types):
+            raise ExecError(
+                f"recursive CTE {name!r} column count mismatch "
+                "between base and recursive terms")
+        for i, (bt, rt) in enumerate(zip(types, rtypes)):
+            if bt.kind == rt.kind and \
+                    (bt.kind != TypeKind.DECIMAL or
+                     bt.scale == rt.scale):
+                continue
+            # int mixing only when the carrier is at least as wide
+            if bt.kind == TypeKind.INT64 and rt.kind in _int_kinds:
+                continue
+            if rt.kind == TypeKind.NULL:
+                continue
+            # an all-NULL base column gets a bigint carrier
+            # (_coldefs), which holds any integer recursive term
+            if bt.kind == TypeKind.NULL and rt.kind in _int_kinds:
+                continue
+            # integers store losslessly in a float64 carrier
+            if bt.kind == TypeKind.FLOAT64 and rt.kind in _int_kinds:
+                continue
+            raise ExecError(
+                f"recursive CTE {name!r} column {names[i]!r} has "
+                f"type {bt} in the non-recursive term but {rt} in a "
+                "recursive term")
+
     base_rows = []
     for b in base_b:
         base_rows.extend(sess._exec_stmt(_with_prior(b, prior)).rows)

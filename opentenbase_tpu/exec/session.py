@@ -123,6 +123,21 @@ def copy_to_select(table: str, cols) -> A.SelectStmt:
         from_=[A.TableRef(table)])
 
 
+def _in_list(table: str, col: str, keys) -> A.Node:
+    """col IN (k1, k2, ...) qual for MERGE's matched-key DML."""
+    consts = []
+    for k in keys:
+        if isinstance(k, bool):
+            consts.append(A.Const(k, "bool"))
+        elif isinstance(k, (int, np.integer)):
+            consts.append(A.Const(int(k), "int"))
+        elif isinstance(k, (float, np.floating)):
+            consts.append(A.Const(repr(float(k)), "num"))
+        else:
+            consts.append(A.Const(str(k), "str"))
+    return A.InExpr(A.ColRef((table, col)), consts, None, False)
+
+
 class TxnState:
     def __init__(self, txid: int, snapshot_ts: int):
         self.txid = txid
@@ -130,7 +145,11 @@ class TxnState:
         # per-store write sets for commit/abort backfill
         self.insert_spans: list[tuple[TableStore, list]] = []
         self.delete_spans: list[tuple[TableStore, tuple]] = []
+        self.lock_spans: list[tuple[TableStore, tuple]] = []
         self.explicit = False
+        self.wal_ops = 0          # WAL-visible ops (for subabort keep)
+        # name -> (ins_len, del_len, lock_len, wal_ops), insert-ordered
+        self.savepoints: dict[str, tuple] = {}
 
 
 class LocalGts:
@@ -159,6 +178,9 @@ class LocalNode:
         self.stores: dict[str, TableStore] = {}
         self.active_txns: set[int] = set()
         self.gts = LocalGts()
+        from ..storage.lockmgr import LockManager
+        self.lockmgr = LockManager()
+        self.lock_timeout = 10.0
         self.cache = DeviceTableCache()
         self.datadir = datadir
         self.wal: Optional[Wal] = None
@@ -274,6 +296,22 @@ class LocalNode:
             self.catalog.views.pop(rec["name"], None)
         elif op == "alter_table":
             replay_alter(self.catalog, self.stores, rec)
+        elif op == "truncate":
+            st = self.stores.get(rec["table"])
+            if st is not None:
+                st.truncate()
+        elif op == "subabort":
+            # ROLLBACK TO SAVEPOINT: revert this txn's ops beyond the
+            # savepoint's WAL position (reference: subxact abort
+            # records, xact.c)
+            lst = pending.get(rec["txid"], [])
+            undo = lst[rec["keep"]:]
+            del lst[rec["keep"]:]
+            for kind, st, sp in undo:
+                if kind == "ins":
+                    st.abort_insert(sp)
+                else:
+                    st.revert_delete([sp])
 
     def checkpoint(self) -> bool:
         if not self.datadir:
@@ -304,10 +342,52 @@ class Session:
     def __init__(self, node: LocalNode):
         self.node = node
         self.txn: Optional[TxnState] = None
+        self.txn_aborted = False
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> list[Result]:
-        return [self._exec_stmt(s) for s in parse_sql(sql)]
+        out = []
+        for s in parse_sql(sql):
+            if self.txn is not None and self.txn_aborted \
+                    and not isinstance(s, A.TxnStmt) \
+                    and not (isinstance(s, A.SavepointStmt)
+                             and s.op == "rollback_to"):
+                raise ExecError(
+                    "current transaction is aborted, commands ignored "
+                    "until end of transaction block")
+            try:
+                out.append(self._exec_retryable(s))
+            except Exception:
+                if self.txn is not None and not self.txn_aborted \
+                        and not isinstance(s, A.TxnStmt):
+                    self.txn_aborted = True
+                    if not self.txn.savepoints:
+                        # abort NOW: writes revert and locks release
+                        # immediately (PG: AbortCurrentTransaction).
+                        # With live savepoints the txn must survive
+                        # for ROLLBACK TO, so only poison it.
+                        self._abort(self.txn)
+                        self.txn.rolled_back = True
+                raise
+        return out
+
+    def _exec_retryable(self, s: A.Node) -> Result:
+        """Implicit (single-statement) transactions retry with a FRESH
+        snapshot when a concurrent writer committed first — the
+        READ COMMITTED re-check; explicit transactions surface the
+        serialization error (REPEATABLE READ semantics, PG's 'could
+        not serialize access due to concurrent update')."""
+        from ..storage.store import SerializationConflict
+        for _attempt in range(100):
+            try:
+                return self._exec_stmt(s)
+            except SerializationConflict as e:
+                if self.txn is not None:
+                    raise ExecError(str(e)) from None
+                continue
+        raise ExecError(
+            "could not serialize access due to concurrent update "
+            "(retries exhausted)")
 
     def query(self, sql: str) -> list[tuple]:
         """Convenience: single SELECT -> rows."""
@@ -334,7 +414,10 @@ class Session:
             st.backfill_insert(spans, ts)
         for st, span in t.delete_spans:
             st.backfill_delete([span], ts)
+        for st, span in t.lock_spans:
+            st.clear_locks([span])
         self.node.active_txns.discard(t.txid)
+        self.node.lockmgr.resolve(t.txid, committed=True)
 
     def _abort(self, t: TxnState):
         self.node._log({"op": "abort", "txid": t.txid})
@@ -342,7 +425,10 @@ class Session:
             st.abort_insert(spans)
         for st, span in t.delete_spans:
             st.revert_delete([span])
+        for st, span in t.lock_spans:
+            st.clear_locks([span])
         self.node.active_txns.discard(t.txid)
+        self.node.lockmgr.resolve(t.txid, committed=False)
 
     # ------------------------------------------------------------------
     def _exec_stmt(self, stmt: A.Node) -> Result:
@@ -404,6 +490,9 @@ class Session:
                            sync=True)
             return Result("CREATE TABLE")
         if isinstance(stmt, A.DropTableStmt):
+            if stmt.name in self.node.catalog.tables:
+                from .constraints import drop_guards
+                drop_guards(self.node.catalog, stmt.name)
             pinfo = self.node.catalog.partitioned.get(stmt.name)
             if pinfo is not None:
                 for p in list(pinfo["parts"]):
@@ -502,18 +591,217 @@ class Session:
         if isinstance(stmt, A.BarrierStmt):
             self.node.checkpoint()
             return Result("BARRIER")
+        if isinstance(stmt, A.TruncateStmt):
+            return self._exec_truncate(stmt)
+        if isinstance(stmt, A.SavepointStmt):
+            return self._exec_savepoint(stmt)
+        if isinstance(stmt, A.MergeStmt):
+            return self._exec_merge(stmt)
         raise ExecError(f"unsupported statement {type(stmt).__name__}")
+
+    # ---- TRUNCATE (reference: ExecuteTruncate, commands/tablecmds.c:
+    # non-MVCC relfilenode swap; like PG, refused when the table is
+    # referenced by a foreign key) ----
+    def _exec_truncate(self, stmt: A.TruncateStmt) -> Result:
+        cat = self.node.catalog
+        cat.table(stmt.table)                     # existence check
+        if self.txn is not None:
+            raise ExecError("TRUNCATE cannot run inside a transaction "
+                            "block (non-MVCC bulk clear)")
+        for other in cat.tables.values():
+            if other.name != stmt.table and any(
+                    fk["ref_table"] == stmt.table for fk in other.fks):
+                raise ExecError(
+                    f"cannot truncate {stmt.table!r}: referenced by a "
+                    f"foreign key on {other.name!r}")
+        names = [stmt.table]
+        if stmt.table in cat.partitioned:
+            names += [p["name"]
+                      for p in cat.partitioned[stmt.table]["parts"]]
+        for nm in names:
+            st = self.node.stores[nm]
+            st.truncate()
+            self.node.cache.invalidate(st)
+            self.node._log({"op": "truncate", "table": nm}, sync=True)
+        return Result("TRUNCATE TABLE")
+
+    # ---- SAVEPOINT / ROLLBACK TO / RELEASE (reference: subxact
+    # machinery, access/transam/xact.c DefineSavepoint /
+    # RollbackToSavepoint) ----
+    def _exec_savepoint(self, stmt: A.SavepointStmt) -> Result:
+        t = self.txn
+        if t is None or not t.explicit:
+            raise ExecError(f"{stmt.op.replace('_', ' ').upper()} can "
+                            "only be used in transaction blocks")
+        if stmt.op == "savepoint":
+            t.savepoints[stmt.name] = (len(t.insert_spans),
+                                       len(t.delete_spans),
+                                       len(t.lock_spans), t.wal_ops)
+            return Result("SAVEPOINT")
+        if stmt.name not in t.savepoints:
+            raise ExecError(f"savepoint {stmt.name!r} does not exist")
+        if stmt.op == "release":
+            # drop the named savepoint and everything after it
+            drop = False
+            for nm in list(t.savepoints):
+                if nm == stmt.name:
+                    drop = True
+                if drop:
+                    del t.savepoints[nm]
+            return Result("RELEASE")
+        mi, md, ml, keep_wal = t.savepoints[stmt.name]
+        for st, spans in t.insert_spans[mi:]:
+            st.abort_insert(spans)
+        del t.insert_spans[mi:]
+        for st, span in t.delete_spans[md:]:
+            st.revert_delete([span])
+        del t.delete_spans[md:]
+        for st, span in t.lock_spans[ml:]:
+            st.clear_locks([span])
+        del t.lock_spans[ml:]
+        self.node._log({"op": "subabort", "txid": t.txid,
+                        "keep": keep_wal})
+        t.wal_ops = keep_wal
+        drop = False
+        for nm in list(t.savepoints):
+            if drop:
+                del t.savepoints[nm]
+            if nm == stmt.name:
+                drop = True
+        # ROLLBACK TO recovers a failed transaction (PG semantics)
+        self.txn_aborted = False
+        return Result("ROLLBACK")
+
+    # ---- MERGE (reference: executor/execMerge.c ExecMerge) ----
+    def _merge_parts(self, stmt: A.MergeStmt):
+        """Decompose MERGE set-wise.  ON must be one equality between
+        a target and a source column; each WHEN branch becomes one
+        engine query + one DML (columnar, not per-row)."""
+        cat = (self.node.catalog if hasattr(self, "node")
+               else self.cluster.catalog)
+        tgt = cat.table(stmt.target)
+        cat.table(stmt.source)
+        on = stmt.on
+        if not (isinstance(on, A.BinOp) and on.op == "="
+                and isinstance(on.left, A.ColRef)
+                and isinstance(on.right, A.ColRef)):
+            raise ExecError("MERGE ON must be a single equality "
+                            "tgt.col = src.col")
+        sides = {}
+        for e in (on.left, on.right):
+            if len(e.parts) != 2:
+                raise ExecError("MERGE ON columns must be qualified")
+            sides[e.parts[0]] = e.parts[1]
+        if set(sides) != {stmt.target, stmt.source}:
+            raise ExecError("MERGE ON must join target to source")
+        return tgt, sides[stmt.target], sides[stmt.source]
+
+    def _exec_merge(self, stmt: A.MergeStmt) -> Result:
+        tgt, tkey, skey = self._merge_parts(stmt)
+        t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
+        total = 0
+        try:
+            total = self._merge_steps(stmt, tgt, tkey, skey)
+        except Exception:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit(t)
+        return Result("MERGE", rowcount=total)
+
+    def _merge_steps(self, stmt: A.MergeStmt, tgt, tkey: str,
+                     skey: str) -> int:
+        total = 0
+        join = A.JoinRef("inner", A.TableRef(stmt.target),
+                         A.TableRef(stmt.source), stmt.on)
+        if stmt.matched_set is not None:
+            assigned = {c: e for c, e in stmt.matched_set}
+            if tkey in assigned:
+                raise ExecError("MERGE may not update the join key")
+            items = [A.SelectItem(
+                assigned.get(c.name, A.ColRef((stmt.target, c.name))),
+                alias=c.name) for c in tgt.columns]
+            rows = self._exec_stmt(
+                A.SelectStmt(items=items, from_=[join])).rows
+            if rows:
+                ki = [c.name for c in tgt.columns].index(tkey)
+                keys = sorted({r[ki] for r in rows})
+                if len(keys) != len(rows):
+                    raise ExecError(
+                        "MERGE command cannot affect row a second "
+                        "time (duplicate source join keys)")
+                self._exec_stmt(A.DeleteStmt(
+                    stmt.target, _in_list(stmt.target, tkey, keys)))
+                cols = {c.name: [r[i] for r in rows]
+                        for i, c in enumerate(tgt.columns)}
+                self._merge_insert(tgt, cols, len(rows))
+                total += len(rows)
+        elif stmt.matched_delete:
+            rows = self._exec_stmt(A.SelectStmt(
+                items=[A.SelectItem(
+                    A.ColRef((stmt.target, tkey)), alias="k")],
+                from_=[join], distinct=True)).rows
+            if rows:
+                keys = sorted({r[0] for r in rows})
+                r = self._exec_stmt(A.DeleteStmt(
+                    stmt.target, _in_list(stmt.target, tkey, keys)))
+                total += r.rowcount
+        if stmt.insert_values is not None:
+            cols = stmt.insert_cols or [c.name for c in tgt.columns]
+            if len(cols) != len(stmt.insert_values):
+                raise ExecError("MERGE INSERT column count mismatch")
+            # anti-join: source rows with no target match
+            items = [A.SelectItem(e, alias=cn)
+                     for cn, e in zip(cols, stmt.insert_values)]
+            sel = A.SelectStmt(
+                items=items,
+                from_=[A.JoinRef("left", A.TableRef(stmt.source),
+                                 A.TableRef(stmt.target), stmt.on)],
+                where=A.NullTest(A.ColRef((stmt.target, tkey)), True))
+            rows = self._exec_stmt(sel).rows
+            if rows:
+                coldata = {cn: [r[i] for r in rows]
+                           for i, cn in enumerate(cols)}
+                self._merge_insert(tgt, coldata, len(rows),
+                                   cols=cols)
+                total += len(rows)
+        return total
+
+    def _merge_insert(self, td, coldata, n, cols=None):
+        # partition-aware: route through the same paths INSERT uses
+        if td.name in self.node.catalog.partitioned:
+            self._insert_partitioned(td.name, coldata, n)
+            return
+        self._check_partition_bound(td.name, coldata, n)
+        self._insert_rows(td, self.node.stores[td.name], coldata, n)
 
     # ---- ALTER TABLE (reference: tablecmds.c ATExecCmd subset) ----
     @staticmethod
     def _alter_guards(catalog, stmt: A.AlterTableStmt):
-        """Shared validation: a dist key or indexed column cannot be
-        dropped/renamed; returns the TableDef."""
+        """Shared validation: a dist key, indexed column, or partition
+        key cannot be dropped/renamed; returns the TableDef."""
         td = catalog.table(stmt.table)
+        part_parent = next(
+            (p for p, pi in catalog.partitioned.items()
+             if any(pt["name"] == stmt.table for pt in pi["parts"])),
+            None)
         if stmt.action in ("drop_column", "rename_column"):
             if stmt.name in td.distribution.dist_cols:
                 raise ExecError(
                     f"cannot alter distribution column {stmt.name!r}")
+            pkey = (catalog.partitioned.get(stmt.table) or
+                    (catalog.partitioned[part_parent]
+                     if part_parent else None))
+            if pkey is not None and stmt.name == pkey["key"]:
+                raise ExecError(
+                    f"cannot alter partition key column {stmt.name!r}")
+            from .constraints import column_drop_guards
+            column_drop_guards(catalog, stmt.table, stmt.name)
             if not td.has_column(stmt.name):
                 raise ExecError(f"column {stmt.name!r} does not exist")
             idx_cols = catalog.btree_cols.get(stmt.table, set())
@@ -537,6 +825,10 @@ class Session:
             if catalog.global_indexes.get(stmt.table):
                 raise ExecError("cannot rename a table with global "
                                 "indexes; drop them first")
+            if part_parent is not None:
+                raise ExecError(
+                    f"cannot rename partition {stmt.table!r} of "
+                    f"table {part_parent!r}")
         return td
 
     def _exec_alter(self, stmt: A.AlterTableStmt) -> Result:
@@ -593,6 +885,8 @@ class Session:
         return Planner(self.node.catalog).plan(bq)
 
     def _exec_select(self, stmt: A.SelectStmt) -> Result:
+        if stmt.for_update:
+            return self._exec_select_for_update(stmt)
         planned = self._plan_select(stmt)
         t, implicit = self._begin_implicit()
         batch = None
@@ -750,8 +1044,30 @@ class Session:
         return Result("UPDATE" if is_update else "DELETE",
                       rowcount=total)
 
+    def _run_check_query(self, sel: A.SelectStmt, t) -> list:
+        """Constraint-validation SELECT inside txn `t` (sees its own
+        uncommitted rows through MVCC own-txid visibility)."""
+        planned = self._plan_select(sel)
+        ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
+                          self.node.cache)
+        batch = Executor(ctx).run(planned)
+        _, rows = materialize(batch, planned.output_names)
+        return rows
+
+    def _validate_write(self, table: str, t, kind: str = "insert"):
+        from .constraints import (tables_needing_validation,
+                                  validate_after_write)
+        if not tables_needing_validation(self.node.catalog, table,
+                                         kind):
+            return
+        validate_after_write(
+            lambda sel: self._run_check_query(sel, t),
+            self.node.catalog, table, kind)
+
     def _insert_rows(self, td: TableDef, st: TableStore,
                      coldata: dict, n: int) -> int:
+        from .constraints import check_not_null
+        check_not_null(td, coldata, n)
         t, implicit = self._begin_implicit()
         self._track_write(t)
         clean, masks = {}, {}
@@ -780,6 +1096,13 @@ class Session:
         spans = st.insert(enc, n, t.txid, shardids=sid,
                           nulls=masks or None)
         t.insert_spans.append((st, spans))
+        t.wal_ops += 1
+        try:
+            self._validate_write(td.name, t)
+        except Exception:
+            if implicit:
+                self._abort(t)
+            raise
         if implicit:
             self._commit(t)
         return n
@@ -799,26 +1122,18 @@ class Session:
                                where=stmt.where)
             bq = binder.bind_select(sel)
             quals = bq.where
-        from .expr_compile import compile_pred, host_chunk_env
         n_deleted = 0
         try:
-            for ci, ch in st.scan_chunks():
-                vis = st.visible_mask(ch, t.snapshot_ts, t.txid)
-                mask = vis
-                if quals:
-                    env, nullable = host_chunk_env(stmt.table, ch)
-                    dicts = {f"{stmt.table}.{k}": d
-                             for k, d in st.dicts.items()}
-                    for q in quals:
-                        mask = mask & np.asarray(
-                            compile_pred(q, dicts, nullable)(env))
-                if mask.any():
-                    span = st.mark_delete(ci, mask, t.txid)
-                    t.delete_spans.append((st, span))
-                    self.node._log({"op": "delete", "table": td.name,
-                                    "chunk": ci, "mask": mask,
-                                    "txid": t.txid})
-                    n_deleted += int(mask.sum())
+            for span, ci, mask in self._mark_with_wait(
+                    st, stmt.table, quals, t, lock_only=False):
+                t.delete_spans.append((st, span))
+                t.wal_ops += 1
+                self.node._log({"op": "delete", "table": td.name,
+                                "chunk": ci, "mask": mask,
+                                "txid": t.txid})
+                n_deleted += int(mask.sum())
+            if n_deleted:
+                self._validate_write(td.name, t, kind="delete")
         except Exception:
             if implicit:
                 self._abort(t)
@@ -826,6 +1141,107 @@ class Session:
         if implicit:
             self._commit(t)
         return Result("DELETE", rowcount=n_deleted)
+
+    def _exec_select_for_update(self, stmt: A.SelectStmt) -> Result:
+        """SELECT ... FOR UPDATE [NOWAIT]: lock matching rows first
+        (blocking on in-progress writers), then read under the same
+        snapshot — locked rows cannot change until txn end (reference:
+        LockRows on top of the scan, nodeLockRows.c).  Restricted to a
+        single plain table, as aggregation/joins destroy row identity
+        (PG rejects FOR UPDATE with aggregates too)."""
+        if (len(stmt.from_) != 1
+                or not isinstance(stmt.from_[0], A.TableRef)
+                or stmt.group_by or stmt.group_sets or stmt.setop
+                or stmt.distinct or stmt.ctes or stmt.having):
+            raise ExecError(
+                "FOR UPDATE is only supported on a single-table "
+                "SELECT without aggregation/set operations")
+        table = stmt.from_[0].name
+        st = self.node.stores.get(table)
+        if st is None:
+            raise ExecError(f"table {table!r} does not exist")
+        quals = []
+        if stmt.where is not None:
+            bq = Binder(self.node.catalog).bind_select(
+                A.SelectStmt(items=[A.SelectItem(A.Star())],
+                             from_=[A.TableRef(table)],
+                             where=stmt.where))
+            quals = bq.where
+        t, implicit = self._begin_implicit()
+        if implicit:
+            self.txn = t
+        self._track_write(t)
+        try:
+            for span, _ci, _mask in self._mark_with_wait(
+                    st, table, quals, t, lock_only=True,
+                    nowait=stmt.for_update == "nowait"):
+                t.lock_spans.append((st, span))
+            r = self._exec_select(
+                dataclasses.replace(stmt, for_update=None))
+        except Exception:
+            if implicit:
+                self.txn = None
+                self._abort(t)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit(t)
+        return r
+
+    def _target_masks(self, st, table: str, quals: list, t) -> list:
+        from .expr_compile import compile_pred, host_chunk_env
+        out = []
+        for ci, ch in st.scan_chunks():
+            mask = st.visible_mask(ch, t.snapshot_ts, t.txid)
+            if quals:
+                env, nullable = host_chunk_env(table, ch)
+                dicts = {f"{table}.{k}": d
+                         for k, d in st.dicts.items()}
+                for q in quals:
+                    mask = mask & np.asarray(
+                        compile_pred(q, dicts, nullable)(env))
+            if mask.any():
+                out.append((ci, mask))
+        return out
+
+    def _mark_with_wait(self, st, table: str, quals: list, t,
+                        lock_only: bool, nowait: bool = False) -> list:
+        """Statement-atomic row marking with lock waits (the
+        single-node twin of DataNode.delete_where/lock_where;
+        reference: heap_delete / heap_lock_tuple blocking on the
+        updater xid then re-checking)."""
+        from ..storage.lockmgr import LockNotAvailable
+        from ..storage.store import (SerializationConflict,
+                                     WriteConflict)
+        node = self.node
+        while True:
+            targets = self._target_masks(st, table, quals, t)
+            done = []
+            try:
+                for ci, mask in targets:
+                    span = st.lock_rows(ci, mask, t.txid) if lock_only \
+                        else st.mark_delete(ci, mask, t.txid)
+                    done.append((span, ci, mask))
+            except WriteConflict as e:
+                if lock_only:
+                    st.clear_locks([sp for sp, _c, _m in done])
+                else:
+                    st.revert_delete([sp for sp, _c, _m in done])
+                if nowait:
+                    raise LockNotAvailable(
+                        "could not obtain lock on row (held by txn "
+                        f"{e.holder})") from None
+                v = node.lockmgr.verdict(e.holder)
+                if v is None:
+                    v = node.lockmgr.wait_for(e.holder, t.txid,
+                                              node.lock_timeout)
+                if v == "committed":
+                    raise SerializationConflict(
+                        "could not serialize access due to concurrent "
+                        f"update (txn {e.holder} committed first)") \
+                        from None
+                continue
+            return done
 
     def _exec_update(self, stmt: A.UpdateStmt) -> Result:
         # MVCC update = delete + insert of new row versions (the reference
@@ -847,6 +1263,19 @@ class Session:
         if implicit:
             self.txn = t
         try:
+            # row locks first: concurrent updaters queue instead of
+            # racing the read-write window (reference: heap_update's
+            # tuple lock; see the cluster session's twin)
+            lock_quals = []
+            if stmt.where is not None:
+                lock_quals = Binder(self.node.catalog).bind_select(
+                    A.SelectStmt(items=[A.SelectItem(A.Star())],
+                                 from_=[A.TableRef(stmt.table)],
+                                 where=stmt.where)).where
+            st_lock = self.node.stores[stmt.table]
+            for span, _ci, _m in self._mark_with_wait(
+                    st_lock, stmt.table, lock_quals, t, lock_only=True):
+                t.lock_spans.append((st_lock, span))
             planned = self._plan_select(sel)
             ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
                               self.node.cache)
@@ -895,15 +1324,27 @@ class Session:
                 self.txn = TxnState(self.node.gts.next_txid(),
                                     self.node.gts.next_gts())
                 self.txn.explicit = True
+                self.txn_aborted = False
             return Result("BEGIN")
         if stmt.op == "commit":
             if self.txn is not None:
+                if self.txn_aborted:
+                    # COMMIT of an aborted txn rolls back (PG); abort
+                    # already ran at error time unless savepoints kept
+                    # the txn alive for a possible ROLLBACK TO
+                    if not getattr(self.txn, "rolled_back", False):
+                        self._abort(self.txn)
+                    self.txn = None
+                    self.txn_aborted = False
+                    return Result("ROLLBACK")
                 self._commit(self.txn)
                 self.txn = None
             return Result("COMMIT")
         if self.txn is not None:
-            self._abort(self.txn)
+            if not getattr(self.txn, "rolled_back", False):
+                self._abort(self.txn)
             self.txn = None
+        self.txn_aborted = False
         return Result("ROLLBACK")
 
     def _exec_explain(self, stmt: A.ExplainStmt) -> Result:

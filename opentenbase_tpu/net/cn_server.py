@@ -1,0 +1,236 @@
+"""Client-facing SQL server — the coordinator's front door.
+
+Reference analog: tcop/postgres.c:6703 (PostgresMain, the per-backend
+read-execute-respond loop behind libpq), the startup-packet password
+handshake (auth.c), and the out-of-band query-cancel protocol — a
+separate short-lived connection carrying (pid, secret), postmaster.c
+processCancelRequest.
+
+Design notes (TPU-first deployment): the CN server owns the cluster's
+device mesh, so EVERY connected client shares one staged-table cache and
+one compiled-program cache — a new connection pays zero recompilation
+for plans the cluster has already run (the reference pays backend fork +
+catalog warmup per connection instead).  Sessions are threads; the GIL
+is released inside XLA compute, so concurrent clients overlap host work
+with device work.
+
+Cancel semantics match PostgreSQL's: the flag is polled at safe points
+(statement start, between fragment dispatches), so a cancel lands at
+the next host-sync boundary, aborts the open transaction, and leaves
+the session usable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+from .wire import recv_msg, send_msg
+
+_BANNER = "opentenbase_tpu"
+
+
+# ---------------------------------------------------------------------------
+# password file (reference: pg_authid's rolpassword, md5/scram verifier)
+# ---------------------------------------------------------------------------
+
+def hash_password(password: str, salt: str) -> str:
+    return hashlib.sha256((salt + ":" + password).encode()).hexdigest()
+
+
+def write_users(path: str, users: dict[str, str]) -> None:
+    """users: {name: cleartext} -> salted-hash file."""
+    rec = {}
+    for name, pw in users.items():
+        salt = secrets.token_hex(8)
+        rec[name] = {"salt": salt, "hash": hash_password(pw, salt)}
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=2)
+
+
+def check_password(path: str, user: str, password: str) -> bool:
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return False
+    u = rec.get(user)
+    if u is None:
+        return False
+    return hash_password(password, u["salt"]) == u["hash"]
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class CnServer:
+    """One process-wide SQL listener; one session (thread) per client.
+
+    make_session: () -> ClusterSession — each connection gets a fresh
+    session over the SHARED cluster object (shared mesh runner, shared
+    plan caches, per-session txn/GUC/prepared state).
+    """
+
+    def __init__(self, make_session, users_path: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.make_session = make_session
+        self.users_path = users_path
+        self._sessions: dict = {}     # pid -> (secret, session)
+        self._next_pid = [1000]
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                outer._handle(self.request)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+
+    def start(self) -> "CnServer":
+        t = threading.Thread(target=self._server.serve_forever,
+                             daemon=True)
+        t.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------------
+    def _auth_ok(self, msg) -> bool:
+        if self.users_path is None:
+            return True       # auth not configured (trust mode)
+        return check_password(self.users_path, msg.get("user", ""),
+                              msg.get("password", ""))
+
+    def _handle(self, sock: socket.socket):
+        first = recv_msg(sock)
+        if first is None:
+            return
+        if first.get("op") == "cancel":
+            # out-of-band cancel: a separate connection that never
+            # authenticates (it proves identity with the secret)
+            with self._lock:
+                ent = self._sessions.get(first.get("pid"))
+            if ent is not None and ent[0] == first.get("secret"):
+                sess = ent[1]
+                if sess.cancel_event is not None:
+                    sess.cancel_event.set()
+                send_msg(sock, {"ok": True})
+            else:
+                send_msg(sock, {"ok": False})
+            return
+        if first.get("op") != "startup":
+            send_msg(sock, {"error": "expected startup message"})
+            return
+        if not self._auth_ok(first):
+            send_msg(sock, {"error":
+                            "password authentication failed"})
+            return
+        sess = self.make_session()
+        sess.cancel_event = threading.Event()
+        with self._lock:
+            pid = self._next_pid[0]
+            self._next_pid[0] += 1
+            secret = secrets.token_hex(16)
+            self._sessions[pid] = (secret, sess)
+        send_msg(sock, {"ok": {"server": _BANNER, "pid": pid,
+                               "secret": secret}})
+        try:
+            while True:
+                msg = recv_msg(sock)
+                if msg is None or msg.get("op") == "terminate":
+                    return
+                if msg.get("op") != "query":
+                    send_msg(sock, {"error":
+                                    f"unknown op {msg.get('op')!r}"})
+                    continue
+                try:
+                    # a cancel that landed while the session was idle
+                    # targets nothing — drop it (reference: a backend
+                    # ignores SIGINT outside statement execution)
+                    sess.cancel_event.clear()
+                    results = sess.execute(msg["sql"])
+                    send_msg(sock, {"ok": [
+                        {"command": r.command, "names": r.names,
+                         "rows": r.rows, "rowcount": r.rowcount,
+                         "text": r.text} for r in results]})
+                except Exception as e:   # statement error: report, keep
+                    send_msg(sock, {"error":
+                                    f"{type(e).__name__}: {e}"})
+        finally:
+            # disconnect aborts any open transaction (reference:
+            # backend exit path, AbortOutOfAnyTransaction)
+            try:
+                if sess.txn is not None:
+                    sess.execute("rollback")
+            except Exception:
+                pass
+            with self._lock:
+                self._sessions.pop(pid, None)
+
+
+# ---------------------------------------------------------------------------
+# client (the libpq analog; also used by `ctl shell --connect`)
+# ---------------------------------------------------------------------------
+
+class CnClient:
+    def __init__(self, host: str, port: int, user: str = "otb",
+                 password: str = "", timeout: float = 300.0):
+        self.addr = (host, port)
+        self._sock = socket.create_connection(self.addr,
+                                              timeout=timeout)
+        send_msg(self._sock, {"op": "startup", "user": user,
+                              "password": password})
+        resp = recv_msg(self._sock)
+        if resp is None or "error" in resp:
+            raise ConnectionError(
+                (resp or {}).get("error", "connection closed"))
+        self.pid = resp["ok"]["pid"]
+        self.secret = resp["ok"]["secret"]
+
+    def execute(self, sql: str) -> list[dict]:
+        send_msg(self._sock, {"op": "query", "sql": sql})
+        resp = recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("server closed connection")
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["ok"]
+
+    def query(self, sql: str) -> list[tuple]:
+        return [tuple(r) for r in self.execute(sql)[-1]["rows"]]
+
+    def cancel(self):
+        """Cancel the in-flight statement from ANOTHER connection (the
+        PQcancel analog)."""
+        s = socket.create_connection(self.addr, timeout=30)
+        try:
+            send_msg(s, {"op": "cancel", "pid": self.pid,
+                         "secret": self.secret})
+            return (recv_msg(s) or {}).get("ok", False)
+        finally:
+            s.close()
+
+    def close(self):
+        try:
+            send_msg(self._sock, {"op": "terminate"})
+        except Exception:
+            pass
+        self._sock.close()
+
+
+def default_users_path(cluster_dir: str) -> str:
+    return os.path.join(cluster_dir, "users.json")

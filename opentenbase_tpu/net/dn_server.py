@@ -38,7 +38,17 @@ class DnServer:
         self.node.recover(catalog, gtm)
         self.node.open_wal()
         node = self.node
-        lock = threading.Lock()   # one executor at a time per DN (round 1)
+        lock = threading.Lock()   # one DEVICE executor at a time per DN
+
+        # host-side ops run without the executor lock: DML marking, txn
+        # resolution, and lock-manager traffic must interleave freely —
+        # a session blocked in a row-lock wait must never stop the
+        # holder's commit from being processed (the reference gets this
+        # from per-backend processes; here it's lock scoping)
+        host_ops = {"ping", "insert_raw", "delete_where", "lock_where",
+                    "prepare", "commit", "abort", "wrote_in",
+                    "row_count", "table_version", "wait_edges",
+                    "gdd_kill", "savepoint_mark", "rollback_to_mark"}
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
@@ -50,16 +60,14 @@ class DnServer:
                     if msg is None:
                         return
                     try:
-                        if msg.get("op") == "ping":
-                            # liveness must not queue behind a long
-                            # query: the supervisor would mistake a busy
-                            # node for a dead one and restart it
-                            resp = {"ok": "pong"}
+                        if msg.get("op") in host_ops:
+                            resp = {"ok": _dispatch(node, msg)}
                         else:
                             with lock:
                                 resp = {"ok": _dispatch(node, msg)}
                     except Exception as e:
-                        resp = {"error": f"{type(e).__name__}: {e}"}
+                        resp = {"error": f"{type(e).__name__}: {e}",
+                                "etype": type(e).__name__}
                     send_msg(self.request, resp)
 
         class Server(socketserver.ThreadingTCPServer):
@@ -100,6 +108,20 @@ def _dispatch(node: DataNode, msg: dict):
     if op == "delete_where":
         return node.delete_where(msg["table"], msg["quals"],
                                  msg["snapshot_ts"], msg["txid"])
+    if op == "truncate":
+        return node.truncate(msg["table"])
+    if op == "savepoint_mark":
+        return node.savepoint_mark(msg["txid"])
+    if op == "rollback_to_mark":
+        return node.rollback_to_mark(msg["txid"], msg["keep"])
+    if op == "lock_where":
+        return node.lock_where(msg["table"], msg["quals"],
+                               msg["snapshot_ts"], msg["txid"],
+                               msg.get("nowait", False))
+    if op == "wait_edges":
+        return node.lockmgr.wait_edges()
+    if op == "gdd_kill":
+        return node.lockmgr.kill(msg["txid"])
     if op == "alter_table":
         return node.alter_table(msg["rec"])
     if op == "exec_plan":
@@ -142,56 +164,136 @@ def _dispatch(node: DataNode, msg: dict):
     if op == "row_count":
         st = node.stores.get(msg["table"])
         return st.row_count() if st else 0
+    if op == "table_version":
+        st = node.stores.get(msg["table"])
+        return st.version if st is not None else None
+    if op == "stage_table":
+        # driver-host mesh staging: ship this DN's live columns (value +
+        # MVCC sys + null masks), dictionaries, and version to the mesh
+        # owner (reference: the FN receiver pulling producer pages,
+        # forwardrecv.c — here one bulk snapshot instead of a stream)
+        st = node.stores.get(msg["table"])
+        if st is None:
+            return None
+        cols = st.host_live_columns([c.name for c in st.td.columns])
+        n = len(next(iter(cols.values()))) if cols else st.row_count()
+        return {"version": st.version, "count": n, "cols": cols,
+                "dicts": {c: list(d.values)
+                          for c, d in st.dicts.items()},
+                "null_columns": sorted(st.null_columns)}
     if op == "ping":
         return "pong"
     raise ValueError(f"unknown op {op!r}")
 
 
+class DnConnectionPool:
+    """Warm connection pool to ONE datanode, shared by every session on
+    the coordinator (reference: the pooler process, poolmgr.c:632 —
+    per-node connection slots leased per request and returned warm).
+
+    Leasing a socket per CALL (not per session) is what lets a session
+    blocked in a row-lock wait coexist with the lock holder's commit on
+    the same node: each RPC rides its own connection, so a long-blocked
+    lock_where cannot starve txn-resolution traffic."""
+
+    def __init__(self, addr: tuple, max_conns: int = 32):
+        self.addr = addr
+        self.max_conns = max_conns
+        self._free: list = []
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._count = 0
+        self.leases = 0          # observability: total acquisitions
+        self.created = 0         # sockets ever opened (reuse proof)
+
+    def acquire(self) -> socket.socket:
+        with self._cv:
+            self.leases += 1
+            while True:
+                if self._free:
+                    return self._free.pop()
+                if self._count < self.max_conns:
+                    self._count += 1
+                    break
+                self._cv.wait(1.0)
+        try:
+            s = socket.create_connection(self.addr, timeout=300)
+        except OSError:
+            with self._cv:
+                self._count -= 1
+                self._cv.notify()
+            raise
+        self.created += 1
+        return s
+
+    def release(self, sock: socket.socket, broken: bool = False):
+        with self._cv:
+            if broken:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._count -= 1
+            else:
+                self._free.append(sock)
+            self._cv.notify()
+
+    def close_all(self):
+        with self._cv:
+            for s in self._free:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._count -= len(self._free)
+            self._free.clear()
+            self._cv.notify_all()
+
+
 class RemoteDataNode:
     """Coordinator-side proxy with DataNode's service surface
-    (reference: PGXCNodeHandle, pgxcnode.c — one pooled connection per
-    peer node with a buffered request/response protocol)."""
+    (reference: PGXCNodeHandle, pgxcnode.c, riding the pooler's
+    per-node connection slots)."""
 
     def __init__(self, index: int, host: str, port: int):
         self.index = index
         self.addr = (host, port)
-        self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self.pool = DnConnectionPool((host, port))
 
     def _call(self, **msg):
-        with self._lock:
-            try:
-                if self._sock is None:
-                    self._sock = socket.create_connection(self.addr,
-                                                          timeout=300)
-                send_msg(self._sock, msg)
-                resp = recv_msg(self._sock)
-            except (ConnectionError, OSError, EOFError):
-                # never reuse a socket after a failed exchange: a late
-                # response would desync the protocol (stale answer to the
-                # next request)
-                self.close_locked()
-                raise
+        sock = self.pool.acquire()
+        try:
+            send_msg(sock, msg)
+            resp = recv_msg(sock)
+        except (ConnectionError, OSError, EOFError):
+            # never reuse a socket after a failed exchange: a late
+            # response would desync the protocol (stale answer to the
+            # next request)
+            self.pool.release(sock, broken=True)
+            raise
         if resp is None:
-            self.close()
+            self.pool.release(sock, broken=True)
             raise ConnectionError(f"dn{self.index} closed connection")
+        self.pool.release(sock)
         if "error" in resp:
+            et = resp.get("etype", "")
+            # concurrency-control errors keep their type across the
+            # wire: the CN's retry/NOWAIT logic dispatches on them
+            if et == "SerializationConflict":
+                from ..storage.store import SerializationConflict
+                raise SerializationConflict(resp["error"])
+            if et in ("LockTimeout", "DeadlockDetected",
+                      "LockNotAvailable"):
+                from ..storage import lockmgr as _lm
+                raise getattr(_lm, et)(resp["error"])
             raise RuntimeError(f"dn{self.index}: {resp['error']}")
         return resp["ok"]
 
     def close_locked(self):
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            finally:
-                self._sock = None
+        self.pool.close_all()
 
     def close(self):
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            finally:
-                self._sock = None
+        self.pool.close_all()
 
     # ---- mirrored surface ----
     def ddl_create(self, td):
@@ -262,6 +364,33 @@ class RemoteDataNode:
 
     def row_count(self, table):
         return self._call(op="row_count", table=table)
+
+    def table_version(self, table):
+        return self._call(op="table_version", table=table)
+
+    def lock_where(self, table, quals, snapshot_ts, txid,
+                   nowait=False):
+        return self._call(op="lock_where", table=table, quals=quals,
+                          snapshot_ts=snapshot_ts, txid=txid,
+                          nowait=nowait)
+
+    def wait_edges(self):
+        return self._call(op="wait_edges")
+
+    def truncate(self, table):
+        return self._call(op="truncate", table=table)
+
+    def savepoint_mark(self, txid):
+        return self._call(op="savepoint_mark", txid=txid)
+
+    def rollback_to_mark(self, txid, keep):
+        return self._call(op="rollback_to_mark", txid=txid, keep=keep)
+
+    def gdd_kill(self, txid):
+        return self._call(op="gdd_kill", txid=txid)
+
+    def stage_table(self, table):
+        return self._call(op="stage_table", table=table)
 
     def ping(self) -> bool:
         try:

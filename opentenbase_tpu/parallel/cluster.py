@@ -20,7 +20,9 @@ from ..catalog.types import TypeKind
 from ..exec.executor import DeviceTableCache
 from ..gtm.server import GtmCore
 from ..parallel.locator import Locator
-from ..storage.store import TableStore
+from ..storage.lockmgr import LockNotAvailable
+from ..storage.store import (SerializationConflict, TableStore,
+                             WriteConflict)
 from ..storage.wal import Wal, checkpoint_store, restore_store
 from ..utils.faultinject import fault_point
 
@@ -35,12 +37,16 @@ class DataNode:
     in-process and multi-process deployments share one code path."""
 
     def __init__(self, index: int, datadir: Optional[str] = None):
+        from ..storage.lockmgr import LockManager
         self.index = index
         self.stores: dict[str, TableStore] = {}
         self.cache = DeviceTableCache()
         self.datadir = datadir
         self.wal: Optional[Wal] = None
         self.txn_spans: dict[int, list] = {}  # txid -> [(kind, table, span)]
+        # row-lock waits + wait-for edges (storage/lockmgr.py)
+        self.lockmgr = LockManager()
+        self.lock_timeout = 10.0
         # logical decoding hook (storage/logical.py LogicalDecoder),
         # attached by a LogicalPublisher
         self.decoder = None
@@ -119,11 +125,11 @@ class DataNode:
             self.decoder.on_insert(table, st, enc, masks, n, txid)
         return n
 
-    def delete_where(self, table: str, quals: list, snapshot_ts: int,
-                     txid: int) -> int:
+    def _target_masks(self, table: str, quals: list, snapshot_ts: int,
+                      txid: int) -> list:
         from ..exec.expr_compile import compile_pred, host_chunk_env
         st = self.stores[table]
-        n_deleted = 0
+        out = []
         for ci, ch in st.scan_chunks():
             mask = st.visible_mask(ch, snapshot_ts, txid)
             if quals:
@@ -133,16 +139,84 @@ class DataNode:
                     mask = mask & np.asarray(
                         compile_pred(q, dicts, nullable)(env))
             if mask.any():
-                if self.decoder is not None and not self._unlogged(table):
-                    # capture replica-identity rows BEFORE mark_delete
+                out.append((ci, ch, mask))
+        return out
+
+    def _await_holder(self, holder: int, waiter: int):
+        """Block until the conflicting txn resolves (reference:
+        XactLockTableWait).  Committed holder -> the targeted row
+        version is gone: serialization conflict (the CN retries
+        implicit statements with a fresh snapshot).  Aborted -> caller
+        simply retries the marking pass."""
+        v = self.lockmgr.verdict(holder)
+        if v is None:
+            v = self.lockmgr.wait_for(holder, waiter,
+                                      self.lock_timeout)
+        if v == "committed":
+            raise SerializationConflict(
+                "could not serialize access due to concurrent "
+                f"update (txn {holder} committed first)")
+
+    def delete_where(self, table: str, quals: list, snapshot_ts: int,
+                     txid: int) -> int:
+        """Mark matching rows deleted; a write-write conflict WAITS for
+        the holder (reference: heap_delete blocking on the updater xid)
+        then retries — first-deleter-wins only applies between two
+        still-in-progress transactions racing the same mark."""
+        st = self.stores[table]
+        while True:
+            targets = self._target_masks(table, quals, snapshot_ts,
+                                         txid)
+            marked = []
+            try:
+                for ci, ch, mask in targets:
+                    marked.append((st.mark_delete(ci, mask, txid),
+                                   ci, ch, mask))
+            except WriteConflict as e:
+                # atomic statement retry: revert THIS pass's marks so
+                # the decoder/WAL never see a half-marked statement
+                st.revert_delete([sp for sp, _ci, _ch, _m in marked])
+                self._await_holder(e.holder, txid)
+                continue
+            n_deleted = 0
+            for span, ci, ch, mask in marked:
+                if self.decoder is not None and \
+                        not self._unlogged(table):
                     self.decoder.on_delete(table, st, ch, mask, txid)
-                span = st.mark_delete(ci, mask, txid)
                 self.txn_spans.setdefault(txid, []).append(
                     ("del", table, span))
                 self.log({"op": "delete", "table": table, "chunk": ci,
                           "mask": mask, "txid": txid})
                 n_deleted += int(mask.sum())
-        return n_deleted
+            return n_deleted
+
+    def lock_where(self, table: str, quals: list, snapshot_ts: int,
+                   txid: int, nowait: bool = False) -> int:
+        """SELECT ... FOR UPDATE: exclusive row locks, held to txn end
+        (reference: heap_lock_tuple / LockRows node).  Locks are
+        transient (not WAL'd) — a crash aborts the holder anyway."""
+        st = self.stores[table]
+        while True:
+            targets = self._target_masks(table, quals, snapshot_ts,
+                                         txid)
+            locked = []
+            try:
+                for ci, _ch, mask in targets:
+                    locked.append(st.lock_rows(ci, mask, txid))
+            except WriteConflict as e:
+                st.clear_locks(locked)
+                if nowait:
+                    raise LockNotAvailable(
+                        "could not obtain lock on row "
+                        f"(held by txn {e.holder})") from None
+                self._await_holder(e.holder, txid)
+                continue
+            n = 0
+            for span in locked:
+                self.txn_spans.setdefault(txid, []).append(
+                    ("lock", table, span))
+                n += len(span[1])
+            return n
 
     def exec_plan_device(self, plan, snapshot_ts: int, txid: int,
                          params: dict, sources: dict):
@@ -238,6 +312,48 @@ class DataNode:
             total += self.stores[table].build_btree_index(col)
         return total
 
+    def truncate(self, table: str):
+        """Non-MVCC bulk clear (reference: ExecuteTruncate's
+        relfilenode swap); WAL-logged so recovery replays it in order
+        against earlier inserts."""
+        st = self.stores.get(table)
+        if st is None:
+            return 0
+        st.truncate()
+        self.cache.invalidate(st)
+        self.log({"op": "truncate", "table": table}, sync=True)
+        return 0
+
+    def savepoint_mark(self, txid: int) -> int:
+        """Current position in this txn's op list (reference:
+        subxact start, xact.c DefineSavepoint)."""
+        return len(self.txn_spans.get(txid, []))
+
+    def rollback_to_mark(self, txid: int, keep: int):
+        """Revert this txn's ops past `keep` (reference: subxact
+        abort).  The WAL subabort record carries the count of
+        WAL-VISIBLE ops kept (locks are never logged)."""
+        ops = self.txn_spans.get(txid, [])
+        undo = ops[keep:]
+        del ops[keep:]
+        wal_keep = sum(1 for kind, _t, _s in ops if kind != "lock")
+        logged = False
+        for kind, table, sp in reversed(undo):
+            st = self.stores.get(table)
+            if st is None:
+                continue
+            if kind == "ins":
+                st.abort_insert(sp)
+                logged = True
+            elif kind == "lock":
+                st.clear_locks([sp])
+            else:
+                st.revert_delete([sp])
+                logged = True
+        if logged:
+            self.log({"op": "subabort", "txid": txid,
+                      "keep": wal_keep})
+
     def vacuum(self, table, cutoff: int) -> int:
         """Compact dead rows.  Refuses (-1) while any txn holds positional
         spans on this node — compaction would shift the rows they
@@ -266,10 +382,14 @@ class DataNode:
                 continue
             if kind == "ins":
                 st.backfill_insert(sp, np.int64(ts))
+            elif kind == "lock":
+                st.clear_locks([sp])
             else:
                 st.backfill_delete([sp], np.int64(ts))
         if self.decoder is not None:
             self.decoder.on_commit(txid, ts)
+        # wake lock waiters LAST: they retry against settled state
+        self.lockmgr.resolve(txid, committed=True)
 
     def abort(self, txid: int):
         ops = self.txn_spans.pop(txid, [])
@@ -281,10 +401,13 @@ class DataNode:
                 continue
             if kind == "ins":
                 st.abort_insert(sp)
+            elif kind == "lock":
+                st.clear_locks([sp])
             else:
                 st.revert_delete([sp])
         if self.decoder is not None:
             self.decoder.on_abort(txid)
+        self.lockmgr.resolve(txid, committed=False)
 
     def wrote_in(self, txid: int) -> bool:
         return bool(self.txn_spans.get(txid))
@@ -357,6 +480,19 @@ class DataNode:
             elif op == "alter_table":
                 from ..exec.session import replay_alter
                 replay_alter(None, self.stores, rec)
+            elif op == "truncate":
+                st = self.stores.get(rec["table"])
+                if st is not None:
+                    st.truncate()
+            elif op == "subabort":
+                lst = pending.get(rec["txid"], [])
+                undo = lst[rec["keep"]:]
+                del lst[rec["keep"]:]
+                for kind, st, sp in undo:
+                    if kind == "ins":
+                        st.abort_insert(sp)
+                    else:
+                        st.revert_delete([sp])
             elif op == "prepare":
                 gid_of[rec["txid"]] = rec["gid"]
             elif op == "commit":
@@ -520,6 +656,16 @@ class Cluster:
         audit_path = os.path.join(self.datadir, "audit.log") \
             if self.datadir else None
         self.audit = AuditLogger(audit_path)
+        self._gdd = None
+
+    def ensure_gdd(self):
+        """Start the cross-node deadlock detector on first DML that can
+        wait (reference: the gdd worker is launched per cluster)."""
+        if self._gdd is None:
+            from .gdd import GddDetector
+            self._gdd = GddDetector(self)
+            self._gdd.start()
+        return self._gdd
 
     def resource_queue(self):
         """Admission-control queue per max_concurrent_queries GUC

@@ -1,0 +1,109 @@
+"""Global deadlock detection — union per-node wait-for graphs, break
+cycles.
+
+Reference analog: utils/gdd/gdd_detector.c — OpenTenBase's global
+deadlock detector collects each node's local wait-for edges, unions
+them into one graph, and aborts a transaction in every cycle.  Here a
+node's edges come straight from its LockManager (storage/lockmgr.py)
+instead of being reconstructed from pg_locks scans; the victim is the
+YOUNGEST transaction in the cycle (largest GTM txid — least work lost),
+killed via the lock manager so its own wait raises DeadlockDetected and
+its session aborts normally, releasing every lock it holds.
+
+Local (single-node) cycles never reach this detector: LockManager
+refuses them synchronously at wait time.  This thread exists for the
+cross-node case — txn A waits on B at dn0 while B waits on A at dn1 —
+which no single node can see.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def collect_edges(datanodes) -> dict[int, set[int]]:
+    """waiter txid -> {holder txids} across every datanode (in-process
+    lockmgr access, or the wait_edges RPC for TCP datanodes)."""
+    edges: dict[int, set[int]] = {}
+    for dn in datanodes:
+        try:
+            e = dn.lockmgr.wait_edges() if hasattr(dn, "lockmgr") \
+                else dn.wait_edges()
+        except Exception:
+            continue
+        for w, h in e.items():
+            edges.setdefault(int(w), set()).add(int(h))
+    return edges
+
+
+def find_cycle(edges: dict[int, set[int]]):
+    """One cycle (list of txids) in the wait-for multigraph, or None."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in edges}
+    stack_path: list[int] = []
+
+    def dfs(n):
+        color[n] = GRAY
+        stack_path.append(n)
+        for h in edges.get(n, ()):
+            if color.get(h, WHITE) == GRAY:
+                return stack_path[stack_path.index(h):]
+            if color.get(h, WHITE) == WHITE and h in edges:
+                got = dfs(h)
+                if got is not None:
+                    return got
+        stack_path.pop()
+        color[n] = BLACK
+        return None
+
+    for n in list(edges):
+        if color[n] == WHITE:
+            got = dfs(n)
+            if got is not None:
+                return got
+    return None
+
+
+def kill_victim(datanodes, victim: int):
+    for dn in datanodes:
+        try:
+            if hasattr(dn, "lockmgr"):
+                dn.lockmgr.kill(victim)
+            else:
+                dn.gdd_kill(victim)
+        except Exception:
+            pass
+
+
+class GddDetector(threading.Thread):
+    """Periodic cross-node cycle breaker (reference: the gdd worker;
+    period matches PostgreSQL's deadlock_timeout spirit, 1s)."""
+
+    def __init__(self, cluster, period: float = 1.0):
+        super().__init__(daemon=True, name="gdd-detector")
+        self.cluster = cluster
+        self.period = period
+        self._stop = threading.Event()
+        self.broken: list[int] = []      # victims, for observability
+
+    def stop(self):
+        self._stop.set()
+
+    def run(self):
+        while not self._stop.wait(self.period):
+            try:
+                self.check_once()
+            except Exception:
+                pass
+
+    def check_once(self):
+        edges = collect_edges(self.cluster.datanodes)
+        if not edges:
+            return None
+        cycle = find_cycle(edges)
+        if cycle is None:
+            return None
+        victim = max(cycle)              # youngest txn: least work lost
+        kill_victim(self.cluster.datanodes, victim)
+        self.broken.append(victim)
+        return victim

@@ -246,10 +246,33 @@ class Planner:
             all_cols |= cs_
         param_filters = []   # reference no table column (init-plan probes)
 
+        # WHERE quals touching the NULL-EXTENDED side of an outer join
+        # must filter the JOIN OUTPUT: pushed into the scan they would
+        # run before null-extension (a row failing them comes back as a
+        # null-extended row), and as join residual they would get ON
+        # semantics.  (Reference: reduce_outer_joins/qual placement in
+        # initsplan.c — PG pushes only after proving strictness and
+        # converting the join to inner; we keep the join and filter
+        # above, which is always correct.)
+        nullable_side: set[str] = set()
+        for st_ in bq.join_order:
+            if st_.kind == "left":
+                nullable_side.add(bq.rtable[st_.rte_index].alias)
+            elif st_.kind == "full":
+                nullable_side = set(rte_cols)
+                break
+        nullable_cols = set()
+        for a in nullable_side:
+            nullable_cols |= rte_cols[a]
+        post_filters: list[E.Expr] = []
+
         for q in where:
             cols = expr_cols(q)
             if not (cols & all_cols):
                 param_filters.append(q)
+                continue
+            if cols & nullable_cols:
+                post_filters.append(q)
                 continue
             own = owner_of(cols)
             if own is not None:
@@ -277,6 +300,12 @@ class Planner:
         still = [q for q in residual if not expr_cols(q) <= avail]
         if still:
             raise PlanError(f"unplaceable predicates: {still}")
+        if post_filters:
+            missing = [q for q in post_filters
+                       if not expr_cols(q) <= avail]
+            if missing:
+                raise PlanError(f"unplaceable predicates: {missing}")
+            plan = P.Filter(plan, post_filters)
         if param_filters:
             plan = P.Filter(plan, param_filters)
 

@@ -216,6 +216,9 @@ class SelectStmt(Node):
     # of exprs; plain GROUP BY items (group_by) prepend to every set
     # (reference: gram.y group_by_list -> GroupingSet nodes)
     group_sets: Optional[list[list[Node]]] = None
+    # SELECT ... FOR UPDATE row locking: None | 'wait' | 'nowait'
+    # (reference: LockingClause -> RowMarkClause, nodeLockRows.c)
+    for_update: Optional[str] = None
 
 
 # ---- DML ------------------------------------------------------------------
@@ -270,6 +273,10 @@ class ColumnDefAst(Node):
     type_args: tuple[int, ...]
     not_null: bool = False
     primary_key: bool = False
+    # column CHECK (expr) — the expression's SQL text (bound at use)
+    check_src: Optional[str] = None
+    # column REFERENCES reftable (refcol)
+    references: Optional[tuple[str, str]] = None
 
 
 @dataclasses.dataclass
@@ -283,6 +290,39 @@ class CreateTableStmt(Node):
     if_not_exists: bool = False
     # PARTITION BY RANGE|LIST (col) — reference: pg_partitioned_table
     partition_by: Optional[tuple[str, str]] = None   # (method, col)
+    # table CHECK constraints (expression SQL text; reference:
+    # pg_constraint contype 'c') and FOREIGN KEYs (contype 'f')
+    checks: list[str] = dataclasses.field(default_factory=list)
+    foreign_keys: list[tuple] = dataclasses.field(default_factory=list)
+    # each: (fk_cols tuple, ref_table, ref_cols tuple)
+
+
+@dataclasses.dataclass
+class TruncateStmt(Node):
+    """TRUNCATE [TABLE] name — non-MVCC bulk clear (reference:
+    ExecuteTruncate, commands/tablecmds.c)."""
+    table: str
+
+
+@dataclasses.dataclass
+class SavepointStmt(Node):
+    """SAVEPOINT / ROLLBACK TO / RELEASE — subtransactions
+    (reference: DefineSavepoint / RollbackToSavepoint, xact.c)."""
+    op: str                  # 'savepoint' | 'rollback_to' | 'release'
+    name: str
+
+
+@dataclasses.dataclass
+class MergeStmt(Node):
+    """MERGE INTO tgt USING src ON cond WHEN [NOT] MATCHED THEN ...
+    (reference: ExecMerge, executor/execMerge.c)."""
+    target: str
+    source: str
+    on: Node
+    matched_set: Optional[list] = None      # [(col, expr)] for UPDATE
+    matched_delete: bool = False            # WHEN MATCHED THEN DELETE
+    insert_cols: Optional[list] = None
+    insert_values: Optional[list] = None    # exprs over src columns
 
 
 @dataclasses.dataclass

@@ -33,7 +33,10 @@ def table_def_from_ast(stmt: A.CreateTableStmt) -> TableDef:
             pk.append(c.name)
     dist = Distribution(_DIST_MAP[stmt.dist_type], list(stmt.dist_cols),
                         stmt.group or "default_group")
-    return TableDef(stmt.name, cols, dist)
+    fks = [{"cols": list(fc), "ref_table": rt, "ref_cols": list(rc)}
+           for fc, rt, rc in stmt.foreign_keys]
+    return TableDef(stmt.name, cols, dist, checks=list(stmt.checks),
+                    fks=fks)
 
 
 def sequence_def_from_ast(stmt: A.CreateSequenceStmt) -> SequenceDef:

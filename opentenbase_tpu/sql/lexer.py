@@ -39,7 +39,8 @@ csv header begin commit rollback abort transaction work explain analyze
 analyse verbose vacuum show node group barrier execute direct prepare
 deallocate start for using nulls first last natural count sum avg min max
 coalesce nullif greatest least exclude checkpoint cluster pause unpause
-move year month day second minute hour
+move year month day second minute hour nowait
+check references foreign truncate savepoint release merge matched
 """.split())
 
 # fully reserved: cannot be used as table/column/alias identifiers

@@ -140,8 +140,24 @@ class Parser:
             return A.TxnStmt("commit")
         if v in ("rollback", "abort"):
             self.advance()
+            if self.accept_kw("to"):
+                self.accept_kw("savepoint")
+                return A.SavepointStmt("rollback_to", self.ident())
             self.accept_kw("transaction", "work")
             return A.TxnStmt("rollback")
+        if v == "savepoint":
+            self.advance()
+            return A.SavepointStmt("savepoint", self.ident())
+        if v == "release":
+            self.advance()
+            self.accept_kw("savepoint")
+            return A.SavepointStmt("release", self.ident())
+        if v == "truncate":
+            self.advance()
+            self.accept_kw("table")
+            return A.TruncateStmt(self.ident())
+        if v == "merge":
+            return self.merge_stmt()
         if v == "explain":
             self.advance()
             analyze = verbose = False
@@ -205,6 +221,57 @@ class Parser:
                 return A.DeallocateStmt(None)
             return A.DeallocateStmt(self.ident())
         raise SqlSyntaxError(f"unsupported statement {v!r}", self.sql, t.pos)
+
+    def merge_stmt(self) -> A.MergeStmt:
+        """MERGE INTO tgt USING src ON cond
+        WHEN MATCHED THEN UPDATE SET c = e, ... | DELETE
+        WHEN NOT MATCHED THEN INSERT [(cols)] VALUES (exprs)
+        (reference: gram.y MergeStmt -> execMerge.c)."""
+        self.expect_kw("merge")
+        self.expect_kw("into")
+        target = self.ident()
+        self.expect_kw("using")
+        source = self.ident()
+        self.expect_kw("on")
+        on = self.expr()
+        matched_set = None
+        matched_delete = False
+        insert_cols = insert_values = None
+        while self.accept_kw("when"):
+            negated = self.accept_kw("not")
+            self.expect_kw("matched")
+            self.expect_kw("then")
+            if negated:
+                self.expect_kw("insert")
+                if self.accept_op("("):
+                    insert_cols = [self.ident()]
+                    while self.accept_op(","):
+                        insert_cols.append(self.ident())
+                    self.expect_op(")")
+                self.expect_kw("values")
+                self.expect_op("(")
+                insert_values = [self.expr()]
+                while self.accept_op(","):
+                    insert_values.append(self.expr())
+                self.expect_op(")")
+            elif self.accept_kw("delete"):
+                matched_delete = True
+            else:
+                self.expect_kw("update")
+                self.expect_kw("set")
+                matched_set = []
+                while True:
+                    col = self.ident()
+                    self.expect_op("=")
+                    matched_set.append((col, self.expr()))
+                    if not self.accept_op(","):
+                        break
+        if matched_set is None and not matched_delete \
+                and insert_values is None:
+            raise SqlSyntaxError("MERGE needs at least one WHEN clause",
+                                 self.sql, self.tok.pos)
+        return A.MergeStmt(target, source, on, matched_set,
+                           matched_delete, insert_cols, insert_values)
 
     def prepare_stmt(self) -> A.PrepareStmt:
         """PREPARE name [(type, ...)] AS statement (reference:
@@ -409,6 +476,10 @@ class Parser:
                               else self.expr())
             elif self.accept_kw("offset"):
                 stmt.offset = self.expr()
+            elif self.accept_kw("for"):
+                self.expect_kw("update")
+                stmt.for_update = "nowait" if self.accept_kw("nowait") \
+                    else "wait"
             else:
                 break
 
@@ -725,6 +796,8 @@ class Parser:
         self.expect_op("(")
         columns: list[A.ColumnDefAst] = []
         pk: list[str] = []
+        checks: list[str] = []
+        fks: list[tuple] = []
         while True:
             if self.accept_kw("primary"):
                 self.expect_kw("key")
@@ -733,11 +806,34 @@ class Parser:
                 while self.accept_op(","):
                     pk.append(self.ident())
                 self.expect_op(")")
+            elif self.accept_kw("check"):
+                checks.append(self._check_expr_src())
+            elif self.accept_kw("foreign"):
+                self.expect_kw("key")
+                self.expect_op("(")
+                fcols = [self.ident()]
+                while self.accept_op(","):
+                    fcols.append(self.ident())
+                self.expect_op(")")
+                self.expect_kw("references")
+                rt = self.ident()
+                self.expect_op("(")
+                rcols = [self.ident()]
+                while self.accept_op(","):
+                    rcols.append(self.ident())
+                self.expect_op(")")
+                fks.append((tuple(fcols), rt, tuple(rcols)))
             else:
                 columns.append(self.column_def())
             if not self.accept_op(","):
                 break
         self.expect_op(")")
+        for c in columns:
+            if c.check_src:
+                checks.append(c.check_src)
+            if c.references:
+                fks.append(((c.name,), c.references[0],
+                            (c.references[1],)))
         dist_type, dist_cols, group = "shard", [], None
         if self.accept_kw("distribute"):
             self.expect_kw("by")
@@ -779,7 +875,8 @@ class Parser:
             dist_cols = [pk[0]] if pk else \
                 ([columns[0].name] if columns else [])
         return A.CreateTableStmt(name, columns, pk, dist_type, dist_cols,
-                                 group, if_not_exists, partition_by)
+                                 group, if_not_exists, partition_by,
+                                 checks, fks)
 
     def column_def(self) -> A.ColumnDefAst:
         name = self.ident()
@@ -796,6 +893,7 @@ class Parser:
             self.expect_op(")")
             targs = tuple(args)
         not_null = primary = False
+        check_src = references = None
         while True:
             if self.accept_kw("not"):
                 self.expect_kw("null")
@@ -805,9 +903,31 @@ class Parser:
                 primary = True
             elif self.accept_kw("null"):
                 pass
+            elif self.accept_kw("check"):
+                check_src = self._check_expr_src()
+            elif self.accept_kw("references"):
+                rt = self.ident()
+                self.expect_op("(")
+                rc = self.ident()
+                self.expect_op(")")
+                references = (rt, rc)
             else:
                 break
-        return A.ColumnDefAst(name, tname, targs, not_null, primary)
+        return A.ColumnDefAst(name, tname, targs, not_null, primary,
+                              check_src, references)
+
+    def _check_expr_src(self) -> str:
+        """CHECK ( expr ) — capture the expression's SOURCE text (the
+        catalog stores constraint text, like pg_constraint's conbin is
+        deparsed back to text; binding happens at enforcement)."""
+        self.expect_op("(")
+        start = self.tok.pos
+        depth = 0
+        # skip a balanced token stream (the expr may contain parens)
+        self.expr()
+        end = self.tok.pos
+        self.expect_op(")")
+        return self.sql[start:end].strip()
 
     def alter_stmt(self) -> A.AlterTableStmt:
         self.expect_kw("alter")

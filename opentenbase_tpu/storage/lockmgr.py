@@ -1,0 +1,113 @@
+"""Row-lock wait management — one LockManager per datanode.
+
+Reference analog: src/backend/storage/lmgr (XactLockTableWait: a txn
+waiting on another txn's completion to acquire a tuple lock) plus the
+distributed-deadlock machinery (utils/gdd/gdd_detector.c).
+
+TPU-first framing: row locks never touch the device data plane.  A
+conflict is discovered host-side during the (already host-side) DML
+marking pass, and waiting is a host thread blocking on the holder's
+commit/abort — the columnar batches and compiled programs stay lock-free.
+Only write-write conflicts ever wait; readers never block (MVCC).
+
+Wait edges (waiter txid -> holder txid) are exported per node; the
+cluster-level GDD detector (parallel/gdd.py) unions them across
+datanodes, finds cycles, and kills the youngest transaction in a cycle
+— the reference's global wait-for-graph algorithm, without the
+per-backend proclock scanning (gdd_detector.c builds the same graph
+from pg_stat_activity + lock tables).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+
+class LockTimeout(Exception):
+    pass
+
+
+class DeadlockDetected(Exception):
+    pass
+
+
+class LockNotAvailable(Exception):
+    """FOR UPDATE NOWAIT hit a held lock."""
+
+
+class LockManager:
+    # remembered txn verdicts (bounded): a waiter that observed the
+    # conflict just before the holder resolved still gets its answer
+    _RESOLVED_KEEP = 8192
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._resolved: OrderedDict[int, str] = OrderedDict()
+        self._waits: dict[int, int] = {}      # waiter -> holder
+        self._killed: set[int] = set()        # GDD victims
+
+    # ---- txn lifecycle ----
+    def resolve(self, txid: int, committed: bool):
+        with self._cond:
+            self._resolved[txid] = "committed" if committed \
+                else "aborted"
+            while len(self._resolved) > self._RESOLVED_KEEP:
+                self._resolved.popitem(last=False)
+            self._killed.discard(txid)
+            self._cond.notify_all()
+
+    def verdict(self, txid: int):
+        with self._cond:
+            return self._resolved.get(txid)
+
+    # ---- GDD surface ----
+    def wait_edges(self) -> dict[int, int]:
+        with self._cond:
+            return dict(self._waits)
+
+    def kill(self, txid: int):
+        """Mark a GDD victim: its own waits raise DeadlockDetected at
+        the next wakeup (the victim's session then aborts normally,
+        releasing its locks)."""
+        with self._cond:
+            self._killed.add(txid)
+            self._cond.notify_all()
+
+    # ---- the wait itself ----
+    def wait_for(self, holder: int, waiter: int,
+                 timeout: float) -> str:
+        """Block until `holder` commits or aborts.  Returns 'committed'
+        or 'aborted'; raises LockTimeout / DeadlockDetected.  A local
+        wait cycle (both txns waiting on this node) is detected
+        immediately; cross-node cycles are the GDD detector's job."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            h = holder
+            seen = set()
+            while h is not None and h not in seen:
+                if h == waiter:
+                    raise DeadlockDetected(
+                        f"deadlock detected: txn {waiter} and txn "
+                        f"{holder} wait on each other")
+                seen.add(h)
+                h = self._waits.get(h)
+            self._waits[waiter] = holder
+            try:
+                while True:
+                    if waiter in self._killed:
+                        self._killed.discard(waiter)
+                        raise DeadlockDetected(
+                            "deadlock detected (distributed cycle; "
+                            f"txn {waiter} chosen as victim)")
+                    v = self._resolved.get(holder)
+                    if v is not None:
+                        return v
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise LockTimeout(
+                            f"lock wait on txn {holder} timed out")
+                    self._cond.wait(min(remaining, 0.25))
+            finally:
+                self._waits.pop(waiter, None)

@@ -22,6 +22,7 @@ Reference analog: heap storage (src/backend/access/heap) + buffer manager
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Iterator, Optional
 
 import numpy as np
@@ -46,7 +47,20 @@ def _decimal_str(v: int, scale: int) -> str:
 
 
 class WriteConflict(Exception):
-    """Concurrent write-write conflict (first-deleter-wins)."""
+    """Concurrent write-write conflict.  Carries the holding txid so the
+    datanode's lock manager can wait for it (reference: the updater xid
+    a blocked heap_update waits on, XactLockTableWait)."""
+
+    def __init__(self, msg: str, holder: int = 0):
+        super().__init__(msg)
+        self.holder = int(holder)
+
+
+class SerializationConflict(Exception):
+    """The row version this txn targeted was replaced by a COMMITTED
+    concurrent writer (reference: 'could not serialize access due to
+    concurrent update').  Implicit single-statement transactions retry
+    with a fresh snapshot; explicit transactions surface the error."""
 
 
 import itertools as _itertools
@@ -112,6 +126,15 @@ class Chunk:
     # (reference: the per-tuple null bitmap in HeapTupleHeader,
     # include/access/htup_details.h t_bits)
     nulls: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    # row locks (SELECT FOR UPDATE), allocated lazily — transient, not
+    # checkpointed/WAL-logged: a crash aborts every holder anyway
+    # (reference: xmax LOCK_ONLY infomask bits, heapam.c)
+    lock_txid: np.ndarray = None
+
+    def lock_array(self) -> np.ndarray:
+        if self.lock_txid is None:
+            self.lock_txid = np.full(self.cap, NO_TXID, dtype=np.int64)
+        return self.lock_txid
 
     @staticmethod
     def empty(td: TableDef, cap: int = CHUNK_CAP) -> "Chunk":
@@ -146,6 +169,10 @@ class TableStore:
     def __init__(self, td: TableDef):
         self.td = td
         self.chunks: list[Chunk] = []
+        # serializes check-then-set row marking and chunk appends: DN
+        # host ops run concurrently across sessions (the reference gets
+        # per-tuple atomicity from buffer-page locks, bufmgr.c)
+        self._mu = threading.RLock()
         self.version = next(_VERSION_COUNTER)  # bumped on any mutation
         self.dicts: dict[str, StringDict] = {
             c.name: StringDict() for c in td.columns
@@ -245,6 +272,15 @@ class TableStore:
         (value arrays hold type-default fill there)."""
         if nrows == 0:
             return []
+        self._mu.acquire()
+        try:
+            return self._insert_locked(columns, nrows, txid, shardids,
+                                       commit_ts, nulls)
+        finally:
+            self._mu.release()
+
+    def _insert_locked(self, columns, nrows, txid, shardids,
+                       commit_ts, nulls):
         self.version = next(_VERSION_COUNTER)
         spans = []
         done = 0
@@ -286,17 +322,70 @@ class TableStore:
         updater's xid; we use first-deleter-wins + error, serializable-lite).
         Returns a (chunk_idx, row_indexes) span for the txn's backfill list.
         """
-        ch = self.chunks[chunk_idx]
-        idx = np.nonzero(row_mask[:ch.nrows])[0]
-        other = ch.xmax_txid[idx]
-        conflict = (other != NO_TXID) & (other != txid)
-        if conflict.any():
-            raise WriteConflict(
-                f"row already deleted by in-progress txn "
-                f"{int(other[conflict][0])}")
-        ch.xmax_txid[idx] = txid
+        with self._mu:
+            ch = self.chunks[chunk_idx]
+            idx = np.nonzero(row_mask[:ch.nrows])[0]
+            other = ch.xmax_txid[idx]
+            conflict = (other != NO_TXID) & (other != txid)
+            if conflict.any():
+                raise WriteConflict(
+                    f"row already deleted by in-progress txn "
+                    f"{int(other[conflict][0])}",
+                    holder=other[conflict][0])
+            if ch.lock_txid is not None:
+                lk = ch.lock_txid[idx]
+                lconf = (lk != NO_TXID) & (lk != txid)
+                if lconf.any():
+                    raise WriteConflict(
+                        f"row locked by in-progress txn "
+                        f"{int(lk[lconf][0])}", holder=lk[lconf][0])
+            ch.xmax_txid[idx] = txid
+            self.version = next(_VERSION_COUNTER)
+            return (chunk_idx, idx)
+
+    def lock_rows(self, chunk_idx: int, row_mask: np.ndarray,
+                  txid: int) -> tuple[int, np.ndarray]:
+        """SELECT FOR UPDATE: stamp row locks without deleting
+        (reference: heap_lock_tuple with LockTupleExclusive — xmax used
+        as a lock marker, HEAP_XMAX_LOCK_ONLY).  Conflicts with other
+        in-progress deleters AND other lockers; same wait protocol as
+        mark_delete.  Returns a (chunk_idx, row_indexes) span cleared at
+        txn end."""
+        with self._mu:
+            ch = self.chunks[chunk_idx]
+            idx = np.nonzero(row_mask[:ch.nrows])[0]
+            other = ch.xmax_txid[idx]
+            conflict = (other != NO_TXID) & (other != txid)
+            if conflict.any():
+                raise WriteConflict(
+                    f"row being deleted by in-progress txn "
+                    f"{int(other[conflict][0])}",
+                    holder=other[conflict][0])
+            la = ch.lock_array()
+            lk = la[idx]
+            lconf = (lk != NO_TXID) & (lk != txid)
+            if lconf.any():
+                raise WriteConflict(
+                    f"row locked by in-progress txn "
+                    f"{int(lk[lconf][0])}", holder=lk[lconf][0])
+            la[idx] = txid
+            return (chunk_idx, idx)
+
+    def truncate(self):
+        """Drop every row immediately (reference: ExecuteTruncate —
+        non-MVCC, the relfilenode swap).  Dictionaries survive (codes
+        may be referenced by WAL records not yet checkpointed)."""
+        self.chunks = []
+        self.ann_indexes = {}
+        self.btree_indexes = {}
+        self.null_columns = set()
         self.version = next(_VERSION_COUNTER)
-        return (chunk_idx, idx)
+
+    def clear_locks(self, spans):
+        for ci, idx in spans:
+            ch = self.chunks[ci]
+            if ch.lock_txid is not None:
+                ch.lock_txid[idx] = NO_TXID
 
     # -- commit/abort backfill (the CSN-log analog: we resolve commit
     #    timestamps into the hint columns eagerly, host-side; reference

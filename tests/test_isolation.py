@@ -1,27 +1,54 @@
 """Isolation suite: scripted multi-session interleavings over a real
 cluster (reference: src/test/isolation — 152 spec files of
 session/step/permutation scripts; this runner is the same idea in
-python form, ~20 specs over the engine's snapshot-isolation MVCC).
+python form, 40+ specs over the engine's snapshot-isolation MVCC with
+blocking row locks).
 
-Each spec: setup SQL, then ordered steps — ("s1", sql) executes on
-session s1, ("s1", sql, expected) asserts a query result, ("fault",
-point) arms a 2PC crash window, ("restart",) recovers the cluster."""
+Each spec: setup SQL, then ordered steps —
+  ("s1", sql)                 execute on session s1
+  ("s1", sql, expected)       assert a query result
+  ("block", "s2", sql)        start sql on s2 in a thread; assert it
+                              BLOCKS (still running after a grace wait)
+  ("join", "s2")              await the blocked statement; assert OK
+  ("join_error", "s2", sub)   await it; assert it failed, msg contains
+  ("error", "s1", sql, sub)   statement must fail synchronously
+  ("fault", point)            arm a 2PC crash window
+  ("restart",)                recover the cluster from disk
+"""
+
+import threading
+import time
 
 import pytest
 
 from opentenbase_tpu.exec.dist_session import ClusterSession
 from opentenbase_tpu.parallel.cluster import Cluster
-from opentenbase_tpu.storage.store import WriteConflict
 from opentenbase_tpu.utils import faultinject as FI
+
+
+class _Blocked:
+    def __init__(self, sess, sql):
+        self.err = None
+        self.done = threading.Event()
+
+        def run():
+            try:
+                sess.execute(sql)
+            except Exception as e:
+                self.err = e
+            finally:
+                self.done.set()
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
 
 
 def run_spec(tmp_path, spec):
     cluster = Cluster(n_datanodes=3, datadir=str(tmp_path / "cl"))
     sessions: dict = {}
+    blocked: dict = {}
 
     def sess(name):
-        if name == "restart":
-            return None
         if name not in sessions:
             sessions[name] = ClusterSession(cluster)
         return sessions[name]
@@ -29,37 +56,53 @@ def run_spec(tmp_path, spec):
     for sql in spec.get("setup", []):
         sess("s0").execute(sql)
     for step in spec["steps"]:
-        if step[0] == "fault":
+        kind = step[0]
+        if kind == "fault":
             FI.arm(step[1])
             continue
-        if step[0] == "disarm":
+        if kind == "disarm":
             FI.disarm()
             continue
-        if step[0] == "restart":
+        if kind == "restart":
             FI.disarm()
-            nonlocal_cluster = Cluster(datadir=str(tmp_path / "cl"))
+            cluster = Cluster(datadir=str(tmp_path / "cl"))
             sessions.clear()
-            cluster = nonlocal_cluster
-
-            def sess(name, _c=cluster):     # noqa: F811
-                if name not in sessions:
-                    sessions[name] = ClusterSession(_c)
-                return sessions[name]
             continue
-        if step[0] == "conflict":
+        if kind == "block":
             _, name, sql = step
-            with pytest.raises(WriteConflict):
+            b = _Blocked(sess(name), sql)
+            assert not b.done.wait(0.35), \
+                (spec["name"], "expected to block:", sql)
+            blocked[name] = b
+            continue
+        if kind == "join":
+            b = blocked.pop(step[1])
+            assert b.done.wait(30), (spec["name"], "still blocked")
+            assert b.err is None, (spec["name"], b.err)
+            continue
+        if kind == "join_error":
+            _, name, sub = step
+            b = blocked.pop(name)
+            assert b.done.wait(30), (spec["name"], "still blocked")
+            assert b.err is not None and sub in str(b.err).lower(), \
+                (spec["name"], b.err)
+            continue
+        if kind == "error":
+            _, name, sql, sub = step
+            with pytest.raises(Exception, match=sub):
                 sess(name).execute(sql)
             continue
-        if step[0] == "crash":
+        if kind == "crash":
             _, name, sql = step
             with pytest.raises(FI.InjectedFault):
                 sess(name).execute(sql)
             sess(name).txn = None
+            sess(name).txn_aborted = False
             continue
         name, sql = step[0], step[1]
         if len(step) == 3:
-            assert sess(name).query(sql) == step[2], (spec["name"], step)
+            assert sess(name).query(sql) == step[2], \
+                (spec["name"], step)
         else:
             sess(name).execute(sql)
     FI.disarm()
@@ -86,78 +129,94 @@ SPECS = [
                ("s1", "insert into t values (100, 9.0)"),
                ("s1", "select v from t where k = 100", [(9.0,)]),
                ("s1", "rollback"),
-               ("s1", "select count(*) from t where k = 100", [(0,)])]},
+               ("s1", "select count(*) from t where k = 100",
+                [(0,)])]},
     {"name": "repeatable-snapshot-within-txn",
      "setup": BASE,
      "steps": [("s1", "begin"),
                ("s1", "select count(*) from t", [(12,)]),
-               ("s2", "insert into t values (100, 1.0)"),
-               ("s1", "select count(*) from t", [(12,)]),   # no phantom
+               ("s2", "insert into t values (200, 1.0)"),
+               ("s1", "select count(*) from t", [(12,)]),
                ("s1", "commit"),
                ("s1", "select count(*) from t", [(13,)])]},
-    {"name": "delete-invisible-until-commit",
-     "setup": BASE,
-     "steps": [("s1", "begin"),
-               ("s1", "delete from t where k < 6"),
-               ("s2", "select count(*) from t", [(12,)]),
-               ("s1", "commit"),
-               ("s2", "select count(*) from t", [(6,)])]},
-    {"name": "multi-dn-commit-atomic-visibility",
-     "setup": BASE,
-     "steps": [("s1", "begin"),
-               ("s1", "delete from t where k < 4"),
-               ("s1", "insert into t values (200, 1.0), (201, 1.0)"),
-               ("s2", "select count(*) from t", [(12,)]),
-               ("s1", "commit"),
-               # reader sees BOTH effects or neither — never a mix
-               ("s2", "select count(*) from t", [(10,)])]},
-    {"name": "aborted-multi-dn-txn-leaves-nothing",
-     "setup": BASE,
-     "steps": [("s1", "begin"),
-               ("s1", "insert into t values (300, 1.0), (301, 1.0), "
-                      "(302, 1.0), (303, 1.0)"),
-               ("s1", "rollback"),
-               ("s2", "select count(*) from t", [(12,)])]},
-    {"name": "update-visible-after-commit-only",
+    {"name": "uncommitted-update-invisible",
      "setup": BASE,
      "steps": [("s1", "begin"),
                ("s1", "update t set v = 99 where k = 3"),
                ("s2", "select v from t where k = 3", [(3.5,)]),
                ("s1", "commit"),
                ("s2", "select v from t where k = 3", [(99.0,)])]},
-    # ---- write-write conflict matrix --------------------------------
-    {"name": "delete-delete-conflict",
+    {"name": "rolled-back-update-never-visible",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "update t set v = 99 where k = 3"),
+               ("s1", "rollback"),
+               ("s2", "select v from t where k = 3", [(3.5,)])]},
+    {"name": "delete-invisible-until-commit",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "delete from t where k = 4"),
+               ("s2", "select count(*) from t", [(12,)]),
+               ("s1", "commit"),
+               ("s2", "select count(*) from t", [(11,)])]},
+    # ---- write-write conflicts now BLOCK (reference: heap_update
+    # waiting on the first updater's xid, then re-checking) -----------
+    {"name": "delete-delete-blocks-until-rollback",
      "setup": BASE,
      "steps": [("s1", "begin"),
                ("s1", "delete from t where k = 5"),
-               ("conflict", "s2", "delete from t where k = 5"),
+               ("block", "s2", "delete from t where k = 5"),
                ("s1", "rollback"),
-               ("s2", "delete from t where k = 5"),
+               ("join", "s2"),          # holder aborted: s2's delete wins
                ("s2", "select count(*) from t", [(11,)])]},
-    {"name": "update-update-conflict",
+    {"name": "update-update-blocks-then-applies-to-new-version",
      "setup": BASE,
      "steps": [("s1", "begin"),
                ("s1", "update t set v = 1 where k = 5"),
-               ("conflict", "s2", "update t set v = 2 where k = 5"),
+               ("block", "s2", "update t set v = 3 where k = 5"),
                ("s1", "commit"),
-               ("s2", "update t set v = 3 where k = 5"),
+               # READ COMMITTED re-check: s2 retries on the committed
+               # version; neither update is lost
+               ("join", "s2"),
                ("s2", "select v from t where k = 5", [(3.0,)])]},
-    {"name": "update-delete-conflict",
+    {"name": "update-delete-blocks-until-rollback",
      "setup": BASE,
      "steps": [("s1", "begin"),
                ("s1", "update t set v = 1 where k = 7"),
-               ("conflict", "s2", "delete from t where k = 7"),
+               ("block", "s2", "delete from t where k = 7"),
                ("s1", "rollback"),
-               ("s2", "delete from t where k = 7")]},
+               ("join", "s2"),
+               ("s2", "select count(*) from t where k = 7", [(0,)])]},
+    {"name": "delete-then-committed-delete-deletes-nothing",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "delete from t where k = 5"),
+               ("block", "s2", "delete from t where k = 5"),
+               ("s1", "commit"),
+               # row is gone when s2's retry re-evaluates: 0 rows
+               ("join", "s2"),
+               ("s2", "select count(*) from t", [(11,)])]},
     {"name": "conflict-scoped-to-rows",
      "setup": BASE,
      "steps": [("s1", "begin"),
                ("s1", "delete from t where k = 5"),
-               ("s2", "delete from t where k = 6"),  # disjoint: fine
+               ("s2", "delete from t where k = 6"),  # disjoint: no wait
                ("s1", "commit"),
                ("s1", "select count(*) from t", [(10,)])]},
+    {"name": "explicit-txn-serialization-error",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s2", "begin"),
+               ("s2", "select count(*) from t", [(12,)]),
+               ("s1", "update t set v = 1 where k = 5"),
+               ("block", "s2", "update t set v = 2 where k = 5"),
+               ("s1", "commit"),
+               # REPEATABLE READ: the blocked explicit txn errors
+               ("join_error", "s2", "serialize"),
+               ("s2", "rollback"),
+               ("s2", "select v from t where k = 5", [(1.0,)])]},
     {"name": "write-skew-allowed-snapshot-isolation",
-     # documented deviation: SI permits write skew (no blocking reads)
+     # documented deviation: SI permits write skew (no predicate locks)
      "setup": BASE,
      "steps": [("s1", "begin"),
                ("s2", "begin"),
@@ -168,7 +227,122 @@ SPECS = [
                ("s1", "commit"),
                ("s2", "commit"),
                ("s1", "select count(*) from t", [(14,)])]},
-    # ---- 2PC crash windows × readers ---------------------------------
+    # ---- SELECT FOR UPDATE ------------------------------------------
+    {"name": "for-update-blocks-writer",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "select v from t where k = 2 for update",
+                [(2.5,)]),
+               ("block", "s2", "update t set v = 9 where k = 2"),
+               ("s1", "commit"),
+               ("join", "s2"),
+               ("s2", "select v from t where k = 2", [(9.0,)])]},
+    {"name": "for-update-blocks-deleter",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "select v from t where k = 2 for update",
+                [(2.5,)]),
+               ("block", "s2", "delete from t where k = 2"),
+               ("s1", "rollback"),
+               ("join", "s2"),
+               ("s2", "select count(*) from t where k = 2", [(0,)])]},
+    {"name": "for-update-blocks-for-update",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "select v from t where k = 2 for update",
+                [(2.5,)]),
+               ("block", "s2",
+                "select v from t where k = 2 for update"),
+               ("s1", "commit"),
+               ("join", "s2")]},
+    {"name": "for-update-nowait-errors-immediately",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "select v from t where k = 2 for update",
+                [(2.5,)]),
+               ("error", "s2",
+                "select v from t where k = 2 for update nowait",
+                "could not obtain lock"),
+               ("s1", "rollback")]},
+    {"name": "for-update-disjoint-rows-no-wait",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "select v from t where k = 2 for update",
+                [(2.5,)]),
+               ("s2", "select v from t where k = 3 for update",
+                [(3.5,)]),
+               ("s1", "commit")]},
+    {"name": "for-update-readers-never-block",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "select v from t where k = 2 for update",
+                [(2.5,)]),
+               ("s2", "select v from t where k = 2", [(2.5,)]),
+               ("s1", "commit")]},
+    {"name": "for-update-released-on-rollback",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "select v from t where k = 2 for update",
+                [(2.5,)]),
+               ("s1", "rollback"),
+               ("s2", "select v from t where k = 2 for update nowait",
+                [(2.5,)])]},
+    {"name": "for-update-released-on-statement-error",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "select v from t where k = 2 for update",
+                [(2.5,)]),
+               # error aborts the txn NOW: locks release immediately
+               ("error", "s1", "select * from nonexistent",
+                "does not exist"),
+               ("s2", "select v from t where k = 2 for update nowait",
+                [(2.5,)]),
+               ("s1", "rollback")]},
+    {"name": "for-update-implicit-txn-releases-at-statement-end",
+     "setup": BASE,
+     "steps": [("s1", "select v from t where k = 2 for update",
+                [(2.5,)]),
+               ("s2", "select v from t where k = 2 for update nowait",
+                [(2.5,)])]},
+    {"name": "for-update-whole-table",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "select count(*) from t", [(12,)]),
+               ("s1", "select k from t for update"),
+               ("block", "s2", "update t set v = 0 where k = 11"),
+               ("s1", "commit"),
+               ("join", "s2")]},
+    # ---- aborted-transaction state ----------------------------------
+    {"name": "failed-statement-poisons-explicit-txn",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "insert into t values (300, 1.0)"),
+               ("error", "s1", "select * from nonexistent",
+                "does not exist"),
+               ("error", "s1", "select count(*) from t",
+                "transaction is aborted"),
+               ("s1", "rollback"),
+               ("s1", "select count(*) from t", [(12,)])]},
+    {"name": "commit-of-aborted-txn-rolls-back",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "insert into t values (300, 1.0)"),
+               ("error", "s1", "select * from nonexistent",
+                "does not exist"),
+               ("s1", "commit"),
+               ("s1", "select count(*) from t", [(12,)])]},
+    {"name": "error-releases-write-marks",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "delete from t where k = 5"),
+               ("error", "s1", "select * from nonexistent",
+                "does not exist"),
+               # s1's pending delete mark reverted at error time:
+               # s2 deletes without waiting
+               ("s2", "delete from t where k = 5"),
+               ("s1", "rollback"),
+               ("s2", "select count(*) from t", [(11,)])]},
+    # ---- 2PC crash windows x readers --------------------------------
     {"name": "crash-before-prepare-reader-clean",
      "setup": BASE,
      "steps": [("s1", "begin"),
@@ -205,19 +379,167 @@ SPECS = [
      "setup": BASE,
      "steps": [("s1", "insert into t values (800, 1.0)"),
                ("s2", "insert into t values (801, 1.0)"),
-               ("s3", "select count(*) from t where k >= 800", [(2,)])]},
+               ("s3", "select count(*) from t where k >= 800",
+                [(2,)])]},
     {"name": "new-session-sees-latest",
      "setup": BASE,
      "steps": [("s1", "begin"),
                ("s1", "insert into t values (900, 1.0)"),
                ("s1", "commit"),
                ("s9", "select count(*) from t", [(13,)])]},
+    # ---- multi-statement read-modify-write --------------------------
+    {"name": "rmw-for-update-serializes",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "select v from t where k = 1 for update",
+                [(1.5,)]),
+               ("block", "s2", "update t set v = v + 1 where k = 1"),
+               ("s1", "update t set v = v + 10 where k = 1"),
+               ("s1", "commit"),
+               ("join", "s2"),
+               ("s3", "select v from t where k = 1", [(12.5,)])]},
+    {"name": "insert-insert-no-conflict",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s2", "begin"),
+               ("s1", "insert into t values (950, 1.0)"),
+               ("s2", "insert into t values (951, 1.0)"),
+               ("s1", "commit"),
+               ("s2", "commit"),
+               ("s3", "select count(*) from t where k >= 950",
+                [(2,)])]},
+    {"name": "update-nonoverlapping-predicates-no-wait",
+     "setup": BASE,
+     "steps": [("s1", "begin"),
+               ("s1", "update t set v = 0 where k < 3"),
+               ("s2", "update t set v = 0 where k > 8"),
+               ("s1", "commit"),
+               ("s3", "select count(*) from t where v = 0", [(6,)])]},
 ]
 
 
 @pytest.mark.parametrize("spec", SPECS, ids=[s["name"] for s in SPECS])
 def test_isolation_spec(tmp_path, spec):
     run_spec(tmp_path, spec)
+
+
+class TestLostUpdates:
+    """The done-criterion workload: concurrent increments lose ZERO
+    updates (reference: the lost-update anomaly EvalPlanQual exists to
+    prevent; here update-takes-row-locks + statement retry)."""
+
+    def test_concurrent_increments_cluster(self, tmp_path):
+        cluster = Cluster(n_datanodes=2)
+        s = ClusterSession(cluster)
+        s.execute("create table c (k bigint primary key, v bigint) "
+                  "distribute by shard(k)")
+        s.execute("insert into c values (1, 0)")
+        N, W = 15, 3
+        errs = []
+
+        def worker():
+            sess = ClusterSession(cluster)
+            try:
+                for _ in range(N):
+                    sess.execute("update c set v = v + 1 where k = 1")
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(W)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        assert not errs, errs
+        assert s.query("select v from c where k = 1") == [(N * W,)]
+
+    def test_concurrent_increments_single_node(self):
+        from opentenbase_tpu.exec.session import LocalNode, Session
+        node = LocalNode()
+        s = Session(node)
+        s.execute("create table c (k bigint primary key, v bigint)")
+        s.execute("insert into c values (1, 0)")
+        N, W = 15, 3
+        errs = []
+
+        def worker():
+            sess = Session(node)
+            try:
+                for _ in range(N):
+                    sess.execute("update c set v = v + 1 where k = 1")
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(W)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        assert not errs, errs
+        assert s.query("select v from c where k = 1") == [(N * W,)]
+
+
+class TestDeadlock:
+    def test_cross_row_deadlock_broken(self):
+        cluster = Cluster(n_datanodes=2)
+        s = ClusterSession(cluster)
+        s.execute("create table d (k bigint primary key, v bigint) "
+                  "distribute by shard(k)")
+        s.execute("insert into d values (1, 0), (2, 0)")
+        sA, sB = ClusterSession(cluster), ClusterSession(cluster)
+        sA.execute("begin")
+        sB.execute("begin")
+        sA.query("select v from d where k = 1 for update")
+        sB.query("select v from d where k = 2 for update")
+        res = {}
+
+        def go(sess, key, tag):
+            try:
+                sess.execute(f"update d set v = v + 1 where k = {key}")
+                res[tag] = "ok"
+            except Exception as e:
+                res[tag] = str(e)
+
+        ta = threading.Thread(target=go, args=(sA, 2, "a"))
+        tb = threading.Thread(target=go, args=(sB, 1, "b"))
+        ta.start()
+        tb.start()
+        ta.join(30)
+        tb.join(30)
+        assert not ta.is_alive() and not tb.is_alive()
+        fails = [v for v in res.values() if v != "ok"]
+        assert fails and any("deadlock" in f.lower() for f in fails), \
+            res
+        for ss in (sA, sB):
+            try:
+                ss.execute("rollback")
+            except Exception:
+                pass
+        # the cluster is usable afterwards
+        s.execute("update d set v = 100 where k = 1")
+        assert s.query("select v from d where k = 1") == [(100,)]
+
+    def test_local_two_txn_cycle_detected_synchronously(self):
+        from opentenbase_tpu.storage.lockmgr import (DeadlockDetected,
+                                                     LockManager)
+        lm = LockManager()
+        done = threading.Event()
+
+        def first():
+            try:
+                lm.wait_for(2, 1, timeout=5)
+            except Exception:
+                pass
+            finally:
+                done.set()
+
+        t = threading.Thread(target=first, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        with pytest.raises(DeadlockDetected):
+            lm.wait_for(1, 2, timeout=5)
+        lm.resolve(2, committed=False)
+        done.wait(5)
 
 
 class TestClockInvariants:

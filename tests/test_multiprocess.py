@@ -226,3 +226,87 @@ class TestTcpCluster:
         servers[0].stop()
         proxy.close()
         assert proxy.ping() is False
+
+
+class TestTcpMeshTier:
+    def test_join_query_rides_device_mesh(self, tcp_cluster):
+        """The device data plane works ACROSS process boundaries: remote
+        DNs ship version-cached shard snapshots to the mesh owner
+        (stage_table RPC), and the query compiles to the same shard_map
+        program as the in-process deployment (reference: the FN
+        sender/receiver pair as separate processes, forwardsend.c:165,
+        forwardrecv.c:141)."""
+        s, *_ = tcp_cluster
+        s.execute("create table f (k bigint primary key, g bigint, "
+                  "v bigint) distribute by shard(k)")
+        s.execute("create table dm (g bigint primary key, nm bigint) "
+                  "distribute by shard(g)")
+        s.execute("insert into f values " + ", ".join(
+            f"({i}, {i % 3}, {i * 10})" for i in range(30)))
+        s.execute("insert into dm values (0, 100), (1, 200), (2, 300)")
+        got = sorted(s.query(
+            "select nm, sum(v) from f, dm where f.g = dm.g "
+            "group by nm"))
+        assert got == [(100, 1350), (200, 1450), (300, 1550)]
+        assert s.last_tier == "mesh", s.last_fallback
+
+    def test_snapshot_cache_invalidates_on_write(self, tcp_cluster):
+        s, *_ = tcp_cluster
+        s.execute("create table w (k bigint primary key, v bigint) "
+                  "distribute by shard(k)")
+        s.execute("insert into w values (1, 10), (2, 20), (3, 30)")
+        assert s.query("select count(*), sum(v) from w") == [(3, 60)]
+        t1 = s.last_tier
+        s.execute("update w set v = v + 1 where k = 2")
+        assert s.query("select count(*), sum(v) from w") == [(3, 61)]
+        s.execute("delete from w where k = 1")
+        assert s.query("select count(*), sum(v) from w") == [(2, 51)]
+        assert t1 == "mesh", s.last_fallback
+
+
+class TestConnectionPool:
+    def test_session_churn_reuses_sockets(self, tcp_cluster):
+        """The pooler criterion (reference: poolmgr.c:632): connections
+        survive session end — N short-lived sessions lease warm sockets
+        instead of opening new ones."""
+        s, *_ = tcp_cluster
+        cluster = s.cluster
+        s.execute("create table pc (k bigint primary key) "
+                  "distribute by shard(k)")
+        s.execute("insert into pc values (1), (2), (3)")
+        created0 = sum(dn.pool.created for dn in cluster.datanodes)
+        for _ in range(6):
+            churn = ClusterSession(cluster)
+            assert churn.query("select count(*) from pc") == [(3,)]
+        created1 = sum(dn.pool.created for dn in cluster.datanodes)
+        leases = sum(dn.pool.leases for dn in cluster.datanodes)
+        assert created1 == created0, "session churn opened new sockets"
+        assert leases > created1
+
+    def test_concurrent_rpcs_one_node(self, tcp_cluster):
+        """A blocked lock RPC must not starve other traffic to the same
+        DN (per-call leasing)."""
+        import threading
+        import time as _t
+        s, *_ = tcp_cluster
+        s2 = ClusterSession(s.cluster)
+        s.execute("create table cc (k bigint primary key, v bigint) "
+                  "distribute by shard(k)")
+        s.execute("insert into cc values (1, 0), (2, 0)")
+        s.execute("begin")
+        s.query("select v from cc where k = 1 for update")
+        done = []
+
+        def blocked():
+            s2.execute("update cc set v = 1 where k = 1")
+            done.append(1)
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        _t.sleep(0.3)
+        # the same DN still answers other sessions while one is blocked
+        s3 = ClusterSession(s.cluster)
+        assert s3.query("select count(*) from cc") == [(2,)]
+        s.execute("commit")
+        t.join(20)
+        assert done

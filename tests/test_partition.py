@@ -157,3 +157,64 @@ class TestPartitionRecovery:
             [(1, 10), (2, 20), (3, 30)]
         s2.execute("insert into m values (4,'1999-01-20',40)")
         assert s2.query("select count(*) from m_q1") == [(3,)]
+
+
+class TestClusterParentParity:
+    """Round-3 advisor findings: cluster-mode partition paths must match
+    the single-node session (bounds check on child insert, ALTER
+    recursion, parent-qualified DML)."""
+
+    def test_child_insert_bound_enforced(self, cs):
+        with pytest.raises(ExecError, match="partition constraint"):
+            cs.execute("insert into m_q1 values (9,'1999-06-15',0)")
+        # nothing silently dropped from parent reads
+        assert cs.query("select count(*) from m "
+                        "where d > '1999-06-01'") == [(0,)]
+
+    def test_alter_recurses_to_children(self, cs):
+        cs.execute("alter table m add column note bigint")
+        cs.execute("insert into m values (7,'1999-02-02',70,700)")
+        got = sorted(cs.query("select id, note from m"))
+        assert got == [(1, None), (2, None), (3, None), (7, 700)] or \
+            got == [(1, 0), (2, 0), (3, 0), (7, 700)]
+        cs.execute("alter table m drop column note")
+        assert len(cs.query("select * from m")[0]) == 3
+
+    def test_parent_qualified_dml(self, cs):
+        cs.execute("delete from m where m.d < '1999-04-01'")
+        assert sorted(cs.query("select id from m")) == [(2,)]
+        cs.execute("update m set v = m.v + 5 where m.id = 2")
+        assert cs.query("select v from m") == [(25,)]
+
+
+class TestRecursiveTypeCheck:
+    def test_wider_recursive_term_rejected(self):
+        s = Session(LocalNode())
+        with pytest.raises(ExecError, match="recursive"):
+            s.query("with recursive t(n) as (select 1 union all "
+                    "select n+0.5 from t where n < 3) "
+                    "select * from t")
+
+    def test_null_and_float_base_columns_ok(self):
+        s = Session(LocalNode())
+        assert s.query(
+            "with recursive t(n, m) as (select 1, null union all "
+            "select n+1, m from t where n < 3) select n, m from t") == \
+            [(1, None), (2, None), (3, None)]
+        assert s.query(
+            "with recursive t(n) as (select 1.5 union all "
+            "select n+1 from t where n < 3) "
+            "select count(*) from t") == [(3,)]
+
+
+class TestAlterPartitionGuards:
+    def test_child_rename_rejected(self, cs):
+        with pytest.raises(ExecError, match="rename partition"):
+            cs.execute("alter table m_q1 rename to zz")
+
+    def test_partition_key_alter_rejected(self, cs):
+        for bad in ("alter table m drop column d",
+                    "alter table m rename column d to e",
+                    "alter table m_q1 drop column d"):
+            with pytest.raises(ExecError, match="partition key"):
+                cs.execute(bad)

@@ -61,15 +61,26 @@ class TestTxn:
         sess.execute("commit")
         assert other.query("select count(*) from emp") == [(4,)]
 
-    def test_write_write_conflict(self, sess):
+    def test_write_write_conflict_blocks(self, sess):
+        # a conflicting write now WAITS for the holder (reference:
+        # heap_delete blocking on the updater xid) instead of erroring
+        import threading
         other = Session(sess.node)
         sess.execute("begin")
         sess.execute("delete from emp where id = 1")
-        with pytest.raises(WriteConflict):
-            other.execute("delete from emp where id = 1")
+        res = {}
+
+        def go():
+            res["n"] = other.execute(
+                "delete from emp where id = 1")[0].rowcount
+
+        t = threading.Thread(target=go)
+        t.start()
+        t.join(0.3)
+        assert t.is_alive(), "conflicting delete should block"
         sess.execute("rollback")
-        # lock released: other session may now delete
-        assert other.execute("delete from emp where id = 1")[0].rowcount == 1
+        t.join(15)
+        assert not t.is_alive() and res["n"] == 1
 
 
 class TestRecovery:
