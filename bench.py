@@ -90,6 +90,24 @@ def _pandas_q5(dfs):
         "rev", ascending=False)
 
 
+# columns each query actually touches (8 bytes/value storage) — the
+# bytes-touched estimate under perfect column pruning
+_Q_COLS = {
+    1: {"lineitem": 7},                       # shipdate,qty,price,disc,tax,rf,ls
+    3: {"lineitem": 4, "orders": 4, "customer": 2},
+    5: {"lineitem": 4, "orders": 3, "customer": 2, "supplier": 2,
+        "nation": 3, "region": 2},
+}
+
+
+def _gb_touched(qn, data):
+    total = 0
+    for t, ncols in _Q_COLS.get(qn, {}).items():
+        rows = len(next(iter(data[t].values())))
+        total += rows * ncols * 8
+    return total / 1e9
+
+
 def _time(fn, repeat):
     fn()  # warm (compile + staging)
     times = []
@@ -159,9 +177,11 @@ def main():
                         n_rows)
         eng = _time(lambda: s1.query(Q[1]), repeat)
         ctl = _time(lambda: _pandas_q1(dfs), max(2, repeat // 2))
+        gb1 = _gb_touched(1, data)
         ladder.append({"config": "Q1 single", "engine_ms": eng * 1e3,
                        "mrows_s": n_rows / eng / 1e6,
-                       "vs_pandas": ctl / eng})
+                       "vs_pandas": ctl / eng,
+                       "gb_touched": gb1, "gb_per_s": gb1 / eng})
         del s1, node
 
     # ---- config 2: Q1/Q3/Q5 through the device-mesh data plane ----
@@ -181,17 +201,20 @@ def main():
         for qn in (1, 3, 5):
             eng = _time(lambda: s2.query(Q[qn]), repeat)
             ctl = _time(lambda: controls[qn](dfs), max(2, repeat // 2))
+            gb = _gb_touched(qn, data)
             entry = {"config": f"Q{qn} mesh x{ndn}",
                      "engine_ms": eng * 1e3,
                      "mrows_s_chip": n_rows / eng / 1e6 / ndn,
                      "vs_pandas": ctl / eng,
+                     "gb_touched": gb,
+                     "gb_per_s": gb / eng,
                      "tier": s2.last_tier}
             if s2.last_tier != "mesh":
                 entry["fallback"] = s2.last_fallback
             ladder.append(entry)
             if qn == 1:
                 mesh_q1 = entry
-        if os.environ.get("BENCH_OLTP"):
+        if os.environ.get("BENCH_OLTP", "1") != "0":
             ins_p50, raw_p50, prep_p50 = _oltp_latencies(s2)
             ladder.append({"config": "point ops",
                            "insert_p50_ms": ins_p50,

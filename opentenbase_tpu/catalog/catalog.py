@@ -58,7 +58,34 @@ class Catalog:
         # "values"}]} (reference: pg_partitioned_table + pg_class
         # relispartition; pruning happens at bind time)
         self.partitioned: dict[str, dict] = {}
+        # SPM plan baselines: statement fingerprint (literal-masked AST
+        # hash) -> accepted join order (reference: optimizer/spm/spm.c
+        # — capture once, replay for plan stability across stats churn)
+        self.spm: dict[str, list] = {}
+        # node groups: name -> member datanode indexes; sharded tables
+        # with a non-default group place rows on members only via a
+        # per-group shard map (reference: pgxc_group.h + nodemgr.c)
+        self.node_groups: dict[str, list] = {}
+        self.group_shard_maps: dict[str, list] = {}
         self._next_oid = 16384
+
+    def create_node_group(self, name: str, members: list):
+        import numpy as np
+        with self._lock:
+            if name in self.node_groups:
+                raise CatalogError(f"node group {name!r} already exists")
+            self.node_groups[name] = list(members)
+            self.group_shard_maps[name] = (
+                np.asarray(members, np.int32)[
+                    np.arange(len(self.shard_map)) % len(members)]
+                .tolist())
+
+    def shard_map_for_group(self, group: str):
+        import numpy as np
+        m = self.group_shard_maps.get(group)
+        if m is None:
+            return self.shard_map
+        return np.asarray(m, np.int32)
 
     # ---- tables ----
     def create_table(self, td: TableDef, if_not_exists: bool = False) -> TableDef:
@@ -78,6 +105,9 @@ class Catalog:
                 if not td.has_column(dc):
                     raise CatalogError(
                         f"distribution column {dc!r} not in table {td.name!r}")
+            grp = td.distribution.group
+            if grp != "default_group" and grp not in self.node_groups:
+                raise CatalogError(f"node group {grp!r} does not exist")
             td.oid = self._next_oid
             self._next_oid += 1
             self.tables[td.name] = td
@@ -161,6 +191,9 @@ class Catalog:
                 "stats": self.stats,
                 "views": self.views,
                 "partitioned": self.partitioned,
+                "spm": self.spm,
+                "node_groups": self.node_groups,
+                "group_shard_maps": self.group_shard_maps,
                 "next_oid": self._next_oid,
             }
         tmp = path + ".tmp"
@@ -190,5 +223,8 @@ class Catalog:
         cat.stats = blob.get("stats", {})
         cat.views = blob.get("views", {})
         cat.partitioned = blob.get("partitioned", {})
+        cat.spm = blob.get("spm", {})
+        cat.node_groups = blob.get("node_groups", {})
+        cat.group_shard_maps = blob.get("group_shard_maps", {})
         cat._next_oid = blob.get("next_oid", 16384)
         return cat

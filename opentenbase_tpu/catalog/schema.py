@@ -24,6 +24,7 @@ class DistType(enum.Enum):
     HASH = "hash"               # hash(dist cols) mod nodecount (legacy XC)
     MODULO = "modulo"           # dist col value mod nodecount
     ROUNDROBIN = "roundrobin"   # writer round-robins rows
+    RANGE = "range"             # split points -> contiguous node ranges
     SINGLE = "single"           # un-distributed (catalog/CN-local)
 
 
@@ -35,15 +36,21 @@ class Distribution:
     dist_type: DistType
     dist_cols: list[str] = dataclasses.field(default_factory=list)
     group: str = "default_group"
+    # RANGE distribution split points (storage-representation values):
+    # node i holds [bounds[i-1], bounds[i]) — reference: LOCATOR_TYPE_RANGE,
+    # locator.h:20-56
+    range_bounds: list = dataclasses.field(default_factory=list)
 
     def to_json(self):
         return {"dist_type": self.dist_type.value,
-                "dist_cols": self.dist_cols, "group": self.group}
+                "dist_cols": self.dist_cols, "group": self.group,
+                "range_bounds": list(self.range_bounds)}
 
     @staticmethod
     def from_json(d):
         return Distribution(DistType(d["dist_type"]), list(d["dist_cols"]),
-                            d.get("group", "default_group"))
+                            d.get("group", "default_group"),
+                            list(d.get("range_bounds", [])))
 
 
 @dataclasses.dataclass

@@ -72,6 +72,7 @@ def cmd_start(args):
                               [(s.host, s.port) for s in servers],
                               (gtm.host, gtm.port))
     users = default_users_path(args.dir)
+    cluster.ensure_monitor()
     cn = CnServer(lambda: ClusterSession(cluster),
                   users_path=users if os.path.exists(users) else None,
                   port=cfg.get("cn_port", 7900)).start()

@@ -136,16 +136,22 @@ def tables_needing_validation(catalog, table: str,
                for fk in other.fks)
 
 
-def drop_guards(catalog, table: str):
-    """DROP TABLE of an FK-referenced parent would poison every later
-    write to the children (reference: dependency.c DEPENDENCY_NORMAL
-    restrict)."""
-    for other in catalog.tables.values():
-        if other.name != table and any(
-                fk["ref_table"] == table for fk in other.fks):
-            raise ConstraintViolation(
-                f"cannot drop table {table!r}: referenced by a "
-                f"foreign key on {other.name!r}")
+def referencing_tables(catalog, table: str) -> list:
+    """Tables holding a FOREIGN KEY that references `table`."""
+    return [other.name for other in catalog.tables.values()
+            if other.name != table and any(
+                fk["ref_table"] == table for fk in other.fks)]
+
+
+def drop_guards(catalog, table: str, action: str = "drop"):
+    """DROP/TRUNCATE of an FK-referenced parent would poison every
+    later write to the children (reference: dependency.c
+    DEPENDENCY_NORMAL restrict; heap_truncate_check_FKs)."""
+    refs = referencing_tables(catalog, table)
+    if refs:
+        raise ConstraintViolation(
+            f"cannot {action} table {table!r}: referenced by a "
+            f"foreign key on {refs[0]!r}")
 
 
 def column_drop_guards(catalog, table: str, column: str):

@@ -223,15 +223,24 @@ class DistExecutor:
                     "cluster not mesh-capable"
             else:
                 try:
-                    gathered = runner.run(dp, self.snapshot_ts, self.txid,
-                                          self.params)
+                    gathered, executed = runner.run(
+                        dp, self.snapshot_ts, self.txid, self.params)
                     top = dp.fragments[dp.top_fragment]
                     self.tier = "mesh"   # overwritten by later subplans:
                     # the LAST _run_distplan call is the main plan, so the
                     # recorded tier is always the main plan's
-                    return self._exec_fragment_on(
-                        top, dp, "cn",
-                        {(gi, "cn"): b for gi, b in gathered.items()})
+                    ex_out = {(gi, "cn"): b
+                              for gi, b in gathered.items()}
+                    # hybrid: fragments the mesh could not carry (CN
+                    # combines consuming gathers) finish host-side over
+                    # the device-computed gather outputs
+                    for frag in dp.fragments:
+                        if frag.index == dp.top_fragment or \
+                                frag.index in executed:
+                            continue
+                        self._feed_exchanges(frag, dp, ex_out)
+                    return self._exec_fragment_on(top, dp, "cn",
+                                                  ex_out)
                 except MeshUnsupported as e:
                     # host-mediated tier handles everything else
                     self.fallback_reason = str(e)
@@ -278,14 +287,41 @@ class DistExecutor:
         # every plan shape; the mesh tier declines these plans.
         needed = {n.index for n in _walk_plan(frag.plan)
                   if isinstance(n, ExchangeRef)}
-        cn_fed = needed and all((i, "cn") in ex_out for i in needed)
+        ndn = self.cluster.ndn
+        cn_only = {i for i in needed
+                   if (i, "cn") in ex_out
+                   and not any((i, d) in ex_out for d in range(ndn))}
+        scans_tables = any(isinstance(n, P.SeqScan)
+                           for n in _walk_plan(frag.plan))
+        if cn_only and scans_tables:
+            # the fragment must run on the DNs (it scans shards) but an
+            # input was gathered to the CN: replicate that input to
+            # every DN (each joins its shard against the full copy)
+            for i in cn_only:
+                for d in range(ndn):
+                    ex_out[(i, d)] = ex_out[(i, "cn")]
+            cn_only = set()
+        cn_fed = needed and not scans_tables and (
+            all((i, "cn") in ex_out for i in needed) or cn_only)
         if cn_fed:
+            # synthesize CN copies of any per-DN-only inputs: concat
+            # redistribute parts (all rows), take one broadcast copy
+            kinds = {ex.index: ex.kind for ex in dp.exchanges}
+            for i in needed:
+                if (i, "cn") in ex_out:
+                    continue
+                parts = [ex_out[(i, d)] for d in range(ndn)
+                         if (i, d) in ex_out]
+                ex_out[(i, "cn")] = parts[0] \
+                    if kinds.get(i) == "broadcast" \
+                    else _concat_host(parts)
             batch = self._exec_fragment_on(frag, dp, "cn", ex_out)
             hb = _to_host(batch)
             for ex in consumers:
                 if ex.kind in ("gather", "gather_one"):
                     ex_out[(ex.index, "cn")] = hb
                 elif ex.kind == "broadcast":
+                    ex_out[(ex.index, "cn")] = hb
                     for d in range(self.cluster.ndn):
                         ex_out[(ex.index, d)] = hb
                 elif ex.kind == "redistribute":
@@ -319,6 +355,7 @@ class DistExecutor:
                 ex_out[(ex.index, "cn")] = _concat_host(per_dn)
             elif ex.kind == "broadcast":
                 full = _concat_host(per_dn)
+                ex_out[(ex.index, "cn")] = full
                 for d in range(self.cluster.ndn):
                     ex_out[(ex.index, d)] = full
             elif ex.kind == "redistribute":

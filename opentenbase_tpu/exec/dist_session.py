@@ -433,6 +433,21 @@ class ClusterSession:
                 raise ExecError(
                     f"prepared statement {stmt.name!r} does not exist")
             return Result("DEALLOCATE")
+        if isinstance(stmt, A.CreateNodeGroupStmt):
+            from ..catalog.catalog import CatalogError
+            name_to_idx = {nd.name: nd.index
+                           for nd in c.catalog.datanodes()}
+            members = []
+            for m in stmt.members:
+                if m not in name_to_idx:
+                    raise ExecError(f"unknown datanode {m!r}")
+                members.append(name_to_idx[m])
+            try:
+                c.catalog.create_node_group(stmt.name, members)
+            except CatalogError as e:
+                raise ExecError(str(e)) from None
+            c._save_catalog()
+            return Result("CREATE NODE GROUP")
         if isinstance(stmt, A.TruncateStmt):
             return self._exec_truncate(stmt)
         if isinstance(stmt, A.SavepointStmt):
@@ -448,12 +463,8 @@ class ClusterSession:
         if self.txn is not None:
             raise ExecError("TRUNCATE cannot run inside a transaction "
                             "block (non-MVCC bulk clear)")
-        for other in c.catalog.tables.values():
-            if other.name != stmt.table and any(
-                    fk["ref_table"] == stmt.table for fk in other.fks):
-                raise ExecError(
-                    f"cannot truncate {stmt.table!r}: referenced by a "
-                    f"foreign key on {other.name!r}")
+        from .constraints import drop_guards
+        drop_guards(c.catalog, stmt.table, action="truncate")
         names = [stmt.table]
         if stmt.table in c.catalog.partitioned:
             names += [p["name"]
@@ -638,7 +649,27 @@ class ClusterSession:
                           txn: "ClusterTxn" = None) -> DistPlan:
         binder = Binder(self.cluster.catalog)
         bq = binder.bind_select(stmt)
-        planned = Planner(self.cluster.catalog).plan(bq)
+        # SPM plan baselines: replay the accepted join order for this
+        # normalized statement; capture the first plan when asked
+        # (reference: optimizer/spm/spm.c — enable_spm applies,
+        # spm_capture records)
+        gucs = self.cluster.gucs
+        forced = None
+        fp = None
+        if gucs.get("enable_spm", "off") == "on" or \
+                gucs.get("spm_capture", "off") == "on":
+            from ..sql.fingerprint import fingerprint
+            fp = fingerprint(stmt)
+            if gucs.get("enable_spm", "off") == "on":
+                forced = self.cluster.catalog.spm.get(fp)
+        planned = Planner(self.cluster.catalog).plan(
+            bq, forced_order=forced)
+        if fp is not None and forced is None and \
+                gucs.get("spm_capture", "off") == "on" and \
+                len(planned.join_order_chosen) > 1:
+            self.cluster.catalog.spm[fp] = \
+                list(planned.join_order_chosen)
+            self.cluster._save_catalog()
         fqs_enabled = self.cluster.gucs.get(
             "enable_fast_query_shipping", "on") != "off"
         gidx_enabled = self.cluster.gucs.get(

@@ -511,14 +511,18 @@ class Executor:
             if left_outer else jnp.sum(counts)
         if self._traced:
             # no host sync inside a compiled (shard_map) program: static
-            # size proportional to the LARGER input (a small probe side
-            # joining a big build emits ~build-many rows — FK joins);
-            # overflow reported per join id for a targeted retry
+            # output class laddered per join id.  join_expand packs live
+            # pairs as a prefix, so the class starts at 1/4 of the larger
+            # input (most joins SHRINK: filters + selective keys) and
+            # overflow retraces one step up — the learned value persists
+            # in the mesh runner's ladder memory, and every op downstream
+            # of the join (agg sorts, exchanges, gathers) scales with it
             jid = (self.frag_tag, self._join_seq)
             self._join_seq += 1
             factor = (self.ctx.join_factors or {}).get(
                 jid, self.ctx.join_size_factor)
-            out_size = max(left.padded, right.padded) * factor
+            out_size = max(64, (max(left.padded, right.padded) // 4)
+                           * factor)
             self.join_required.append((jid, total, out_size))
         else:
             out_size = next_pow2(max(int(total), 1))
@@ -714,11 +718,55 @@ class Executor:
         return DBatch(cols, out_valid, types, dicts, nulls)
 
     def _exec_append(self, node) -> DBatch:
-        """Concatenate children (UNION branches): through the host wire
-        format so node-local TEXT dictionaries merge correctly."""
-        from .dist import _concat_host, _to_device, _to_host
-        parts = [_to_host(self.exec_node(c)) for c in node.inputs]
-        return _to_device(_concat_host(parts))
+        """Concatenate children (UNION branches).  Untraced: through
+        the host wire format so node-local TEXT dictionaries merge
+        correctly.  Traced (mesh): a device concat — TEXT dictionaries
+        are trace CONSTANTS, so union dictionaries and code LUTs are
+        built host-side at trace time and each branch's codes remap
+        with one static gather (zero host work per execution)."""
+        if not self._traced:
+            from .dist import _concat_host, _to_device, _to_host
+            parts = [_to_host(self.exec_node(c)) for c in node.inputs]
+            return _to_device(_concat_host(parts))
+        parts = [self.exec_node(c) for c in node.inputs]
+        first = parts[0]
+        out_cols, out_dicts, out_nulls = {}, {}, {}
+        for nme in first.cols:
+            t = first.types[nme]
+            if t.kind == TypeKind.TEXT:
+                values: list = []
+                index: dict = {}
+                remapped = []
+                for p in parts:
+                    vals = p.dicts.get(nme, [])
+                    lut = np.empty(max(len(vals), 1), np.int32)
+                    for i, v in enumerate(vals):
+                        j = index.get(v)
+                        if j is None:
+                            j = len(values)
+                            values.append(v)
+                            index[v] = j
+                        lut[i] = j
+                    codes = jnp.clip(p.cols[nme], 0,
+                                     max(len(vals) - 1, 0))
+                    remapped.append(jnp.asarray(lut)[codes])
+                out_cols[nme] = jnp.concatenate(remapped)
+                out_dicts[nme] = values
+            else:
+                dt = first.cols[nme].dtype
+                out_cols[nme] = jnp.concatenate(
+                    [p.cols[nme].astype(dt) for p in parts])
+        valid = jnp.concatenate([p.valid for p in parts])
+        null_names = set()
+        for p in parts:
+            null_names |= set(p.nulls)
+        for nme in null_names:
+            out_nulls[nme] = jnp.concatenate(
+                [p.nulls.get(nme,
+                             jnp.zeros(p.valid.shape[0], bool))
+                 for p in parts])
+        return DBatch(out_cols, valid, dict(first.types), out_dicts,
+                      out_nulls)
 
     # ---- aggregate ----
     def _eval_group_keys(self, node: P.Agg, b: DBatch):

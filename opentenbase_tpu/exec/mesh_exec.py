@@ -85,7 +85,7 @@ class _StagedTable:
 
 
 _ALLOWED = (P.SeqScan, P.Filter, P.Project, P.HashJoin, P.Agg, P.Sort,
-            P.Limit, P.Window, ExchangeRef)
+            P.Limit, P.Window, P.Append, ExchangeRef)
 
 
 class MeshRunner:
@@ -110,7 +110,9 @@ class MeshRunner:
     # ------------------------------------------------------------------
     # plan screening
     # ------------------------------------------------------------------
-    def _screen(self, dp: DistPlan):
+    def _screen(self, dp: DistPlan) -> set:
+        """Validate the plan and return the mesh-computable fragment
+        set (the split fixpoint runs ONCE per query)."""
         if dp.fqs_node is not None:
             raise MeshUnsupported("FQS plan runs on one node")
         for ex in dp.exchanges:
@@ -120,20 +122,56 @@ class MeshRunner:
             for k in ex.keys or []:
                 if not isinstance(k, (E.Col, E.TextExpr)):
                     raise MeshUnsupported("non-column exchange key")
+        included = self._split_fragments(dp)
+        for fi in included:
+            self._screen_node(
+                next(f for f in dp.fragments if f.index == fi).plan)
+        return included
+
+    def _split_fragments(self, dp) -> set:
+        """The MESH-COMPUTABLE fragment frontier.  Fragments consuming
+        a gather run at the coordinator (a set-op combine, a cross join
+        of scalar subqueries): the device program computes everything
+        UP TO the gathers and the host finishes from there — hybrid
+        execution instead of declining the whole plan (reference: the
+        CN always executes the top combine in the FN plane too).  A
+        non-gather exchange consumed by a CN-side fragment drags its
+        producer off the mesh as well (its output would otherwise only
+        exist in device memory), propagated to a fixpoint."""
         gathers = {ex.index for ex in dp.exchanges
                    if ex.kind in ("gather", "gather_one")}
+        src_of = {ex.index: ex.source_fragment
+                  for ex in dp.exchanges}
+        needs = {}
         for frag in dp.fragments:
             if frag.index == dp.top_fragment:
-                continue  # CN fragment executes host-side
-            # a gather consumed by a DN fragment means the plan routes
-            # through CN materialization (a set-op combine feeding a
-            # redistribution) — the host tier's CN-mediated path owns
-            # that shape
-            for n in self._walk(frag.plan):
-                if isinstance(n, ExchangeRef) and n.index in gathers:
-                    raise MeshUnsupported(
-                        "gather feeds a non-top fragment")
-            self._screen_node(frag.plan)
+                continue
+            needs[frag.index] = {
+                n.index for n in self._walk(frag.plan)
+                if isinstance(n, ExchangeRef)}
+        excluded: set = set()
+        changed = True
+        while changed:
+            changed = False
+            for fi, nd in needs.items():
+                if fi in excluded:
+                    continue
+                if any(i in gathers or src_of[i] in excluded
+                       for i in nd):
+                    excluded.add(fi)
+                    changed = True
+            for fi, nd in needs.items():
+                if fi not in excluded:
+                    continue
+                for i in nd:
+                    if i not in gathers and                             src_of[i] not in excluded:
+                        excluded.add(src_of[i])
+                        changed = True
+        included = {fi for fi in needs if fi not in excluded}
+        if not any(src_of[g] in included for g in gathers):
+            raise MeshUnsupported(
+                "no mesh-computable gather fragment")
+        return included
 
     @staticmethod
     def _walk(node):
@@ -160,6 +198,9 @@ class MeshRunner:
             raise MeshUnsupported("stat view scan")
         for attr in ("child", "left", "right"):
             c = getattr(node, attr, None)
+            if isinstance(c, P.PhysNode):
+                self._screen_node(c)
+        for c in getattr(node, "inputs", None) or []:
             if isinstance(c, P.PhysNode):
                 self._screen_node(c)
 
@@ -386,6 +427,9 @@ class MeshRunner:
             c = getattr(clone, attr, None)
             if isinstance(c, P.PhysNode):
                 setattr(clone, attr, MeshRunner._bind(c, ex_batches))
+        if getattr(clone, "inputs", None):
+            clone.inputs = [MeshRunner._bind(c, ex_batches)
+                            for c in clone.inputs]
         return clone
 
     def run(self, dp: DistPlan, snapshot_ts: int, txid: int,
@@ -395,20 +439,14 @@ class MeshRunner:
         host-reachable."""
         from .executor import DBatch, ExecContext, Executor
 
-        self._screen(dp)
+        included = self._screen(dp)
         tables = set()
         for frag in dp.fragments:
-            if frag.index == dp.top_fragment:
+            if frag.index not in included:
                 continue
-            stack = [frag.plan]
-            while stack:
-                nd = stack.pop()
+            for nd in self._walk(frag.plan):
                 if isinstance(nd, P.SeqScan):
                     tables.add(nd.table.name)
-                for attr in ("child", "left", "right"):
-                    c = getattr(nd, attr, None)
-                    if isinstance(c, P.PhysNode):
-                        stack.append(c)
         for t in tables:
             for dn in self.cluster.datanodes:
                 if hasattr(dn, "stores") and t not in dn.stores:
@@ -419,13 +457,15 @@ class MeshRunner:
                 raise MeshUnsupported("non-scalar init-plan param")
 
         staged = {t: self._stage_table(t) for t in tables}
+        if not staged:
+            raise MeshUnsupported("no mesh-stageable scans")
         base_pad = max((s.padded for s in staged.values()), default=64)
         # ladder values (join factors, exchange bucket multipliers,
         # gather classes) LEARNED on a previous execution of the same
         # plan shape are remembered, so steady state runs the compiled
         # program exactly once — no overflow replay per query
         lkey = self._ladder_key(dp, table_names := sorted(staged),
-                                staged)
+                                staged, included)
         remembered = self._ladder.get(lkey)
         if remembered is not None:
             factors, mults, gathers = (dict(remembered[0]),
@@ -452,7 +492,8 @@ class MeshRunner:
             try:
                 out, meta, over_jids, a2a_over, g_over = self._execute(
                     dp, staged, snapshot_ts, txid, params,
-                    dict(factors), dict(mults), dict(gathers))
+                    dict(factors), dict(mults), dict(gathers),
+                    included)
             except (jax.errors.TracerBoolConversionError,
                     jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError) as e:
@@ -484,10 +525,10 @@ class MeshRunner:
                         dict(gmeta["types"]), dict(gmeta["dicts"]),
                         {n: jnp.asarray(np.asarray(a))
                          for n, a in nulls.items()})
-                return result
+                return result, included
         raise MeshUnsupported("size-class ladder exhausted")
 
-    def _ladder_key(self, dp, table_names, staged):
+    def _ladder_key(self, dp, table_names, staged, included):
         """Identity of a plan shape + data scale, independent of the
         ladder values themselves — the key under which learned join
         factors / bucket multipliers / gather classes persist."""
@@ -495,7 +536,7 @@ class MeshRunner:
             return hash((
                 tuple((f.index, self._plan_key(f.plan))
                       for f in dp.fragments
-                      if f.index != dp.top_fragment),
+                      if f.index in included),
                 tuple((ex.index, ex.kind, tuple(ex.keys or ()),
                        ex.source_fragment) for ex in dp.exchanges),
                 tuple((t, staged[t].padded) for t in table_names),
@@ -558,15 +599,19 @@ class MeshRunner:
         if isinstance(node, P.Window):
             return (t, tuple(node.calls),
                     MeshRunner._plan_key(node.child))
+        if isinstance(node, P.Append):
+            return (t, tuple(MeshRunner._plan_key(c)
+                             for c in node.inputs))
         raise MeshUnsupported(t)
 
     def _execute(self, dp, staged, snapshot_ts, txid, params, factors,
-                 mults, gathers):
+                 mults, gathers, included):
         from .executor import ExecContext, Executor
 
         table_names = sorted(staged)
         gather_ex = [ex for ex in dp.exchanges
-                     if ex.kind in ("gather", "gather_one")]
+                     if ex.kind in ("gather", "gather_one")
+                     and ex.source_fragment in included]
         if not gather_ex:
             raise MeshUnsupported("no gather exchange")
         gather_idx = [ex.index for ex in gather_ex]
@@ -575,7 +620,7 @@ class MeshRunner:
             prog_key = hash((
                 tuple((f.index, self._plan_key(f.plan))
                       for f in dp.fragments
-                      if f.index != dp.top_fragment),
+                      if f.index in included),
                 tuple((ex.index, ex.kind, tuple(ex.keys or ()),
                        ex.source_fragment) for ex in dp.exchanges),
                 tuple((t, staged[t].padded,
@@ -621,7 +666,7 @@ class MeshRunner:
             gather_over: list = []
             meta["gi_order"] = []
             for frag in dp.fragments:
-                if frag.index == dp.top_fragment:
+                if frag.index not in included:
                     continue
                 plan = self._bind(frag.plan, ex_batches)
                 exe = Executor(ctx, frag_tag=frag.index)

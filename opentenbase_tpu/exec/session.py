@@ -300,6 +300,10 @@ class LocalNode:
             st = self.stores.get(rec["table"])
             if st is not None:
                 st.truncate()
+        elif op == "create_node_group":
+            if rec["name"] not in self.catalog.node_groups:
+                self.catalog.create_node_group(rec["name"],
+                                               rec["members"])
         elif op == "subabort":
             # ROLLBACK TO SAVEPOINT: revert this txn's ops beyond the
             # savepoint's WAL position (reference: subxact abort
@@ -591,6 +595,25 @@ class Session:
         if isinstance(stmt, A.BarrierStmt):
             self.node.checkpoint()
             return Result("BARRIER")
+        if isinstance(stmt, A.CreateNodeGroupStmt):
+            name_to_idx = {nd.name: nd.index
+                           for nd in self.node.catalog.datanodes()}
+            members = []
+            for m in stmt.members:
+                if m not in name_to_idx:
+                    raise ExecError(f"unknown datanode {m!r}")
+                members.append(name_to_idx[m])
+            try:
+                self.node.catalog.create_node_group(stmt.name, members)
+            except CatalogError as e:
+                raise ExecError(str(e)) from None
+            # WAL-logged: recovery must rebuild the group BEFORE
+            # replaying dependent CREATE TABLE records (the catalog
+            # validates TO GROUP at create time)
+            self.node._log({"op": "create_node_group",
+                            "name": stmt.name, "members": members},
+                           sync=True)
+            return Result("CREATE NODE GROUP")
         if isinstance(stmt, A.TruncateStmt):
             return self._exec_truncate(stmt)
         if isinstance(stmt, A.SavepointStmt):
@@ -608,12 +631,12 @@ class Session:
         if self.txn is not None:
             raise ExecError("TRUNCATE cannot run inside a transaction "
                             "block (non-MVCC bulk clear)")
-        for other in cat.tables.values():
-            if other.name != stmt.table and any(
-                    fk["ref_table"] == stmt.table for fk in other.fks):
-                raise ExecError(
-                    f"cannot truncate {stmt.table!r}: referenced by a "
-                    f"foreign key on {other.name!r}")
+        from .constraints import drop_guards
+        drop_guards(cat, stmt.table, action="truncate")
+        if self.node.active_txns:
+            raise ExecError(
+                "cannot truncate: in-flight transactions hold row "
+                "spans")
         names = [stmt.table]
         if stmt.table in cat.partitioned:
             names += [p["name"]
@@ -731,7 +754,16 @@ class Session:
             if rows:
                 ki = [c.name for c in tgt.columns].index(tkey)
                 keys = sorted({r[ki] for r in rows})
-                if len(keys) != len(rows):
+                # PG errors only when ONE TARGET row is matched by
+                # MULTIPLE SOURCE rows; several target rows matching
+                # one source row each update once (execMerge.c)
+                from collections import Counter
+                scnt = Counter(r[0] for r in self._exec_stmt(
+                    A.SelectStmt(
+                        items=[A.SelectItem(
+                            A.ColRef((stmt.source, skey)), alias="k")],
+                        from_=[A.TableRef(stmt.source)])).rows)
+                if any(scnt[k] > 1 for k in keys):
                     raise ExecError(
                         "MERGE command cannot affect row a second "
                         "time (duplicate source join keys)")

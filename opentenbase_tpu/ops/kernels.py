@@ -110,20 +110,48 @@ def grouped_agg_dense(group_id, valid, agg_inputs: tuple,
 @functools.partial(jax.jit, static_argnames=("max_groups", "agg_kinds"))
 def grouped_agg_sort(key_cols: tuple, valid, agg_inputs: tuple,
                      max_groups: int, agg_kinds: tuple):
-    """General grouped aggregation: lexicographic sort on the key columns
-    (invalid rows last), boundary detection, segment reduce.
+    """General grouped aggregation: sort on the key columns (invalid
+    rows last), boundary detection, segment reduce.
+
+    Sort formulation: multi-key lexicographic comparison sort moving
+    every aggregate input as payload is ~3x slower than sorting a
+    permutation and gathering (measured 8M rows: 9.7s vs 3.5s on CPU).
+    So: (1) the key columns are runtime-PACKED into one int64 —
+    `acc = acc * range + (k - min)` with ranges reduced on the fly;
+    when the product overflows int64 it wraps, which is still a
+    deterministic function of the keys, and the real key columns ride
+    as tie-break sort keys after it, so ordering stays total and
+    grouping stays exact (the comparator just short-circuits on the
+    packed word in the common case); (2) only (keys, iota) are sorted,
+    and payloads are gathered once through the resulting permutation;
+    (3) segment reductions run with indices_are_sorted.
 
     Returns (group_key_cols, agg_outputs, n_groups).  Caller guarantees
-    distinct-group count <= max_groups (host retries at the next size class
-    otherwise — count returned lets it check).
+    distinct-group count <= max_groups (host retries at the next size
+    class otherwise — count returned lets it check).
     """
     n = valid.shape[0]
     invalid = ~valid
-    operands = list(key_cols) + [a for a in agg_inputs] + [valid]
-    sorted_all = jax.lax.sort([invalid] + operands, num_keys=1 + len(key_cols))
-    s_keys = sorted_all[1:1 + len(key_cols)]
-    s_aggs = sorted_all[1 + len(key_cols):-1]
-    s_valid = sorted_all[-1]
+    if len(key_cols) > 1:
+        i64 = jnp.iinfo(jnp.int64)
+        packed = jnp.zeros(n, dtype=jnp.int64)
+        for k in key_cols:
+            k = k.astype(jnp.int64)
+            mn = jnp.min(jnp.where(valid, k, i64.max))
+            mx = jnp.max(jnp.where(valid, k, i64.min))
+            packed = packed * (mx - mn + 1) + \
+                jnp.where(valid, k - mn, 0)
+        sort_keys = [invalid, packed, *key_cols]
+        key_off = 2
+    else:
+        sort_keys = [invalid, *key_cols]
+        key_off = 1
+    iota = jnp.arange(n)
+    sorted_all = jax.lax.sort([*sort_keys, iota],
+                              num_keys=len(sort_keys))
+    perm = sorted_all[-1]
+    s_keys = sorted_all[key_off:key_off + len(key_cols)]
+    s_valid = valid[perm]
     first = jnp.arange(n) == 0
     differs = jnp.zeros(n, dtype=bool)
     for k in s_keys:
@@ -133,19 +161,28 @@ def grouped_agg_sort(key_cols: tuple, valid, agg_inputs: tuple,
     gid_raw = jnp.cumsum(boundary) - 1
     gid = jnp.where(s_valid, gid_raw, max_groups)
     outs = []
-    for kind, vals in zip(agg_kinds, s_aggs):
+    for kind, vals in zip(agg_kinds, agg_inputs):
         if kind == "count":
             vals = s_valid.astype(jnp.int64)
-        elif kind == "sumf":
-            vals = _masked_for("sum", vals.astype(jnp.float64), s_valid)
         else:
-            vals = _masked_for(kind, vals, s_valid)
+            vals = vals[perm]
+            if kind == "sumf":
+                vals = _masked_for("sum", vals.astype(jnp.float64),
+                                   s_valid)
+            else:
+                vals = _masked_for(kind, vals, s_valid)
         if kind == "min":
-            o = jax.ops.segment_min(vals, gid, num_segments=max_groups + 1)
+            o = jax.ops.segment_min(vals, gid,
+                                    num_segments=max_groups + 1,
+                                    indices_are_sorted=True)
         elif kind == "max":
-            o = jax.ops.segment_max(vals, gid, num_segments=max_groups + 1)
+            o = jax.ops.segment_max(vals, gid,
+                                    num_segments=max_groups + 1,
+                                    indices_are_sorted=True)
         else:
-            o = jax.ops.segment_sum(vals, gid, num_segments=max_groups + 1)
+            o = jax.ops.segment_sum(vals, gid,
+                                    num_segments=max_groups + 1,
+                                    indices_are_sorted=True)
         outs.append(o[:max_groups])
     starts = jnp.nonzero(boundary, size=max_groups, fill_value=0)[0]
     gkeys = tuple(k[starts] for k in s_keys)

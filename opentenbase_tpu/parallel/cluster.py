@@ -315,10 +315,16 @@ class DataNode:
     def truncate(self, table: str):
         """Non-MVCC bulk clear (reference: ExecuteTruncate's
         relfilenode swap); WAL-logged so recovery replays it in order
-        against earlier inserts."""
+        against earlier inserts.  Refused while ANY transaction holds
+        positional spans on this node — emptying the chunk list would
+        crash their commit backfill (same rule as vacuum)."""
         st = self.stores.get(table)
         if st is None:
             return 0
+        if self.txn_spans:
+            raise RuntimeError(
+                "cannot truncate: in-flight transactions hold row "
+                "spans on this node")
         st.truncate()
         self.cache.invalidate(st)
         self.log({"op": "truncate", "table": table}, sync=True)
@@ -657,6 +663,7 @@ class Cluster:
             if self.datadir else None
         self.audit = AuditLogger(audit_path)
         self._gdd = None
+        self._monitor = None
 
     def ensure_gdd(self):
         """Start the cross-node deadlock detector on first DML that can
@@ -666,6 +673,15 @@ class Cluster:
             self._gdd = GddDetector(self)
             self._gdd.start()
         return self._gdd
+
+    def ensure_monitor(self, period: float = 2.0):
+        """Start the liveness daemon feeding the health map consumed
+        by otb_nodes (reference: clustermon.c + the node health map)."""
+        if getattr(self, "_monitor", None) is None:
+            from .monitor import ClusterMonitor
+            self._monitor = ClusterMonitor(self, period)
+            self._monitor.start()
+        return self._monitor
 
     def resource_queue(self):
         """Admission-control queue per max_concurrent_queries GUC

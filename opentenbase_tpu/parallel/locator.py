@@ -148,12 +148,22 @@ class Locator:
         if dt == DistType.MODULO:
             key = np.asarray(columns[td.distribution.dist_cols[0]])
             return (key.astype(np.int64) % ndn).astype(np.int32)
+        if dt == DistType.RANGE:
+            col = td.column(td.distribution.dist_cols[0])
+            vals = _canon_bulk(col, np.asanyarray(
+                columns[td.distribution.dist_cols[0]])).view(np.int64)
+            bounds = np.asarray(td.distribution.range_bounds,
+                                np.int64)
+            return np.minimum(np.searchsorted(bounds, vals,
+                                              side="right"),
+                              ndn - 1).astype(np.int32)
         keys = _dist_key_arrays(td, columns)
         if dt == DistType.HASH:
             return (hash_columns_np(keys) % np.uint64(ndn)).astype(np.int32)
         if dt == DistType.SHARD:
             sid = shard_ids_for_columns(keys)
-            return self.catalog.shard_map[sid]
+            return np.asarray(self.catalog.shard_map_for_group(
+                td.distribution.group))[sid]
         raise ValueError(f"unroutable distribution {dt}")
 
     def shard_ids_for_rows(self, td: TableDef,
@@ -189,7 +199,13 @@ class Locator:
             return int(hash_columns_np(arrs)[0] % np.uint64(ndn))
         if dt == DistType.SHARD:
             sid = int(shard_of_hash(hash_columns_np(arrs))[0])
-            return int(self.catalog.shard_map[sid])
+            return int(np.asarray(self.catalog.shard_map_for_group(
+                td.distribution.group))[sid])
+        if dt == DistType.RANGE:
+            v = int(arrs[0].view(np.int64)[0])
+            bounds = list(td.distribution.range_bounds)
+            import bisect
+            return min(bisect.bisect_right(bounds, v), ndn - 1)
         return None
 
     def nodes_for_table(self, td: TableDef) -> list[int]:
@@ -201,5 +217,6 @@ class Locator:
         if dt == DistType.REPLICATED:
             return list(range(ndn))
         if dt == DistType.SHARD:
-            return sorted(set(int(x) for x in np.unique(self.catalog.shard_map)))
+            m = self.catalog.shard_map_for_group(td.distribution.group)
+            return sorted(set(int(x) for x in np.unique(m)))
         return list(range(ndn))

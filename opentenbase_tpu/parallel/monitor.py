@@ -1,0 +1,53 @@
+"""Cluster monitor daemon — periodic node liveness feeding the health
+map.
+
+Reference analog: the cluster monitor process (pgxc/clustermon.c) and
+the health map coordinators consult before dispatch
+(nodemgr.c:1122 PgxcNodeGetHealthMap).  One daemon thread pings every
+datanode on a bounded interval and records (healthy, when); the
+`otb_nodes` stat view serves from this map, so dead-node detection has
+a bounded staleness instead of paying a live ping per query."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class ClusterMonitor(threading.Thread):
+    def __init__(self, cluster, period: float = 2.0):
+        super().__init__(daemon=True, name="cluster-monitor")
+        self.cluster = cluster
+        self.period = period
+        self._stop = threading.Event()
+        # index -> {"healthy": bool, "ts": monotonic}
+        self.health: dict[int, dict] = {}
+
+    def stop(self):
+        self._stop.set()
+
+    def check_once(self):
+        for dn in self.cluster.datanodes:
+            if hasattr(dn, "addr"):
+                # fresh connection per probe: a pooled socket outlives
+                # a dead listener and would mask the failure (same rule
+                # as the supervisor's liveness probe)
+                from ..net.dn_server import RemoteDataNode
+                probe = RemoteDataNode(dn.index, *dn.addr)
+                try:
+                    ok = probe.ping()
+                finally:
+                    probe.close()
+            else:
+                ok = True           # in-process node: alive with us
+            self.health[dn.index] = {"healthy": bool(ok),
+                                     "ts": time.monotonic()}
+        return self.health
+
+    def run(self):
+        self.check_once()
+        while not self._stop.wait(self.period):
+            try:
+                self.check_once()
+            except Exception:
+                pass

@@ -5,7 +5,11 @@ optimizer/path/costsize.c.  Collected per store (per DN shard) with a
 bounded sample, merged cluster-wide: row counts, per-column NDV,
 numeric min/max in STORAGE representation (so selectivity bounds
 compare directly against binder literals converted the same way the
-index tier converts them)."""
+index tier converts them), and EQUI-DEPTH HISTOGRAMS (33 quantile
+bounds; reference: pg_statistic histogram_bounds) so range
+selectivity on SKEWED columns is quantile-interpolated instead of
+assumed uniform — the estimate that drives the planner's
+broadcast-vs-redistribute exchange choice."""
 
 from __future__ import annotations
 
@@ -44,9 +48,15 @@ def analyze_store(store, sample: int = SAMPLE) -> dict:
             continue
         ndv = int(min(len(np.unique(samp)) * max(scale_up ** 0.5, 1.0),
                       rows or 1))
+        hist = None
+        if len(samp) >= 8:
+            qs = np.linspace(0.0, 1.0, 33)
+            hist = [float(v) for v in
+                    np.quantile(samp.astype(np.float64), qs)]
         cols[c.name] = {"ndv": max(ndv, 1),
                         "min": float(np.min(arr)),
-                        "max": float(np.max(arr))}
+                        "max": float(np.max(arr)),
+                        "hist": hist}
     return {"rows": rows, "cols": cols}
 
 
@@ -63,9 +73,20 @@ def merge_stats(parts: list[dict]) -> dict:
         entries = [p["cols"][n] for p in parts if n in p["cols"]]
         mins = [e["min"] for e in entries if e["min"] is not None]
         maxs = [e["max"] for e in entries if e["max"] is not None]
+        hists = [e.get("hist") for e in entries if e.get("hist")]
+        merged_hist = None
+        if hists:
+            # pool the per-DN quantile bounds and re-quantile — an
+            # approximation of the global equi-depth bounds that only
+            # touches O(bounds) values per node
+            pool = np.sort(np.concatenate([np.asarray(h)
+                                           for h in hists]))
+            qs = np.linspace(0.0, 1.0, 33)
+            merged_hist = [float(v) for v in np.quantile(pool, qs)]
         cols[n] = {
             "ndv": min(max(e["ndv"] for e in entries), max(rows, 1)),
             "min": min(mins) if mins else None,
             "max": max(maxs) if maxs else None,
+            "hist": merged_hist,
         }
     return {"rows": rows, "cols": cols}

@@ -77,13 +77,22 @@ def refresh(cluster, names: list[str]):
                 rows.append((gid, info["state"], info["txid"],
                              info.get("commit_ts", 0)))
         elif name == "otb_nodes":
+            mon = getattr(cluster, "_monitor", None)
+            hmap = mon.health if mon is not None else None
             for nd in cluster.catalog.nodes.values():
                 if nd.kind == "datanode" and nd.index < cluster.ndn:
-                    dn = cluster.datanodes[nd.index]
-                    healthy = dn.ping() if hasattr(dn, "ping") else True
+                    if hmap is not None and nd.index in hmap:
+                        # monitor-fed health map: bounded staleness,
+                        # no live ping per query (clustermon.c model)
+                        healthy = hmap[nd.index]["healthy"]
+                    else:
+                        dn = cluster.datanodes[nd.index]
+                        healthy = dn.ping() if hasattr(dn, "ping") \
+                            else True
                 else:
                     healthy = True
-                rows.append((nd.name, nd.kind, nd.host, nd.port, healthy))
+                rows.append((nd.name, nd.kind, nd.host, nd.port,
+                             healthy))
         _replace_rows(cluster, name, rows)
 
 

@@ -35,6 +35,9 @@ class Dist:
     kind: str                    # 'sharded' | 'replicated' | 'cn'
     keys: tuple[str, ...] = ()   # qualified cols rows are hash-placed by
     # () with kind='sharded' = partitioned by unknown key
+    # node group owning the placement: alignment optimizations only
+    # apply within one group's shard map (reference: pgxc_group)
+    group: str = "default_group"
 
 
 @dataclasses.dataclass
@@ -270,7 +273,7 @@ class Distributor:
                 return node, Dist("replicated")
             keys = tuple(f"{node.alias}.{c}" for c in dt.dist_cols) \
                 if dt.dist_type == DistType.SHARD else ()
-            return node, Dist("sharded", keys)
+            return node, Dist("sharded", keys, dt.group)
 
         if isinstance(node, P.AnnSearch):
             dt = node.table.distribution
@@ -344,10 +347,33 @@ class Distributor:
                 d = Dist("cn")
             return node, d
 
-        if isinstance(node, (P.Append, P.SetOp)):
-            # gather every branch to the coordinator, combine there
-            # (branch distributions rarely align; CN combine is always
-            # correct — colocated append/setop is a future optimization)
+        if isinstance(node, P.Append):
+            # UNION ALL / partition-parent expansion: when every branch
+            # is sharded the append runs PER-SHARD on the datanodes
+            # (partitioned by unknown key — downstream joins/aggs add
+            # their own redistribution), which keeps the device data
+            # plane for union-fed joins.  All-replicated appends stay
+            # replicated.  Mixed shapes gather to the CN (correct
+            # everywhere, slower).
+            walked = [self._walk(c) for c in node.inputs]
+            kinds = {cd.kind for _cp, cd in walked}
+            if kinds == {"sharded"}:
+                node.inputs = [cp for cp, _cd in walked]
+                return node, Dist("sharded", ())
+            if kinds == {"replicated"}:
+                node.inputs = [cp for cp, _cd in walked]
+                return node, Dist("replicated")
+            new_inputs = []
+            for cp, cd in walked:
+                if cd.kind != "cn":
+                    cp = self._add_gather(cp,
+                                          one=(cd.kind == "replicated"))
+                new_inputs.append(cp)
+            node.inputs = new_inputs
+            return node, Dist("cn")
+
+        if isinstance(node, P.SetOp):
+            # INTERSECT/EXCEPT dedupe semantics: combine at the CN
             new_inputs = []
             for c in node.inputs:
                 cp, cd = self._walk(c)
@@ -372,15 +398,25 @@ class Distributor:
         node.right, rd = self._walk(node.right)
         pairs = self._join_pairs(node)
 
-        def sharded_on_join_key(d: Dist, side: int) -> Optional[int]:
-            """index of the join pair whose key == d.keys (single-key)."""
-            if d.kind != "sharded" or len(d.keys) != 1:
+        def sharded_on_join_key(d: Dist, side: int):
+            """Ordered join-pair indexes covering ALL of d.keys, or
+            None.  Multi-column distribution keys align only when every
+            key column appears as a join key, in distribution-key order
+            (the hash is order-sensitive)."""
+            if d.kind != "sharded" or not d.keys:
                 return None
-            for i, pr in enumerate(pairs):
-                k = pr[side]
-                if isinstance(k, E.Col) and k.name == d.keys[0]:
-                    return i
-            return None
+            idxs = []
+            for key in d.keys:
+                hit = None
+                for i, pr in enumerate(pairs):
+                    k = pr[side]
+                    if isinstance(k, E.Col) and k.name == key:
+                        hit = i
+                        break
+                if hit is None:
+                    return None
+                idxs.append(hit)
+            return tuple(idxs)
 
         li = sharded_on_join_key(ld, 0)
         ri = sharded_on_join_key(rd, 1)
@@ -406,8 +442,10 @@ class Distributor:
                     node.right, one=(rd.kind == "replicated"))
             return node, Dist("cn")
 
-        # colocated: both sharded on the same join pair
-        if li is not None and ri is not None and li == ri:
+        # colocated: both sharded on the same join pairs (same order)
+        # within the SAME node group's shard map
+        if li is not None and ri is not None and li == ri \
+                and ld.group == rd.group:
             return node, ld
         if ld.kind == "replicated" and rd.kind == "replicated":
             return node, Dist("replicated")
@@ -420,13 +458,17 @@ class Distributor:
             node.right = self._add_broadcast(node.right)
             return node, ld
 
-        # need movement.  Prefer keeping the already-aligned side.
-        if li is not None:
-            node.right = self._add_redistribute(node.right,
-                                                [pairs[li][1]])
+        # need movement.  Prefer keeping the already-aligned side —
+        # only when its placement rides the DEFAULT shard map, which is
+        # what exchanges route by (a group table's alignment cannot be
+        # matched by a default-map redistribute)
+        if li is not None and ld.group == "default_group":
+            node.right = self._add_redistribute(
+                node.right, [pairs[i][1] for i in li])
             return node, ld
-        if ri is not None:
-            node.left = self._add_redistribute(node.left, [pairs[ri][0]])
+        if ri is not None and rd.group == "default_group":
+            node.left = self._add_redistribute(
+                node.left, [pairs[i][0] for i in ri])
             return node, rd
         if not pairs:
             # no equi keys (pure residual join): broadcast build side

@@ -48,6 +48,9 @@ class PlannedStmt:
     plan: P.PhysNode
     init_plans: list[InitPlan]
     output_names: list[str]
+    # join order the planner chose for the main query (alias sequence)
+    # — what an SPM baseline captures (optimizer/spm/spm.c semantics)
+    join_order_chosen: list = dataclasses.field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -152,14 +155,24 @@ class Planner:
         self._ip_counter = itertools.count()
 
     # -- public ------------------------------------------------------------
-    def plan(self, bq) -> PlannedStmt:
+    def plan(self, bq, forced_order=None) -> PlannedStmt:
         from .query import BoundSetOp
         init_plans: list[InitPlan] = []
         if isinstance(bq, BoundSetOp):
             plan, names = self._plan_setop(bq, init_plans)
             return PlannedStmt(plan, init_plans, names)
+        self._forced_order = list(forced_order) if forced_order else None
+        self._order_chosen: list = []
+        self._pq_calls = 0
         plan = self._plan_query(bq, init_plans)
-        return PlannedStmt(plan, init_plans, [n for n, _ in bq.targets])
+        # a baseline is only trustworthy for single-query statements:
+        # subqueries plan through the same walk and would interleave
+        # their join order into the capture (and could wrongly consume
+        # a forced order meant for the main query)
+        chosen = self._order_chosen if self._pq_calls == 1 else []
+        return PlannedStmt(plan, init_plans,
+                           [n for n, _ in bq.targets],
+                           join_order_chosen=chosen)
 
     def _plan_setop(self, so, init_plans):
         from .query import BoundSetOp
@@ -216,6 +229,7 @@ class Planner:
     # -- query planning ----------------------------------------------------
     def _plan_query(self, bq: BoundQuery,
                     init_plans: list[InitPlan]) -> P.PhysNode:
+        self._pq_calls = getattr(self, "_pq_calls", 0) + 1
         bq = self._rewrite_sublinks(bq, init_plans)
 
         # classify conjuncts
@@ -439,11 +453,24 @@ class Planner:
                     sel = 1.0 / max(cst["ndv"], 1) if cst else 0.1
                 elif cst and cst.get("min") is not None and \
                         q.op in ("<", "<=", ">", ">="):
-                    span = max(cst["max"] - cst["min"], 1e-9)
                     v = self._storage_bound(
                         rte.table.column(plain).type, q.right)
                     if v is not None:
-                        frac = (float(v) - cst["min"]) / span
+                        hist = cst.get("hist")
+                        if hist:
+                            # equi-depth quantile interpolation: each
+                            # bucket holds 1/(len-1) of the rows, so
+                            # the bound's insertion position IS the
+                            # cumulative fraction (skew-robust;
+                            # reference: ineq_histogram_selectivity)
+                            import numpy as _np
+                            frac = float(
+                                _np.searchsorted(_np.asarray(hist),
+                                                 float(v))
+                                / (len(hist) - 1))
+                        else:
+                            span = max(cst["max"] - cst["min"], 1e-9)
+                            frac = (float(v) - cst["min"]) / span
                         frac = min(max(frac, 0.0), 1.0)
                         sel = frac if q.op in ("<", "<=") else 1.0 - frac
             elif isinstance(q, E.StrPred):
@@ -516,11 +543,17 @@ class Planner:
                 sel *= 1.0 / ndv
             return max(cur_est * base_est[cand] * sel, 1.0)
 
+        forced = list(getattr(self, "_forced_order", None) or [])
+        if forced and (set(forced) != set(aliases) or outer_steps
+                       or semijoins):
+            forced = []          # stale/ineligible baseline: ignore
         while remaining:
             cand = None
+            if forced:
+                cand = forced[len(joined)]
             # outer joins are not reorderable past inner candidates:
             # take the next FROM-order outer step as soon as it appears
-            if remaining[0] in outer_steps and plan is not None:
+            elif remaining[0] in outer_steps and plan is not None:
                 cand = remaining[0]
             elif cost_mode and plan is None:
                 # starting table = one side of the cheapest join pair
@@ -558,6 +591,9 @@ class Planner:
             if cand is None:
                 cand = remaining[0]      # forced cross join
             remaining.remove(cand)
+            joined_order = getattr(self, "_order_chosen", None)
+            if joined_order is not None:
+                joined_order.append(cand)
             if cost_mode:
                 cur_est = base_est[cand] if plan is None \
                     else join_est(cand)

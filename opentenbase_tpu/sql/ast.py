@@ -295,6 +295,16 @@ class CreateTableStmt(Node):
     checks: list[str] = dataclasses.field(default_factory=list)
     foreign_keys: list[tuple] = dataclasses.field(default_factory=list)
     # each: (fk_cols tuple, ref_table, ref_cols tuple)
+    # DISTRIBUTE BY RANGE split-point literal expressions
+    range_split: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class CreateNodeGroupStmt(Node):
+    """CREATE NODE GROUP name (dn, ...) — reference: pgxc_group.h
+    + CREATE NODE GROUP in nodemgr.c."""
+    name: str
+    members: list
 
 
 @dataclasses.dataclass

@@ -17,9 +17,29 @@ _DIST_MAP = {
     "hash": DistType.HASH,
     "modulo": DistType.MODULO,
     "roundrobin": DistType.ROUNDROBIN,
+    "range": DistType.RANGE,
     "replicated": DistType.REPLICATED,
     "replication": DistType.REPLICATED,
 }
+
+
+def _range_bound(col: ColumnDef, expr) -> int:
+    """A RANGE split point in STORAGE representation (int64) — the
+    same canonical form the locator routes on."""
+    from ..catalog.types import TypeKind, date_to_days, decimal_to_int
+    v = expr.value if isinstance(expr, (A.Const, A.TypedConst)) else None
+    if isinstance(expr, A.UnaryOp) and expr.op == "-" and \
+            isinstance(expr.arg, A.Const):
+        v = -float(expr.arg.value) if "." in str(expr.arg.value) \
+            else -int(expr.arg.value)
+    if v is None:
+        raise ValueError("RANGE split points must be literals")
+    k = col.type.kind
+    if k == TypeKind.DATE:
+        return int(date_to_days(str(v)))
+    if k == TypeKind.DECIMAL:
+        return int(decimal_to_int(str(v), col.type.scale))
+    return int(v)
 
 
 def table_def_from_ast(stmt: A.CreateTableStmt) -> TableDef:
@@ -33,10 +53,17 @@ def table_def_from_ast(stmt: A.CreateTableStmt) -> TableDef:
             pk.append(c.name)
     dist = Distribution(_DIST_MAP[stmt.dist_type], list(stmt.dist_cols),
                         stmt.group or "default_group")
-    fks = [{"cols": list(fc), "ref_table": rt, "ref_cols": list(rc)}
-           for fc, rt, rc in stmt.foreign_keys]
-    return TableDef(stmt.name, cols, dist, checks=list(stmt.checks),
-                    fks=fks)
+    td = TableDef(stmt.name, cols, dist, checks=list(stmt.checks),
+                  fks=[{"cols": list(fc), "ref_table": rt,
+                        "ref_cols": list(rc)}
+                       for fc, rt, rc in stmt.foreign_keys])
+    if stmt.range_split:
+        dcol = td.column(dist.dist_cols[0])
+        bounds = [_range_bound(dcol, e) for e in stmt.range_split]
+        if bounds != sorted(bounds):
+            raise ValueError("RANGE split points must be ascending")
+        dist.range_bounds = bounds
+    return td
 
 
 def sequence_def_from_ast(stmt: A.CreateSequenceStmt) -> SequenceDef:

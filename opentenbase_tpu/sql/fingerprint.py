@@ -1,0 +1,39 @@
+"""Statement fingerprinting for SPM plan baselines.
+
+Reference analog: the normalized-SQL keying of optimizer/spm/spm.c —
+literals are masked so `WHERE k = 5` and `WHERE k = 9` share one
+baseline, while any structural change (different tables, joins,
+grouping) produces a different key.  The fingerprint is a SHA-256 of
+the bound statement's AST with every constant replaced by '?'.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from . import ast as A
+
+
+def _walk(node, out: list):
+    if isinstance(node, (A.Const, A.TypedConst)):
+        out.append("?")
+        return
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        out.append(type(node).__name__)
+        for f in dataclasses.fields(node):
+            _walk(getattr(node, f.name), out)
+        return
+    if isinstance(node, (list, tuple)):
+        out.append("[")
+        for x in node:
+            _walk(x, out)
+        out.append("]")
+        return
+    out.append(repr(node))
+
+
+def fingerprint(stmt: A.Node) -> str:
+    out: list = []
+    _walk(stmt, out)
+    return hashlib.sha256("\x1f".join(out).encode()).hexdigest()[:24]

@@ -682,6 +682,18 @@ class Parser:
         self.expect_kw("create")
         if self.accept_kw("table"):
             return self.create_table_tail()
+        if self.at_kw("node"):
+            save = self.i
+            self.advance()
+            if self.accept_kw("group"):
+                name = self.ident()
+                self.expect_op("(")
+                members = [self.ident()]
+                while self.accept_op(","):
+                    members.append(self.ident())
+                self.expect_op(")")
+                return A.CreateNodeGroupStmt(name, members)
+            self.i = save
         or_replace = False
         if self.at_kw("or"):
             save = self.i
@@ -835,6 +847,7 @@ class Parser:
                 fks.append(((c.name,), c.references[0],
                             (c.references[1],)))
         dist_type, dist_cols, group = "shard", [], None
+        range_split: list = []
         if self.accept_kw("distribute"):
             self.expect_kw("by")
             w = self.ident()
@@ -842,6 +855,21 @@ class Parser:
                 dist_type = "replicated"
             elif w == "roundrobin":
                 dist_type = "roundrobin"
+            elif w == "range":
+                dist_type = "range"
+                self.expect_op("(")
+                dist_cols.append(self.ident())
+                self.expect_op(")")
+                # DISTRIBUTE BY RANGE (col) SPLIT (v1, v2, ...):
+                # node i holds [v_{i-1}, v_i)
+                if self.tok.kind == Tok.IDENT and \
+                        self.tok.value == "split":
+                    self.advance()
+                    self.expect_op("(")
+                    range_split.append(self.expr())
+                    while self.accept_op(","):
+                        range_split.append(self.expr())
+                    self.expect_op(")")
             elif w in ("shard", "hash", "modulo"):
                 dist_type = w
                 self.expect_op("(")
@@ -876,7 +904,7 @@ class Parser:
                 ([columns[0].name] if columns else [])
         return A.CreateTableStmt(name, columns, pk, dist_type, dist_cols,
                                  group, if_not_exists, partition_by,
-                                 checks, fks)
+                                 checks, fks, range_split)
 
     def column_def(self) -> A.ColumnDefAst:
         name = self.ident()

@@ -374,12 +374,15 @@ class TableStore:
     def truncate(self):
         """Drop every row immediately (reference: ExecuteTruncate —
         non-MVCC, the relfilenode swap).  Dictionaries survive (codes
-        may be referenced by WAL records not yet checkpointed)."""
-        self.chunks = []
-        self.ann_indexes = {}
-        self.btree_indexes = {}
-        self.null_columns = set()
-        self.version = next(_VERSION_COUNTER)
+        may be referenced by WAL records not yet checkpointed).  Takes
+        the store mutex: concurrent host-op inserts must never append
+        into a chunk list being replaced."""
+        with self._mu:
+            self.chunks = []
+            self.ann_indexes = {}
+            self.btree_indexes = {}
+            self.null_columns = set()
+            self.version = next(_VERSION_COUNTER)
 
     def clear_locks(self, spans):
         for ci, idx in spans:

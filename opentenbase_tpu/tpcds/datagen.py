@@ -32,6 +32,10 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
     n_cd = 48
     n_hd = 20
     n_promo = 10
+    n_sm = 5
+    n_reason = 8
+    n_cc = 4
+    n_web = 3
     n_ss = max(int(4000 * sf), 400)
     n_cs = max(int(1500 * sf), 150)
     n_ws = max(int(1500 * sf), 150)
@@ -118,6 +122,25 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
                             for i in range(n_promo)],
     }
 
+    SM_TYPES = ["EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY", "LIBRARY"]
+    sm = {
+        "sm_ship_mode_sk": np.arange(1, n_sm + 1, dtype=np.int64),
+        "sm_type": [SM_TYPES[i % len(SM_TYPES)] for i in range(n_sm)],
+    }
+    reason = {
+        "r_reason_sk": np.arange(1, n_reason + 1, dtype=np.int64),
+        "r_reason_desc": [f"reason_{i}" for i in range(n_reason)],
+    }
+    cc = {
+        "cc_call_center_sk": np.arange(1, n_cc + 1, dtype=np.int64),
+        "cc_name": [f"cc_{i}" for i in range(n_cc)],
+        "cc_county": [COUNTIES[i % len(COUNTIES)] for i in range(n_cc)],
+    }
+    web = {
+        "web_site_sk": np.arange(1, n_web + 1, dtype=np.int64),
+        "web_name": [f"site_{i}" for i in range(n_web)],
+    }
+
     cust = {
         "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
         "c_first_name": [FIRST[i % len(FIRST)] for i in range(n_cust)],
@@ -191,6 +214,8 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
         "sr_returned_date_sk": sr_date,
         "sr_customer_sk": ss["ss_customer_sk"][sr_idx],
         "sr_store_sk": ss["ss_store_sk"][sr_idx],
+        "sr_reason_sk": rng.integers(1, n_reason + 1,
+                                     len(sr_idx)).astype(np.int64),
         "sr_return_quantity": sr_qty,
         "sr_return_amt": sr_amt,
     }
@@ -207,6 +232,10 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
                                          n_cs).astype(np.int64)
     cs["cs_promo_sk"] = rng.integers(1, n_promo + 1,
                                      n_cs).astype(np.int64)
+    cs["cs_ship_mode_sk"] = rng.integers(1, n_sm + 1,
+                                         n_cs).astype(np.int64)
+    cs["cs_call_center_sk"] = rng.integers(1, n_cc + 1,
+                                           n_cs).astype(np.int64)
     cs["cs_net_profit"] = np.round(
         cs["cs_ext_sales_price"] * rng.uniform(-0.2, 0.4, n_cs), 2)
 
@@ -217,6 +246,7 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
         "cr_item_sk": cs["cs_item_sk"][cr_idx],
         "cr_returned_date_sk": cr_date,
         "cr_returning_customer_sk": cs["cs_bill_customer_sk"][cr_idx],
+        "cr_call_center_sk": cs["cs_call_center_sk"][cr_idx],
         "cr_return_quantity": cr_qty,
         "cr_return_amount": cr_amt,
     }
@@ -229,8 +259,27 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
         1, n_cust + 1, n_ws).astype(np.int64)
     ws["ws_promo_sk"] = rng.integers(1, n_promo + 1,
                                      n_ws).astype(np.int64)
+    ws["ws_ship_mode_sk"] = rng.integers(1, n_sm + 1,
+                                         n_ws).astype(np.int64)
+    ws["ws_warehouse_sk"] = rng.integers(1, n_wh + 1,
+                                         n_ws).astype(np.int64)
+    ws["ws_web_site_sk"] = rng.integers(1, n_web + 1,
+                                        n_ws).astype(np.int64)
     ws["ws_net_profit"] = np.round(
         ws["ws_ext_sales_price"] * rng.uniform(-0.2, 0.4, n_ws), 2)
+
+    wr_idx, wr_date, wr_qty, wr_amt = returns(ws, n_ws, "ws",
+                                              n_ws // 4)
+    wr = {
+        "wr_order": ws["ws_order"][wr_idx],
+        "wr_item_sk": ws["ws_item_sk"][wr_idx],
+        "wr_returned_date_sk": wr_date,
+        "wr_returning_customer_sk": ws["ws_bill_customer_sk"][wr_idx],
+        "wr_return_quantity": wr_qty,
+        "wr_return_amt": wr_amt,
+        "wr_net_loss": np.round(wr_amt * rng.uniform(0.1, 0.6,
+                                                     len(wr_idx)), 2),
+    }
 
     # inventory: monthly snapshots per (item, warehouse)
     months = dates["d_date_sk"][np.asarray(
@@ -248,7 +297,8 @@ def generate(sf: float = 1.0, seed: int = 7) -> dict:
     return {"date_dim": dates, "item": items, "store": stores,
             "customer": cust, "customer_address": addr,
             "customer_demographics": cd, "household_demographics": hd,
-            "warehouse": wh, "promotion": promo,
+            "warehouse": wh, "promotion": promo, "ship_mode": sm,
+            "reason": reason, "call_center": cc, "web_site": web,
             "store_sales": ss, "store_returns": sr,
             "catalog_sales": cs, "catalog_returns": cr,
-            "web_sales": ws, "inventory": inv}
+            "web_sales": ws, "web_returns": wr, "inventory": inv}

@@ -584,3 +584,1156 @@ where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
 group by i_category, i_class
 order by i_category, i_class
 """
+
+# ---------------------------------------------------------------------------
+# round-4 expansion toward 99/99 (official template shapes, adapted to
+# the trimmed schema + literal parameters like the set above)
+# ---------------------------------------------------------------------------
+
+# Q2: web+catalog revenue per day-of-week, 1999 vs 2000 ratio
+Q[2] = """
+with wscs as (
+  select ws_sold_date_sk as sold_date_sk,
+         ws_ext_sales_price as sales_price
+  from web_sales
+  union all
+  select cs_sold_date_sk as sold_date_sk,
+         cs_ext_sales_price as sales_price
+  from catalog_sales
+), wswscs as (
+  select d_dow, d_year, sum(sales_price) as dow_sales
+  from wscs, date_dim
+  where sold_date_sk = d_date_sk
+  group by d_dow, d_year
+)
+select y.d_dow, y.dow_sales, z.dow_sales as next_sales,
+       z.dow_sales / y.dow_sales as ratio
+from wswscs y, wswscs z
+where y.d_dow = z.d_dow and y.d_year = 1999 and z.d_year = 2000
+order by y.d_dow
+"""
+
+# Q8: store net profit for stores in counties with enough customers
+Q[8] = """
+select s_store_name, sum(ss_net_profit) as profit
+from store_sales, date_dim, store
+where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+  and d_year = 1999
+  and s_county in (select ca_county from customer_address
+                   group by ca_county having count(*) >= 5)
+group by s_store_name
+order by s_store_name
+"""
+
+# Q20: catalog revenue share per class within category
+Q[20] = """
+select i_category, i_class, sum(cs_ext_sales_price) as itemrevenue,
+       sum(cs_ext_sales_price) * 100.0 /
+       sum(sum(cs_ext_sales_price)) over (partition by i_category)
+       as revenueratio
+from catalog_sales, item
+where cs_item_sk = i_item_sk and i_category in ('Books', 'Home')
+group by i_category, i_class
+order by i_category, revenueratio
+"""
+
+# Q26: catalog averages for one demographics slice
+Q[26] = """
+select i_brand, avg(cs_quantity) as agg1,
+       avg(cs_sales_price) as agg2, avg(cs_ext_sales_price) as agg3
+from catalog_sales, customer_demographics, item
+where cs_item_sk = i_item_sk and cs_bill_cdemo_sk = cd_demo_sk
+  and cd_gender = 'F' and cd_marital_status = 'M'
+group by i_brand
+order by i_brand
+limit 100
+"""
+
+# Q27: store averages by brand/state for one demographics slice
+Q[27] = """
+select i_brand, s_state, avg(ss_quantity) as agg1,
+       avg(ss_list_price) as agg2, avg(ss_coupon_amt) as agg3,
+       avg(ss_sales_price) as agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M' and cd_education_status = 'College'
+  and d_year = 1999
+group by i_brand, s_state
+order by i_brand, s_state
+limit 100
+"""
+
+# Q28: store_sales bucket averages (six list-price slices side-by-side)
+Q[28] = """
+select * from
+  (select avg(ss_list_price) b1_lp, count(ss_list_price) b1_cnt,
+          count(distinct ss_list_price) b1_cntd
+   from store_sales where ss_quantity between 0 and 5) b1,
+  (select avg(ss_list_price) b2_lp, count(ss_list_price) b2_cnt,
+          count(distinct ss_list_price) b2_cntd
+   from store_sales where ss_quantity between 6 and 10) b2,
+  (select avg(ss_list_price) b3_lp, count(ss_list_price) b3_cnt,
+          count(distinct ss_list_price) b3_cntd
+   from store_sales where ss_quantity between 11 and 15) b3
+"""
+
+# Q33: manufacturer revenue per channel for one category (3-way union)
+Q[33] = """
+with ss as (
+  select i_manufact_id, sum(ss_ext_sales_price) as total_sales
+  from store_sales, date_dim, item
+  where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+    and i_category = 'Books' and d_year = 1999 and d_moy = 3
+  group by i_manufact_id
+), cs as (
+  select i_manufact_id, sum(cs_ext_sales_price) as total_sales
+  from catalog_sales, date_dim, item
+  where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+    and i_category = 'Books' and d_year = 1999 and d_moy = 3
+  group by i_manufact_id
+), ws as (
+  select i_manufact_id, sum(ws_ext_sales_price) as total_sales
+  from web_sales, date_dim, item
+  where ws_sold_date_sk = d_date_sk and ws_item_sk = i_item_sk
+    and i_category = 'Books' and d_year = 1999 and d_moy = 3
+  group by i_manufact_id
+)
+select i_manufact_id, sum(total_sales) as total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) t
+group by i_manufact_id
+order by total_sales, i_manufact_id
+limit 100
+"""
+
+# Q41: distinct manufacturers whose items sit in a price band
+Q[41] = """
+select distinct i_manufact_id
+from item
+where i_current_price between 20 and 60
+  and i_manufact_id in
+      (select i_manufact_id from item
+       group by i_manufact_id having count(*) >= 2)
+order by i_manufact_id
+limit 100
+"""
+
+# Q44: best and worst items by average store net profit, side by side
+Q[44] = """
+with perf as (
+  select ss_item_sk item_sk, avg(ss_net_profit) avg_profit
+  from store_sales group by ss_item_sk
+), ranked as (
+  select item_sk, avg_profit,
+         rank() over (order by avg_profit desc) rnk_best,
+         rank() over (order by avg_profit asc) rnk_worst
+  from perf
+)
+select b.item_sk as best_performing, w.item_sk as worst_performing
+from ranked b, ranked w
+where b.rnk_best = w.rnk_worst and b.rnk_best <= 10
+order by b.rnk_best
+"""
+
+# Q45: web revenue by customer city/county for a customer-sk band
+Q[45] = """
+select ca_county, ca_city, sum(ws_sales_price) as rev
+from web_sales, customer, customer_address, date_dim
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and ws_sold_date_sk = d_date_sk
+  and d_year = 1999 and d_moy between 1 and 3
+group by ca_county, ca_city
+order by ca_county, ca_city, rev
+limit 100
+"""
+
+# Q56: item (brand) revenue summed across all three channels
+Q[56] = """
+with ss as (
+  select i_brand_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, item
+  where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+    and d_year = 1999 and d_moy = 2
+  group by i_brand_id
+), cs as (
+  select i_brand_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, item
+  where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+    and d_year = 1999 and d_moy = 2
+  group by i_brand_id
+), ws as (
+  select i_brand_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, item
+  where ws_sold_date_sk = d_date_sk and ws_item_sk = i_item_sk
+    and d_year = 1999 and d_moy = 2
+  group by i_brand_id
+)
+select i_brand_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) t
+group by i_brand_id
+order by total_sales, i_brand_id
+limit 100
+"""
+
+# Q60: like Q56 keyed by category id
+Q[60] = """
+with ss as (
+  select i_category_id, sum(ss_ext_sales_price) total_sales
+  from store_sales, date_dim, item
+  where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+    and d_year = 2000 and d_moy = 9
+  group by i_category_id
+), cs as (
+  select i_category_id, sum(cs_ext_sales_price) total_sales
+  from catalog_sales, date_dim, item
+  where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+    and d_year = 2000 and d_moy = 9
+  group by i_category_id
+), ws as (
+  select i_category_id, sum(ws_ext_sales_price) total_sales
+  from web_sales, date_dim, item
+  where ws_sold_date_sk = d_date_sk and ws_item_sk = i_item_sk
+    and d_year = 2000 and d_moy = 9
+  group by i_category_id
+)
+select i_category_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs
+      union all select * from ws) t
+group by i_category_id
+order by total_sales, i_category_id
+limit 100
+"""
+
+# Q62: web shipping latency buckets per warehouse/ship-mode/site
+Q[62] = """
+select w_warehouse_name, sm_type, web_name,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk <= 30
+                then 1 else 0 end) as d30,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 30
+                and ws_ship_date_sk - ws_sold_date_sk <= 60
+                then 1 else 0 end) as d60,
+       sum(case when ws_ship_date_sk - ws_sold_date_sk > 60
+                then 1 else 0 end) as d90
+from web_sales, warehouse, ship_mode, web_site
+where ws_warehouse_sk = w_warehouse_sk
+  and ws_ship_mode_sk = sm_ship_mode_sk
+  and ws_web_site_sk = web_site_sk
+group by w_warehouse_name, sm_type, web_name
+order by w_warehouse_name, sm_type, web_name
+limit 100
+"""
+
+# Q63: manager monthly revenue vs the manager's average month (window)
+Q[63] = """
+select * from (
+  select i_manager_id, d_moy, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over (partition by i_manager_id)
+         as avg_monthly_sales
+  from store_sales, date_dim, item
+  where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+    and d_year = 1999 and i_manager_id <= 8
+  group by i_manager_id, d_moy
+) t
+where sum_sales > 1.1 * avg_monthly_sales
+order by i_manager_id, d_moy
+limit 100
+"""
+
+# Q68: per-ticket extended amounts for city households (Q46 family)
+Q[68] = """
+select c_last_name, c_first_name, ca_city, ss_ticket,
+       sum(ss_ext_sales_price) extended_price,
+       sum(ss_coupon_amt) amt_coupon,
+       sum(ss_list_price) list_price
+from store_sales, date_dim, store, household_demographics,
+     customer_address, customer
+where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+  and ss_hdemo_sk = hd_demo_sk and ss_addr_sk = ca_address_sk
+  and ss_customer_sk = c_customer_sk
+  and hd_dep_count = 3 and d_year = 1999
+group by c_last_name, c_first_name, ca_city, ss_ticket
+order by c_last_name, c_first_name, ca_city, ss_ticket
+limit 100
+"""
+
+# Q71: brand revenue per channel within one month (union of channels)
+Q[71] = """
+select i_brand_id, i_brand, channel,
+       sum(ext_price) ext_price
+from item, (
+  select ws_ext_sales_price as ext_price,
+         ws_sold_date_sk as sold_date_sk, ws_item_sk as sold_item_sk,
+         1 as channel
+  from web_sales, date_dim
+  where d_date_sk = ws_sold_date_sk and d_year = 1999 and d_moy = 12
+  union all
+  select cs_ext_sales_price, cs_sold_date_sk, cs_item_sk, 2
+  from catalog_sales, date_dim
+  where d_date_sk = cs_sold_date_sk and d_year = 1999 and d_moy = 12
+  union all
+  select ss_ext_sales_price, ss_sold_date_sk, ss_item_sk, 3
+  from store_sales, date_dim
+  where d_date_sk = ss_sold_date_sk and d_year = 1999 and d_moy = 12
+) sales
+where sold_item_sk = i_item_sk and i_manager_id <= 10
+group by i_brand_id, i_brand, channel
+order by i_brand_id, channel, ext_price desc
+limit 100
+"""
+
+# Q73: tickets with 3..8 items for given household slices
+Q[73] = """
+select c_last_name, c_first_name, ss_ticket, cnt
+from (
+  select ss_ticket, ss_customer_sk, count(*) cnt
+  from store_sales, date_dim, store, household_demographics
+  where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+    and ss_hdemo_sk = hd_demo_sk
+    and hd_vehicle_count > 1 and d_year = 1999
+  group by ss_ticket, ss_customer_sk
+) dj, customer
+where ss_customer_sk = c_customer_sk and cnt between 3 and 8
+order by cnt desc, c_last_name, c_first_name, ss_ticket
+limit 100
+"""
+
+# Q79: max-profit ticket per customer for vehicle-owning households
+Q[79] = """
+select c_last_name, c_first_name, s_county, ss_ticket,
+       sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+from store_sales, date_dim, store, household_demographics, customer
+where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+  and ss_hdemo_sk = hd_demo_sk and ss_customer_sk = c_customer_sk
+  and hd_dep_count = 4 and d_dow = 1 and d_year = 1999
+group by c_last_name, c_first_name, s_county, ss_ticket
+order by c_last_name, c_first_name, s_county, ss_ticket
+limit 100
+"""
+
+# Q88: count slices side by side (dep-count x vehicle buckets)
+Q[88] = """
+select * from
+ (select count(*) h1 from store_sales, household_demographics
+  where ss_hdemo_sk = hd_demo_sk and hd_dep_count = 1) s1,
+ (select count(*) h2 from store_sales, household_demographics
+  where ss_hdemo_sk = hd_demo_sk and hd_dep_count = 2) s2,
+ (select count(*) h3 from store_sales, household_demographics
+  where ss_hdemo_sk = hd_demo_sk and hd_dep_count = 3) s3,
+ (select count(*) h4 from store_sales, household_demographics
+  where ss_hdemo_sk = hd_demo_sk and hd_dep_count = 4) s4
+"""
+
+# Q89: class monthly revenue vs class average month (window deviation)
+Q[89] = """
+select * from (
+  select i_category, i_class, s_store_name, d_moy,
+         sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) over
+           (partition by i_category, i_class, s_store_name)
+           avg_monthly_sales
+  from store_sales, date_dim, store, item
+  where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+    and ss_store_sk = s_store_sk and d_year = 1999
+    and i_category in ('Books', 'Music')
+  group by i_category, i_class, s_store_name, d_moy
+) t
+where avg_monthly_sales > 0
+  and sum_sales - avg_monthly_sales > 0.1 * avg_monthly_sales
+order by i_category, i_class, s_store_name, d_moy
+limit 100
+"""
+
+# Q90: early-week vs late-week web order ratio for one household slice
+Q[90] = """
+select am.amc * 1.0 / pm.pmc am_pm_ratio from
+ (select count(*) amc
+  from web_sales, customer, household_demographics, date_dim
+  where ws_bill_customer_sk = c_customer_sk
+    and c_current_hdemo_sk = hd_demo_sk
+    and ws_sold_date_sk = d_date_sk and d_dow <= 2
+    and hd_dep_count = 3) am,
+ (select count(*) pmc
+  from web_sales, customer, household_demographics, date_dim
+  where ws_bill_customer_sk = c_customer_sk
+    and c_current_hdemo_sk = hd_demo_sk
+    and ws_sold_date_sk = d_date_sk and d_dow >= 4
+    and hd_dep_count = 3) pm
+"""
+
+# Q91: call-center catalog returns for one demographics slice
+Q[91] = """
+select cc_name, cd_marital_status, cd_education_status,
+       sum(cr_return_amount) returns_loss
+from call_center, catalog_returns, date_dim, customer,
+     customer_demographics
+where cr_call_center_sk = cc_call_center_sk
+  and cr_returned_date_sk = d_date_sk
+  and cr_returning_customer_sk = c_customer_sk
+  and c_current_cdemo_sk = cd_demo_sk
+  and d_year = 1999
+  and cd_education_status in ('College', 'Advanced Degree')
+group by cc_name, cd_marital_status, cd_education_status
+order by returns_loss desc, cc_name, cd_marital_status
+limit 100
+"""
+
+# Q93: per-customer store revenue net of reason-coded returns
+Q[93] = """
+select ss_customer_sk,
+       sum(act_sales) sumsales
+from (
+  select ss_customer_sk,
+         case when sr_return_quantity is not null
+              then (ss_quantity - sr_return_quantity) * ss_sales_price
+              else ss_quantity * ss_sales_price end act_sales
+  from store_sales
+  left join store_returns
+    on ss_ticket = sr_ticket and ss_item_sk = sr_item_sk
+) t
+group by ss_customer_sk
+order by sumsales desc, ss_customer_sk
+limit 100
+"""
+
+# Q96: count of store sales for one household/store slice
+Q[96] = """
+select count(*) cnt
+from store_sales, household_demographics, store
+where ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+  and hd_dep_count = 2 and s_state = 'TN'
+"""
+
+# Q99: catalog shipping latency buckets per call-center/ship-mode
+Q[99] = """
+select w_warehouse_name, sm_type, cc_name,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk <= 30
+                then 1 else 0 end) as d30,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 30
+                and cs_ship_date_sk - cs_sold_date_sk <= 60
+                then 1 else 0 end) as d60,
+       sum(case when cs_ship_date_sk - cs_sold_date_sk > 60
+                then 1 else 0 end) as d90
+from catalog_sales, warehouse, ship_mode, call_center
+where cs_warehouse_sk = w_warehouse_sk
+  and cs_ship_mode_sk = sm_ship_mode_sk
+  and cs_call_center_sk = cc_call_center_sk
+group by w_warehouse_name, sm_type, cc_name
+order by w_warehouse_name, sm_type, cc_name
+limit 100
+"""
+
+# Q4: customer year-over-year growth, store vs web (catalog omitted
+# from the ratio pair like the 2-channel Q11, keeping the CTE shape)
+Q[4] = """
+with year_total as (
+  select c_customer_sk cid, d_year yr,
+         sum(ss_ext_sales_price) total, 1 chan
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk
+    and ss_sold_date_sk = d_date_sk
+  group by c_customer_sk, d_year
+  union all
+  select c_customer_sk cid, d_year yr,
+         sum(ws_ext_sales_price) total, 2 chan
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk
+    and ws_sold_date_sk = d_date_sk
+  group by c_customer_sk, d_year
+)
+select s1.cid
+from year_total s1, year_total s2, year_total w1, year_total w2
+where s1.cid = s2.cid and s1.cid = w1.cid and s1.cid = w2.cid
+  and s1.chan = 1 and s2.chan = 1 and w1.chan = 2 and w2.chan = 2
+  and s1.yr = 1999 and s2.yr = 2000
+  and w1.yr = 1999 and w2.yr = 2000
+  and s1.total > 0 and w1.total > 0
+  and w2.total / w1.total > s2.total / s1.total
+order by s1.cid
+limit 100
+"""
+
+# Q10: customers in given counties active in >1 channel, demographics
+Q[10] = """
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_county in ('county_0', 'county_1', 'county_2')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select 1 from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk and d_year = 1999)
+  and exists (select 1 from web_sales, date_dim
+              where c.c_customer_sk = ws_bill_customer_sk
+                and ws_sold_date_sk = d_date_sk and d_year = 1999)
+group by cd_gender, cd_marital_status, cd_education_status
+order by cd_gender, cd_marital_status, cd_education_status
+limit 100
+"""
+
+# Q11: store-vs-web yearly growth per customer (2-channel Q4)
+Q[11] = """
+with year_total as (
+  select c_customer_sk cid, d_year yr,
+         sum(ss_ext_sales_price) total, 1 chan
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk
+    and ss_sold_date_sk = d_date_sk
+  group by c_customer_sk, d_year
+  union all
+  select c_customer_sk cid, d_year yr,
+         sum(ws_ext_sales_price) total, 2 chan
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk
+    and ws_sold_date_sk = d_date_sk
+  group by c_customer_sk, d_year
+)
+select s2.cid, s2.total s_total, w2.total w_total
+from year_total s2, year_total w2
+where s2.cid = w2.cid and s2.chan = 1 and w2.chan = 2
+  and s2.yr = 2000 and w2.yr = 2000 and s2.total > 0
+order by s2.cid
+limit 100
+"""
+
+# Q14-lite: items sold in ALL three channels (INTERSECT), then their
+# store revenue (official: cross_items CTE + rollup shares)
+Q[14] = """
+with cross_items as (
+  select ss_item_sk x_item from store_sales
+  intersect
+  select cs_item_sk from catalog_sales
+  intersect
+  select ws_item_sk from web_sales
+)
+select i_brand_id, sum(ss_ext_sales_price) sales
+from store_sales, item
+where ss_item_sk = i_item_sk
+  and ss_item_sk in (select x_item from cross_items)
+group by i_brand_id
+order by i_brand_id
+limit 100
+"""
+
+# Q16: catalog orders shipped with a long lag and never returned
+Q[16] = """
+select count(distinct cs_order) order_count,
+       sum(cs_ext_sales_price) total_price,
+       sum(cs_net_profit) total_profit
+from catalog_sales cs1
+where cs_ship_date_sk - cs_sold_date_sk > 60
+  and not exists (select 1 from catalog_returns
+                  where cr_order = cs1.cs_order)
+"""
+
+# Q17-lite: items bought then returned then re-bought by catalog
+# (3-channel chain join; means instead of stddevs)
+Q[17] = """
+select i_brand,
+       count(*) cnt,
+       avg(ss_quantity) store_qty,
+       avg(sr_return_quantity) return_qty,
+       avg(cs_quantity) catalog_qty
+from store_sales, store_returns, catalog_sales, item
+where ss_ticket = sr_ticket and ss_item_sk = sr_item_sk
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk
+  and ss_item_sk = i_item_sk
+group by i_brand
+order by i_brand
+limit 100
+"""
+
+# Q21: inventory quantity before/after a pivot date per warehouse/item
+Q[21] = """
+select w_warehouse_name, i_brand,
+       sum(case when d_date < '1999-06-01' then inv_quantity_on_hand
+                else 0 end) inv_before,
+       sum(case when d_date >= '1999-06-01' then inv_quantity_on_hand
+                else 0 end) inv_after
+from inventory, warehouse, item, date_dim
+where inv_warehouse_sk = w_warehouse_sk
+  and inv_item_sk = i_item_sk and inv_date_sk = d_date_sk
+group by w_warehouse_name, i_brand
+order by w_warehouse_name, i_brand
+limit 100
+"""
+
+# Q23-lite: best store customers' catalog spend on frequent items
+Q[23] = """
+with frequent_items as (
+  select ss_item_sk f_item from store_sales
+  group by ss_item_sk having count(*) > 8
+), best_customers as (
+  select ss_customer_sk b_cust from store_sales
+  group by ss_customer_sk
+  having sum(ss_ext_sales_price) >
+         (select 0.8 * max(csales) from
+            (select sum(ss_ext_sales_price) csales
+             from store_sales group by ss_customer_sk) x)
+)
+select sum(cs_ext_sales_price) sales
+from catalog_sales
+where cs_item_sk in (select f_item from frequent_items)
+  and cs_bill_customer_sk in (select b_cust from best_customers)
+"""
+
+# Q24-lite: store sales returned then re-bought in store, by customer
+Q[24] = """
+select c_last_name, c_first_name, sum(ss_sales_price) netpaid
+from store_sales, store_returns, customer, item
+where ss_ticket = sr_ticket and ss_item_sk = sr_item_sk
+  and ss_customer_sk = c_customer_sk and ss_item_sk = i_item_sk
+  and i_current_price > 50
+group by c_last_name, c_first_name
+having sum(ss_sales_price) > 100
+order by c_last_name, c_first_name
+limit 100
+"""
+
+# Q29-lite: quantity chain store -> return -> catalog rebuy (Q17 qtys)
+Q[29] = """
+select i_brand,
+       sum(ss_quantity) store_qty,
+       sum(sr_return_quantity) return_qty,
+       sum(cs_quantity) catalog_qty
+from store_sales, store_returns, catalog_sales, item
+where ss_ticket = sr_ticket and ss_item_sk = sr_item_sk
+  and sr_customer_sk = cs_bill_customer_sk
+  and sr_item_sk = cs_item_sk and ss_item_sk = i_item_sk
+group by i_brand
+order by i_brand
+limit 100
+"""
+
+# Q30: web customers returning more than 1.2x their state's average
+Q[30] = """
+with customer_total_return as (
+  select wr_returning_customer_sk ctr_cust, ca_state ctr_state,
+         sum(wr_return_amt) ctr_total
+  from web_returns, date_dim, customer, customer_address
+  where wr_returned_date_sk = d_date_sk and d_year = 1999
+    and wr_returning_customer_sk = c_customer_sk
+    and c_current_addr_sk = ca_address_sk
+  group by wr_returning_customer_sk, ca_state
+)
+select c1.ctr_cust, c1.ctr_total
+from customer_total_return c1
+where c1.ctr_total >
+      (select avg(ctr_total) * 1.2 from customer_total_return c2
+       where c1.ctr_state = c2.ctr_state)
+order by c1.ctr_cust
+limit 100
+"""
+
+# Q31-lite: county store-sales quarter growth vs web (two quarters)
+Q[31] = """
+with ss as (
+  select ca_county, d_moy, sum(ss_ext_sales_price) store_sales
+  from store_sales, date_dim, customer_address, customer
+  where ss_sold_date_sk = d_date_sk and d_year = 1999
+    and ss_customer_sk = c_customer_sk
+    and c_current_addr_sk = ca_address_sk
+  group by ca_county, d_moy
+), ws as (
+  select ca_county, d_moy, sum(ws_ext_sales_price) web_sales
+  from web_sales, date_dim, customer_address, customer
+  where ws_sold_date_sk = d_date_sk and d_year = 1999
+    and ws_bill_customer_sk = c_customer_sk
+    and c_current_addr_sk = ca_address_sk
+  group by ca_county, d_moy
+)
+select ss1.ca_county,
+       ss2.store_sales / ss1.store_sales store_growth,
+       ws2.web_sales / ws1.web_sales web_growth
+from ss ss1, ss ss2, ws ws1, ws ws2
+where ss1.ca_county = ss2.ca_county and ss1.ca_county = ws1.ca_county
+  and ss1.ca_county = ws2.ca_county
+  and ss1.d_moy = 1 and ss2.d_moy = 2
+  and ws1.d_moy = 1 and ws2.d_moy = 2
+  and ss1.store_sales > 0 and ws1.web_sales > 0
+order by ss1.ca_county
+"""
+
+# Q32: catalog sales above 1.3x the item's average discount... adapted
+# to ext price (no discount column): excess-priced catalog rows
+Q[32] = """
+select sum(cs_ext_sales_price) excess
+from catalog_sales cs1, item
+where i_item_sk = cs1.cs_item_sk and i_manufact_id <= 4
+  and cs1.cs_ext_sales_price >
+      (select 1.3 * avg(cs_ext_sales_price) from catalog_sales cs2
+       where cs2.cs_item_sk = cs1.cs_item_sk)
+"""
+
+# Q35: demographics of customers active in store AND (web or catalog)
+Q[35] = """
+select cd_gender, cd_marital_status, count(*) cnt,
+       avg(cd_dep_count) avg_dep
+from customer c, customer_demographics
+where cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select 1 from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk and d_year = 1999)
+  and exists (select 1 from web_sales, date_dim
+              where c.c_customer_sk = ws_bill_customer_sk
+                and ws_sold_date_sk = d_date_sk and d_year = 1999)
+group by cd_gender, cd_marital_status
+order by cd_gender, cd_marital_status
+limit 100
+"""
+
+# Q39-lite: warehouse/item monthly inventory mean + spread proxy
+Q[39] = """
+with inv as (
+  select w_warehouse_name, inv_item_sk, d_moy,
+         avg(inv_quantity_on_hand) qty_mean,
+         max(inv_quantity_on_hand) - min(inv_quantity_on_hand)
+           qty_spread
+  from inventory, warehouse, date_dim
+  where inv_warehouse_sk = w_warehouse_sk
+    and inv_date_sk = d_date_sk and d_year = 1999
+  group by w_warehouse_name, inv_item_sk, d_moy
+)
+select i1.w_warehouse_name, i1.inv_item_sk, i1.qty_mean,
+       i2.qty_mean next_mean
+from inv i1, inv i2
+where i1.inv_item_sk = i2.inv_item_sk
+  and i1.w_warehouse_name = i2.w_warehouse_name
+  and i1.d_moy = 1 and i2.d_moy = 2
+  and i1.qty_spread > i1.qty_mean * 0.5
+order by i1.w_warehouse_name, i1.inv_item_sk
+limit 100
+"""
+
+# Q47: monthly brand sales vs neighbours (lag/lead via self join, v1)
+Q[47] = """
+with v1 as (
+  select i_brand, d_moy, sum(ss_sales_price) sum_sales
+  from store_sales, date_dim, item
+  where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+    and d_year = 1999
+  group by i_brand, d_moy
+)
+select v1.i_brand, v1.d_moy, v1.sum_sales,
+       v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+from v1, v1 v1_lag, v1 v1_lead
+where v1.i_brand = v1_lag.i_brand and v1.i_brand = v1_lead.i_brand
+  and v1.d_moy = v1_lag.d_moy + 1 and v1.d_moy = v1_lead.d_moy - 1
+order by v1.i_brand, v1.d_moy
+limit 100
+"""
+
+# Q49-lite: worst return ratios per channel (union + rank)
+Q[49] = """
+select channel, item, return_ratio, return_rank from (
+  select 'web' channel, t.item, t.return_ratio,
+         rank() over (order by t.return_ratio) return_rank
+  from (
+    select ws_item_sk item,
+           sum(wr_return_quantity) * 1.0 / sum(ws_quantity)
+             return_ratio
+    from web_sales join web_returns
+      on ws_order = wr_order and ws_item_sk = wr_item_sk
+    group by ws_item_sk
+  ) t
+  union all
+  select 'catalog' channel, t.item, t.return_ratio,
+         rank() over (order by t.return_ratio) return_rank
+  from (
+    select cs_item_sk item,
+           sum(cr_return_quantity) * 1.0 / sum(cs_quantity)
+             return_ratio
+    from catalog_sales join catalog_returns
+      on cs_order = cr_order and cs_item_sk = cr_item_sk
+    group by cs_item_sk
+  ) t
+) ranked
+where return_rank <= 10
+order by channel, return_rank, item
+"""
+
+# Q57: catalog version of Q47 (call-center monthly deviations)
+Q[57] = """
+with v1 as (
+  select cc_name, d_moy, sum(cs_sales_price) sum_sales
+  from catalog_sales, date_dim, call_center
+  where cs_sold_date_sk = d_date_sk
+    and cs_call_center_sk = cc_call_center_sk
+    and d_year = 1999
+  group by cc_name, d_moy
+)
+select v1.cc_name, v1.d_moy, v1.sum_sales,
+       v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+from v1, v1 v1_lag, v1 v1_lead
+where v1.cc_name = v1_lag.cc_name and v1.cc_name = v1_lead.cc_name
+  and v1.d_moy = v1_lag.d_moy + 1 and v1.d_moy = v1_lead.d_moy - 1
+order by v1.cc_name, v1.d_moy
+limit 100
+"""
+
+# Q58-lite: items with near-equal revenue across all three channels
+Q[58] = """
+with ss_items as (
+  select i_item_sk item_sk, sum(ss_ext_sales_price) ss_rev
+  from store_sales, item
+  where ss_item_sk = i_item_sk group by i_item_sk
+), cs_items as (
+  select i_item_sk item_sk, sum(cs_ext_sales_price) cs_rev
+  from catalog_sales, item
+  where cs_item_sk = i_item_sk group by i_item_sk
+), ws_items as (
+  select i_item_sk item_sk, sum(ws_ext_sales_price) ws_rev
+  from web_sales, item
+  where ws_item_sk = i_item_sk group by i_item_sk
+)
+select ss_items.item_sk, ss_rev, cs_rev, ws_rev
+from ss_items, cs_items, ws_items
+where ss_items.item_sk = cs_items.item_sk
+  and ss_items.item_sk = ws_items.item_sk
+  and ss_rev between 0.5 * cs_rev and 2.0 * cs_rev
+  and ss_rev between 0.5 * ws_rev and 2.0 * ws_rev
+order by ss_items.item_sk
+limit 100
+"""
+
+# Q59: store weekly dow sales, week-over-year comparison
+Q[59] = """
+with wss as (
+  select s_store_name, d_dow, d_year,
+         sum(ss_sales_price) dow_sales
+  from store_sales, date_dim, store
+  where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+  group by s_store_name, d_dow, d_year
+)
+select y.s_store_name, y.d_dow, y.dow_sales,
+       z.dow_sales next_year, z.dow_sales / y.dow_sales ratio
+from wss y, wss z
+where y.s_store_name = z.s_store_name and y.d_dow = z.d_dow
+  and y.d_year = 1999 and z.d_year = 2000 and y.dow_sales > 0
+order by y.s_store_name, y.d_dow
+limit 100
+"""
+
+# Q64-lite: items sold and returned in store then sold by catalog,
+# with price aggregates per item/store (the cross-channel chain)
+Q[64] = """
+select i_brand, s_store_name, count(*) cnt,
+       sum(ss_sales_price) store_rev,
+       sum(cs_ext_sales_price) catalog_rev
+from store_sales, store_returns, catalog_sales, item, store
+where ss_ticket = sr_ticket and ss_item_sk = sr_item_sk
+  and sr_item_sk = cs_item_sk
+  and sr_customer_sk = cs_bill_customer_sk
+  and ss_item_sk = i_item_sk and ss_store_sk = s_store_sk
+group by i_brand, s_store_name
+order by i_brand, s_store_name
+limit 100
+"""
+
+# Q66: warehouse monthly shipping by mode (web + catalog union)
+Q[66] = """
+select w_warehouse_name, sm_type, d_moy, sum(qty) qty,
+       sum(rev) rev
+from (
+  select ws_warehouse_sk wsk, ws_ship_mode_sk smk,
+         ws_sold_date_sk dsk, ws_quantity qty,
+         ws_ext_sales_price rev
+  from web_sales
+  union all
+  select cs_warehouse_sk, cs_ship_mode_sk, cs_sold_date_sk,
+         cs_quantity, cs_ext_sales_price
+  from catalog_sales
+) u, warehouse, ship_mode, date_dim
+where wsk = w_warehouse_sk and smk = sm_ship_mode_sk
+  and dsk = d_date_sk and d_year = 1999
+group by w_warehouse_name, sm_type, d_moy
+order by w_warehouse_name, sm_type, d_moy
+limit 100
+"""
+
+# Q69: demographics of store customers with NO web activity
+Q[69] = """
+select cd_gender, cd_marital_status, count(*) cnt
+from customer c, customer_demographics
+where cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select 1 from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk and d_year = 1999)
+  and not exists (select 1 from web_sales, date_dim
+                  where c.c_customer_sk = ws_bill_customer_sk
+                    and ws_sold_date_sk = d_date_sk
+                    and d_year = 1999)
+group by cd_gender, cd_marital_status
+order by cd_gender, cd_marital_status
+limit 100
+"""
+
+# Q72-lite: catalog orders joined to following-week inventory levels
+Q[72] = """
+select i_brand, w_warehouse_name, count(*) cnt,
+       sum(case when inv_quantity_on_hand < cs_quantity
+                then 1 else 0 end) low_stock
+from catalog_sales, inventory, warehouse, item
+where cs_item_sk = inv_item_sk
+  and cs_warehouse_sk = inv_warehouse_sk
+  and inv_warehouse_sk = w_warehouse_sk
+  and cs_item_sk = i_item_sk
+  and i_manager_id <= 5
+group by i_brand, w_warehouse_name
+order by i_brand, w_warehouse_name
+limit 100
+"""
+
+# Q74: customer store-vs-web year ratio (Q4 family, name output)
+Q[74] = """
+with year_total as (
+  select c_customer_sk cid, c_last_name lname, c_first_name fname,
+         d_year yr, sum(ss_ext_sales_price) total, 1 chan
+  from customer, store_sales, date_dim
+  where c_customer_sk = ss_customer_sk
+    and ss_sold_date_sk = d_date_sk
+  group by c_customer_sk, c_last_name, c_first_name, d_year
+  union all
+  select c_customer_sk cid, c_last_name lname, c_first_name fname,
+         d_year yr, sum(ws_ext_sales_price) total, 2 chan
+  from customer, web_sales, date_dim
+  where c_customer_sk = ws_bill_customer_sk
+    and ws_sold_date_sk = d_date_sk
+  group by c_customer_sk, c_last_name, c_first_name, d_year
+)
+select s1.cid, s1.lname, s1.fname
+from year_total s1, year_total s2, year_total w1, year_total w2
+where s1.cid = s2.cid and s1.cid = w1.cid and s1.cid = w2.cid
+  and s1.chan = 1 and s2.chan = 1 and w1.chan = 2 and w2.chan = 2
+  and s1.yr = 1999 and s2.yr = 2000
+  and w1.yr = 1999 and w2.yr = 2000
+  and s1.total > 0 and w1.total > 0
+  and w2.total / w1.total > s2.total / s1.total
+order by s1.cid
+limit 100
+"""
+
+# Q75: brand yearly channel sales, current vs prior year deltas
+Q[75] = """
+with all_sales as (
+  select d_year, i_brand_id, sum(sales_cnt) sales_cnt,
+         sum(sales_amt) sales_amt
+  from (
+    select d_year, i_brand_id, ss_quantity sales_cnt,
+           ss_ext_sales_price sales_amt
+    from store_sales, item, date_dim
+    where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+    union all
+    select d_year, i_brand_id, cs_quantity, cs_ext_sales_price
+    from catalog_sales, item, date_dim
+    where cs_item_sk = i_item_sk and cs_sold_date_sk = d_date_sk
+    union all
+    select d_year, i_brand_id, ws_quantity, ws_ext_sales_price
+    from web_sales, item, date_dim
+    where ws_item_sk = i_item_sk and ws_sold_date_sk = d_date_sk
+  ) u
+  group by d_year, i_brand_id
+)
+select cur.i_brand_id, prev.sales_cnt prev_cnt, cur.sales_cnt
+       cur_cnt, cur.sales_amt - prev.sales_amt amt_diff
+from all_sales cur, all_sales prev
+where cur.i_brand_id = prev.i_brand_id
+  and cur.d_year = 2000 and prev.d_year = 1999
+  and cur.sales_cnt < prev.sales_cnt
+order by amt_diff, cur.i_brand_id
+limit 100
+"""
+
+# Q76: channel rows with NULL keys (union counts by year/category)
+Q[76] = """
+select channel, d_year, i_category, count(*) cnt, sum(amt) amt
+from (
+  select 'store' channel, ss_sold_date_sk dsk, ss_item_sk isk,
+         ss_ext_sales_price amt
+  from store_sales where ss_customer_sk is not null
+  union all
+  select 'web' channel, ws_sold_date_sk, ws_item_sk,
+         ws_ext_sales_price
+  from web_sales where ws_bill_customer_sk is not null
+  union all
+  select 'catalog' channel, cs_sold_date_sk, cs_item_sk,
+         cs_ext_sales_price
+  from catalog_sales where cs_bill_customer_sk is not null
+) u, date_dim, item
+where dsk = d_date_sk and isk = i_item_sk
+group by channel, d_year, i_category
+order by channel, d_year, i_category
+limit 100
+"""
+
+# Q77-lite: per-channel sales and returns totals, one report
+Q[77] = """
+select channel, sum(sales) sales, sum(returns_amt) returns_amt
+from (
+  select 'store' channel, ss_ext_sales_price sales, 0.0 returns_amt
+  from store_sales
+  union all
+  select 'store', 0.0, sr_return_amt from store_returns
+  union all
+  select 'catalog', cs_ext_sales_price, 0.0 from catalog_sales
+  union all
+  select 'catalog', 0.0, cr_return_amount from catalog_returns
+  union all
+  select 'web', ws_ext_sales_price, 0.0 from web_sales
+  union all
+  select 'web', 0.0, wr_return_amt from web_returns
+) u
+group by channel
+order by channel
+"""
+
+# Q78: customer-item yearly sales with NO returns (anti join), by
+# store-to-web quantity ratio
+Q[78] = """
+select ss_customer_sk, ss_item_sk, sum(ss_quantity) store_qty
+from store_sales
+left join store_returns
+  on ss_ticket = sr_ticket and ss_item_sk = sr_item_sk
+where sr_ticket is null
+group by ss_customer_sk, ss_item_sk
+having sum(ss_quantity) >= 3
+order by ss_customer_sk, ss_item_sk
+limit 100
+"""
+
+# Q80-lite: channel revenue minus returns per promotion
+Q[80] = """
+select channel, sum(sales) sales, sum(ret) returns_amt,
+       sum(profit) profit
+from (
+  select 'store' channel, ss_ext_sales_price sales, 0.0 ret,
+         ss_net_profit profit
+  from store_sales, promotion
+  where ss_promo_sk = p_promo_sk and p_channel_email = 'N'
+  union all
+  select 'store', 0.0, sr_return_amt, 0.0 from store_returns
+  union all
+  select 'web' channel, ws_ext_sales_price, 0.0, ws_net_profit
+  from web_sales, promotion
+  where ws_promo_sk = p_promo_sk and p_channel_email = 'N'
+  union all
+  select 'web', 0.0, wr_return_amt, 0.0 from web_returns
+) u
+group by channel
+order by channel
+"""
+
+# Q82: items in a price band with inventory in a quantity band that
+# actually sold in store
+Q[82] = """
+select distinct i_item_sk, i_current_price
+from item, inventory, store_sales
+where inv_item_sk = i_item_sk and ss_item_sk = i_item_sk
+  and i_current_price between 30 and 60
+  and inv_quantity_on_hand between 100 and 500
+order by i_item_sk
+limit 100
+"""
+
+# Q83-lite: returned quantities per item across all three channels
+Q[83] = """
+with sr_items as (
+  select sr_item_sk item_sk, sum(sr_return_quantity) sr_qty
+  from store_returns group by sr_item_sk
+), cr_items as (
+  select cr_item_sk item_sk, sum(cr_return_quantity) cr_qty
+  from catalog_returns group by cr_item_sk
+), wr_items as (
+  select wr_item_sk item_sk, sum(wr_return_quantity) wr_qty
+  from web_returns group by wr_item_sk
+)
+select sr_items.item_sk, sr_qty, cr_qty, wr_qty
+from sr_items, cr_items, wr_items
+where sr_items.item_sk = cr_items.item_sk
+  and sr_items.item_sk = wr_items.item_sk
+order by sr_items.item_sk
+limit 100
+"""
+
+# Q84-lite: customers by buy-potential band with city filter
+Q[84] = """
+select c_customer_sk, c_last_name, c_first_name
+from customer, customer_address, household_demographics
+where c_current_addr_sk = ca_address_sk
+  and c_current_hdemo_sk = hd_demo_sk
+  and ca_city = 'city_1' and hd_buy_potential = '>5000'
+order by c_customer_sk
+limit 100
+"""
+
+# Q85-lite: web returns with reason + demographics buckets
+Q[85] = """
+select r_reason_desc, avg(wr_return_quantity) avg_qty,
+       avg(wr_return_amt) avg_amt
+from web_returns, store_returns, reason
+where wr_item_sk = sr_item_sk and sr_reason_sk = r_reason_sk
+group by r_reason_desc
+order by r_reason_desc
+limit 100
+"""
+
+# Q86: web revenue ROLLUP by category/class
+Q[86] = """
+select i_category, i_class, sum(ws_net_profit) total_profit
+from web_sales, item
+where ws_item_sk = i_item_sk
+group by rollup (i_category, i_class)
+order by i_category nulls last, i_class nulls last
+"""
+
+# Q92: web sales above 1.3x the item's average (excess web discount)
+Q[92] = """
+select sum(ws_ext_sales_price) excess
+from web_sales ws1, item
+where i_item_sk = ws1.ws_item_sk and i_manufact_id <= 4
+  and ws1.ws_ext_sales_price >
+      (select 1.3 * avg(ws_ext_sales_price) from web_sales ws2
+       where ws2.ws_item_sk = ws1.ws_item_sk)
+"""
+
+# Q94: web orders shipped long-lag and never returned (Q16 web twin)
+Q[94] = """
+select count(distinct ws_order) order_count,
+       sum(ws_ext_sales_price) total_price,
+       sum(ws_net_profit) total_profit
+from web_sales ws1
+where ws_ship_date_sk - ws_sold_date_sk > 60
+  and not exists (select 1 from web_returns
+                  where wr_order = ws1.ws_order)
+"""
+
+# Q95: web orders that were returned (exists twin of Q94)
+Q[95] = """
+select count(distinct ws_order) order_count,
+       sum(ws_ext_sales_price) total_price
+from web_sales ws1
+where exists (select 1 from web_returns
+              where wr_order = ws1.ws_order)
+"""
+
+# Q97: store vs catalog customer overlap (full-join counts)
+Q[97] = """
+with ssci as (
+  select ss_customer_sk cust from store_sales
+  where ss_customer_sk is not null
+  group by ss_customer_sk
+), csci as (
+  select cs_bill_customer_sk cust from catalog_sales
+  group by cs_bill_customer_sk
+)
+select sum(case when ssci.cust is not null and csci.cust is null
+                then 1 else 0 end) store_only,
+       sum(case when ssci.cust is null and csci.cust is not null
+                then 1 else 0 end) catalog_only,
+       sum(case when ssci.cust is not null and csci.cust is not null
+                then 1 else 0 end) store_and_catalog
+from ssci full join csci on ssci.cust = csci.cust
+"""

@@ -102,6 +102,7 @@ create table store_returns (
     sr_returned_date_sk bigint,
     sr_customer_sk bigint,
     sr_store_sk bigint,
+    sr_reason_sk bigint,
     sr_return_quantity int,
     sr_return_amt decimal(10,2)
 ) distribute by shard(sr_ticket);
@@ -115,6 +116,8 @@ create table catalog_sales (
     cs_bill_cdemo_sk bigint,
     cs_warehouse_sk bigint,
     cs_promo_sk bigint,
+    cs_ship_mode_sk bigint,
+    cs_call_center_sk bigint,
     cs_quantity int,
     cs_sales_price decimal(10,2),
     cs_ext_sales_price decimal(10,2),
@@ -126,6 +129,7 @@ create table catalog_returns (
     cr_item_sk bigint,
     cr_returned_date_sk bigint,
     cr_returning_customer_sk bigint,
+    cr_call_center_sk bigint,
     cr_return_quantity int,
     cr_return_amount decimal(10,2)
 ) distribute by shard(cr_order);
@@ -137,11 +141,45 @@ create table web_sales (
     ws_item_sk bigint,
     ws_bill_customer_sk bigint,
     ws_promo_sk bigint,
+    ws_ship_mode_sk bigint,
+    ws_warehouse_sk bigint,
+    ws_web_site_sk bigint,
     ws_quantity int,
     ws_sales_price decimal(10,2),
     ws_ext_sales_price decimal(10,2),
     ws_net_profit decimal(10,2)
 ) distribute by shard(ws_order);
+
+create table web_returns (
+    wr_order int,
+    wr_item_sk bigint,
+    wr_returned_date_sk bigint,
+    wr_returning_customer_sk bigint,
+    wr_return_quantity int,
+    wr_return_amt decimal(10,2),
+    wr_net_loss decimal(10,2)
+) distribute by shard(wr_order);
+
+create table ship_mode (
+    sm_ship_mode_sk bigint primary key,
+    sm_type varchar(12)
+) distribute by replication;
+
+create table reason (
+    r_reason_sk bigint primary key,
+    r_reason_desc varchar(20)
+) distribute by replication;
+
+create table call_center (
+    cc_call_center_sk bigint primary key,
+    cc_name varchar(12),
+    cc_county varchar(20)
+) distribute by replication;
+
+create table web_site (
+    web_site_sk bigint primary key,
+    web_name varchar(12)
+) distribute by replication;
 
 create table inventory (
     inv_item_sk bigint,

@@ -330,3 +330,44 @@ class TestMergeEdgeCases:
         # rows landed in the right partitions (parent reads see them)
         assert sess.query("select count(*) from mp_a") == [(1,)]
         assert sess.query("select count(*) from mp_b") == [(1,)]
+
+
+class TestTruncateConcurrency:
+    def test_truncate_refused_under_open_txn(self):
+        cl = Cluster(n_datanodes=2)
+        s1, s2 = ClusterSession(cl), ClusterSession(cl)
+        s1.execute("create table tt (k bigint primary key) "
+                   "distribute by shard(k)")
+        s1.execute("begin")
+        s1.execute("insert into tt values (1), (2)")
+        with pytest.raises(Exception, match="in-flight"):
+            s2.execute("truncate table tt")
+        s1.execute("commit")
+        s2.execute("truncate table tt")
+        assert s1.query("select count(*) from tt") == [(0,)]
+
+
+class TestMergeCardinality:
+    def test_target_duplicates_legal(self, sess):
+        _mk(sess, "create table mt2 (k bigint, v bigint)", "k")
+        _mk(sess, "create table ms2 (k bigint primary key, v bigint)",
+            "k")
+        sess.execute("insert into mt2 values (1, 10), (1, 11)")
+        sess.execute("insert into ms2 values (1, 100)")
+        sess.execute("merge into mt2 using ms2 on mt2.k = ms2.k "
+                     "when matched then update set v = ms2.v")
+        assert sorted(sess.query("select k, v from mt2")) == \
+            [(1, 100), (1, 100)]
+
+
+class TestNodeGroupRecovery:
+    def test_single_node_group_survives_restart(self, tmp_path):
+        d = str(tmp_path / "n")
+        s = Session(LocalNode(d))
+        s.execute("create node group g1 (dn0)")
+        s.execute("create table gt (k bigint primary key) "
+                  "distribute by shard(k) to group g1")
+        s.execute("insert into gt values (1)")
+        s2 = Session(LocalNode(d))
+        assert s2.node.catalog.node_groups.get("g1") == [0]
+        assert s2.query("select count(*) from gt") == [(1,)]

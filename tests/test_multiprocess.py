@@ -310,3 +310,29 @@ class TestConnectionPool:
         s.execute("commit")
         t.join(20)
         assert done
+
+
+class TestClusterMonitor:
+    def test_dead_dn_flips_health_map(self, tcp_cluster):
+        """clustermon.c analog: the liveness daemon detects a dead DN
+        within a bounded interval and otb_nodes reflects it."""
+        import time as _t
+        s, servers, gtm, d = tcp_cluster
+        mon = s.cluster.ensure_monitor(period=0.2)
+        _t.sleep(0.5)
+        assert all(h["healthy"] for h in mon.health.values())
+        rows = dict((r[0], r[1]) for r in
+                    s.query("select name, healthy from otb_nodes"))
+        assert rows.get("dn0") and rows.get("dn1")
+        servers[0].stop()
+        deadline = _t.monotonic() + 5.0
+        while _t.monotonic() < deadline:
+            if not mon.health.get(0, {}).get("healthy", True):
+                break
+            _t.sleep(0.1)
+        assert not mon.health[0]["healthy"], \
+            "dead DN not detected within the bound"
+        rows = dict((r[0], r[1]) for r in
+                    s.query("select name, healthy from otb_nodes"))
+        assert rows["dn0"] is False and rows["dn1"] is True
+        mon.stop()
