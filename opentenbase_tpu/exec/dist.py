@@ -21,6 +21,7 @@ spaces are node-local (storage/store.py), strings are the wire format.
 
 from __future__ import annotations
 
+import copy as _copy
 import dataclasses
 from typing import Optional
 
@@ -157,11 +158,15 @@ class DistExecutor:
         if self.cancel_check is not None:
             self.cancel_check()
         for ip in dp.init_plans:
-            # init plans are whole little queries: distribute + run them
+            # init plans are whole little queries: distribute + run
+            # them.  Distribution MUTATES the plan tree (exchange refs
+            # spliced in), and the generic plan cache re-runs the same
+            # DistPlan object — so distribute a fresh copy every time
+            # (cheap: init-plan trees are small)
             from ..plan.distribute import Distributor
             d = Distributor(self.cluster.catalog, self.cluster.ndn)
             sub = d.distribute(
-                PlannedStmt(ip.plan, [], []), None)
+                PlannedStmt(_copy.deepcopy(ip.plan), [], []), None)
             batch = self._run_distplan(sub)
             val = self._scalar(batch)
             self.params[ip.name] = (val, ip.type)

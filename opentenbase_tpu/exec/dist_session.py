@@ -202,6 +202,7 @@ class ClusterSession:
             return self._exec_select(stmt)
         if isinstance(stmt, A.CreateTableStmt):
             c.create_table(table_def_from_ast(stmt), stmt.if_not_exists)
+            c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
             if stmt.partition_by:
                 from ..parallel.partition import (PartitionError,
                                                   register_parent)
@@ -232,6 +233,7 @@ class ClusterSession:
             c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
             return Result("CREATE TABLE")
         if isinstance(stmt, A.DropTableStmt):
+            c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
             if stmt.name in c.catalog.tables:
                 from .constraints import drop_guards
                 drop_guards(c.catalog, stmt.name)
@@ -396,6 +398,7 @@ class ClusterSession:
                 raise ExecError("VACUUM refused: transactions in flight")
             return Result("VACUUM", rowcount=n)
         if isinstance(stmt, A.AnalyzeStmt):
+            c.stats_gen = getattr(c, "stats_gen", 0) + 1
             from ..parallel.statistics import merge_stats
             names = [stmt.table] if stmt.table else \
                 list(c.catalog.tables)
@@ -647,6 +650,22 @@ class ClusterSession:
     # ---- SELECT ----
     def _plan_distributed(self, stmt: A.SelectStmt,
                           txn: "ClusterTxn" = None) -> DistPlan:
+        # generic ad-hoc plan cache (exec/plancache.py): repeated
+        # identical SELECTs reuse the DistPlan, and through the mesh
+        # tier's program cache the compiled XLA program.  The
+        # generation covers DDL, stats, AND the planning GUCs, so SET
+        # changes invalidate cached plans.
+        from .plancache import get_or_build
+        c0 = self.cluster
+        gen = (getattr(c0, "ddl_gen", 0), getattr(c0, "stats_gen", 0),
+               tuple(sorted(c0.gucs.items())))
+        return get_or_build(
+            c0, "_dp_cache", stmt, gen,
+            lambda: self._plan_distributed_uncached(stmt, txn),
+            cacheable=lambda dp: dp.fqs_node is None)
+
+    def _plan_distributed_uncached(self, stmt: A.SelectStmt,
+                                   txn: "ClusterTxn" = None) -> DistPlan:
         binder = Binder(self.cluster.catalog)
         bq = binder.bind_select(stmt)
         # SPM plan baselines: replay the accepted join order for this

@@ -239,13 +239,30 @@ class MeshRunner:
             self._snapshots.pop(next(iter(self._snapshots)))
         return snap
 
+    def _version_of(self, dn, name: str):
+        """Cheap per-DN version probe — staging must NOT materialize a
+        snapshot (host_live_columns concatenates the whole table) just
+        to discover nothing changed."""
+        if hasattr(dn, "stores"):
+            st = dn.stores.get(name)
+            if st is None:
+                raise MeshUnsupported(f"table {name} missing on dn")
+            return st.version
+        v = dn.table_version(name)
+        if v is None:
+            raise MeshUnsupported(f"table {name} missing on "
+                                  f"dn{dn.index}")
+        return v
+
     def _stage_table(self, name: str) -> _StagedTable:
-        snaps = [self._snapshot(dn, name)
-                 for dn in self.cluster.datanodes]
-        vkey = tuple(s["version"] for s in snaps)
+        vkey = tuple(self._version_of(dn, name)
+                     for dn in self.cluster.datanodes)
         hit = self._staged.get(name)
         if hit is not None and hit.vkey == vkey:
             return hit
+        snaps = [self._snapshot(dn, name)
+                 for dn in self.cluster.datanodes]
+        vkey = tuple(s["version"] for s in snaps)
         td = self.cluster.catalog.table(name)
         ndn = len(snaps)
 

@@ -1,0 +1,48 @@
+"""Generic ad-hoc plan cache shared by the single-node and cluster
+sessions.
+
+Reference analog: the generic-plan arm of CachedPlanSource
+(utils/cache/plancache.c) applied to UNNAMED statements: repeated
+identical SELECTs reuse the planned tree — and, through the fused/mesh
+tiers' program memoization, the compiled XLA program.  Keyed by the
+EXACT statement (literals included, sql/fingerprint.py unmasked mode)
+plus a generation tuple covering DDL, stats, and the GUCs that shape
+planning.  Mutation is defensive: sessions on a CN server share one
+cluster-level cache across handler threads, so eviction races must
+never fail a query.
+"""
+
+from __future__ import annotations
+
+from ..sql.fingerprint import fingerprint
+
+_MAX = 256
+
+
+def get_or_build(holder, attr: str, stmt, gen, build,
+                 cacheable=lambda obj: True):
+    """Return the cached object for (stmt, gen) on `holder.attr`, or
+    build, insert, and return it.  `build()` runs at most once per
+    call; uncacheable statements/objects just build (e.g. FQS/gidx
+    plans, whose target node was chosen from DATA at plan time)."""
+    cache = getattr(holder, attr, None)
+    if cache is None:
+        cache = {}
+        setattr(holder, attr, cache)
+    try:
+        fp = fingerprint(stmt, mask_literals=False)
+    except Exception:
+        return build()
+    hit = cache.get(fp)
+    if hit is not None and hit[0] == gen:
+        return hit[1]
+    obj = build()
+    if obj is None or not cacheable(obj):
+        return obj
+    try:
+        cache[fp] = (gen, obj)
+        while len(cache) > _MAX:
+            cache.pop(next(iter(cache)))
+    except (KeyError, RuntimeError):
+        pass      # concurrent evictors raced; the cache stays bounded
+    return obj

@@ -436,6 +436,13 @@ class Session:
 
     # ------------------------------------------------------------------
     def _exec_stmt(self, stmt: A.Node) -> Result:
+        if isinstance(stmt, (A.CreateTableStmt, A.DropTableStmt,
+                             A.AlterTableStmt, A.CreateViewStmt,
+                             A.DropViewStmt, A.CreatePartitionStmt,
+                             A.CreateIndexStmt, A.DropIndexStmt,
+                             A.AnalyzeStmt)):
+            # any schema/stats change invalidates cached plans
+            self.node.ddl_gen = getattr(self.node, "ddl_gen", 0) + 1
         if isinstance(stmt, (A.SelectStmt, A.InsertStmt, A.ExplainStmt)):
             from .recursive import expand_in_stmt
             stmt2, cleanup = expand_in_stmt(self, stmt)
@@ -912,9 +919,21 @@ class Session:
 
     # ---- SELECT ----
     def _plan_select(self, stmt: A.SelectStmt) -> PlannedStmt:
-        binder = Binder(self.node.catalog)
-        bq = binder.bind_select(stmt)
-        return Planner(self.node.catalog).plan(bq)
+        # generic ad-hoc plan cache (exec/plancache.py; the cluster
+        # session's twin): identical statements reuse the PlannedStmt
+        # and, through the fused tier's memoization, the compiled
+        # program
+        from .plancache import get_or_build
+        node = self.node
+        gen = (getattr(node, "ddl_gen", 0),
+               len(node.catalog.tables), len(node.catalog.views),
+               tuple(sorted(node.gucs.items())))
+
+        def build():
+            bq = Binder(node.catalog).bind_select(stmt)
+            return Planner(node.catalog).plan(bq)
+
+        return get_or_build(node, "_plan_cache", stmt, gen, build)
 
     def _exec_select(self, stmt: A.SelectStmt) -> Result:
         if stmt.for_update:

@@ -15,25 +15,33 @@ import hashlib
 from . import ast as A
 
 
-def _walk(node, out: list):
+def _walk(node, out: list, mask: bool = True):
     if isinstance(node, (A.Const, A.TypedConst)):
-        out.append("?")
-        return
+        if mask:
+            out.append("?")
+            return
+        # unmasked: serialize the WHOLE literal node — kind/type_name/
+        # unit/qty distinguish `interval '1' day` from `... month` and
+        # numeric 1.5 from string '1.5' (dropping them collides
+        # distinct statements in the exact-plan cache)
     if dataclasses.is_dataclass(node) and not isinstance(node, type):
         out.append(type(node).__name__)
         for f in dataclasses.fields(node):
-            _walk(getattr(node, f.name), out)
+            _walk(getattr(node, f.name), out, mask)
         return
     if isinstance(node, (list, tuple)):
         out.append("[")
         for x in node:
-            _walk(x, out)
+            _walk(x, out, mask)
         out.append("]")
         return
     out.append(repr(node))
 
 
-def fingerprint(stmt: A.Node) -> str:
+def fingerprint(stmt: A.Node, mask_literals: bool = True) -> str:
+    """mask_literals=False keys the EXACT statement (literals
+    included) — the generic ad-hoc plan cache key, vs the SPM
+    baseline's literal-masked key."""
     out: list = []
-    _walk(stmt, out)
+    _walk(stmt, out, mask_literals)
     return hashlib.sha256("\x1f".join(out).encode()).hexdigest()[:24]

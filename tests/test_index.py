@@ -73,6 +73,9 @@ class TestIndexScan:
         idx_t = time.perf_counter() - t0
         saved = dict(sess.node.catalog.btree_cols)
         sess.node.catalog.btree_cols.clear()
+        # direct catalog surgery bypasses the SQL DDL path: bump the
+        # plan-cache generation the way CREATE/DROP INDEX would
+        sess.node.ddl_gen = getattr(sess.node, "ddl_gen", 0) + 1
         try:
             sess.query("select grp from big where id = 1")
             t0 = time.perf_counter()
@@ -81,6 +84,7 @@ class TestIndexScan:
             seq_t = time.perf_counter() - t0
         finally:
             sess.node.catalog.btree_cols.update(saved)
+            sess.node.ddl_gen = getattr(sess.node, "ddl_gen", 0) + 1
         assert idx_t * 2 < seq_t, (idx_t, seq_t)
 
 
