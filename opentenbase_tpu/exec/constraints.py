@@ -83,10 +83,34 @@ def validate_after_write(run_query, catalog, table: str,
         # FKs where `table` is the child
         _fk_orphan_checks(run_query, catalog, td, td.fks)
         return
-    # delete: FKs where `table` is the referenced parent
+    # delete: FKs where `table` is the referenced parent.  Self-
+    # referencing FKs are included (other == table): the anti-join sees
+    # the txn's own deletes through MVCC, so deleting a parent together
+    # with its children in one statement still passes, while deleting
+    # only the parent of a surviving same-table child is rejected
+    # (reference: ri_triggers.c enforces self-FKs identically).
+    # A DELETE against a partition CHILD can orphan rows referencing
+    # its partitioned parent — FK targets resolve through the parent
+    # name, so include it in the referenced set.
+    from ..parallel.partition import parent_of
+    targets = {table}
+    hit = parent_of(catalog, table)
+    if hit is not None:
+        targets.add(hit[0])
     for other in catalog.tables.values():
-        refs = [fk for fk in other.fks if fk["ref_table"] == table]
-        if refs and other.name != table:
+        refs = [fk for fk in other.fks if fk["ref_table"] in targets]
+        if not refs:
+            continue
+        # partition children inherit the parent's FKs, but the
+        # parent-level anti-join already covers all child rows (a
+        # parent reference binds as the union of its partitions) —
+        # skip the child copies to avoid one redundant scan per
+        # partition per DELETE
+        ohit = parent_of(catalog, other.name)
+        if ohit is not None:
+            parent_fks = catalog.tables[ohit[0]].fks
+            refs = [fk for fk in refs if fk not in parent_fks]
+        if refs:
             _fk_orphan_checks(run_query, catalog, other, refs)
 
 
@@ -131,7 +155,12 @@ def tables_needing_validation(catalog, table: str,
     td = catalog.table(table)
     if kind == "insert":
         return bool(td.checks or td.fks)
-    return any(fk["ref_table"] == table
+    from ..parallel.partition import parent_of
+    targets = {table}
+    hit = parent_of(catalog, table)
+    if hit is not None:
+        targets.add(hit[0])
+    return any(fk["ref_table"] in targets
                for other in catalog.tables.values()
                for fk in other.fks)
 

@@ -118,6 +118,8 @@ def _to_device(hb: HostBatch) -> DBatch:
             cols[n] = jnp.asarray(buf)
             dicts[n] = values
         else:
+            from ..utils.dtypes import stage_cast
+            arr = stage_cast(np.asarray(arr))
             buf = np.zeros((padded, *np.shape(arr)[1:]), dtype=arr.dtype)
             buf[:len(arr)] = arr
             cols[n] = jnp.asarray(buf)

@@ -213,20 +213,14 @@ class ClusterSession:
                 c._save_catalog()
             return Result("CREATE TABLE")
         if isinstance(stmt, A.CreatePartitionStmt):
-            from ..catalog.schema import ColumnDef, Distribution
             from ..parallel.partition import (PartitionError,
+                                              child_tabledef,
                                               partition_bounds)
             try:
                 ptd, rec = partition_bounds(c.catalog, stmt)
             except PartitionError as e:
                 raise ExecError(str(e)) from None
-            child = TableDef(
-                stmt.name,
-                [ColumnDef(cc.name, cc.type, cc.nullable)
-                 for cc in ptd.columns],
-                Distribution(ptd.distribution.dist_type,
-                             list(ptd.distribution.dist_cols),
-                             ptd.distribution.group))
+            child = child_tabledef(ptd, stmt.name)
             c.create_table(child)
             c.catalog.partitioned[stmt.parent]["parts"].append(rec)
             c._save_catalog()
@@ -468,13 +462,29 @@ class ClusterSession:
                             "block (non-MVCC bulk clear)")
         from .constraints import drop_guards
         drop_guards(c.catalog, stmt.table, action="truncate")
-        names = [stmt.table]
-        if stmt.table in c.catalog.partitioned:
-            names += [p["name"]
-                      for p in c.catalog.partitioned[stmt.table]["parts"]]
-        for nm in names:
+        # Cluster-level precheck BEFORE touching any node: a later DN
+        # refusing (it alone holds txn spans) after earlier DNs were
+        # irreversibly cleared would leave the table inconsistent
+        # across nodes.  ddl_mutex is held through the fan-out so no
+        # new txn can register mid-clear (register_txn takes the same
+        # mutex); existing txns are excluded by the precheck itself.
+        with c.ddl_mutex:
+            if c.active_txns:
+                raise ExecError("cannot truncate: in-flight "
+                                "transactions exist on this cluster")
             for dn in c.datanodes:
-                dn.truncate(nm)
+                if dn.inflight():
+                    raise ExecError(
+                        f"cannot truncate: in-flight transactions hold "
+                        f"row spans on datanode {dn.index}")
+            names = [stmt.table]
+            if stmt.table in c.catalog.partitioned:
+                names += [
+                    p["name"]
+                    for p in c.catalog.partitioned[stmt.table]["parts"]]
+            for nm in names:
+                for dn in c.datanodes:
+                    dn.truncate(nm)
         return Result("TRUNCATE TABLE")
 
     # ---- SAVEPOINT / ROLLBACK TO / RELEASE: per-DN span markers
@@ -523,7 +533,7 @@ class ClusterSession:
         t, implicit = self._begin_implicit()
         if implicit:
             self.txn = t
-        self.cluster.active_txns.add(t.txid)
+        self.cluster.register_txn(t.txid)
         total = 0
         try:
             total = Session._merge_steps(self, stmt, tgt, tkey, skey)
@@ -657,8 +667,7 @@ class ClusterSession:
         # changes invalidate cached plans.
         from .plancache import get_or_build
         c0 = self.cluster
-        gen = (getattr(c0, "ddl_gen", 0), getattr(c0, "stats_gen", 0),
-               tuple(sorted(c0.gucs.items())))
+        gen = self._plan_gen()
         return get_or_build(
             c0, "_dp_cache", stmt, gen,
             lambda: self._plan_distributed_uncached(stmt, txn),
@@ -766,10 +775,97 @@ class ClusterSession:
             return self._exec_select_for_update(stmt)
         self._refresh_stat_views(stmt)
         t, implicit = self._begin_implicit()
+        if not instrument:
+            res = self._try_autoprep(stmt, t)
+            if res is not None:
+                return res
         dp = self._plan_distributed(stmt, txn=t)
         res, ex = self._run_select_dp(dp, t, instrument=instrument)
         if instrument:
             return res, ex, dp
+        return res
+
+    def _plan_gen(self) -> tuple:
+        """Plan-cache generation: any DDL, stats refresh, or GUC change
+        invalidates cached plans (shared by the exact-statement cache
+        and the auto-prepare cache so they can never diverge)."""
+        c = self.cluster
+        return (getattr(c, "ddl_gen", 0), getattr(c, "stats_gen", 0),
+                tuple(sorted(c.gucs.items())))
+
+    def _try_autoprep(self, stmt: A.SelectStmt, t) -> "Result | None":
+        """Raw-literal OLTP fast path: lift WHERE literals to params,
+        reuse a cluster-wide Prepared keyed by the template — fresh
+        literals then cost a router call, not a plan cycle (reference:
+        FQS pgxc/plan/planner.c:390 answering unprepared single-shard
+        reads; the exact-statement cache only helps REPEATED
+        literals)."""
+        c = self.cluster
+        if c.gucs.get("enable_autoprepare", "on") == "off":
+            return None
+        # paths with extra ad-hoc planning intelligence keep the full
+        # plan cycle: global-index routing consults DATA at plan time,
+        # SPM baselines key on the ad-hoc fingerprint
+        if c.catalog.global_indexes \
+                or c.gucs.get("enable_spm", "off") == "on" \
+                or c.gucs.get("spm_capture", "off") == "on":
+            return None
+        from .autoprep import parameterize
+        try:
+            hit = parameterize(stmt)
+        except Exception:
+            return None
+        if hit is None:
+            return None
+        template, arg_nodes, ptypes = hit
+        from ..sql.fingerprint import fingerprint
+        try:
+            # the type signature is part of the key: A.Param carries
+            # only an index, so `k = 10` (INT64) and `k = 10.5`
+            # (DECIMAL(30,1)) share a template but must not share a
+            # plan (the int plan would bind 10.5 as a truncated int)
+            key = (fingerprint(template, mask_literals=False),
+                   tuple(str(ptypes[i])
+                         for i in range(1, len(ptypes) + 1)))
+        except Exception:
+            return None
+        gen = self._plan_gen()
+        cache = getattr(c, "_auto_prep", None)
+        if cache is None:
+            cache = {}
+            c._auto_prep = cache
+        ent = cache.get(key)
+        if ent is None or ent[0] != gen:
+            try:
+                prep = self._build_prepared(template, ptypes)
+            except Exception:
+                prep = None     # remember: this template can't bind
+            try:
+                cache[key] = (gen, prep)
+                while len(cache) > 256:
+                    cache.pop(next(iter(cache)))
+            except (KeyError, RuntimeError):
+                pass
+        else:
+            prep = ent[1]
+        if prep is None or prep.mode != "plan":
+            return None     # normal plan path (original stmt)
+        params = {}
+        try:
+            for i, arg in enumerate(arg_nodes, start=1):
+                params[f"__bindparam{i}"] = (
+                    self._bind_arg(arg, ptypes[i]), ptypes[i])
+        except Exception:
+            return None
+        self.plan_cache_hits += 1
+        node = prep.router(params) if prep.router is not None else None
+        if node is not None:
+            dp = DistPlan([Fragment(0, prep.planned.plan, "dn")], [], 0,
+                          prep.planned.init_plans,
+                          prep.planned.output_names, fqs_node=node)
+        else:
+            dp = prep.dp
+        res, _ex = self._run_select_dp(dp, t, params)
         return res
 
     def _exec_select_for_update(self, stmt: A.SelectStmt) -> Result:
@@ -798,7 +894,7 @@ class ClusterSession:
         t, implicit = self._begin_implicit()
         if implicit:
             self.txn = t
-        c.active_txns.add(t.txid)
+        c.register_txn(t.txid)
         try:
             for dn in c.datanodes:
                 n = dn.lock_where(td.name, quals, t.snapshot_ts,
@@ -1071,7 +1167,7 @@ class ClusterSession:
         t, implicit = self._begin_implicit()
         if implicit:
             self.txn = t
-            c.active_txns.add(t.txid)
+            c.register_txn(t.txid)
         try:
             # the SELECT leg: existing visible rows matching incoming keys
             from ..plan import exprs as E
@@ -1255,7 +1351,7 @@ class ClusterSession:
             # expose the txn so nested writes (global-index maintenance)
             # join it instead of committing independently
             self.txn = t
-        c.active_txns.add(t.txid)
+        c.register_txn(t.txid)
         try:
             if td.distribution.dist_type == DistType.REPLICATED:
                 dests = {i: np.arange(n)
@@ -1323,7 +1419,7 @@ class ClusterSession:
         t, implicit = self._begin_implicit()
         if implicit:
             self.txn = t
-        c.active_txns.add(t.txid)
+        c.register_txn(t.txid)
         binder = Binder(c.catalog)
         quals = []
         if stmt.where is not None:
@@ -1445,7 +1541,7 @@ class ClusterSession:
                                       self.cluster.gtm.next_gts())
                 self.txn.explicit = True
                 self.txn_aborted = False
-                self.cluster.active_txns.add(self.txn.txid)
+                self.cluster.register_txn(self.txn.txid)
             return Result("BEGIN")
         if stmt.op == "commit":
             if self.txn is not None:

@@ -32,6 +32,8 @@ from ..plan import physical as P
 from ..plan.planner import PlannedStmt, rewrite
 from ..storage.batch import next_pow2
 from ..storage.store import ABORTED_TS, TableStore
+from ..utils.dtypes import (bits_to_float, dev_dtype, device_float,
+                            float_to_bits, stage_cast)
 from ..utils.hashing import hash_columns_jax
 
 
@@ -56,7 +58,7 @@ class DBatch:
 
 
 def _empty_batch(types: dict[str, SqlType], dicts: dict) -> DBatch:
-    cols = {n: jnp.zeros(256, dtype=t.np_dtype) for n, t in types.items()}
+    cols = {n: jnp.zeros(256, dtype=dev_dtype(t)) for n, t in types.items()}
     return DBatch(cols, jnp.zeros(256, dtype=bool), dict(types), dict(dicts))
 
 
@@ -118,12 +120,12 @@ class DeviceTableCache:
                 parts = [ch.columns[name][:ch.nrows] for _, ch in
                          store.scan_chunks()]
                 ct = store.td.column(name).type
-                dt = ct.np_dtype
+                dt = dev_dtype(ct)
                 if not parts:
                     parts = [np.empty((0, *ct.shape_suffix), dt)]
             if not parts:
                 parts = [np.empty(0, dt)]
-            host = np.concatenate(parts)
+            host = stage_cast(np.concatenate(parts))
             buf = np.zeros((padded, *host.shape[1:]), dtype=host.dtype)
             buf[:n] = host
             arrs[name] = jax.device_put(buf)
@@ -407,7 +409,7 @@ class Executor:
         out_cols, out_types, out_dicts = {}, {}, {}
         for name, oe in outputs:
             if isinstance(oe, E.DistExpr):
-                out_cols[name] = dist.astype(jnp.float64)
+                out_cols[name] = dist.astype(device_float())
             else:
                 out_cols[name] = self._eval(oe, base)[idx]
             out_types[name] = oe.type
@@ -666,8 +668,9 @@ class Executor:
             arr = b.cols[n]
             if b.types[n].kind == TypeKind.FLOAT64:
                 # canonicalize -0.0 so SQL equality groups it with +0.0
-                arr = jnp.where(arr == 0.0, 0.0, arr)
-                arr = jax.lax.bitcast_convert_type(arr, jnp.int64)
+                arr = jnp.where(arr == 0, jnp.zeros((), arr.dtype),
+                                arr)
+                arr = float_to_bits(arr)
             arr = arr.astype(jnp.int64)
             nm = b.nulls.get(n)
             if nm is not None:
@@ -711,8 +714,8 @@ class Executor:
                 nulls[n] = gkeys[ki][gi].astype(bool)
                 ki += 1
             if t.kind == TypeKind.FLOAT64:
-                arr = jax.lax.bitcast_convert_type(arr, jnp.float64)
-            cols[n] = arr.astype(t.np_dtype)
+                arr = bits_to_float(arr)
+            cols[n] = arr.astype(dev_dtype(t))
             types[n] = t
         dicts = {n: b.dicts[n] for n in node.names if n in b.dicts}
         return DBatch(cols, out_valid, types, dicts, nulls)
@@ -812,7 +815,7 @@ class Executor:
         cols, types, dicts, nulls = {}, {}, {}, {}
         for i, ((kname, _), karr, kt, kd) in enumerate(
                 zip(node.group_keys, gkey_out, key_types, key_dicts)):
-            cols[kname] = karr.astype(kt.np_dtype)
+            cols[kname] = karr.astype(dev_dtype(kt))
             types[kname] = kt
             if kd is not None:
                 dicts[kname] = kd
@@ -823,8 +826,9 @@ class Executor:
             if special is not None and special[0] == "avg":
                 s, c = outs[oi], outs[oi + 1]
                 oi += 2
-                cols[name] = jnp.where(c > 0, s / jnp.maximum(c, 1)
-                                       / (10 ** special[1]), 0.0)
+                cols[name] = jnp.where(
+                    c > 0, s.astype(device_float()) / jnp.maximum(c, 1)
+                    / (10 ** special[1]), jnp.zeros((), device_float()))
                 nulls[name] = c == 0  # avg over zero non-null inputs
             elif special is not None and special[0] == "nullable":
                 # value plus its non-null contribution count: the SQL
@@ -1084,9 +1088,9 @@ class Executor:
             if is_float:
                 # -0.0 == +0.0 in SQL: normalize before the bit-pattern
                 # dedupe
-                f64 = arg_arr.astype(jnp.float64)
-                f64 = jnp.where(f64 == 0.0, 0.0, f64)
-                enc = jax.lax.bitcast_convert_type(f64, jnp.int64)
+                fv = arg_arr.astype(device_float())
+                fv = jnp.where(fv == 0, jnp.zeros((), fv.dtype), fv)
+                enc = float_to_bits(fv)
             else:
                 enc = arg_arr.astype(jnp.int64)
             nn = jnp.zeros(b.padded, bool) if arg_null is None \
@@ -1105,7 +1109,7 @@ class Executor:
             dnull = gkeys1[n_gk + 1].astype(bool)
             contrib = valid1 & ~dnull
             if is_float:
-                fval = jax.lax.bitcast_convert_type(dval, jnp.float64)
+                fval = bits_to_float(dval)
             else:
                 fval = dval
             # pass 2: reduce the deduped values per group
@@ -1117,7 +1121,7 @@ class Executor:
                               jnp.zeros((), fval.dtype))
                 kinds2 = ("sumf" if (is_float or ac.func == "avg")
                           else "sum", "sum")
-                ins2 = (v.astype(jnp.float64) if ac.func == "avg"
+                ins2 = (v.astype(device_float()) if ac.func == "avg"
                         else v, contrib.astype(jnp.int64))
             elif ac.func in ("min", "max"):
                 if is_float:
@@ -1153,7 +1157,8 @@ class Executor:
                 scale = ac.arg.type.scale \
                     if ac.arg.type.kind == TypeKind.DECIMAL else 0
                 out_cols[name] = jnp.where(
-                    c > 0, s / jnp.maximum(c, 1) / 10 ** scale, 0.0)
+                    c > 0, s.astype(device_float()) / jnp.maximum(c, 1)
+                    / 10 ** scale, jnp.zeros((), device_float()))
                 out_types[name] = T.FLOAT64
                 out_nulls[name] = c == 0
             else:
@@ -1376,7 +1381,7 @@ class Executor:
                     new_nulls[name] = scatter(rcount == 0)
                     continue
                 if wc.func in ("sum", "avg"):
-                    av = a_s.astype(jnp.float64) \
+                    av = a_s.astype(device_float()) \
                         if wc.func == "avg" else a_s
                     av = jnp.where(contrib, av, jnp.zeros((), av.dtype))
                     scum = jnp.cumsum(av)
@@ -1387,8 +1392,9 @@ class Executor:
                             if wc.arg.type.kind == TypeKind.DECIMAL else 0
                         res = jnp.where(
                             rcount > 0,
-                            rsum / jnp.maximum(rcount, 1) / 10 ** scale,
-                            0.0)
+                            rsum.astype(device_float())
+                            / jnp.maximum(rcount, 1) / 10 ** scale,
+                            jnp.zeros((), device_float()))
                     else:
                         res = rsum
                     new_cols[name] = scatter(res)
@@ -1465,7 +1471,7 @@ class Executor:
             j += 1
         st = jnp.stack(levels)                      # (L, n)
         length = jnp.maximum(fec - fsc + 1, 1)
-        jq = jnp.floor(jnp.log2(length.astype(jnp.float64))).astype(
+        jq = jnp.floor(jnp.log2(length.astype(device_float()))).astype(
             jnp.int32)
         jq = jnp.clip(jq, 0, len(levels) - 1)
         span = jnp.left_shift(jnp.int64(1), jq.astype(jnp.int64))

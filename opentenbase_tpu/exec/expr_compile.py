@@ -37,6 +37,7 @@ import numpy as np
 
 from ..catalog.types import TypeKind
 from ..plan import exprs as E
+from ..utils.dtypes import device_float, dev_dtype
 
 Arrays = dict  # name -> jnp array (null masks under NULLKEY + name)
 
@@ -57,7 +58,8 @@ def like_to_regex(pattern: str) -> re.Pattern:
 
 
 def _np_dtype(t) -> np.dtype:
-    return t.np_dtype
+    # device-path dtype: FLOAT64 maps to f32 in tpu-safe mode
+    return dev_dtype(t)
 
 
 def _rescale(fn, from_scale: int, to_scale: int):
@@ -241,13 +243,13 @@ def compile_pair(e: E.Expr, dicts: dict, nullable=frozenset()):
             nf = _union(ln, rn)
             if x.type.kind == TypeKind.FLOAT64:
                 lf2 = (lambda cols, _f=lf, _s=lt.scale:
-                       _f(cols).astype(jnp.float64) / 10 ** _s) \
+                       _f(cols).astype(device_float()) / 10 ** _s) \
                     if lt.kind == TypeKind.DECIMAL else \
-                    (lambda cols, _f=lf: _f(cols).astype(jnp.float64))
+                    (lambda cols, _f=lf: _f(cols).astype(device_float()))
                 rf2 = (lambda cols, _f=rf, _s=rt.scale:
-                       _f(cols).astype(jnp.float64) / 10 ** _s) \
+                       _f(cols).astype(device_float()) / 10 ** _s) \
                     if rt.kind == TypeKind.DECIMAL else \
-                    (lambda cols, _f=rf: _f(cols).astype(jnp.float64))
+                    (lambda cols, _f=rf: _f(cols).astype(device_float()))
                 op = x.op
                 return {"+": lambda cols: lf2(cols) + rf2(cols),
                         "-": lambda cols: lf2(cols) - rf2(cols),
@@ -307,9 +309,9 @@ def compile_pair(e: E.Expr, dicts: dict, nullable=frozenset()):
             if TypeKind.FLOAT64 in (lt.kind, rt.kind):
                 def mk(f, t):
                     if t.kind == TypeKind.DECIMAL:
-                        return lambda cols: (f(cols).astype(jnp.float64)
+                        return lambda cols: (f(cols).astype(device_float())
                                              / 10 ** t.scale)
-                    return lambda cols: f(cols).astype(jnp.float64)
+                    return lambda cols: f(cols).astype(device_float())
                 lf, rf = mk(lf, lt), mk(rf, rt)
             elif TypeKind.DECIMAL in (lt.kind, rt.kind):
                 s = max(lt.scale, rt.scale)
@@ -523,7 +525,7 @@ def compile_pair(e: E.Expr, dicts: dict, nullable=frozenset()):
             q = np.asarray(x.query, dtype=np.float32)
             metric = x.metric
             return (lambda cols: distances(cols[name], jnp.asarray(q),
-                                           metric).astype(jnp.float64)), None
+                                           metric).astype(device_float())), None
 
         if isinstance(x, E.Extract):
             f, nf = c(x.arg)
@@ -538,7 +540,7 @@ def compile_pair(e: E.Expr, dicts: dict, nullable=frozenset()):
                 return (lambda cols: jnp.asarray(0, dtype=dt)), \
                     (lambda env: jnp.asarray(True))
             if dst.kind == TypeKind.FLOAT64 and src.kind == TypeKind.DECIMAL:
-                return (lambda cols: f(cols).astype(jnp.float64)
+                return (lambda cols: f(cols).astype(device_float())
                         / 10 ** src.scale), nf
             if dst.kind == TypeKind.DECIMAL and src.kind == TypeKind.DECIMAL:
                 return _rescale(f, src.scale, dst.scale), nf

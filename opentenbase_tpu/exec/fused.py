@@ -33,6 +33,12 @@ from ..plan import physical as P
 _CACHE: dict = {}
 _CACHE_LIMIT = 256
 
+# Observability hook: when set, called as EXPORT_HOOK(tag, fn, args)
+# after each successful fused execution — the TPU lowering proof
+# (utils/lowering_check.py) uses it to AOT-export the very programs the
+# engine ran.
+EXPORT_HOOK = None
+
 
 def _key_of_expr(e) -> tuple:
     return e  # Expr dataclasses are frozen/hashable
@@ -223,6 +229,10 @@ def try_fused(executor, node) -> Optional[object]:
     except Exception:
         _CACHE.pop(full_key, None)
         raise
+    if EXPORT_HOOK is not None:
+        EXPORT_HOOK("fused", fn,
+                    (arrs, jnp.int64(ctx.snapshot_ts),
+                     jnp.int64(ctx.txid), pvals, jnp.int64(n)))
     from .executor import DBatch
     return DBatch(dict(cols), valid, dict(meta["types"]),
                   dict(meta["dicts"]), dict(nulls))

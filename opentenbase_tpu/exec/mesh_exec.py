@@ -54,6 +54,10 @@ from ..plan.distribute import BatchSource, DistPlan, ExchangeRef
 from ..storage.batch import next_pow2
 from ..utils.hashing import (combine_jax, hash_string, splitmix64_jax)
 
+# Observability hook (see exec/fused.py EXPORT_HOOK): called as
+# EXPORT_HOOK("mesh", fn, flat_args) after each successful program run.
+EXPORT_HOOK = None
+
 
 class MeshUnsupported(Exception):
     """This plan (or cluster) can't run on the device mesh — callers
@@ -311,7 +315,9 @@ class MeshRunner:
         padded = next_pow2(max(max(counts), 1))
         sh = NamedSharding(self.mesh, PS(self.axis))
         arrs = {}
+        from ..utils.dtypes import stage_cast
         for colname, sample in per_dn[0].items():
+            sample = stage_cast(sample)
             buf = np.zeros((ndn, padded, *sample.shape[1:]),
                            dtype=sample.dtype)
             for si in range(ndn):
@@ -555,7 +561,10 @@ class MeshRunner:
                       for f in dp.fragments
                       if f.index in included),
                 tuple((ex.index, ex.kind, tuple(ex.keys or ()),
-                       ex.source_fragment) for ex in dp.exchanges),
+                       ex.source_fragment,
+                       tuple(getattr(ex, "sort_keys", None) or ()),
+                       getattr(ex, "limit", None))
+                      for ex in dp.exchanges),
                 tuple((t, staged[t].padded) for t in table_names),
             ))
         except TypeError:
@@ -584,6 +593,44 @@ class MeshRunner:
         over = (n_live > gsz).astype(jnp.int64)
         return ({n: take(a) for n, a in b.cols.items()}, valid,
                 {n: take(a) for n, a in b.nulls.items()}, over)
+
+    @staticmethod
+    def _topk_spec(ob, ex):
+        """(key names, descs, limit) when this gather can cut to a
+        per-shard top-k INSIDE the program — sort keys are plain
+        non-TEXT columns without null masks (the common
+        ORDER BY agg/col LIMIT n tail, e.g. TPC-H Q3/Q10/Q18).
+        None = ship the full compacted gather (always correct)."""
+        if not ex.sort_keys or not ex.limit:
+            return None
+        names, descs = [], []
+        for k, desc in ex.sort_keys:
+            if not isinstance(k, E.Col) or k.name not in ob.cols \
+                    or k.name in ob.nulls \
+                    or ob.types[k.name].kind == TypeKind.TEXT:
+                return None
+            names.append(k.name)
+            descs.append(bool(desc))
+        return names, tuple(descs), int(ex.limit)
+
+    @staticmethod
+    def _topk_local(cols, valid, nulls, spec):
+        """Sort the compacted gather buffer by the sort keys and keep
+        the first `limit` rows (reference: SimpleSort on RemoteSubplan
+        — each DN pre-sorts/cuts, the CN merge re-sorts ndn*limit
+        rows instead of every group)."""
+        from ..ops import kernels as K
+        names, descs, limit = spec
+        keys = tuple(cols[n] for n in names)
+        pnames = sorted(cols)
+        nnames = sorted(nulls)
+        payload = tuple([cols[n] for n in pnames]
+                        + [nulls[n] for n in nnames])
+        out, s_valid = K.sort_rows(keys, valid, payload, descs, limit)
+        new_cols = {n: out[i] for i, n in enumerate(pnames)}
+        new_nulls = {n: out[len(pnames) + i]
+                     for i, n in enumerate(nnames)}
+        return new_cols, s_valid, new_nulls
 
     @staticmethod
     def _plan_key(node):
@@ -639,7 +686,10 @@ class MeshRunner:
                       for f in dp.fragments
                       if f.index in included),
                 tuple((ex.index, ex.kind, tuple(ex.keys or ()),
-                       ex.source_fragment) for ex in dp.exchanges),
+                       ex.source_fragment,
+                       tuple(getattr(ex, "sort_keys", None) or ()),
+                       getattr(ex, "limit", None))
+                      for ex in dp.exchanges),
                 tuple((t, staged[t].padded,
                        tuple(sorted((c, len(d.values)) for c, d in
                              staged[t].view.dicts.items())))
@@ -711,6 +761,10 @@ class MeshRunner:
                                           "dicts": ob.dicts}
                         cols, valid, nulls, gov = self._compact_local(
                             ob, gathers[ex.index])
+                        spec = self._topk_spec(ob, ex)
+                        if spec is not None:
+                            cols, valid, nulls = self._topk_local(
+                                cols, valid, nulls, spec)
                         gather_out[ex.index] = (cols, valid, nulls)
                         meta["gi_order"].append(ex.index)
                         gather_over.append(
@@ -764,6 +818,8 @@ class MeshRunner:
                 flat_args.append(staged[t].arrs[n])
             flat_args.append(staged[t].nrows)
         outs, a2a_over_vec, join_over, g_over_vec = fn(*flat_args)
+        if EXPORT_HOOK is not None:
+            EXPORT_HOOK("mesh", fn, tuple(flat_args))
         over_vec = np.asarray(jax.device_get(join_over))
         over_jids = sorted({jid for jid, ov in
                             zip(meta.get("jid_order", ()), over_vec)

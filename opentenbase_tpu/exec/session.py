@@ -476,20 +476,14 @@ class Session:
                                 "key": stmt.partition_by[1]}, sync=True)
             return Result("CREATE TABLE")
         if isinstance(stmt, A.CreatePartitionStmt):
-            from ..catalog.schema import ColumnDef, Distribution
             from ..parallel.partition import (PartitionError,
+                                              child_tabledef,
                                               partition_bounds)
             try:
                 ptd, rec = partition_bounds(self.node.catalog, stmt)
             except PartitionError as e:
                 raise ExecError(str(e)) from None
-            child = TableDef(
-                stmt.name,
-                [ColumnDef(c.name, c.type, c.nullable)
-                 for c in ptd.columns],
-                Distribution(ptd.distribution.dist_type,
-                             list(ptd.distribution.dist_cols),
-                             ptd.distribution.group))
+            child = child_tabledef(ptd, stmt.name)
             self.node.catalog.create_table(child)
             self.node.stores[child.name] = TableStore(child)
             self.node._log({"op": "create_table",

@@ -23,6 +23,7 @@ the session usable.
 from __future__ import annotations
 
 import hashlib
+import hmac
 import json
 import os
 import secrets
@@ -63,7 +64,12 @@ def check_password(path: str, user: str, password: str) -> bool:
     u = rec.get(user)
     if u is None:
         return False
-    return hash_password(password, u["salt"]) == u["hash"]
+    # constant-time: a network peer must not learn hash prefixes from
+    # comparison timing (reference: auth.c uses strcmp on md5 hashes,
+    # but hmac.compare_digest is the modern contract)
+    return hmac.compare_digest(
+        hash_password(password, u["salt"]).encode(),
+        str(u["hash"]).encode())
 
 
 # ---------------------------------------------------------------------------
@@ -124,7 +130,11 @@ class CnServer:
             # authenticates (it proves identity with the secret)
             with self._lock:
                 ent = self._sessions.get(first.get("pid"))
-            if ent is not None and ent[0] == first.get("secret"):
+            # bytes on both sides: compare_digest raises on non-ASCII
+            # str input, and the peer controls the secret field
+            if ent is not None and hmac.compare_digest(
+                    ent[0].encode(),
+                    str(first.get("secret", "")).encode()):
                 sess = ent[1]
                 if sess.cancel_event is not None:
                     sess.cancel_event.set()

@@ -157,6 +157,8 @@ def _dispatch(node: DataNode, msg: dict):
         return node.abort(msg["txid"])
     if op == "wrote_in":
         return node.wrote_in(msg["txid"])
+    if op == "inflight":
+        return node.inflight()
     if op == "checkpoint":
         return node.checkpoint(None)
     if op == "vacuum":
@@ -379,6 +381,9 @@ class RemoteDataNode:
 
     def truncate(self, table):
         return self._call(op="truncate", table=table)
+
+    def inflight(self):
+        return self._call(op="inflight")
 
     def savepoint_mark(self, txid):
         return self._call(op="savepoint_mark", txid=txid)

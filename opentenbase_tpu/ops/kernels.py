@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.dtypes import device_float
+
 INT64_MAX = np.int64(2**63 - 1)
 
 
@@ -92,7 +94,7 @@ def grouped_agg_dense(group_id, valid, agg_inputs: tuple,
         if kind == "count":
             vals = valid.astype(jnp.int64)
         elif kind == "sumf":
-            vals = _masked_for("sum", vals.astype(jnp.float64), valid)
+            vals = _masked_for("sum", vals.astype(device_float()), valid)
         else:
             vals = _masked_for(kind, vals, valid)
         if kind == "min":
@@ -167,7 +169,7 @@ def grouped_agg_sort(key_cols: tuple, valid, agg_inputs: tuple,
         else:
             vals = vals[perm]
             if kind == "sumf":
-                vals = _masked_for("sum", vals.astype(jnp.float64),
+                vals = _masked_for("sum", vals.astype(device_float()),
                                    s_valid)
             else:
                 vals = _masked_for(kind, vals, s_valid)

@@ -330,6 +330,10 @@ class DataNode:
         self.log({"op": "truncate", "table": table}, sync=True)
         return 0
 
+    def inflight(self) -> bool:
+        """Any transaction currently holding positional spans here."""
+        return bool(self.txn_spans)
+
     def savepoint_mark(self, txid: int) -> int:
         """Current position in this txn's op list (reference:
         subxact start, xact.c DefineSavepoint)."""
@@ -656,6 +660,12 @@ class Cluster:
         self._init_services()
 
     def _init_services(self):
+        import threading
+        # serializes txn registration against non-MVCC bulk ops
+        # (TRUNCATE): held across its precheck + fan-out so no txn can
+        # begin mid-clear and refuse a later DN after earlier DNs were
+        # irreversibly emptied
+        self.ddl_mutex = threading.RLock()
         from .maintenance import AuditLogger, ResourceQueue
         self._resqueue: Optional[ResourceQueue] = None
         self._resqueue_slots = 0
@@ -814,6 +824,12 @@ class Cluster:
         self.ddl_gen = getattr(self, "ddl_gen", 0) + 1
         from . import statviews
         statviews.register(self)
+
+    def register_txn(self, txid: int):
+        """All txn registration funnels through here so bulk ops can
+        exclude new txns by holding ddl_mutex."""
+        with self.ddl_mutex:
+            self.active_txns.add(txid)
 
     # ---- distributed commit (reference: execRemote.c
     # pgxc_node_remote_prepare :3944 / pgxc_node_remote_commit :4883) ----

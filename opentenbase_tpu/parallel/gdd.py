@@ -37,30 +37,40 @@ def collect_edges(datanodes) -> dict[int, set[int]]:
 
 
 def find_cycle(edges: dict[int, set[int]]):
-    """One cycle (list of txids) in the wait-for multigraph, or None."""
+    """One cycle (list of txids) in the wait-for multigraph, or None.
+
+    Iterative DFS (explicit stack): a wait CHAIN can be thousands of
+    transactions long, and Python recursion would RecursionError —
+    which GddDetector.run swallows, silently disabling deadlock
+    breaking until lock timeouts fire."""
     WHITE, GRAY, BLACK = 0, 1, 2
     color = {n: WHITE for n in edges}
     stack_path: list[int] = []
 
-    def dfs(n):
-        color[n] = GRAY
-        stack_path.append(n)
-        for h in edges.get(n, ()):
-            if color.get(h, WHITE) == GRAY:
-                return stack_path[stack_path.index(h):]
-            if color.get(h, WHITE) == WHITE and h in edges:
-                got = dfs(h)
-                if got is not None:
-                    return got
-        stack_path.pop()
-        color[n] = BLACK
-        return None
-
-    for n in list(edges):
-        if color[n] == WHITE:
-            got = dfs(n)
-            if got is not None:
-                return got
+    for root in list(edges):
+        if color[root] != WHITE:
+            continue
+        # stack holds (node, iterator over its holders)
+        color[root] = GRAY
+        stack_path.append(root)
+        stack = [(root, iter(edges.get(root, ())))]
+        while stack:
+            n, it = stack[-1]
+            advanced = False
+            for h in it:
+                ch = color.get(h, WHITE)
+                if ch == GRAY:
+                    return stack_path[stack_path.index(h):]
+                if ch == WHITE and h in edges:
+                    color[h] = GRAY
+                    stack_path.append(h)
+                    stack.append((h, iter(edges.get(h, ()))))
+                    advanced = True
+                    break
+            if not advanced:
+                stack.pop()
+                stack_path.pop()
+                color[n] = BLACK
     return None
 
 

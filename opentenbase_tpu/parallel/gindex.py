@@ -84,7 +84,7 @@ def create(session, stmt) -> None:
     t, implicit = session._begin_implicit()
     if implicit:
         session.txn = t
-        c.active_txns.add(t.txid)
+        c.register_txn(t.txid)
     try:
         keys, sids = _derive_entries(session, td, col, [], t)
         if stmt.unique and len(set(keys)) != len(keys):

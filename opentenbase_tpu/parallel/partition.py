@@ -122,6 +122,22 @@ def partition_bounds(catalog, stmt: A.CreatePartitionStmt):
     return ptd, rec
 
 
+def child_tabledef(ptd, name: str):
+    """Partition-child TableDef: parent's columns + distribution +
+    inherited CHECK/FK constraints (reference: ExecConstraints applies
+    the parent's constraints after ExecFindPartition routing).  Shared
+    by the single-node and cluster CREATE ... PARTITION OF paths."""
+    from ..catalog.schema import ColumnDef, Distribution, TableDef
+    return TableDef(
+        name,
+        [ColumnDef(c.name, c.type, c.nullable) for c in ptd.columns],
+        Distribution(ptd.distribution.dist_type,
+                     list(ptd.distribution.dist_cols),
+                     ptd.distribution.group),
+        checks=list(ptd.checks),
+        fks=[dict(fk) for fk in ptd.fks])
+
+
 def prune_partitions(pinfo: dict, key_type, where: Optional[A.Node],
                      alias: str) -> list[str]:
     """Surviving partition names under the statement's WHERE.

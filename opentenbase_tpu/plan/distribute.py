@@ -74,6 +74,7 @@ class Exchange:
     keys: list[E.Expr]
     source_fragment: int
     sort_keys: list = dataclasses.field(default_factory=list)
+    limit: object = None          # per-DN top-k cut (gather only)
 
 
 @dataclasses.dataclass
@@ -333,9 +334,16 @@ class Distributor:
         if isinstance(node, P.Sort):
             node.child, d = self._walk(node.child)
             if d.kind == "sharded":
-                # per-DN top-k, merge at CN, re-limit there
+                # per-DN top-k, merge at CN, re-limit there.  With a
+                # limit the DN side sorts AND cuts to limit(+offset)
+                # first, so the gather ships ndn*limit rows instead of
+                # every group (reference: SimpleSort on RemoteSubplan,
+                # planner.h:38-47 — the DN pre-sorts, the combiner
+                # merges; the top-k union provably contains the global
+                # top-k under the same total order)
                 gathered = self._add_gather(node.child,
-                                            sort_keys=node.keys)
+                                            sort_keys=node.keys,
+                                            limit=node.limit)
                 cn_sort = P.Sort(gathered, node.keys, node.limit)
                 return cn_sort, Dist("cn")
             return node, d
@@ -546,8 +554,8 @@ class Distributor:
         return P.Broadcast(child)
 
     def _add_gather(self, child: P.PhysNode, sort_keys=None,
-                    one: bool = False) -> P.PhysNode:
-        return P.Gather(child, sort_keys or [], one)
+                    one: bool = False, limit=None) -> P.PhysNode:
+        return P.Gather(child, sort_keys or [], one, limit)
 
     # -- fragmentation at exchange boundaries --
     def _fragmentize(self, plan: P.PhysNode, location: str) -> int:
@@ -565,7 +573,8 @@ class Distributor:
                     kind = "gather_one"
                 ex = Exchange(len(self.exchanges), kind,
                               getattr(node, "keys", []), src,
-                              sort_keys=getattr(node, "sort_keys", []))
+                              sort_keys=getattr(node, "sort_keys", []),
+                              limit=getattr(node, "limit", None))
                 self.exchanges.append(ex)
                 return ExchangeRef(ex.index)
             for attr in ("child", "left", "right"):

@@ -158,6 +158,7 @@ class Gather(PhysNode):
     sort_keys: list[tuple[E.Expr, bool]] = dataclasses.field(
         default_factory=list)   # merge-sorted gather (SimpleSort analog)
     one: bool = False           # replicated child: read a single node
+    limit: Optional[int] = None  # per-DN top-k cut before shipping
 
     def children(self):
         return [self.child]

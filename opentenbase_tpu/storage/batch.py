@@ -122,10 +122,11 @@ def stage_padded(host_cols, sel):
     import jax
     import numpy as np
 
+    from ..utils.dtypes import stage_cast
     out = {}
     n = None
     for name, arr in host_cols.items():
-        sub = arr[sel]
+        sub = stage_cast(arr[sel])
         if n is None:
             n = len(sub)
         padded = next_pow2(max(n, 1))

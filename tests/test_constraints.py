@@ -371,3 +371,125 @@ class TestNodeGroupRecovery:
         s2 = Session(LocalNode(d))
         assert s2.node.catalog.node_groups.get("g1") == [0]
         assert s2.query("select count(*) from gt") == [(1,)]
+
+
+class TestSelfReferencingFk:
+    """ADVICE r4: the delete-side orphan scan must include the table's
+    own self-FKs (reference: ri_triggers.c enforces them identically)."""
+
+    @pytest.fixture(autouse=True)
+    def _tables(self, sess):
+        _mk(sess, "create table emp (id bigint primary key, "
+                  "mgr bigint references emp (id))", "id")
+        self.s = sess
+
+    def test_delete_referenced_parent_rejected(self):
+        self.s.execute("insert into emp values (1, 1)")
+        self.s.execute("insert into emp values (2, 1)")
+        with pytest.raises(ExecError, match="foreign key"):
+            self.s.execute("delete from emp where id = 1")
+        assert self.s.query("select count(*) from emp") == [(2,)]
+
+    def test_delete_parent_and_children_together_passes(self):
+        self.s.execute("insert into emp values (1, 1)")
+        self.s.execute("insert into emp values (2, 1)")
+        self.s.execute("delete from emp where id >= 1")
+        assert self.s.query("select count(*) from emp") == [(0,)]
+
+    def test_delete_leaf_passes(self):
+        self.s.execute("insert into emp values (1, 1)")
+        self.s.execute("insert into emp values (2, 1)")
+        self.s.execute("delete from emp where id = 2")
+        assert self.s.query("select count(*) from emp") == [(1,)]
+
+
+class TestPartitionConstraintInheritance:
+    """ADVICE r4: CHECK/FK declared on a partitioned parent must be
+    enforced for rows routed to partition children (reference:
+    ExecConstraints runs after ExecFindPartition)."""
+
+    @staticmethod
+    def _mkpart(sess, head: str, key: str, tail: str):
+        """DDL with dist clause BEFORE the partition clause (grammar
+        order: distribute by ... partition by ...)."""
+        d = DIST.format(key) if isinstance(sess, ClusterSession) else ""
+        sess.execute(head + d + " " + tail)
+
+    def test_parent_check_enforced_on_routed_insert(self, sess):
+        self._mkpart(sess, "create table pc (k bigint primary key, "
+                     "v bigint check (v > 0))", "k",
+                     "partition by range (k)")
+        sess.execute("create table pc_a partition of pc "
+                     "for values from (0) to (100)")
+        sess.execute("insert into pc values (1, 5)")
+        with pytest.raises(ExecError, match="check constraint"):
+            sess.execute("insert into pc values (2, -5)")
+        assert sess.query("select count(*) from pc") == [(1,)]
+
+    def test_parent_check_enforced_on_direct_child_insert(self, sess):
+        self._mkpart(sess, "create table pd (k bigint primary key, "
+                     "v bigint check (v > 0))", "k",
+                     "partition by range (k)")
+        sess.execute("create table pd_a partition of pd "
+                     "for values from (0) to (100)")
+        with pytest.raises(ExecError, match="check constraint"):
+            sess.execute("insert into pd_a values (2, -5)")
+
+    def test_parent_fk_enforced_on_routed_insert(self, sess):
+        _mk(sess, "create table pref (r bigint primary key)", "r")
+        self._mkpart(sess, "create table pf (k bigint primary key, "
+                     "fk bigint references pref (r))", "k",
+                     "partition by range (k)")
+        sess.execute("create table pf_a partition of pf "
+                     "for values from (0) to (100)")
+        sess.execute("insert into pref values (7)")
+        sess.execute("insert into pf values (1, 7)")
+        with pytest.raises(ExecError, match="foreign key"):
+            sess.execute("insert into pf values (2, 99)")
+        assert sess.query("select count(*) from pf") == [(1,)]
+
+
+class TestGddIterativeDfs:
+    def test_long_wait_chain_no_recursion_error(self):
+        from opentenbase_tpu.parallel.gdd import find_cycle
+        # chain 0 -> 1 -> ... -> N, with a cycle closing at the tail
+        n = 5000
+        edges = {i: {i + 1} for i in range(n)}
+        edges[n] = {n - 3}
+        cycle = find_cycle(edges)
+        assert cycle is not None
+        assert set(cycle) == {n - 3, n - 2, n - 1, n}
+
+    def test_chain_without_cycle(self):
+        from opentenbase_tpu.parallel.gdd import find_cycle
+        edges = {i: {i + 1} for i in range(5000)}
+        assert find_cycle(edges) is None
+
+    def test_small_cycle_still_found(self):
+        from opentenbase_tpu.parallel.gdd import find_cycle
+        got = find_cycle({1: {2}, 2: {1}})
+        assert set(got) == {1, 2}
+
+
+class TestChildDeleteParentFk:
+    """DELETE against a partition child must still enforce FKs that
+    reference the partitioned PARENT (FK targets resolve through the
+    parent name)."""
+
+    def test_child_delete_orphan_rejected(self, sess):
+        d = DIST.format("id") if isinstance(sess, ClusterSession) else ""
+        sess.execute("create table parentp (id bigint primary key)"
+                     + d + " partition by range (id)")
+        sess.execute("create table parentp_a partition of parentp "
+                     "for values from (0) to (100)")
+        _mk(sess, "create table childt (c bigint primary key, "
+                  "p bigint references parentp (id))", "c")
+        sess.execute("insert into parentp values (5)")
+        sess.execute("insert into childt values (1, 5)")
+        with pytest.raises(ExecError, match="foreign key"):
+            sess.execute("delete from parentp_a where id = 5")
+        with pytest.raises(ExecError, match="foreign key"):
+            sess.execute("delete from parentp where id = 5")
+        sess.execute("delete from childt where c = 1")
+        sess.execute("delete from parentp_a where id = 5")
+        assert sess.query("select count(*) from parentp") == [(0,)]

@@ -204,3 +204,85 @@ class TestUpsert:
         for dn in s.cluster.datanodes:
             assert dn.stores["rdim"].row_count() >= 3
         s.execute("drop table rdim")
+
+
+class TestAutoPrepare:
+    """VERDICT r4 #6: unprepared point reads must ride the prepared
+    machinery via literal lifting (exec/autoprep.py)."""
+
+    def _mk(self):
+        from opentenbase_tpu.exec.dist_session import ClusterSession
+        from opentenbase_tpu.parallel.cluster import Cluster
+        cl = Cluster(n_datanodes=3)
+        s = ClusterSession(cl)
+        s.execute("create table apv (k bigint primary key, v bigint, "
+                  "d decimal(10,2), dt date) distribute by shard(k)")
+        s.execute("insert into apv values "
+                  + ",".join(f"({i},{i * 3},{i}.5,'1995-01-{1 + i % 28:02d}')"
+                             for i in range(200)))
+        return s
+
+    def test_fresh_literals_share_plan(self):
+        s = self._mk()
+        assert s.query("select v from apv where k = 10") == [(30,)]
+        h0 = s.plan_cache_hits
+        assert s.query("select v from apv where k = 11") == [(33,)]
+        assert s.query("select v from apv where k = 12") == [(36,)]
+        assert s.plan_cache_hits >= h0 + 2     # autoprep, not replans
+        cache = getattr(s.cluster, "_auto_prep", {})
+        assert len(cache) == 1                 # one template
+
+    def test_literal_kinds(self):
+        s = self._mk()
+        assert s.query("select count(*) from apv where d > 100.5") \
+            == [(99,)]
+        assert s.query("select count(*) from apv where d > 150.5") \
+            == [(49,)]
+        assert s.query("select count(*) from apv "
+                       "where dt = '1995-01-05' and k < 100") == [(4,)]
+        assert s.query("select count(*) from apv where k = -1") == [(0,)]
+
+    def test_string_literals_stay_distinct(self):
+        s = self._mk()
+        s.execute("create table apn (k bigint primary key, nm text) "
+                  "distribute by shard(k)")
+        s.execute("insert into apn values (1,'a'),(2,'b'),(3,'a')")
+        assert s.query("select count(*) from apn where nm = 'a' "
+                       "and k > 0") == [(2,)]
+        assert s.query("select count(*) from apn where nm = 'b' "
+                       "and k > 0") == [(1,)]
+
+    def test_ddl_invalidates(self):
+        s = self._mk()
+        assert s.query("select v from apv where k = 5") == [(15,)]
+        s.execute("alter table apv add column z bigint")
+        assert s.query("select v from apv where k = 5") == [(15,)]
+        s.execute("update apv set v = 99 where k = 5")
+        assert s.query("select v from apv where k = 5") == [(99,)]
+
+    def test_in_list_not_lifted(self):
+        s = self._mk()
+        assert s.query("select count(*) from apv where k in (1,2,3)") \
+            == [(3,)]
+        assert s.query("select count(*) from apv where k in (4,5)") \
+            == [(2,)]
+
+    def test_subquery_literals_stay_baked(self):
+        s = self._mk()
+        assert s.query("select count(*) from apv where v > "
+                       "(select min(v) + 30 from apv)") == [(189,)]
+        assert s.query("select count(*) from apv where v > "
+                       "(select min(v) + 60 from apv)") == [(179,)]
+
+    def test_type_distinct_literals_do_not_share_plans(self):
+        # `k = 10` (INT64) vs `k = 10.5` (DECIMAL) share a template but
+        # must not share a plan — the int plan would truncate 10.5
+        s = self._mk()
+        assert s.query("select v from apv where k = 10") == [(30,)]
+        assert s.query("select v from apv where k = 10.5") == []
+        assert s.query("select count(*) from apv where d > 100.5") \
+            == [(99,)]
+        assert s.query("select count(*) from apv where d > 100.25") \
+            == [(100,)]
+        assert s.query("select count(*) from apv where d > 100.55") \
+            == [(99,)]
