@@ -121,6 +121,11 @@ class NodeDef:
     host: str = "localhost"
     port: int = 0
     index: int = 0         # dense datanode index used by the shard map
+    # registered standby for auto-failover: {"host","port","datadir"}
+    standby: dict = None
+    # bumped at every failover of this slot: a coordinator holding a
+    # connection to an older epoch's address must re-resolve (fencing)
+    epoch: int = 0
 
     def to_json(self):
         return dataclasses.asdict(self)

@@ -30,8 +30,13 @@ def cmd_init(args):
     from ..parallel.cluster import Cluster
     Cluster(n_datanodes=args.datanodes, datadir=args.dir).checkpoint()
     from ..net.cn_server import default_users_path, write_users
+    from ..net.pgwire import write_pg_users
     write_users(default_users_path(args.dir),
                 {args.user: args.password})
+    # add the md5 verifier so the PostgreSQL-protocol port (libpq
+    # drivers) authenticates the same user
+    write_pg_users(default_users_path(args.dir),
+                   {args.user: args.password})
     print(f"initialized cluster dir {args.dir} "
           f"({args.datanodes} datanodes, sql user {args.user!r})")
 
@@ -72,19 +77,28 @@ def cmd_start(args):
                               [(s.host, s.port) for s in servers],
                               (gtm.host, gtm.port))
     users = default_users_path(args.dir)
-    cluster.ensure_monitor()
+    cluster.ensure_monitor(auto_failover=True)
     cn = CnServer(lambda: ClusterSession(cluster),
                   users_path=users if os.path.exists(users) else None,
                   port=cfg.get("cn_port", 7900)).start()
     print(f"cn listening on {cn.host}:{cn.port}")
+    # PostgreSQL-protocol front door (psql/psycopg2/JDBC) one port up
+    from ..net.pgwire import PgWireServer
+    pg = PgWireServer(lambda: ClusterSession(cluster),
+                      users_path=users if os.path.exists(users)
+                      else None,
+                      port=cfg.get("pg_port",
+                                   cfg.get("cn_port", 7900) + 1)).start()
+    print(f"pg wire listening on {pg.host}:{pg.port}")
     addrs = {"gtm": [gtm.host, gtm.port],
              "datanodes": [[s.host, s.port] for s in servers],
-             "cn": [cn.host, cn.port]}
+             "cn": [cn.host, cn.port],
+             "pg": [pg.host, pg.port]}
     with open(os.path.join(args.dir, "addresses.json"), "w") as f:
         json.dump(addrs, f)
     print("cluster up (supervised); ^C to stop")
     try:
-        Supervisor(servers, factories).run(interval=5.0)
+        Supervisor(servers, factories, catalog_path).run(interval=5.0)
     except KeyboardInterrupt:
         for s in servers:
             s.stop()
@@ -97,9 +111,31 @@ class Supervisor:
     children, postmaster.c, + the cluster monitor's health map,
     nodemgr.c:1122 PgxcNodeGetHealthMap)."""
 
-    def __init__(self, servers: list, factories: list):
+    def __init__(self, servers: list, factories: list,
+                 catalog_path: str = ""):
         self.servers = servers          # mutated in place on restart
         self.factories = factories      # index -> () -> started server
+        self.catalog_path = catalog_path
+
+    def _fenced(self, i: int) -> bool:
+        """True when the shared catalog no longer points at this
+        server's address — a failover promoted the standby, and
+        resurrecting the old primary here would split-brain the slot
+        (reference: the fencing step of pgxc_ctl failover)."""
+        if not self.catalog_path or not os.path.exists(
+                self.catalog_path):
+            return False
+        try:
+            from ..catalog.catalog import Catalog
+            cat = Catalog.load(self.catalog_path)
+            srv = self.servers[i]
+            for nd in cat.datanodes():
+                if nd.index == i and nd.port and \
+                        (nd.host, nd.port) != (srv.host, srv.port):
+                    return True
+        except Exception:
+            return False
+        return False
 
     def _alive(self, i: int) -> bool:
         """Fresh connection per probe, closed afterwards: liveness means
@@ -129,6 +165,8 @@ class Supervisor:
         for i in range(len(self.servers)):
             if self._alive(i):
                 continue
+            if self._fenced(i):
+                continue    # failover moved this slot: do not resurrect
             try:
                 self.servers[i].stop()
             except Exception:

@@ -119,32 +119,9 @@ class ClusterSession:
             if self.cluster.gucs.get("audit_enabled", "off") == "on" \
             else None
         for s in parse_sql(sql):
-            self._check_cancel()
-            if self.txn is not None and self.txn_aborted \
-                    and not isinstance(s, A.TxnStmt) \
-                    and not (isinstance(s, A.SavepointStmt)
-                             and s.op == "rollback_to"):
-                # PG semantics: after an error the txn is poisoned —
-                # only COMMIT (which rolls back) or ROLLBACK may follow
-                raise ExecError(
-                    "current transaction is aborted, commands ignored "
-                    "until end of transaction block")
             try:
-                r = self._exec_retryable(s)
+                r = self.execute_ast(s)
             except Exception as e:
-                if self.txn is not None and not self.txn_aborted \
-                        and not isinstance(s, A.TxnStmt):
-                    # a failed statement aborts the explicit txn NOW —
-                    # writes revert and row locks release immediately
-                    # (PG: AbortCurrentTransaction on error); the
-                    # session stays poisoned until COMMIT/ROLLBACK.
-                    # A failure INSIDE commit/rollback is excluded
-                    # (2PC outcome belongs to recovery), and live
-                    # savepoints keep the txn alive for ROLLBACK TO
-                    self.txn_aborted = True
-                    if not getattr(self.txn, "savepoints", None):
-                        self._abort(self.txn)
-                        self.txn.rolled_back = True
                 if audit:
                     audit.record(type(s).__name__, str(e), ok=False)
                 raise
@@ -155,6 +132,41 @@ class ClusterSession:
 
     def query(self, sql: str) -> list[tuple]:
         return self.execute(sql)[-1].rows
+
+    def execute_ast(self, s: A.Node) -> Result:
+        """Execute ONE already-parsed statement — the shared core of
+        execute() and the PG extended protocol's Execute message, where
+        the parse happened at Parse time (reference:
+        exec_execute_message, tcop/postgres.c).
+
+        PG txn semantics: after an error the txn is poisoned — only
+        COMMIT (which rolls back) or ROLLBACK may follow; a failed
+        statement aborts the explicit txn NOW (writes revert, row locks
+        release — AbortCurrentTransaction), except failures INSIDE
+        commit/rollback (2PC outcome belongs to recovery), and live
+        savepoints keep the txn alive for ROLLBACK TO."""
+        self._check_cancel()
+        # multi-CN: reload the shared catalog if another coordinator's
+        # DDL (or a failover) bumped the GTM generation
+        if self.txn is None:
+            self.cluster.maybe_sync_catalog()
+        if self.txn is not None and self.txn_aborted \
+                and not isinstance(s, A.TxnStmt) \
+                and not (isinstance(s, A.SavepointStmt)
+                         and s.op == "rollback_to"):
+            raise ExecError(
+                "current transaction is aborted, commands ignored "
+                "until end of transaction block")
+        try:
+            return self._exec_retryable(s)
+        except Exception:
+            if self.txn is not None and not self.txn_aborted \
+                    and not isinstance(s, A.TxnStmt):
+                self.txn_aborted = True
+                if not getattr(self.txn, "savepoints", None):
+                    self._abort(self.txn)
+                    self.txn.rolled_back = True
+            raise
 
     def _exec_retryable(self, s: A.Node) -> Result:
         """READ COMMITTED re-check for implicit statements: a

@@ -30,7 +30,7 @@ from ..ops import kernels as K
 from ..plan import exprs as E
 from ..plan import physical as P
 from ..plan.planner import PlannedStmt, rewrite
-from ..storage.batch import next_pow2
+from ..storage.batch import next_pow2, size_class
 from ..storage.store import ABORTED_TS, TableStore
 from ..utils.dtypes import (bits_to_float, dev_dtype, device_float,
                             float_to_bits, stage_cast)
@@ -79,7 +79,7 @@ class DeviceTableCache:
                 (set(colnames) | nullwant) <= set(hit[1]):
             return hit[1], hit[2]
         n = store.row_count()
-        padded = next_pow2(max(n, 1))
+        padded = size_class(max(n, 1))
         arrs = {}
         want = set(colnames) | {"__xmin_ts", "__xmax_ts", "__xmin_txid",
                                 "__xmax_txid"} | nullwant
@@ -488,6 +488,18 @@ class Executor:
 
         if node.kind == "cross":
             return self._cross_join(left, right)
+
+        if node.kind == "inner" and right.padded > left.padded:
+            # build the SMALLER side (reference: nodeHash.c hashes the
+            # cheaper input): inner joins are symmetric, and the
+            # planner's left-deep accumulation otherwise makes the
+            # freshly-joined big table the build side — sorting 2M
+            # build rows instead of 130k
+            node = dataclasses.replace(node, left=node.right,
+                                       right=node.left,
+                                       left_keys=node.right_keys,
+                                       right_keys=node.left_keys)
+            left, right = right, left
 
         lkey, lhashed, lcheck = self._join_key(node.left_keys, left)
         rkey, rhashed, rcheck = self._join_key(node.right_keys, right)

@@ -312,7 +312,8 @@ class MeshRunner:
                     cols[f"__null.{nc}"] = np.zeros(counts[-1], bool)
             per_dn.append(cols)
 
-        padded = next_pow2(max(max(counts), 1))
+        from ..storage.batch import size_class
+        padded = size_class(max(max(counts), 1))
         sh = NamedSharding(self.mesh, PS(self.axis))
         arrs = {}
         from ..utils.dtypes import stage_cast

@@ -96,6 +96,21 @@ class GtmCore:
         self._reserved_until = self._ts + RESERVE
         self._txid_reserved_until = self._txid + RESERVE
 
+    # ---- catalog generation (multi-coordinator DDL sync): every CN
+    # checks this monotone counter before planning and reloads the
+    # shared catalog on change (reference: CN-to-CN DDL propagation,
+    # EXEC_ON_COORDS fan-out — here the GTM is the sync point).
+    # Volatile by design: a GTM restart resets it to 0, which every CN
+    # sees as a MISMATCH with its cached value and reloads — safe.
+    def catalog_gen(self) -> int:
+        with self._lock:
+            return getattr(self, "_catalog_gen", 0)
+
+    def bump_catalog_gen(self) -> int:
+        with self._lock:
+            self._catalog_gen = getattr(self, "_catalog_gen", 0) + 1
+            return self._catalog_gen
+
     # ---- API ----
     def next_gts(self) -> int:
         with self._lock:
@@ -257,6 +272,10 @@ class GtmServer:
                             resp = {"barriers": core_ref.barrier_list()}
                         elif op == "stats":
                             resp = {"stats": core_ref.stats()}
+                        elif op == "cat_gen":
+                            resp = {"gen": core_ref.catalog_gen()}
+                        elif op == "cat_gen_bump":
+                            resp = {"gen": core_ref.bump_catalog_gen()}
                         elif op == "ping":
                             resp = {"pong": True}
                         else:
@@ -367,3 +386,9 @@ class GtmClient:
 
     def stats(self) -> dict:
         return self.call(op="stats")["stats"]
+
+    def catalog_gen(self) -> int:
+        return self.call(op="cat_gen")["gen"]
+
+    def bump_catalog_gen(self) -> int:
+        return self.call(op="cat_gen_bump")["gen"]

@@ -109,24 +109,39 @@ def grouped_agg_dense(group_id, valid, agg_inputs: tuple,
     return tuple(outs), present
 
 
+def _sortable_int(k, valid):
+    """Key column -> int64 equality-preserving image + (min, max) over
+    the valid rows (floats ride their bit pattern with -0.0
+    canonicalized — grouping needs equality, not order)."""
+    if jnp.issubdtype(k.dtype, jnp.floating):
+        from ..utils.dtypes import float_to_bits
+        k = float_to_bits(jnp.where(k == 0, jnp.zeros((), k.dtype), k))
+    else:
+        k = k.astype(jnp.int64)
+    i64 = jnp.iinfo(jnp.int64)
+    mn = jnp.min(jnp.where(valid, k, i64.max))
+    mx = jnp.max(jnp.where(valid, k, i64.min))
+    return k, mn, mx
+
+
 @functools.partial(jax.jit, static_argnames=("max_groups", "agg_kinds"))
 def grouped_agg_sort(key_cols: tuple, valid, agg_inputs: tuple,
                      max_groups: int, agg_kinds: tuple):
     """General grouped aggregation: sort on the key columns (invalid
     rows last), boundary detection, segment reduce.
 
-    Sort formulation: multi-key lexicographic comparison sort moving
-    every aggregate input as payload is ~3x slower than sorting a
-    permutation and gathering (measured 8M rows: 9.7s vs 3.5s on CPU).
-    So: (1) the key columns are runtime-PACKED into one int64 —
-    `acc = acc * range + (k - min)` with ranges reduced on the fly;
-    when the product overflows int64 it wraps, which is still a
-    deterministic function of the keys, and the real key columns ride
-    as tie-break sort keys after it, so ordering stays total and
-    grouping stays exact (the comparator just short-circuits on the
-    packed word in the common case); (2) only (keys, iota) are sorted,
-    and payloads are gathered once through the resulting permutation;
-    (3) segment reductions run with indices_are_sorted.
+    Sort formulation (measured on 524k rows, XLA CPU): a single-array
+    `jnp.sort` is ~4x faster than ANY multi-operand comparator sort
+    (41ms vs 182ms for 2 operands, 452ms for 6).  So the fast path
+    packs (keys, iota) into ONE int64 word — `acc = acc*range +
+    (k-min)` with RUNTIME ranges, then `word = acc*n + iota` (invalid
+    rows pack as the maximal acc so they sort last) — sorts it, and
+    recovers perm = word % n and the group image word // n.  The pack
+    is injective exactly when prod(ranges)*n fits 62 bits, checked at
+    runtime; `lax.cond` falls back to the exact multi-operand
+    comparator sort otherwise (hashed/full-range keys).  Payloads are
+    gathered once through perm; segment reductions run with
+    indices_are_sorted.
 
     Returns (group_key_cols, agg_outputs, n_groups).  Caller guarantees
     distinct-group count <= max_groups (host retries at the next size
@@ -134,31 +149,72 @@ def grouped_agg_sort(key_cols: tuple, valid, agg_inputs: tuple,
     """
     n = valid.shape[0]
     invalid = ~valid
-    if len(key_cols) > 1:
-        i64 = jnp.iinfo(jnp.int64)
-        packed = jnp.zeros(n, dtype=jnp.int64)
-        for k in key_cols:
-            k = k.astype(jnp.int64)
-            mn = jnp.min(jnp.where(valid, k, i64.max))
-            mx = jnp.max(jnp.where(valid, k, i64.min))
-            packed = packed * (mx - mn + 1) + \
-                jnp.where(valid, k - mn, 0)
-        sort_keys = [invalid, packed, *key_cols]
-        key_off = 2
-    else:
-        sort_keys = [invalid, *key_cols]
-        key_off = 1
-    iota = jnp.arange(n)
-    sorted_all = jax.lax.sort([*sort_keys, iota],
-                              num_keys=len(sort_keys))
-    perm = sorted_all[-1]
-    s_keys = sorted_all[key_off:key_off + len(key_cols)]
-    s_valid = valid[perm]
-    first = jnp.arange(n) == 0
-    differs = jnp.zeros(n, dtype=bool)
-    for k in s_keys:
-        differs = differs | (k != jnp.roll(k, 1))
-    boundary = s_valid & (first | differs)
+    iota = jnp.arange(n, dtype=jnp.int64)
+
+    ints, mns, mxs = [], [], []
+    for k in key_cols:
+        ki, mn, mx = _sortable_int(k, valid)
+        ints.append(ki)
+        mns.append(mn)
+        mxs.append(mx)
+
+    # runtime injectivity check: sum of key bit-widths + log2(n+1)
+    # must fit a 62-bit pack (f32 log2 overestimates by <1e-6 per
+    # term; the 62 vs 63 margin absorbs it).  Ranges are measured in
+    # uint64: mx - mn over int64 WRAPS when keys span more than 2^63
+    # (float bit patterns of mixed sign, full-range hashes) and a
+    # wrapped range would slip past the gate as tiny.
+    bits = jnp.float32(0)
+    spans = []
+    for mn, mx in zip(mns, mxs):
+        span = jnp.where(mx >= mn,
+                         mx.astype(jnp.uint64) - mn.astype(jnp.uint64),
+                         jnp.uint64(0))
+        spans.append(span)
+        bits = bits + jnp.log2(span.astype(jnp.float32) + 2)
+    bits = bits + jnp.log2(jnp.float32(n + 2))
+    pack_ok = bits < jnp.float32(62.0)
+
+    def fast(_):
+        acc = jnp.zeros(n, dtype=jnp.int64)
+        for ki, mn, span in zip(ints, mns, spans):
+            # only evaluated under pack_ok: span < 2^62 fits int64
+            rng = span.astype(jnp.int64) + 1
+            acc = acc * rng + jnp.clip(ki - mn, 0, rng - 1)
+        top = jnp.max(jnp.where(valid, acc, 0)) + 1
+        word = jnp.where(invalid, top, acc) * n + iota
+        sw = jnp.sort(word)
+        perm = sw % n
+        img = sw // n
+        s_valid = valid[perm]
+        first = jnp.arange(n) == 0
+        boundary = s_valid & (first | (img != jnp.roll(img, 1)))
+        return perm, s_valid, boundary
+
+    def exact(_):
+        if len(key_cols) > 1:
+            packed = jnp.zeros(n, dtype=jnp.int64)
+            for ki, mn, mx in zip(ints, mns, mxs):
+                packed = packed * (mx - mn + 1) + \
+                    jnp.where(valid, ki - mn, 0)
+            sort_keys = [invalid, packed, *ints]
+            key_off = 2
+        else:
+            sort_keys = [invalid, *ints]
+            key_off = 1
+        sorted_all = jax.lax.sort([*sort_keys, iota],
+                                  num_keys=len(sort_keys))
+        perm = sorted_all[-1]
+        s_keys = sorted_all[key_off:key_off + len(key_cols)]
+        s_valid = valid[perm]
+        first = jnp.arange(n) == 0
+        differs = jnp.zeros(n, dtype=bool)
+        for k in s_keys:
+            differs = differs | (k != jnp.roll(k, 1))
+        boundary = s_valid & (first | differs)
+        return perm, s_valid, boundary
+
+    perm, s_valid, boundary = jax.lax.cond(pack_ok, fast, exact, None)
     n_groups = jnp.sum(boundary)
     gid_raw = jnp.cumsum(boundary) - 1
     gid = jnp.where(s_valid, gid_raw, max_groups)
@@ -187,7 +243,8 @@ def grouped_agg_sort(key_cols: tuple, valid, agg_inputs: tuple,
                                     indices_are_sorted=True)
         outs.append(o[:max_groups])
     starts = jnp.nonzero(boundary, size=max_groups, fill_value=0)[0]
-    gkeys = tuple(k[starts] for k in s_keys)
+    take = perm[starts]
+    gkeys = tuple(k[take] for k in key_cols)
     return gkeys, tuple(outs), n_groups
 
 
@@ -197,26 +254,114 @@ def grouped_agg_sort(key_cols: tuple, valid, agg_inputs: tuple,
 
 @jax.jit
 def join_build(build_keys, build_valid):
-    """Sort the build side; invalid rows get key INT64_MAX so they sort last
-    and can never match a (clamped) probe key."""
+    """Sort the build side; invalid rows get key INT64_MAX so they sort
+    last and can never match a (clamped) probe key.
+
+    Fast path (same single-word trick as grouped_agg_sort): when the
+    key range times n fits 62 bits, (key, position) pack into one int64
+    and a single-array `jnp.sort` replaces the 2-operand comparator
+    argsort (~4x on XLA CPU); hashed/full-range keys take the exact
+    argsort branch."""
+    n = build_keys.shape[0]
     keys = jnp.where(build_valid, build_keys, INT64_MAX)
-    perm = jnp.argsort(keys)
-    return keys[perm], perm
+    i64 = jnp.iinfo(jnp.int64)
+    mn = jnp.min(jnp.where(build_valid, build_keys, i64.max))
+    mx = jnp.max(jnp.where(build_valid, build_keys, i64.min))
+    # uint64 span: int64 subtraction wraps for ranges past 2^63
+    # (hashed multi-column keys) and would fake a tiny range
+    span = jnp.where(mx >= mn,
+                     mx.astype(jnp.uint64) - mn.astype(jnp.uint64),
+                     jnp.uint64(0))
+    bits = jnp.log2(span.astype(jnp.float32) + 2) + \
+        jnp.log2(jnp.float32(n + 2))
+    pack_ok = (bits < jnp.float32(62.0)) & jnp.any(build_valid)
+
+    def fast(_):
+        iota = jnp.arange(n, dtype=jnp.int64)
+        rng = span.astype(jnp.int64) + 1   # gated: span < 2^62
+        acc = jnp.where(build_valid,
+                        jnp.clip(build_keys - mn, 0, rng - 1), rng)
+        word = acc * n + iota
+        sw = jnp.sort(word)
+        perm = sw % n
+        acc_s = sw // n
+        sk = jnp.where(acc_s >= rng, INT64_MAX, acc_s + mn)
+        return sk, perm
+
+    def exact(_):
+        perm = jnp.argsort(keys)
+        return keys[perm], perm
+
+    return jax.lax.cond(pack_ok, fast, exact, None)
 
 
 @jax.jit
 def join_probe_counts(sorted_keys, probe_keys, probe_valid):
     """Per-probe-row match range in the sorted build side.
 
-    INT64_MAX is a reserved key value (the invalid-build sentinel): a valid
-    probe row carrying it is treated as unmatchable rather than matching
-    masked-out build rows.
+    Two runtime strategies under one `lax.cond`:
+    - direct-address (dense keys — TPC-H order/cust/supp keys are
+      near-contiguous): scatter the build rows into a [key-min,
+      key-max] table, probe = ONE gather (measured 2.1M probes into
+      131k build: ~35ms vs 340ms for two binary searches on XLA CPU);
+    - binary search with ONE `searchsorted` (the right edge comes from
+      a run-end table built by a suffix-min scan on the small build
+      side: 205ms) for sparse/hashed key spaces.
+
+    INT64_MAX is a reserved key value (the invalid-build sentinel): a
+    valid probe row carrying it is treated as unmatchable rather than
+    matching masked-out build rows.
     """
+    nb = sorted_keys.shape[0]
+    np_ = probe_keys.shape[0]
     pk = jnp.where(probe_valid, probe_keys, INT64_MAX - 1)
-    lo = jnp.searchsorted(sorted_keys, pk, side="left")
-    hi = jnp.searchsorted(sorted_keys, pk, side="right")
-    counts = jnp.where(probe_valid & (probe_keys != INT64_MAX), hi - lo, 0)
-    return lo, counts
+    usable = probe_valid & (probe_keys != INT64_MAX)
+    if not nb:
+        return (jnp.zeros(np_, dtype=jnp.int64),
+                jnp.zeros(np_, dtype=jnp.int64))
+
+    live = sorted_keys != INT64_MAX
+    mn = sorted_keys[0]
+    mx = jnp.max(jnp.where(live, sorted_keys, jnp.iinfo(jnp.int64).min))
+    # direct-address table size: enough for dense SQL keys (TPC-H
+    # orderkey/custkey/suppkey are near-contiguous) without exceeding
+    # the probe-side footprint class.  Range measured in uint64 — the
+    # int64 difference wraps for full-range key spaces and would
+    # wrongly pick the direct table.
+    T = max(2 * nb, np_)
+    span = jnp.where(mx >= mn,
+                     mx.astype(jnp.uint64) - mn.astype(jnp.uint64),
+                     jnp.uint64(1) << 63)
+    direct_ok = live[0] & (mx >= mn) & (span < jnp.uint64(T))
+
+    def direct(_):
+        idx = jnp.arange(nb, dtype=jnp.int64)
+        cell = jnp.where(live, jnp.clip(sorted_keys - mn, 0, T - 1), T)
+        lo_tab = jnp.full(T + 1, nb, dtype=jnp.int64).at[cell].min(
+            idx, mode="drop")
+        cnt_tab = jnp.zeros(T + 1, dtype=jnp.int64).at[cell].add(
+            1, mode="drop")
+        off = pk - mn
+        inb = usable & (off >= 0) & (off < T)
+        loc = jnp.clip(off, 0, T - 1)
+        cnt = jnp.where(inb, cnt_tab[loc], 0)
+        lo = jnp.where(cnt > 0, lo_tab[loc], 0)
+        return lo, cnt
+
+    def searched(_):
+        lo = jnp.searchsorted(sorted_keys, pk,
+                              side="left").astype(jnp.int64)
+        idx = jnp.arange(nb, dtype=jnp.int64)
+        chg = jnp.concatenate([sorted_keys[1:] != sorted_keys[:-1],
+                               jnp.ones(1, bool)])
+        nxt = jnp.where(chg, idx + 1, nb)
+        end = jax.lax.associative_scan(jnp.minimum, nxt[::-1])[::-1]
+        loc = jnp.clip(lo, 0, nb - 1)
+        hit = sorted_keys[loc] == pk
+        counts = jnp.where(usable & hit, end[loc] - lo, 0)
+        return lo, counts
+
+    return jax.lax.cond(direct_ok, direct, searched, None)
 
 
 @functools.partial(jax.jit, static_argnames=("out_size", "left_outer"))

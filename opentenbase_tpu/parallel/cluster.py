@@ -684,12 +684,17 @@ class Cluster:
             self._gdd.start()
         return self._gdd
 
-    def ensure_monitor(self, period: float = 2.0):
+    def ensure_monitor(self, period: float = 2.0,
+                       auto_failover: bool = False):
         """Start the liveness daemon feeding the health map consumed
-        by otb_nodes (reference: clustermon.c + the node health map)."""
+        by otb_nodes (reference: clustermon.c + the node health map).
+        With auto_failover, dead DNs with a registered standby are
+        promoted automatically (pgxc_ctl failover, zero operator
+        steps)."""
         if getattr(self, "_monitor", None) is None:
             from .monitor import ClusterMonitor
-            self._monitor = ClusterMonitor(self, period)
+            self._monitor = ClusterMonitor(self, period,
+                                           auto_failover=auto_failover)
             self._monitor.start()
         return self._monitor
 
@@ -745,6 +750,129 @@ class Cluster:
     def _save_catalog(self):
         if self.datadir:
             self.catalog.save(os.path.join(self.datadir, "catalog.json"))
+        # multi-coordinator DDL sync: publish the new catalog
+        # generation on the GTM so every other CN reloads before its
+        # next statement (reference: CN DDL fan-out EXEC_ON_COORDS)
+        if hasattr(self.gtm, "bump_catalog_gen"):
+            try:
+                self._seen_catalog_gen = self.gtm.bump_catalog_gen()
+            except Exception:
+                pass
+
+    def maybe_sync_catalog(self, ttl_s: float = 0.25) -> bool:
+        """Cheap per-statement staleness gate for multi-CN topologies:
+        poll the GTM's catalog generation at most every `ttl_s` and
+        reload the shared catalog when another coordinator changed it.
+        Returns True when a reload happened."""
+        if not hasattr(self.gtm, "bump_catalog_gen") or not self.datadir:
+            return False
+        import time as _t
+        raw = self.gucs.get("catalog_sync_interval_ms", "")
+        if raw:
+            try:
+                ttl_s = float(raw) / 1e3
+            except ValueError:
+                pass
+        now = _t.monotonic()
+        last = getattr(self, "_cat_checked", 0.0)
+        if now - last < ttl_s:
+            return False
+        self._cat_checked = now
+        try:
+            gen = self.gtm.catalog_gen()
+        except Exception:
+            return False
+        if gen == getattr(self, "_seen_catalog_gen", 0):
+            return False
+        self.reload_catalog()
+        self._seen_catalog_gen = gen
+        return True
+
+    def reload_catalog(self):
+        """Re-read the shared catalog (another CN's DDL or a failover
+        changed it): rebuild locator + routing, refresh datanode
+        proxies whose addresses moved, invalidate every plan cache."""
+        path = os.path.join(self.datadir, "catalog.json")
+        if not os.path.exists(path):
+            return
+        self.catalog = Catalog.load(path)
+        self.locator = Locator(self.catalog)
+        epochs = getattr(self, "_node_epochs", {})
+        for nd in self.catalog.datanodes():
+            if nd.index < len(self.datanodes):
+                cur = self.datanodes[nd.index]
+                addr = getattr(cur, "addr", None)
+                # re-resolve on an address change OR an epoch bump: a
+                # failover can reuse the old address, and warm pooled
+                # sockets to the fenced primary must be dropped
+                if addr is not None and nd.port and (
+                        tuple(addr) != (nd.host, nd.port)
+                        or epochs.get(nd.index, 0) != nd.epoch):
+                    from ..net.dn_server import RemoteDataNode
+                    try:
+                        cur.close()
+                    except Exception:
+                        pass
+                    self.datanodes[nd.index] = RemoteDataNode(
+                        nd.index, nd.host, nd.port)
+            epochs[nd.index] = nd.epoch
+        self._node_epochs = epochs
+        self.ddl_gen = getattr(self, "ddl_gen", 0) + 1
+        from . import statviews
+        statviews.register(self)
+
+    # ---- standby registration + automatic failover (reference:
+    # pgxc_ctl failover + pooler re-resolving primaries, nodemgr.c:80;
+    # detection feeds from ClusterMonitor) ----
+    def register_standby(self, dn_index: int, host: str = "",
+                         port: int = 0, datadir: str = ""):
+        """Record dn_index's standby in the shared catalog so the
+        monitor can promote it without operator action."""
+        for nd in self.catalog.datanodes():
+            if nd.index == dn_index:
+                nd.standby = {"host": host, "port": port,
+                              "datadir": datadir}
+                self._save_catalog()
+                return
+        raise KeyError(f"no datanode {dn_index}")
+
+    def auto_failover(self, dn_index: int):
+        """Promote dn_index's registered standby and reroute: crash
+        recovery over the standby's shipped directory, a fresh DN
+        server over it, catalog address swap + epoch bump (fencing:
+        supervisors must not resurrect the old address), and a catalog
+        generation bump so every coordinator re-resolves."""
+        nd = next(n for n in self.catalog.datanodes()
+                  if n.index == dn_index)
+        sb = nd.standby
+        if not sb or not sb.get("datadir"):
+            raise RuntimeError(f"dn{dn_index} has no registered "
+                               "standby")
+        cur = self.datanodes[dn_index]
+        if hasattr(cur, "addr"):
+            # TCP topology: host a fresh DN server over the recovered
+            # standby directory (single-host deployment: DN servers
+            # already live in the coordinator/supervisor process)
+            from ..net.dn_server import DnServer, RemoteDataNode
+            catalog_path = os.path.join(self.datadir, "catalog.json")
+            srv = DnServer(dn_index, sb["datadir"], catalog_path,
+                           gtm_addr=getattr(self.gtm, "addr", None))
+            srv.start()
+            try:
+                cur.close()
+            except Exception:
+                pass
+            self.datanodes[dn_index] = RemoteDataNode(
+                dn_index, srv.host, srv.port)
+            nd.host, nd.port = srv.host, srv.port
+            promoted = srv
+        else:
+            promoted = self.promote_standby(dn_index, sb["datadir"])
+        nd.epoch += 1
+        nd.standby = None
+        self._save_catalog()
+        self.ddl_gen = getattr(self, "ddl_gen", 0) + 1
+        return promoted
 
     def create_table(self, td: TableDef, if_not_exists: bool = False):
         td = self.catalog.create_table(td, if_not_exists)

@@ -15,19 +15,27 @@ import time
 
 
 class ClusterMonitor(threading.Thread):
-    def __init__(self, cluster, period: float = 2.0):
+    def __init__(self, cluster, period: float = 2.0,
+                 auto_failover: bool = False, fail_threshold: int = 2):
         super().__init__(daemon=True, name="cluster-monitor")
         self.cluster = cluster
         self.period = period
         self._stop = threading.Event()
         # index -> {"healthy": bool, "ts": monotonic}
         self.health: dict[int, dict] = {}
+        # detection ACTS when a standby is registered: consecutive
+        # failed probes past the threshold trigger Cluster.auto_failover
+        # (reference: pgxc_ctl failover driven by clustermon detection)
+        self.auto_failover = auto_failover
+        self.fail_threshold = fail_threshold
+        self._fails: dict[int, int] = {}
+        self.failovers: list[int] = []    # observability
 
     def stop(self):
         self._stop.set()
 
     def check_once(self):
-        for dn in self.cluster.datanodes:
+        for dn in list(self.cluster.datanodes):
             if hasattr(dn, "addr"):
                 # fresh connection per probe: a pooled socket outlives
                 # a dead listener and would mask the failure (same rule
@@ -36,12 +44,28 @@ class ClusterMonitor(threading.Thread):
                 probe = RemoteDataNode(dn.index, *dn.addr)
                 try:
                     ok = probe.ping()
+                except Exception:
+                    ok = False
                 finally:
                     probe.close()
             else:
                 ok = True           # in-process node: alive with us
             self.health[dn.index] = {"healthy": bool(ok),
                                      "ts": time.monotonic()}
+            if ok:
+                self._fails[dn.index] = 0
+            else:
+                self._fails[dn.index] = self._fails.get(dn.index, 0) + 1
+                if self.auto_failover and \
+                        self._fails[dn.index] >= self.fail_threshold:
+                    try:
+                        self.cluster.auto_failover(dn.index)
+                        self.failovers.append(dn.index)
+                        self._fails[dn.index] = 0
+                        self.health[dn.index] = {
+                            "healthy": True, "ts": time.monotonic()}
+                    except Exception:
+                        pass    # no standby / promote failed: detect only
         return self.health
 
     def run(self):

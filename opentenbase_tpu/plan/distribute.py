@@ -404,6 +404,12 @@ class Distributor:
     def _walk_join(self, node: P.HashJoin):
         node.left, ld = self._walk(node.left)
         node.right, rd = self._walk(node.right)
+        # one datanode: every placement is trivially colocated — skip
+        # exchanges entirely (reference: single-node plans carry no
+        # RemoteSubplan; also the single-chip TPU bench shape)
+        if self.ndn == 1 and ld.kind in ("sharded", "replicated") \
+                and rd.kind in ("sharded", "replicated"):
+            return node, (ld if ld.kind == "sharded" else rd)
         pairs = self._join_pairs(node)
 
         def sharded_on_join_key(d: Dist, side: int):
@@ -507,6 +513,8 @@ class Distributor:
         node.child, d = self._walk(node.child)
         if d.kind in ("replicated", "cn"):
             return node, d
+        if self.ndn == 1:
+            return node, d      # one DN: groups are whole already
         key_names = set()
         for _, ke in node.group_keys:
             if isinstance(ke, E.Col):

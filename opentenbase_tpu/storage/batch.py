@@ -114,6 +114,24 @@ def next_pow2(n: int, floor: int = 256) -> int:
     return p
 
 
+def size_class(n: int, floor: int = 256) -> int:
+    """Quarter-step size class {1, 1.25, 1.5, 1.75}*2^k: staged base
+    tables live at one size for their whole lifetime, so the finer
+    ladder trades 4x the (cached) compile classes for <=25% padding
+    waste instead of <=100% — at SF1, lineitem pads to 6.29M instead
+    of 8.39M, and every scan kernel's work drops with it."""
+    p = floor
+    while p < n:
+        p <<= 1
+    if p == floor:
+        return p
+    for num in (4, 5, 6, 7):
+        c = (p >> 3) * num
+        if c >= n:
+            return c
+    return p
+
+
 def stage_padded(host_cols, sel):
     """Host column slices -> pow2-padded device arrays for one pass.
     `sel` is a slice (row-range slab), an int index array (hash
