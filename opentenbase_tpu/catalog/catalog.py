@@ -50,6 +50,24 @@ class Catalog:
         # ANALYZE output: table -> {"rows", "cols": {col: {"ndv", "min",
         # "max"}}} (reference: pg_statistic, consumed by costsize.c)
         self.stats: dict[str, dict] = {}
+        # resource groups: name -> {"concurrency","staging_budget_rows",
+        # "device_time_share"} (reference: pg_resgroup +
+        # resgroup-ops-linux.c, re-designed TPU-native: concurrency is
+        # GTM-coordinated cluster-wide, the staging budget bounds HBM
+        # residency by routing over-budget queries to the spill tier,
+        # and device time is accounted per group)
+        self.resource_groups: dict[str, dict] = {}
+        # column masks: name -> {"table","column","expr"}, applied as
+        # a projection rewrite at bind time (reference: datamask.c) —
+        # and FGA audit policies: name -> {"table","pred"} (reference:
+        # audit_fga.c predicate-gated audit records)
+        self.masks: dict[str, dict] = {}
+        self.fga_policies: dict[str, dict] = {}
+        # trigger functions: name -> {"body": stmt-list text} and
+        # triggers: name -> {"table","timing","event","when","func"}
+        # (reference: pg_proc + pg_trigger, fired by commands/trigger.c)
+        self.functions: dict[str, dict] = {}
+        self.triggers: dict[str, dict] = {}
         # views: name -> SELECT text, expanded at bind time (reference:
         # pg_rewrite view rules; text-stored so persistence is trivial)
         self.views: dict[str, str] = {}
@@ -190,6 +208,11 @@ class Catalog:
                 "local_indexes": self.local_indexes,
                 "stats": self.stats,
                 "views": self.views,
+                "functions": self.functions,
+                "triggers": self.triggers,
+                "masks": self.masks,
+                "fga_policies": self.fga_policies,
+                "resource_groups": self.resource_groups,
                 "partitioned": self.partitioned,
                 "spm": self.spm,
                 "node_groups": self.node_groups,
@@ -222,6 +245,11 @@ class Catalog:
         cat.local_indexes = blob.get("local_indexes", {})
         cat.stats = blob.get("stats", {})
         cat.views = blob.get("views", {})
+        cat.functions = blob.get("functions", {})
+        cat.triggers = blob.get("triggers", {})
+        cat.masks = blob.get("masks", {})
+        cat.fga_policies = blob.get("fga_policies", {})
+        cat.resource_groups = blob.get("resource_groups", {})
         cat.partitioned = blob.get("partitioned", {})
         cat.spm = blob.get("spm", {})
         cat.node_groups = blob.get("node_groups", {})

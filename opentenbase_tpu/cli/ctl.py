@@ -206,6 +206,25 @@ def _connect(args):
     return ClusterSession(cluster)
 
 
+def cmd_dump(args):
+    """pg_dump analog: one reloadable SQL script (cli/dump.py)."""
+    from .dump import dump_sql
+    s = _connect(args)
+    script = dump_sql(s)
+    with open(args.out, "w") as f:
+        f.write(script)
+    print(f"dumped {script.count(chr(10))} lines to {args.out}")
+
+
+def cmd_load(args):
+    """pg_restore analog: replay a dump script."""
+    from .dump import restore_sql
+    s = _connect(args)
+    with open(args.file) as f:
+        n = restore_sql(s, f.read())
+    print(f"restored {n} statements from {args.file}")
+
+
 def cmd_shell(args):
     if getattr(args, "connect", None):
         return _remote_shell(args)
@@ -355,6 +374,14 @@ def main(argv=None):
     p.add_argument("dir")
     p.add_argument("--barrier", required=True)
     p.set_defaults(fn=cmd_restore)
+    p = sub.add_parser("dump")
+    p.add_argument("dir", nargs="?", default=".")
+    p.add_argument("--out", required=True)
+    p.set_defaults(fn=cmd_dump)
+    p = sub.add_parser("load")
+    p.add_argument("dir", nargs="?", default=".")
+    p.add_argument("--file", required=True)
+    p.set_defaults(fn=cmd_load)
     p = sub.add_parser("barriers")
     p.add_argument("dir")
     p.set_defaults(fn=cmd_barriers)

@@ -134,7 +134,8 @@ def _to_device(hb: HostBatch) -> DBatch:
 class DistExecutor:
     def __init__(self, cluster: Cluster, snapshot_ts: int, txid: int,
                  instrument: bool = False, use_mesh: bool = False,
-                 cancel_check=None):
+                 cancel_check=None, group_budget_rows: int = 0):
+        self.group_budget_rows = group_budget_rows
         self.cluster = cluster
         # statement-cancel probe (reference: CHECK_FOR_INTERRUPTS at the
         # executor's safe points) — raises when the client canceled
@@ -211,6 +212,12 @@ class DistExecutor:
                                           dp, "cn", {})
         wm_raw = self.cluster.gucs.get("work_mem_rows", "")
         budget = int(wm_raw) if wm_raw.isdigit() else 0
+        # resource-group HBM staging budget: the TIGHTER of the session
+        # GUC and the group cap applies (reference: resource-group
+        # memory enforcement, re-targeted at device staging)
+        gb = getattr(self, "group_budget_rows", 0)
+        if gb > 0:
+            budget = min(budget, gb) if budget > 0 else gb
         if budget > 0 and self._scan_exceeds_budget(dp, budget):
             # budgeted execution AND a scanned table is actually over
             # budget: the mesh tier stages whole tables to device HBM,

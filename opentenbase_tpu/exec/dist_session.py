@@ -55,7 +55,7 @@ class Prepared:
     planned: object = None        # pristine PlannedStmt (FQS fragment)
     dp: object = None             # generic distributed DistPlan
     router: object = None         # params -> datanode index | None
-    ddl_gen: int = -1
+    ddl_gen: object = -1   # _prep_gen() tuple (DDL+stats+GUC state)
 
 
 def _subst_params(obj, args: list):
@@ -200,8 +200,46 @@ class ClusterSession:
         self.cluster.abort_txn(t.txid, t.written_dns)
 
     # ------------------------------------------------------------------
+    def _fire_triggers(self, t, implicit: bool, table: str,
+                       timing: str, event: str, rows_new, rows_old,
+                       colnames):
+        """Fire row triggers inside txn `t` (see exec/triggers.py)."""
+        from .triggers import fire
+        installed = False
+        if implicit and self.txn is None:
+            self.txn = t
+            installed = True
+        try:
+            fire(self, self.cluster.catalog, table, timing, event,
+                 rows_new, rows_old, colnames)
+        finally:
+            if installed:
+                self.txn = None
+
+    def _old_rows(self, table: str, where, t) -> list:
+        td = self.cluster.catalog.table(table)
+        sel = A.SelectStmt(
+            items=[A.SelectItem(A.ColRef((cn,)), alias=cn)
+                   for cn in td.column_names],
+            from_=[A.TableRef(table)], where=where)
+        return self._run_check_query(sel, t)
+
     def _exec_stmt(self, stmt: A.Node) -> Result:
         c = self.cluster
+        from .security import _SECURITY_DDL
+        from .security import ddl as security_ddl
+        if isinstance(stmt, _SECURITY_DDL):
+            c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
+            tag = security_ddl(c.catalog, stmt)
+            c._save_catalog()
+            return Result(tag)
+        from .triggers import _TRIGGER_DDL
+        from .triggers import ddl as trigger_ddl
+        if isinstance(stmt, _TRIGGER_DDL):
+            c.ddl_gen = getattr(c, "ddl_gen", 0) + 1
+            tag = trigger_ddl(c.catalog, stmt)
+            c._save_catalog()
+            return Result(tag)
         if isinstance(stmt, (A.SelectStmt, A.InsertStmt, A.ExplainStmt)):
             from .recursive import expand_in_stmt
             stmt2, cleanup = expand_in_stmt(self, stmt)
@@ -391,7 +429,41 @@ class ClusterSession:
             return self._exec_txn(stmt)
         if isinstance(stmt, A.ExplainStmt):
             return self._exec_explain(stmt)
+        if isinstance(stmt, A.CreateResourceGroupStmt):
+            if stmt.name in c.catalog.resource_groups:
+                raise ExecError(
+                    f"resource group {stmt.name!r} already exists")
+            grp = {"concurrency": 0, "staging_budget_rows": 0,
+                   "device_time_share": 1.0}
+            for k, v in stmt.options.items():
+                if k not in grp:
+                    raise ExecError(f"unknown resource group option "
+                                    f"{k!r}")
+                grp[k] = float(v) if k == "device_time_share"                     else int(v)
+            c.catalog.resource_groups[stmt.name] = grp
+            c._save_catalog()
+            return Result("CREATE RESOURCE GROUP")
+        if isinstance(stmt, A.DropResourceGroupStmt):
+            if stmt.name not in c.catalog.resource_groups:
+                if stmt.if_exists:
+                    return Result("DROP RESOURCE GROUP")
+                raise ExecError(
+                    f"resource group {stmt.name!r} does not exist")
+            del c.catalog.resource_groups[stmt.name]
+            c._save_catalog()
+            return Result("DROP RESOURCE GROUP")
         if isinstance(stmt, A.SetStmt):
+            if stmt.name == "resource_group":
+                # SESSION-scoped (PG semantics): the group binds this
+                # session's queries, not the whole cluster
+                v = str(stmt.value)
+                if v and v not in ("", "none", "default") \
+                        and v not in c.catalog.resource_groups:
+                    raise ExecError(
+                        f"resource group {v!r} does not exist")
+                self.resource_group = "" if v in ("none", "default") \
+                    else v
+                return Result("SET")
             c.gucs[stmt.name] = str(stmt.value)
             return Result("SET")
         if isinstance(stmt, A.ShowStmt):
@@ -577,12 +649,21 @@ class ClusterSession:
         self.prepared[stmt.name] = self._build_prepared(stmt.stmt, ptypes)
         return Result("PREPARE")
 
+    def _prep_gen(self):
+        """Prepared-plan staleness key: DDL, stats, AND GUCs — a SET
+        (e.g. bypass_datamask flipping masking back on) must replan
+        EXECUTE just like it replans the ad-hoc caches."""
+        return self._plan_gen()
+
     def _build_prepared(self, inner: A.Node, ptypes: dict) -> Prepared:
         from ..sql.analyze import BindError
-        prep = Prepared(inner, ptypes, ddl_gen=self._ddl_gen())
+        prep = Prepared(inner, ptypes, ddl_gen=self._prep_gen())
         if isinstance(inner, A.SelectStmt):
             try:
-                binder = Binder(self.cluster.catalog, param_types=ptypes)
+                masks = self.cluster.gucs.get(
+                    "bypass_datamask", "off") != "on"
+                binder = Binder(self.cluster.catalog,
+                                param_types=ptypes, apply_masks=masks)
                 bq = binder.bind_select(inner)
                 planned = Planner(self.cluster.catalog).plan(bq)
                 # distribute() rewrites the tree in place: keep a pristine
@@ -639,8 +720,9 @@ class ClusterSession:
         if prep is None:
             raise ExecError(
                 f"prepared statement {stmt.name!r} does not exist")
-        if prep.ddl_gen != self._ddl_gen():
-            # DDL since PREPARE: replan against the current catalog
+        if prep.ddl_gen != self._prep_gen():
+            # DDL / stats / GUC change since PREPARE: replan against
+            # the current catalog + settings
             prep = self._build_prepared(prep.stmt, prep.param_types)
             self.prepared[stmt.name] = prep
         if prep.mode != "plan":
@@ -671,7 +753,8 @@ class ClusterSession:
 
     # ---- SELECT ----
     def _plan_distributed(self, stmt: A.SelectStmt,
-                          txn: "ClusterTxn" = None) -> DistPlan:
+                          txn: "ClusterTxn" = None,
+                          apply_masks: bool = True) -> DistPlan:
         # generic ad-hoc plan cache (exec/plancache.py): repeated
         # identical SELECTs reuse the DistPlan, and through the mesh
         # tier's program cache the compiled XLA program.  The
@@ -679,15 +762,19 @@ class ClusterSession:
         # changes invalidate cached plans.
         from .plancache import get_or_build
         c0 = self.cluster
-        gen = self._plan_gen()
+        masks = apply_masks and \
+            not getattr(self, "_unmasked_reads", False) and \
+            c0.gucs.get("bypass_datamask", "off") != "on"
+        gen = (self._plan_gen(), masks)
         return get_or_build(
             c0, "_dp_cache", stmt, gen,
-            lambda: self._plan_distributed_uncached(stmt, txn),
+            lambda: self._plan_distributed_uncached(stmt, txn, masks),
             cacheable=lambda dp: dp.fqs_node is None)
 
     def _plan_distributed_uncached(self, stmt: A.SelectStmt,
-                                   txn: "ClusterTxn" = None) -> DistPlan:
-        binder = Binder(self.cluster.catalog)
+                                   txn: "ClusterTxn" = None,
+                                   apply_masks: bool = True) -> DistPlan:
+        binder = Binder(self.cluster.catalog, apply_masks=apply_masks)
         bq = binder.bind_select(stmt)
         # SPM plan baselines: replay the accepted join order for this
         # normalized statement; capture the first plan when asked
@@ -756,20 +843,73 @@ class ClusterSession:
         """Run a SELECT DistPlan under admission control and record the
         data-plane telemetry — shared by plain SELECT and EXECUTE.  The
         device-mesh data plane is the default (reference: the FN plane is
-        the default tuple transport); 'off' forces the host tier."""
-        queue = self.cluster.resource_queue()
+        the default tuple transport); 'off' forces the host tier.
+
+        Resource-group enforcement (reference: resgroup-ops-linux.c +
+        gtm_resqueue.c, TPU-native): per-group concurrency slots are
+        acquired on the GTM (cluster-wide — every coordinator shares
+        the cap), the group's HBM staging budget routes over-budget
+        queries through the spill tier, and device wall time is
+        accounted per group."""
+        import time as _t
+        c = self.cluster
+        queue = c.resource_queue()
         if queue is not None:
             queue.acquire()
+        group = getattr(self, "resource_group", "")
+        ginfo = c.catalog.resource_groups.get(group) if group else None
+        gtm_held = False
+        try:
+            if ginfo and ginfo.get("concurrency", 0) > 0:
+                cap = int(ginfo["concurrency"])
+                deadline = _t.monotonic() + 30.0
+                # exponential backoff: a saturated group must not
+                # hammer the GTM (GTS/commit traffic shares it)
+                delay = 0.002
+                while not c.gtm.resq_acquire(group, cap):
+                    if _t.monotonic() > deadline:
+                        raise ExecError(
+                            f"resource group {group!r} queue wait "
+                            f"timeout ({cap} slots busy cluster-wide)")
+                    self._check_cancel()
+                    _t.sleep(delay)
+                    delay = min(delay * 2, 0.1)
+                gtm_held = True
+        except Exception:
+            # cancel / GTM error while waiting: the admission slot
+            # must not leak (it would shrink cluster concurrency
+            # permanently)
+            if queue is not None:
+                queue.release()
+            raise
+        t0 = _t.perf_counter()
         try:
             ex = DistExecutor(self.cluster, txn.snapshot_ts, txn.txid,
                               cancel_check=self._check_cancel,
                               instrument=instrument,
                               use_mesh=self.cluster.gucs.get(
-                                  "enable_mesh_exchange", "on") != "off")
+                                  "enable_mesh_exchange", "on") != "off",
+                              group_budget_rows=int(ginfo.get(
+                                  "staging_budget_rows", 0))
+                              if ginfo else 0)
             if params:
                 ex.params.update(params)
             batch = ex.run(dp)
         finally:
+            elapsed = _t.perf_counter() - t0
+            if group:
+                usage = getattr(c, "resgroup_usage", None)
+                if usage is None:
+                    usage = c.resgroup_usage = {}
+                u = usage.setdefault(group,
+                                     {"device_s": 0.0, "queries": 0})
+                u["device_s"] += elapsed
+                u["queries"] += 1
+            if gtm_held:
+                try:
+                    c.gtm.resq_release(group)
+                except Exception:
+                    pass
             if queue is not None:
                 queue.release()
         names, rows = materialize(batch, dp.output_names)
@@ -787,14 +927,17 @@ class ClusterSession:
             return self._exec_select_for_update(stmt)
         self._refresh_stat_views(stmt)
         t, implicit = self._begin_implicit()
+        res = None
         if not instrument:
             res = self._try_autoprep(stmt, t)
-            if res is not None:
-                return res
-        dp = self._plan_distributed(stmt, txn=t)
-        res, ex = self._run_select_dp(dp, t, instrument=instrument)
-        if instrument:
-            return res, ex, dp
+        if res is None:
+            dp = self._plan_distributed(stmt, txn=t)
+            res, ex = self._run_select_dp(dp, t, instrument=instrument)
+            if instrument:
+                return res, ex, dp
+        if self.cluster.catalog.fga_policies:
+            from .security import fga_check
+            fga_check(self, stmt)
         return res
 
     def _plan_gen(self) -> tuple:
@@ -813,7 +956,8 @@ class ClusterSession:
         reads; the exact-statement cache only helps REPEATED
         literals)."""
         c = self.cluster
-        if c.gucs.get("enable_autoprepare", "on") == "off":
+        if c.gucs.get("enable_autoprepare", "on") == "off" \
+                or getattr(self, "_unmasked_reads", False):
             return None
         # paths with extra ad-hoc planning intelligence keep the full
         # plan cycle: global-index routing consults DATA at plan time,
@@ -1338,8 +1482,9 @@ class ClusterSession:
 
     def _run_check_query(self, sel: A.SelectStmt, t) -> list:
         """Constraint-validation SELECT inside txn `t` (cluster twin of
-        the single-node session's helper)."""
-        dp = self._plan_distributed(sel, txn=t)
+        the single-node session's helper).  Binds unmasked: constraint
+        and trigger-image reads must see REAL values."""
+        dp = self._plan_distributed(sel, txn=t, apply_masks=False)
         batch = DistExecutor(self.cluster, t.snapshot_ts, t.txid).run(dp)
         _, rows = materialize(batch, dp.output_names)
         return rows
@@ -1354,8 +1499,10 @@ class ClusterSession:
             lambda sel: self._run_check_query(sel, t),
             self.cluster.catalog, table, kind)
 
-    def _insert_rows(self, td: TableDef, coldata: dict, n: int) -> int:
+    def _insert_rows(self, td: TableDef, coldata: dict, n: int,
+                     fire_triggers: bool = True) -> int:
         from .constraints import check_not_null
+        from .triggers import has_triggers
         check_not_null(td, coldata, n)
         c = self.cluster
         t, implicit = self._begin_implicit()
@@ -1364,7 +1511,17 @@ class ClusterSession:
             # join it instead of committing independently
             self.txn = t
         c.register_txn(t.txid)
+        trig = fire_triggers and has_triggers(c.catalog, td.name,
+                                              "insert")
+        new_rows = colnames = None
+        if trig:
+            colnames = list(coldata)
+            new_rows = [tuple(coldata[cn][i] for cn in colnames)
+                        for i in range(n)]
         try:
+            if trig:
+                self._fire_triggers(t, implicit, td.name, "before",
+                                    "insert", new_rows, None, colnames)
             if td.distribution.dist_type == DistType.REPLICATED:
                 dests = {i: np.arange(n)
                          for i in range(c.ndn)}          # write everywhere
@@ -1411,6 +1568,9 @@ class ClusterSession:
                     except gindex.GIndexError as e:
                         raise ExecError(str(e)) from None
             self._validate_write(td.name, t)
+            if trig:
+                self._fire_triggers(t, implicit, td.name, "after",
+                                    "insert", new_rows, None, colnames)
         except Exception:
             if implicit:
                 self.txn = None
@@ -1421,7 +1581,8 @@ class ClusterSession:
             self._commit(t)
         return n
 
-    def _exec_delete(self, stmt: A.DeleteStmt) -> Result:
+    def _exec_delete(self, stmt: A.DeleteStmt,
+                     fire_triggers: bool = True) -> Result:
         from ..parallel import gindex
         c = self.cluster
         if stmt.table in c.catalog.partitioned:
@@ -1440,8 +1601,17 @@ class ClusterSession:
                                where=stmt.where)
             quals = binder.bind_select(sel).where
         has_gidx = bool(gindex.indexes_on(c.catalog, td.name))
+        from .triggers import has_triggers
+        trig = fire_triggers and has_triggers(c.catalog, td.name,
+                                              "delete")
         n_deleted = 0
         try:
+            old_rows = None
+            if trig:
+                old_rows = self._old_rows(stmt.table, stmt.where, t)
+                self._fire_triggers(t, implicit, td.name, "before",
+                                    "delete", None, old_rows,
+                                    td.column_names)
             affected = gindex.affected_keys(self, td, quals, t) \
                 if has_gidx else None
             for dn in c.datanodes:
@@ -1454,6 +1624,10 @@ class ClusterSession:
                 gindex.resync_keys(self, td, affected, t)
             if n_deleted:
                 self._validate_write(td.name, t, kind="delete")
+            if trig and old_rows and n_deleted:
+                self._fire_triggers(t, implicit, td.name, "after",
+                                    "delete", None, old_rows,
+                                    td.column_names)
         except Exception:
             if implicit:
                 self.txn = None
@@ -1500,16 +1674,37 @@ class ClusterSession:
                 if dn.lock_where(td.name, quals, t.snapshot_ts,
                                  t.txid, False):
                     t.written_dns.add(dn.index)
-            dp = self._plan_distributed(sel)
+            from .triggers import has_triggers
+            trig = has_triggers(c.catalog, td.name, "update")
+            if trig:
+                # OLD images ride the same scan as NEW values: aligned
+                sel = dataclasses.replace(sel, items=list(sel.items) + [
+                    A.SelectItem(A.ColRef((col.name,)),
+                                 alias="__old__" + col.name)
+                    for col in td.columns])
+            dp = self._plan_distributed(sel, apply_masks=False)
             batch = DistExecutor(
                 self.cluster, t.snapshot_ts, t.txid,
                 cancel_check=self._check_cancel).run(dp)
             names, rows = materialize(batch, dp.output_names)
-            self._exec_delete(A.DeleteStmt(stmt.table, stmt.where))
+            old_rows = None
+            if trig:
+                ncol = len(td.columns)
+                old_rows = [r[ncol:] for r in rows]
+                rows = [r[:ncol] for r in rows]
+                names = names[:ncol]
+                self._fire_triggers(t, implicit, td.name, "before",
+                                    "update", rows, old_rows, names)
+            self._exec_delete(A.DeleteStmt(stmt.table, stmt.where),
+                              fire_triggers=False)
             if rows:
                 coldata = {cn: [r[i] for r in rows]
                            for i, cn in enumerate(names)}
-                self._insert_rows(td, coldata, len(rows))
+                self._insert_rows(td, coldata, len(rows),
+                                  fire_triggers=False)
+            if trig:
+                self._fire_triggers(t, implicit, td.name, "after",
+                                    "update", rows, old_rows, names)
         except Exception:
             if implicit:
                 self.txn = None

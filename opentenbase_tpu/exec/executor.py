@@ -287,7 +287,11 @@ class Executor:
             padded_static = int(next(iter(arrs.values())).shape[0])
         else:
             arrs, n = self.ctx.cache.get(store, sorted(needed))
-            padded_static = None
+            # quarter-step size classes: the pad is whatever the cache
+            # staged (size_class, not next_pow2) — read it off the
+            # arrays, never recompute
+            padded_static = int(next(iter(arrs.values())).shape[0]) \
+                if arrs else None
 
         qcols, types, dicts, qnulls = {}, {}, {}, {}
         for c in store.td.columns:

@@ -292,6 +292,12 @@ class LocalNode:
                 rec["rec"])
         elif op == "create_view":
             self.catalog.views[rec["name"]] = rec["text"]
+        elif op == "trigger_ddl":
+            self.catalog.functions = dict(rec["functions"])
+            self.catalog.triggers = dict(rec["triggers"])
+        elif op == "security_ddl":
+            self.catalog.masks = dict(rec["masks"])
+            self.catalog.fga_policies = dict(rec["fga"])
         elif op == "drop_view":
             self.catalog.views.pop(rec["name"], None)
         elif op == "alter_table":
@@ -435,7 +441,45 @@ class Session:
         self.node.lockmgr.resolve(t.txid, committed=False)
 
     # ------------------------------------------------------------------
+    def _fire_triggers(self, t, implicit: bool, table: str,
+                       timing: str, event: str, rows_new, rows_old,
+                       colnames):
+        """Fire row triggers inside txn `t` (installed as the session
+        txn for the duration so body statements join it — a trigger
+        failure aborts the whole DML statement)."""
+        from .triggers import fire
+        installed = False
+        if implicit and self.txn is None:
+            self.txn = t
+            installed = True
+        try:
+            fire(self, self.node.catalog, table, timing, event,
+                 rows_new, rows_old, colnames)
+        finally:
+            if installed:
+                self.txn = None
+
     def _exec_stmt(self, stmt: A.Node) -> Result:
+        from .security import _SECURITY_DDL
+        from .security import ddl as security_ddl
+        if isinstance(stmt, _SECURITY_DDL):
+            self.node.ddl_gen = getattr(self.node, "ddl_gen", 0) + 1
+            tag = security_ddl(self.node.catalog, stmt)
+            self.node._log({"op": "security_ddl",
+                            "masks": self.node.catalog.masks,
+                            "fga": self.node.catalog.fga_policies},
+                           sync=True)
+            return Result(tag)
+        from .triggers import _TRIGGER_DDL
+        from .triggers import ddl as trigger_ddl
+        if isinstance(stmt, _TRIGGER_DDL):
+            self.node.ddl_gen = getattr(self.node, "ddl_gen", 0) + 1
+            tag = trigger_ddl(self.node.catalog, stmt)
+            self.node._log({"op": "trigger_ddl",
+                            "functions": self.node.catalog.functions,
+                            "triggers": self.node.catalog.triggers},
+                           sync=True)
+            return Result(tag)
         if isinstance(stmt, (A.CreateTableStmt, A.DropTableStmt,
                              A.AlterTableStmt, A.CreateViewStmt,
                              A.DropViewStmt, A.CreatePartitionStmt,
@@ -912,7 +956,8 @@ class Session:
         return Result("ALTER TABLE")
 
     # ---- SELECT ----
-    def _plan_select(self, stmt: A.SelectStmt) -> PlannedStmt:
+    def _plan_select(self, stmt: A.SelectStmt,
+                     apply_masks: bool = True) -> PlannedStmt:
         # generic ad-hoc plan cache (exec/plancache.py; the cluster
         # session's twin): identical statements reuse the PlannedStmt
         # and, through the fused tier's memoization, the compiled
@@ -923,11 +968,17 @@ class Session:
                len(node.catalog.tables), len(node.catalog.views),
                tuple(sorted(node.gucs.items())))
 
+        masks = apply_masks and \
+            not getattr(self, "_unmasked_reads", False) and \
+            node.gucs.get("bypass_datamask", "off") != "on"
+
         def build():
-            bq = Binder(node.catalog).bind_select(stmt)
+            bq = Binder(node.catalog,
+                        apply_masks=masks).bind_select(stmt)
             return Planner(node.catalog).plan(bq)
 
-        return get_or_build(node, "_plan_cache", stmt, gen, build)
+        return get_or_build(node, "_plan_cache", stmt,
+                            (gen, masks), build)
 
     def _exec_select(self, stmt: A.SelectStmt) -> Result:
         if stmt.for_update:
@@ -1092,7 +1143,7 @@ class Session:
     def _run_check_query(self, sel: A.SelectStmt, t) -> list:
         """Constraint-validation SELECT inside txn `t` (sees its own
         uncommitted rows through MVCC own-txid visibility)."""
-        planned = self._plan_select(sel)
+        planned = self._plan_select(sel, apply_masks=False)
         ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
                           self.node.cache)
         batch = Executor(ctx).run(planned)
@@ -1110,11 +1161,26 @@ class Session:
             self.node.catalog, table, kind)
 
     def _insert_rows(self, td: TableDef, st: TableStore,
-                     coldata: dict, n: int) -> int:
+                     coldata: dict, n: int,
+                     fire_triggers: bool = True) -> int:
         from .constraints import check_not_null
+        from .triggers import has_triggers
         check_not_null(td, coldata, n)
         t, implicit = self._begin_implicit()
         self._track_write(t)
+        trig = fire_triggers and has_triggers(self.node.catalog,
+                                              td.name, "insert")
+        if trig:
+            colnames = list(coldata)
+            new_rows = [tuple(coldata[cn][i] for cn in colnames)
+                        for i in range(n)]
+            try:
+                self._fire_triggers(t, implicit, td.name, "before",
+                                    "insert", new_rows, None, colnames)
+            except Exception:
+                if implicit:
+                    self._abort(t)
+                raise
         clean, masks = {}, {}
         for c, vals in coldata.items():
             cv, m = st.split_nulls(c, vals)
@@ -1144,6 +1210,9 @@ class Session:
         t.wal_ops += 1
         try:
             self._validate_write(td.name, t)
+            if trig:
+                self._fire_triggers(t, implicit, td.name, "after",
+                                    "insert", new_rows, None, colnames)
         except Exception:
             if implicit:
                 self._abort(t)
@@ -1152,7 +1221,18 @@ class Session:
             self._commit(t)
         return n
 
-    def _exec_delete(self, stmt: A.DeleteStmt) -> Result:
+    def _old_rows(self, table: str, where, t) -> list:
+        """Materialize the pre-image rows a DELETE/UPDATE will touch
+        (trigger OLD.*), inside txn t."""
+        td = self.node.catalog.table(table)
+        sel = A.SelectStmt(
+            items=[A.SelectItem(A.ColRef((cn,)), alias=cn)
+                   for cn in td.column_names],
+            from_=[A.TableRef(table)], where=where)
+        return self._run_check_query(sel, t)
+
+    def _exec_delete(self, stmt: A.DeleteStmt,
+                     fire_triggers: bool = True) -> Result:
         if stmt.table in self.node.catalog.partitioned:
             return self._partition_dml_fanout(stmt)
         td = self.node.catalog.table(stmt.table)
@@ -1167,8 +1247,17 @@ class Session:
                                where=stmt.where)
             bq = binder.bind_select(sel)
             quals = bq.where
+        from .triggers import has_triggers
+        trig = fire_triggers and has_triggers(self.node.catalog,
+                                              td.name, "delete")
         n_deleted = 0
         try:
+            old_rows = None
+            if trig:
+                old_rows = self._old_rows(stmt.table, stmt.where, t)
+                self._fire_triggers(t, implicit, td.name, "before",
+                                    "delete", None, old_rows,
+                                    td.column_names)
             for span, ci, mask in self._mark_with_wait(
                     st, stmt.table, quals, t, lock_only=False):
                 t.delete_spans.append((st, span))
@@ -1179,6 +1268,10 @@ class Session:
                 n_deleted += int(mask.sum())
             if n_deleted:
                 self._validate_write(td.name, t, kind="delete")
+            if trig and old_rows and n_deleted:
+                self._fire_triggers(t, implicit, td.name, "after",
+                                    "delete", None, old_rows,
+                                    td.column_names)
         except Exception:
             if implicit:
                 self._abort(t)
@@ -1321,17 +1414,39 @@ class Session:
             for span, _ci, _m in self._mark_with_wait(
                     st_lock, stmt.table, lock_quals, t, lock_only=True):
                 t.lock_spans.append((st_lock, span))
-            planned = self._plan_select(sel)
+            from .triggers import has_triggers
+            trig = has_triggers(self.node.catalog, td.name, "update")
+            if trig:
+                # OLD images ride the same scan as the NEW values so
+                # the two row sets stay aligned row-for-row
+                sel = dataclasses.replace(sel, items=list(sel.items) + [
+                    A.SelectItem(A.ColRef((c.name,)),
+                                 alias="__old__" + c.name)
+                    for c in td.columns])
+            planned = self._plan_select(sel, apply_masks=False)
             ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
                               self.node.cache)
             batch = Executor(ctx).run(planned)
             names, rows = materialize(batch, planned.output_names)
-            self._exec_delete(A.DeleteStmt(stmt.table, stmt.where))
+            old_rows = None
+            if trig:
+                ncol = len(td.columns)
+                old_rows = [r[ncol:] for r in rows]
+                rows = [r[:ncol] for r in rows]
+                names = names[:ncol]
+                self._fire_triggers(t, implicit, td.name, "before",
+                                    "update", rows, old_rows, names)
+            self._exec_delete(A.DeleteStmt(stmt.table, stmt.where),
+                              fire_triggers=False)
             if rows:
                 coldata = {c: [r[i] for r in rows]
                            for i, c in enumerate(names)}
                 self._insert_rows(td, self.node.stores[stmt.table],
-                                  coldata, len(rows))
+                                  coldata, len(rows),
+                                  fire_triggers=False)
+            if trig:
+                self._fire_triggers(t, implicit, td.name, "after",
+                                    "update", rows, old_rows, names)
         except Exception:
             if implicit:
                 self.txn = None

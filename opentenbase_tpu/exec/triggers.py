@@ -1,0 +1,191 @@
+"""Row-level triggers + the minimal procedural layer they need.
+
+Reference analog: commands/trigger.c (trigger firing around DML) +
+src/pl/plpgsql (here: a statement-sequence SQL body, not a full
+language — CREATE FUNCTION f() RETURNS TRIGGER AS 'stmt; stmt'
+LANGUAGE SQL).  Bodies reference the affected row as NEW.col / OLD.col;
+RAISE 'message' aborts the statement (the plpgsql RAISE EXCEPTION
+surface).
+
+Execution model: DML collects the affected row set (INSERT: the
+incoming rows; UPDATE: old+new images; DELETE: old images), then for
+each trigger on (table, event) and each row, the WHEN condition and the
+body statements are rewritten with NEW./OLD. references replaced by the
+row's literal values and executed through the session INSIDE the same
+transaction — a trigger failure aborts the whole statement.  Set-based
+engines fire per logical row like the reference does; the body
+statements themselves run as normal (columnar) statements, so an
+audit-insert or cascading update is still one engine statement per
+affected row, not per touched byte.
+"""
+
+from __future__ import annotations
+
+from ..sql import ast as A
+from ..sql.parser import parse_sql
+from .executor import ExecError
+
+_MAX_DEPTH = 8
+
+_body_cache: dict[str, list] = {}
+
+
+def _parse_body(name: str, body: str) -> list:
+    hit = _body_cache.get(body)
+    if hit is None:
+        try:
+            hit = parse_sql(body)
+        except Exception as e:
+            raise ExecError(f"function {name!r} body does not parse: "
+                            f"{e}") from None
+        _body_cache[body] = hit
+        if len(_body_cache) > 256:
+            _body_cache.pop(next(iter(_body_cache)))
+    return hit
+
+
+def _lit(v) -> A.Node:
+    if v is None:
+        return A.Const(None, "null")
+    if isinstance(v, bool):
+        return A.Const(v, "bool")
+    if isinstance(v, int):
+        return A.Const(str(v), "int")
+    if isinstance(v, float):
+        return A.Const(repr(v), "num")
+    return A.Const(str(v), "str")
+
+
+def _subst_row(node, new_row: dict, old_row: dict):
+    """Rewrite NEW.col / OLD.col references to row-value literals."""
+    def fn(x):
+        if isinstance(x, A.ColRef) and len(x.parts) == 2:
+            q, c = x.parts
+            if q == "new":
+                if new_row is None or c not in new_row:
+                    raise ExecError(f"NEW.{c} is not available here")
+                return _lit(new_row[c])
+            if q == "old":
+                if old_row is None or c not in old_row:
+                    raise ExecError(f"OLD.{c} is not available here")
+                return _lit(old_row[c])
+        return None
+    return A.rewrite(node, fn)
+
+
+def triggers_for(catalog, table: str, timing: str, event: str) -> list:
+    return [tg for tg in catalog.triggers.values()
+            if tg["table"] == table and tg["timing"] == timing
+            and tg["event"] == event]
+
+
+def has_triggers(catalog, table: str, event: str) -> bool:
+    """Fast gate so trigger-free DML pays nothing (no OLD-row
+    materialization, no firing pass)."""
+    return any(tg["table"] == table and tg["event"] == event
+               for tg in catalog.triggers.values())
+
+
+def _eval_when(session, when: A.Node, new_row, old_row) -> bool:
+    cond = _subst_row(when, new_row, old_row)
+    sel = A.SelectStmt(items=[A.SelectItem(cond)], from_=[])
+    rows = session._exec_stmt(sel).rows
+    return bool(rows and rows[0][0])
+
+
+def fire(session, catalog, table: str, timing: str, event: str,
+         rows_new: "list | None", rows_old: "list | None",
+         colnames: list):
+    """Fire every (table, timing, event) trigger per affected row.
+    rows_new/rows_old are aligned lists of row tuples (None when the
+    event has no such image)."""
+    tgs = triggers_for(catalog, table, timing, event)
+    if not tgs:
+        return
+    depth = getattr(session, "_trigger_depth", 0)
+    if depth >= _MAX_DEPTH:
+        raise ExecError(
+            f"trigger nesting exceeded {_MAX_DEPTH} levels "
+            "(recursive trigger?)")
+    n = len(rows_new) if rows_new is not None else len(rows_old)
+    session._trigger_depth = depth + 1
+    try:
+        for tg in tgs:
+            fn = catalog.functions.get(tg["func"])
+            if fn is None:
+                raise ExecError(
+                    f"trigger {tg.get('name')!r} calls missing "
+                    f"function {tg['func']!r}")
+            body = _parse_body(tg["func"], fn["body"])
+            when = None
+            if tg.get("when"):
+                when = parse_sql("select " + tg["when"])[0].items[0].expr
+            for i in range(n):
+                new_row = dict(zip(colnames, rows_new[i])) \
+                    if rows_new is not None else None
+                old_row = dict(zip(colnames, rows_old[i])) \
+                    if rows_old is not None else None
+                if when is not None and \
+                        not _eval_when(session, when, new_row, old_row):
+                    continue
+                for stmt in body:
+                    s2 = _subst_row(stmt, new_row, old_row)
+                    if isinstance(s2, A.RaiseStmt):
+                        raise ExecError(s2.message)
+                    session._exec_stmt(s2)
+    finally:
+        session._trigger_depth = depth
+
+
+def ddl(catalog, stmt):
+    """Apply a trigger/function DDL statement to `catalog`; returns the
+    command tag, or None when stmt is not a trigger DDL (reference:
+    CreateFunction / CreateTrigger utility commands)."""
+    if isinstance(stmt, A.CreateFunctionStmt):
+        if stmt.returns != "trigger":
+            raise ExecError("only RETURNS TRIGGER functions are "
+                            "supported")
+        if stmt.name in catalog.functions and not stmt.or_replace:
+            raise ExecError(f"function {stmt.name!r} already exists")
+        _parse_body(stmt.name, stmt.body)     # validate at DDL time
+        catalog.functions[stmt.name] = {"body": stmt.body}
+        return "CREATE FUNCTION"
+    if isinstance(stmt, A.DropFunctionStmt):
+        if stmt.name not in catalog.functions:
+            if stmt.if_exists:
+                return "DROP FUNCTION"
+            raise ExecError(f"function {stmt.name!r} does not exist")
+        users = [t for t, tg in catalog.triggers.items()
+                 if tg["func"] == stmt.name]
+        if users:
+            raise ExecError(
+                f"cannot drop function {stmt.name!r}: trigger "
+                f"{users[0]!r} depends on it")
+        del catalog.functions[stmt.name]
+        return "DROP FUNCTION"
+    if isinstance(stmt, A.CreateTriggerStmt):
+        if stmt.table not in catalog.tables:
+            raise ExecError(f"table {stmt.table!r} does not exist")
+        if stmt.func not in catalog.functions:
+            raise ExecError(f"function {stmt.func!r} does not exist")
+        if stmt.name in catalog.triggers:
+            raise ExecError(f"trigger {stmt.name!r} already exists")
+        catalog.triggers[stmt.name] = {
+            "name": stmt.name, "table": stmt.table,
+            "timing": stmt.timing, "event": stmt.event,
+            "when": stmt.when_src, "func": stmt.func}
+        return "CREATE TRIGGER"
+    if isinstance(stmt, A.DropTriggerStmt):
+        tg = catalog.triggers.get(stmt.name)
+        if tg is None or tg["table"] != stmt.table:
+            if stmt.if_exists:
+                return "DROP TRIGGER"
+            raise ExecError(f"trigger {stmt.name!r} on "
+                            f"{stmt.table!r} does not exist")
+        del catalog.triggers[stmt.name]
+        return "DROP TRIGGER"
+    return None
+
+
+_TRIGGER_DDL = (A.CreateFunctionStmt, A.DropFunctionStmt,
+                A.CreateTriggerStmt, A.DropTriggerStmt)

@@ -111,6 +111,34 @@ class GtmCore:
             self._catalog_gen = getattr(self, "_catalog_gen", 0) + 1
             return self._catalog_gen
 
+    # ---- cluster-wide resource queues (reference: gtm_resqueue.c —
+    # the GTM is the one place every coordinator already talks to, so
+    # per-group concurrency caps enforced here hold across ALL CNs,
+    # not per-process) ----
+    def seq_list(self) -> dict:
+        return self.call(op="seq_list")["seqs"]
+
+    def resq_acquire(self, group: str, cap: int) -> bool:
+        with self._lock:
+            rq = getattr(self, "_resq", None)
+            if rq is None:
+                rq = self._resq = {}
+            n = rq.get(group, 0)
+            if cap > 0 and n >= cap:
+                return False
+            rq[group] = n + 1
+            return True
+
+    def resq_release(self, group: str) -> None:
+        with self._lock:
+            rq = getattr(self, "_resq", None)
+            if rq and rq.get(group, 0) > 0:
+                rq[group] -= 1
+
+    def resq_counts(self) -> dict:
+        with self._lock:
+            return dict(getattr(self, "_resq", None) or {})
+
     # ---- API ----
     def next_gts(self) -> int:
         with self._lock:
@@ -138,6 +166,12 @@ class GtmCore:
             s["next"] = v + s["increment"] * cache
             self._persist_locked()
             return v
+
+    def seq_list(self) -> dict:
+        """Live sequence state {name: {"next","increment"}} — dump
+        needs positions, not definitions (pg_dump emits setval)."""
+        with self._lock:
+            return {n: dict(s) for n, s in self._sequences.items()}
 
     def seq_create(self, name: str, start: int = 1, increment: int = 1):
         with self._lock:
@@ -272,6 +306,16 @@ class GtmServer:
                             resp = {"barriers": core_ref.barrier_list()}
                         elif op == "stats":
                             resp = {"stats": core_ref.stats()}
+                        elif op == "seq_list":
+                            resp = {"seqs": core_ref.seq_list()}
+                        elif op == "resq_acquire":
+                            resp = {"ok2": core_ref.resq_acquire(
+                                msg["group"], msg["cap"])}
+                        elif op == "resq_release":
+                            core_ref.resq_release(msg["group"])
+                            resp = {"ok": True}
+                        elif op == "resq_counts":
+                            resp = {"counts": core_ref.resq_counts()}
                         elif op == "cat_gen":
                             resp = {"gen": core_ref.catalog_gen()}
                         elif op == "cat_gen_bump":
@@ -386,6 +430,19 @@ class GtmClient:
 
     def stats(self) -> dict:
         return self.call(op="stats")["stats"]
+
+    def seq_list(self) -> dict:
+        return self.call(op="seq_list")["seqs"]
+
+    def resq_acquire(self, group: str, cap: int) -> bool:
+        return self.call(op="resq_acquire", group=group,
+                         cap=cap)["ok2"]
+
+    def resq_release(self, group: str) -> None:
+        self.call(op="resq_release", group=group)
+
+    def resq_counts(self) -> dict:
+        return self.call(op="resq_counts")["counts"]
 
     def catalog_gen(self) -> int:
         return self.call(op="cat_gen")["gen"]

@@ -137,6 +137,12 @@ class AuditLogger:
         self._lock = threading.Lock()
         self._f = open(path, "a") if path else None
 
+    @property
+    def ring(self) -> list:
+        """Recent audit records (the otb_stat_audit view surface)."""
+        with self._lock:
+            return list(self._ring)
+
     def record(self, statement_type: str, detail: str, rowcount: int = 0,
                ok: bool = True):
         rec = {"ts": time.time(), "type": statement_type,

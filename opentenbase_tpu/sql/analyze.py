@@ -43,13 +43,28 @@ class Scope:
         return hits[0] if hits else None
 
 
+def _qualify_cols(node, alias: str, colnames: set):
+    """Qualify bare column refs in a mask expression with the table
+    alias so it binds in any join scope."""
+    return A.rewrite(
+        node,
+        lambda x: A.ColRef((alias, x.parts[0]))
+        if isinstance(x, A.ColRef) and len(x.parts) == 1
+        and x.parts[0] in colnames else None)
+
+
 class Binder:
-    def __init__(self, catalog: Catalog, param_types: dict = None):
+    def __init__(self, catalog: Catalog, param_types: dict = None,
+                 apply_masks: bool = False):
         self.catalog = catalog
         # $n -> SqlType, from PREPARE's declared type list: $n binds to a
         # runtime parameter column (reference: ParamRef -> Param with
         # paramtype from the prepared statement, parse_param.c)
         self.param_types = param_types or {}
+        # column masking (exec/security.py): user-facing SELECT paths
+        # opt in; internal DML/constraint/trigger reads must see (and
+        # write back) REAL values, so the default is off
+        self.apply_masks = apply_masks
 
     # ------------------------------------------------------------------
     def _append_subquery_rte(self, rtable, sub, alias: str):
@@ -279,10 +294,51 @@ class Binder:
         limit = self._const_int(stmt.limit) if stmt.limit else None
         offset = self._const_int(stmt.offset) if stmt.offset else None
 
+        if self.apply_masks and getattr(self.catalog, "masks", None):
+            targets = self._mask_targets(targets, rtable, scopes,
+                                         correlated)
         return BoundQuery(rtable=rtable, join_order=join_order, where=where,
                           targets=targets, group_by=group_by, having=having,
                           order_by=order_by, limit=limit, offset=offset,
                           distinct=stmt.distinct, correlated_cols=correlated)
+
+    def _mask_targets(self, targets, rtable, scopes, correlated):
+        """Projection rewrite for column masks (reference: datamask.c):
+        every E.Col in a target that resolves to a masked (table,
+        column) is replaced by the mask expression, bound under the
+        same table alias.  Predicates/join keys/GROUP BY keep real
+        values; only what leaves the projection is masked."""
+        from ..sql.parser import Parser
+        sub = {}
+        for rte in rtable:
+            if rte.kind != "table":
+                continue
+            for m in self.catalog.masks.values():
+                if m["table"] != rte.table.name:
+                    continue
+                col = m["column"]
+                if col not in rte.columns:
+                    continue
+                qname = rte.columns[col][0]
+                ast = Parser(m["expr"]).expr()
+                ast = _qualify_cols(ast, rte.alias,
+                                    set(rte.columns))
+                try:
+                    sub[qname] = self.bind_expr(ast, scopes,
+                                                correlated)
+                except BindError as e:
+                    raise BindError(
+                        f"mask on {m['table']}.{col} does not bind: "
+                        f"{e}") from None
+        if not sub:
+            return targets
+
+        def repl(e):
+            return A.rewrite(
+                e, lambda x: sub.get(x.name)
+                if isinstance(x, E.Col) else None)
+
+        return [(n, repl(e)) for n, e in targets]
 
     def _bind_setop(self, stmt: A.SelectStmt, outer) -> "BoundSetOp":
         """Set-operation chains.  Branches must agree in arity and column

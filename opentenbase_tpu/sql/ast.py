@@ -35,6 +35,123 @@ class Const(Node):
 
 
 @dataclasses.dataclass
+class CreateFunctionStmt(Node):
+    """CREATE FUNCTION name() RETURNS TRIGGER AS '<stmts>' LANGUAGE SQL"""
+    name: str = ""
+    body: str = ""
+    returns: str = "trigger"
+    or_replace: bool = False
+
+
+@dataclasses.dataclass
+class DropFunctionStmt(Node):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class CreateTriggerStmt(Node):
+    """CREATE TRIGGER t {BEFORE|AFTER} {INSERT|UPDATE|DELETE} ON tbl
+    [FOR EACH ROW] [WHEN (cond)] EXECUTE FUNCTION f()"""
+    name: str = ""
+    timing: str = "after"        # 'before' | 'after'
+    event: str = "insert"        # 'insert' | 'update' | 'delete'
+    table: str = ""
+    when: object = None          # expression over NEW./OLD.
+    when_src: str = ""           # source text (catalog-persisted form)
+    func: str = ""
+
+
+@dataclasses.dataclass
+class DropTriggerStmt(Node):
+    name: str = ""
+    table: str = ""
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class RaiseStmt(Node):
+    """RAISE 'message' — the procedural error surface (plpgsql RAISE
+    EXCEPTION, scoped to what trigger bodies need)."""
+    message: str = ""
+
+
+def rewrite(node, fn):
+    """Generic bottom-up-free AST rewriter: fn(node) -> replacement or
+    None to descend.  Preserves identity when nothing changes (callers
+    rely on `is` checks to skip rebuilt trees).  The ONE walker behind
+    mask qualification, trigger NEW/OLD substitution, and friends —
+    keep edge-case handling (tuple reconstruction, identity
+    short-circuit) here, not in per-feature copies."""
+    hit = fn(node)
+    if hit is not None:
+        return hit
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        changed = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            nv = rewrite(v, fn)
+            if nv is not v:
+                changed[f.name] = nv
+        return dataclasses.replace(node, **changed) if changed else node
+    if isinstance(node, list):
+        out = [rewrite(x, fn) for x in node]
+        return out if any(a is not b for a, b in zip(out, node)) \
+            else node
+    if isinstance(node, tuple):
+        out = tuple(rewrite(x, fn) for x in node)
+        return out if any(a is not b for a, b in zip(out, node)) \
+            else node
+    return node
+
+
+@dataclasses.dataclass
+class CreateMaskStmt(Node):
+    """CREATE MASK name ON table (col) AS 'expr' — transparent column
+    masking (reference: utils/misc/datamask.c)."""
+    name: str = ""
+    table: str = ""
+    column: str = ""
+    expr_src: str = ""
+
+
+@dataclasses.dataclass
+class DropMaskStmt(Node):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class CreateAuditPolicyStmt(Node):
+    """CREATE AUDIT POLICY name ON table WHEN (pred) — fine-grained
+    audit (reference: audit/audit_fga.c)."""
+    name: str = ""
+    table: str = ""
+    pred_src: str = ""
+
+
+@dataclasses.dataclass
+class DropAuditPolicyStmt(Node):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class CreateResourceGroupStmt(Node):
+    """CREATE RESOURCE GROUP g WITH (concurrency = N,
+    staging_budget_rows = M, device_time_share = K) — reference:
+    commands/resgroupcmds.c + gtm_resqueue.c."""
+    name: str = ""
+    options: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class DropResourceGroupStmt(Node):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
 class Param(Node):
     index: int                        # $n
 

@@ -148,6 +148,13 @@ class Parser:
         if v == "savepoint":
             self.advance()
             return A.SavepointStmt("savepoint", self.ident())
+        if v == "raise":
+            self.advance()
+            m = self.advance()
+            if m.kind != Tok.STR:
+                raise SqlSyntaxError("RAISE requires a string message",
+                                     self.sql, m.pos)
+            return A.RaiseStmt(m.value)
         if v == "release":
             self.advance()
             self.accept_kw("savepoint")
@@ -702,6 +709,96 @@ class Parser:
                 or_replace = True
             else:
                 self.i = save
+        if self.at_kw("resource"):
+            self.advance()
+            self.expect_kw("group")
+            name = self.ident()
+            opts = {}
+            if self.accept_kw("with"):
+                self.expect_op("(")
+                while True:
+                    k = self.ident()
+                    self.expect_op("=")
+                    v = self.advance()
+                    opts[k] = v.value
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return A.CreateResourceGroupStmt(name, opts)
+        if self.accept_kw("mask"):
+            name = self.ident()
+            self.expect_kw("on")
+            table = self.ident()
+            self.expect_op("(")
+            col = self.ident()
+            self.expect_op(")")
+            self.expect_kw("as")
+            e = self.advance()
+            if e.kind != Tok.STR:
+                raise SqlSyntaxError("mask expression must be a "
+                                     "string literal", self.sql, e.pos)
+            return A.CreateMaskStmt(name, table, col, e.value)
+        if self.accept_kw("audit"):
+            self.expect_kw("policy")
+            name = self.ident()
+            self.expect_kw("on")
+            table = self.ident()
+            self.expect_kw("when")
+            self.expect_op("(")
+            wstart = self.tok.pos
+            self.expr()
+            pred_src = self.sql[wstart:self.tok.pos].strip()
+            self.expect_op(")")
+            return A.CreateAuditPolicyStmt(name, table, pred_src)
+        if self.accept_kw("function"):
+            name = self.ident()
+            self.expect_op("(")
+            self.expect_op(")")
+            self.expect_kw("returns")
+            returns = self.ident()
+            self.expect_kw("as")
+            body = self.advance()
+            if body.kind != Tok.STR:
+                raise SqlSyntaxError("function body must be a string "
+                                     "literal", self.sql, body.pos)
+            if self.accept_kw("language"):
+                self.ident()
+            return A.CreateFunctionStmt(name, body.value, returns,
+                                        or_replace)
+        if self.accept_kw("trigger"):
+            name = self.ident()
+            timing = self.advance().value
+            if timing not in ("before", "after"):
+                raise SqlSyntaxError("trigger timing must be BEFORE "
+                                     "or AFTER", self.sql, self.tok.pos)
+            event = self.advance().value    # insert/update/delete are
+            if event not in ("insert", "update", "delete"):  # reserved
+                raise SqlSyntaxError("trigger event must be INSERT/"
+                                     "UPDATE/DELETE", self.sql,
+                                     self.tok.pos)
+            self.expect_kw("on")
+            table = self.ident()
+            if self.accept_kw("for"):
+                self.accept_kw("each")
+                self.accept_kw("row")
+            when = None
+            when_src = ""
+            if self.accept_kw("when"):
+                self.expect_op("(")
+                wstart = self.tok.pos
+                when = self.expr()
+                when_src = self.sql[wstart:self.tok.pos].strip()
+                self.expect_op(")")
+            self.expect_kw("execute")
+            if not (self.accept_kw("function")
+                    or self.accept_kw("procedure")):
+                raise SqlSyntaxError("expected EXECUTE FUNCTION",
+                                     self.sql, self.tok.pos)
+            func = self.ident()
+            self.expect_op("(")
+            self.expect_op(")")
+            return A.CreateTriggerStmt(name, timing, event, table,
+                                       when, when_src, func)
         if self.accept_kw("view"):
             name = self.ident()
             self.expect_kw("as")
@@ -983,6 +1080,41 @@ class Parser:
 
     def drop_stmt(self) -> A.Node:
         self.expect_kw("drop")
+        if self.at_kw("resource"):
+            self.advance()
+            self.expect_kw("group")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropResourceGroupStmt(self.ident(), if_exists)
+        if self.accept_kw("mask"):
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropMaskStmt(self.ident(), if_exists)
+        if self.accept_kw("audit"):
+            self.expect_kw("policy")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropAuditPolicyStmt(self.ident(), if_exists)
+        if self.accept_kw("trigger"):
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            name = self.ident()
+            self.expect_kw("on")
+            return A.DropTriggerStmt(name, self.ident(), if_exists)
+        if self.accept_kw("function"):
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropFunctionStmt(self.ident(), if_exists)
         if self.accept_kw("publication"):
             return A.DropPublicationStmt(self.ident())
         if self.accept_kw("subscription"):

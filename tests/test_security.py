@@ -1,0 +1,156 @@
+"""Column masking + FGA audit (VERDICT r4 #9; reference: datamask.c,
+audit_fga.c)."""
+
+import pytest
+
+from opentenbase_tpu.exec.dist_session import ClusterSession
+from opentenbase_tpu.exec.executor import ExecError
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.parallel.cluster import Cluster
+
+
+@pytest.fixture(params=["single", "cluster"])
+def sess(request):
+    if request.param == "single":
+        return Session(LocalNode())
+    return ClusterSession(Cluster(n_datanodes=3))
+
+
+def _mk(sess, ddl: str, key: str):
+    if isinstance(sess, ClusterSession):
+        ddl += f" distribute by shard({key})"
+    sess.execute(ddl)
+
+
+@pytest.fixture
+def people(sess):
+    _mk(sess, "create table people (id bigint primary key, nm text, "
+              "ssn text, sal bigint)", "id")
+    sess.execute("insert into people values "
+                 "(1, 'ann', '123-45-6789', 1000), "
+                 "(2, 'bob', '987-65-4321', 2000)")
+    return sess
+
+
+class TestColumnMasking:
+    def test_select_masks_output(self, people):
+        s = people
+        s.execute("create mask m_ssn on people (ssn) as '''***'''")
+        assert sorted(s.query("select nm, ssn from people")) == \
+            [("ann", "***"), ("bob", "***")]
+        # star expansion masks too
+        rows = sorted(s.query("select * from people"))
+        assert [r[2] for r in rows] == ["***", "***"]
+
+    def test_numeric_mask_expression(self, people):
+        s = people
+        s.execute("create mask m_sal on people (sal) as "
+                  "'sal - sal % 1000'")
+        assert sorted(s.query("select id, sal from people")) == \
+            [(1, 1000), (2, 2000)]
+        s.execute("insert into people values (3, 'cid', 'x', 2345)")
+        assert s.query("select sal from people where id = 3") == \
+            [(2000,)]
+
+    def test_where_sees_real_values(self, people):
+        s = people
+        s.execute("create mask m_ssn on people (ssn) as '''***'''")
+        # predicate on the masked column uses REAL data
+        assert s.query("select nm from people "
+                       "where ssn = '123-45-6789'") == [("ann",)]
+
+    def test_join_round_trip(self, people):
+        s = people
+        _mk(s, "create table badges (pid bigint primary key, "
+               "code text)", "pid")
+        s.execute("insert into badges values (1, 'B1'), (2, 'B2')")
+        s.execute("create mask m_ssn on people (ssn) as '''***'''")
+        rows = sorted(s.query(
+            "select people.nm, people.ssn, badges.code from people, "
+            "badges where people.id = badges.pid"))
+        assert rows == [("ann", "***", "B1"), ("bob", "***", "B2")]
+
+    def test_update_does_not_write_masked_values(self, people):
+        s = people
+        s.execute("create mask m_ssn on people (ssn) as '''***'''")
+        s.execute("update people set sal = sal + 1 where id = 1")
+        s.execute("set bypass_datamask = on")
+        assert s.query("select ssn from people where id = 1") == \
+            [("123-45-6789",)]
+        s.execute("set bypass_datamask = off")
+
+    def test_bypass_guc(self, people):
+        s = people
+        s.execute("create mask m_ssn on people (ssn) as '''***'''")
+        s.execute("set bypass_datamask = on")
+        assert s.query("select ssn from people where id = 1") == \
+            [("123-45-6789",)]
+        s.execute("set bypass_datamask = off")
+        assert s.query("select ssn from people where id = 1") == \
+            [("***",)]
+
+    def test_drop_mask(self, people):
+        s = people
+        s.execute("create mask m_ssn on people (ssn) as '''***'''")
+        s.execute("drop mask m_ssn")
+        assert s.query("select ssn from people where id = 1") == \
+            [("123-45-6789",)]
+
+    def test_duplicate_mask_rejected(self, people):
+        s = people
+        s.execute("create mask m1 on people (ssn) as '''***'''")
+        with pytest.raises(ExecError, match="already masked"):
+            s.execute("create mask m2 on people (ssn) as '''xxx'''")
+
+
+class TestFgaAudit:
+    def _cluster(self):
+        cl = Cluster(n_datanodes=2)
+        s = ClusterSession(cl)
+        s.execute("create table accounts (id bigint primary key, "
+                  "owner text, bal bigint) distribute by shard(id)")
+        s.execute("insert into accounts values (1, 'ann', 100), "
+                  "(2, 'bob', 999999)")
+        return s
+
+    def test_policy_fires_on_match(self):
+        s = self._cluster()
+        s.execute("create audit policy big_reads on accounts "
+                  "when (bal > 100000)")
+        before = len(s.cluster.audit.ring)
+        s.query("select * from accounts where bal > 500000")
+        hits = [r for r in s.cluster.audit.ring[before:]
+                if "FGA" in str(r)]
+        assert hits, "FGA record not emitted"
+        assert "big_reads" in str(hits[-1])
+
+    def test_policy_silent_without_match(self):
+        s = self._cluster()
+        s.execute("create audit policy big_reads on accounts "
+                  "when (bal > 100000)")
+        before = len(s.cluster.audit.ring)
+        s.query("select * from accounts where bal < 200")
+        hits = [r for r in s.cluster.audit.ring[before:]
+                if "FGA" in str(r)]
+        assert not hits
+
+    def test_policy_other_table_untouched(self):
+        s = self._cluster()
+        s.execute("create table other (k bigint primary key) "
+                  "distribute by shard(k)")
+        s.execute("create audit policy big_reads on accounts "
+                  "when (bal > 100000)")
+        before = len(s.cluster.audit.ring)
+        s.query("select count(*) from other")
+        hits = [r for r in s.cluster.audit.ring[before:]
+                if "FGA" in str(r)]
+        assert not hits
+
+    def test_drop_policy(self):
+        s = self._cluster()
+        s.execute("create audit policy p on accounts when (bal > 0)")
+        s.execute("drop audit policy p")
+        before = len(s.cluster.audit.ring)
+        s.query("select * from accounts")
+        assert not [r for r in s.cluster.audit.ring[before:]
+                    if "FGA" in str(r)]

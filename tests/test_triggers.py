@@ -1,0 +1,173 @@
+"""Row-level triggers + minimal procedural layer (VERDICT r4 #8;
+reference: commands/trigger.c + src/pl/plpgsql, scoped to
+statement-sequence SQL bodies)."""
+
+import pytest
+
+from opentenbase_tpu.exec.dist_session import ClusterSession
+from opentenbase_tpu.exec.executor import ExecError
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.parallel.cluster import Cluster
+
+
+@pytest.fixture(params=["single", "cluster"])
+def sess(request):
+    if request.param == "single":
+        return Session(LocalNode())
+    return ClusterSession(Cluster(n_datanodes=3))
+
+
+DIST = " distribute by shard({})"
+
+
+def _mk(sess, ddl: str, key: str):
+    if isinstance(sess, ClusterSession):
+        ddl += DIST.format(key)
+    sess.execute(ddl)
+
+
+class TestAuditTrail:
+    def test_after_insert_audit(self, sess):
+        _mk(sess, "create table acct (id bigint primary key, "
+                  "bal bigint)", "id")
+        _mk(sess, "create table audit_log (aid bigint, what text, "
+                  "amount bigint)", "aid")
+        sess.execute(
+            "create function log_ins() returns trigger as "
+            "'insert into audit_log values (new.id, ''created'', "
+            "new.bal)' language sql")
+        sess.execute("create trigger t_ins after insert on acct "
+                     "for each row execute function log_ins()")
+        sess.execute("insert into acct values (1, 100), (2, 200)")
+        assert sorted(sess.query(
+            "select aid, what, amount from audit_log")) == \
+            [(1, "created", 100), (2, "created", 200)]
+
+    def test_after_update_audit_old_new(self, sess):
+        _mk(sess, "create table acct2 (id bigint primary key, "
+                  "bal bigint)", "id")
+        _mk(sess, "create table audit2 (aid bigint, old_bal bigint, "
+                  "new_bal bigint)", "aid")
+        sess.execute(
+            "create function log_upd() returns trigger as "
+            "'insert into audit2 values (new.id, old.bal, new.bal)' "
+            "language sql")
+        sess.execute("create trigger t_upd after update on acct2 "
+                     "for each row execute function log_upd()")
+        sess.execute("insert into acct2 values (1, 100), (2, 200)")
+        sess.execute("update acct2 set bal = bal + 5 where id = 1")
+        assert sess.query("select aid, old_bal, new_bal from audit2") \
+            == [(1, 100, 105)]
+
+    def test_after_delete_audit(self, sess):
+        _mk(sess, "create table acct3 (id bigint primary key, "
+                  "bal bigint)", "id")
+        _mk(sess, "create table audit3 (aid bigint, last_bal bigint)",
+            "aid")
+        sess.execute(
+            "create function log_del() returns trigger as "
+            "'insert into audit3 values (old.id, old.bal)' "
+            "language sql")
+        sess.execute("create trigger t_del after delete on acct3 "
+                     "for each row execute function log_del()")
+        sess.execute("insert into acct3 values (7, 70), (8, 80)")
+        sess.execute("delete from acct3 where bal > 75")
+        assert sess.query("select aid, last_bal from audit3") == \
+            [(8, 80)]
+
+
+class TestCascadingUpdate:
+    def test_parent_update_cascades_to_child(self, sess):
+        _mk(sess, "create table dept (id bigint primary key, "
+                  "head bigint)", "id")
+        _mk(sess, "create table emp2 (eid bigint primary key, "
+                  "did bigint, mgr bigint)", "eid")
+        sess.execute(
+            "create function sync_mgr() returns trigger as "
+            "'update emp2 set mgr = new.head where did = new.id' "
+            "language sql")
+        sess.execute("create trigger t_sync after update on dept "
+                     "for each row execute function sync_mgr()")
+        sess.execute("insert into dept values (1, 100)")
+        sess.execute("insert into emp2 values (10, 1, 100), "
+                     "(11, 1, 100), (12, 2, 555)")
+        sess.execute("update dept set head = 999 where id = 1")
+        assert sorted(sess.query("select eid, mgr from emp2")) == \
+            [(10, 999), (11, 999), (12, 555)]
+
+
+class TestWhenAndRaise:
+    def test_before_insert_raise_blocks(self, sess):
+        _mk(sess, "create table guarded (id bigint primary key, "
+                  "v bigint)", "id")
+        sess.execute("create function no_neg() returns trigger as "
+                     "'raise ''negative v is not allowed''' "
+                     "language sql")
+        sess.execute("create trigger t_guard before insert on guarded "
+                     "for each row when (new.v < 0) "
+                     "execute function no_neg()")
+        sess.execute("insert into guarded values (1, 5)")
+        with pytest.raises(ExecError, match="negative v"):
+            sess.execute("insert into guarded values (2, -1)")
+        # the whole statement aborted atomically
+        assert sess.query("select count(*) from guarded") == [(1,)]
+
+    def test_trigger_error_aborts_whole_statement(self, sess):
+        _mk(sess, "create table gb (id bigint primary key, v bigint)",
+            "id")
+        sess.execute("create function boom() returns trigger as "
+                     "'raise ''boom''' language sql")
+        sess.execute("create trigger t_boom after insert on gb "
+                     "for each row when (new.v > 10) "
+                     "execute function boom()")
+        with pytest.raises(ExecError, match="boom"):
+            sess.execute("insert into gb values (1, 5), (2, 50)")
+        assert sess.query("select count(*) from gb") == [(0,)]
+
+
+class TestDdlSurface:
+    def test_drop_function_in_use_rejected(self, sess):
+        _mk(sess, "create table du (id bigint primary key)", "id")
+        sess.execute("create function f_du() returns trigger as "
+                     "'raise ''x''' language sql")
+        sess.execute("create trigger t_du before insert on du "
+                     "execute function f_du()")
+        with pytest.raises(ExecError, match="depends"):
+            sess.execute("drop function f_du")
+        sess.execute("drop trigger t_du on du")
+        sess.execute("drop function f_du")
+        sess.execute("insert into du values (1)")   # trigger gone
+        assert sess.query("select count(*) from du") == [(1,)]
+
+    def test_body_validated_at_ddl_time(self, sess):
+        with pytest.raises(ExecError, match="does not parse"):
+            sess.execute("create function bad() returns trigger as "
+                         "'not sql at all' language sql")
+
+    def test_recursion_guard(self, sess):
+        _mk(sess, "create table rec1 (id bigint primary key)", "id")
+        sess.execute("create function f_rec() returns trigger as "
+                     "'insert into rec1 values (new.id)' "
+                     "language sql")
+        sess.execute("create trigger t_rec after insert on rec1 "
+                     "for each row execute function f_rec()")
+        with pytest.raises(ExecError, match="nesting"):
+            sess.execute("insert into rec1 values (1)")
+
+
+class TestPersistence:
+    def test_triggers_survive_restart(self, tmp_path):
+        d = str(tmp_path / "n")
+        s = Session(LocalNode(d))
+        s.execute("create table pt (id bigint primary key, v bigint)")
+        s.execute("create table pa (aid bigint, v bigint)")
+        s.execute("create function f_p() returns trigger as "
+                  "'insert into pa values (new.id, new.v)' "
+                  "language sql")
+        s.execute("create trigger t_p after insert on pt "
+                  "for each row execute function f_p()")
+        s.execute("insert into pt values (1, 11)")
+        s2 = Session(LocalNode(d))
+        s2.execute("insert into pt values (2, 22)")
+        assert sorted(s2.query("select aid, v from pa")) == \
+            [(1, 11), (2, 22)]
