@@ -33,6 +33,16 @@ STAT_TABLES = {
         ColumnDef("name", T.TEXT), ColumnDef("kind", T.TEXT),
         ColumnDef("host", T.TEXT), ColumnDef("port", T.INT32),
         ColumnDef("healthy", T.BOOL)],
+    # resource-group usage (reference: pg_resgroup status views).
+    # concurrency/staging are cluster-wide DEFINITIONS; queries/
+    # query_seconds are THIS coordinator's accounting (each CN
+    # accumulates its own executor wall time — whole-query, host work
+    # included; cross-CN aggregation is a future GTM rollup)
+    "otb_resgroups": [
+        ColumnDef("name", T.TEXT), ColumnDef("concurrency", T.INT64),
+        ColumnDef("staging_budget_rows", T.INT64),
+        ColumnDef("queries", T.INT64),
+        ColumnDef("query_seconds", T.FLOAT64)],
 }
 
 
@@ -93,6 +103,14 @@ def refresh(cluster, names: list[str]):
                     healthy = True
                 rows.append((nd.name, nd.kind, nd.host, nd.port,
                              healthy))
+        elif name == "otb_resgroups":
+            usage = getattr(cluster, "resgroup_usage", {})
+            for gname, g in cluster.catalog.resource_groups.items():
+                u = usage.get(gname, {})
+                rows.append((gname, int(g.get("concurrency", 0)),
+                             int(g.get("staging_budget_rows", 0)),
+                             int(u.get("queries", 0)),
+                             float(u.get("device_s", 0.0))))
         _replace_rows(cluster, name, rows)
 
 

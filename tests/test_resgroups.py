@@ -173,3 +173,15 @@ class TestGtmCoordination:
         for srv in dns:
             srv.stop()
         gtm.stop()
+
+
+class TestStatView:
+    def test_otb_resgroups_view(self):
+        cl, s = _mk_cluster()
+        s.execute("create resource group viewg with (concurrency = 4, "
+                  "staging_budget_rows = 50000)")
+        s.execute("set resource_group = viewg")
+        s.query("select count(*) from rg")
+        rows = s.query("select name, concurrency, queries from "
+                       "otb_resgroups")   # query_seconds also exposed
+        assert ("viewg", 4, 1) in rows
