@@ -50,6 +50,9 @@ class Catalog:
         # ANALYZE output: table -> {"rows", "cols": {col: {"ndv", "min",
         # "max"}}} (reference: pg_statistic, consumed by costsize.c)
         self.stats: dict[str, dict] = {}
+        # scheduled jobs: name -> {"interval_s","sql"} (reference:
+        # pg_dbms_job catalog; run by parallel/jobs.JobScheduler)
+        self.jobs: dict[str, dict] = {}
         # resource groups: name -> {"concurrency","staging_budget_rows",
         # "device_time_share"} (reference: pg_resgroup +
         # resgroup-ops-linux.c, re-designed TPU-native: concurrency is
@@ -213,6 +216,7 @@ class Catalog:
                 "masks": self.masks,
                 "fga_policies": self.fga_policies,
                 "resource_groups": self.resource_groups,
+                "jobs": self.jobs,
                 "partitioned": self.partitioned,
                 "spm": self.spm,
                 "node_groups": self.node_groups,
@@ -250,6 +254,7 @@ class Catalog:
         cat.masks = blob.get("masks", {})
         cat.fga_policies = blob.get("fga_policies", {})
         cat.resource_groups = blob.get("resource_groups", {})
+        cat.jobs = blob.get("jobs", {})
         cat.partitioned = blob.get("partitioned", {})
         cat.spm = blob.get("spm", {})
         cat.node_groups = blob.get("node_groups", {})

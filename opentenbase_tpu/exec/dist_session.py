@@ -429,6 +429,13 @@ class ClusterSession:
             return self._exec_txn(stmt)
         if isinstance(stmt, A.ExplainStmt):
             return self._exec_explain(stmt)
+        if isinstance(stmt, (A.CreateJobStmt, A.DropJobStmt)):
+            from ..parallel import jobs as _jobs
+            try:
+                tag = _jobs.ddl(c, stmt)
+            except _jobs.JobError as e:
+                raise ExecError(str(e)) from None
+            return Result(tag)
         if isinstance(stmt, A.CreateResourceGroupStmt):
             if stmt.name in c.catalog.resource_groups:
                 raise ExecError(

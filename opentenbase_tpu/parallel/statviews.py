@@ -38,6 +38,11 @@ STAT_TABLES = {
     # query_seconds are THIS coordinator's accounting (each CN
     # accumulates its own executor wall time — whole-query, host work
     # included; cross-CN aggregation is a future GTM rollup)
+    # scheduled-job status (reference: the pg_dbms_job views)
+    "otb_jobs": [
+        ColumnDef("name", T.TEXT), ColumnDef("interval_s", T.FLOAT64),
+        ColumnDef("runs", T.INT64), ColumnDef("failures", T.INT64),
+        ColumnDef("last_error", T.TEXT)],
     "otb_resgroups": [
         ColumnDef("name", T.TEXT), ColumnDef("concurrency", T.INT64),
         ColumnDef("staging_budget_rows", T.INT64),
@@ -103,6 +108,15 @@ def refresh(cluster, names: list[str]):
                     healthy = True
                 rows.append((nd.name, nd.kind, nd.host, nd.port,
                              healthy))
+        elif name == "otb_jobs":
+            sch = getattr(cluster, "_job_scheduler", None)
+            state = sch.state if sch is not None else {}
+            for jname, j in cluster.catalog.jobs.items():
+                st = state.get(jname, {})
+                rows.append((jname, float(j["interval_s"]),
+                             int(st.get("runs", 0)),
+                             int(st.get("failures", 0)),
+                             st.get("last_error", "")))
         elif name == "otb_resgroups":
             usage = getattr(cluster, "resgroup_usage", {})
             for gname, g in cluster.catalog.resource_groups.items():

@@ -152,6 +152,21 @@ class DropResourceGroupStmt(Node):
 
 
 @dataclasses.dataclass
+class CreateJobStmt(Node):
+    """CREATE JOB name SCHEDULE <seconds> AS '<sql>' (reference:
+    pg_dbms_job / job_scheduler.c)."""
+    name: str = ""
+    interval_s: float = 0.0
+    sql: str = ""
+
+
+@dataclasses.dataclass
+class DropJobStmt(Node):
+    name: str = ""
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
 class Param(Node):
     index: int                        # $n
 

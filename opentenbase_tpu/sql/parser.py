@@ -709,6 +709,22 @@ class Parser:
                 or_replace = True
             else:
                 self.i = save
+        if self.at_kw("job"):
+            self.advance()
+            name = self.ident()
+            self.expect_kw("schedule")
+            iv = self.advance()
+            try:
+                interval_s = float(iv.value)
+            except (TypeError, ValueError):
+                raise SqlSyntaxError("SCHEDULE expects seconds",
+                                     self.sql, iv.pos) from None
+            self.expect_kw("as")
+            body = self.advance()
+            if body.kind != Tok.STR:
+                raise SqlSyntaxError("job body must be a string "
+                                     "literal", self.sql, body.pos)
+            return A.CreateJobStmt(name, interval_s, body.value)
         if self.at_kw("resource"):
             self.advance()
             self.expect_kw("group")
@@ -1080,6 +1096,13 @@ class Parser:
 
     def drop_stmt(self) -> A.Node:
         self.expect_kw("drop")
+        if self.at_kw("job"):
+            self.advance()
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropJobStmt(self.ident(), if_exists)
         if self.at_kw("resource"):
             self.advance()
             self.expect_kw("group")
