@@ -48,6 +48,11 @@ def _load_cfg(d):
 
 def cmd_start(args):
     cfg = _load_cfg(args.dir)
+    # arm the persistent XLA compilation cache BEFORE anything compiles:
+    # a restarted cluster re-reads every compiled program from disk
+    # instead of paying the compile wall again (ISSUE 1)
+    from ..exec.plancache import enable_persistent_cache
+    enable_persistent_cache(os.path.join(args.dir, "xla-cache"))
     from ..gtm.server import GtmCore, GtmServer
     from ..net.dn_server import DnServer
     gtm_core = GtmCore(os.path.join(args.dir, "gtm.json"))

@@ -161,6 +161,16 @@ def dump_sql(session, batch_rows: int = 500) -> str:
     for t, cols in sorted(catalog.btree_cols.items()):
         for i, c in enumerate(sorted(cols)):
             out.append(f"create index {t}_{c}_idx on {t} ({c});")
+    # global indexes: emitted AFTER the data so restore's backfill sees
+    # the rows (the __gidx_* mapping tables themselves are excluded
+    # from _topo_tables — CREATE GLOBAL INDEX rebuilds them, re-routed
+    # for the restored cluster's topology); dropping these silently
+    # lost cluster-wide UNIQUE + point routing (ADVICE r5 #1)
+    for t, cols in sorted(catalog.global_indexes.items()):
+        for col, cinfo in sorted(cols.items()):
+            uq = "unique " if cinfo.get("unique") else ""
+            out.append(f"create {uq}global index {cinfo['name']} "
+                       f"on {t} ({col});")
     for vname, text in catalog.views.items():
         out.append(f"create view {vname} as {text};")
     for fname, fn in catalog.functions.items():

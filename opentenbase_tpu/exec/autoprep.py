@@ -104,3 +104,23 @@ def parameterize(stmt: A.SelectStmt):
         return None
     template = dataclasses.replace(stmt, where=new_where)
     return template, args, types
+
+
+def cached_template(cluster, key, gen, build):
+    """Cluster-wide Prepared-template cache, backed by the shared
+    program-cache subsystem (exec/plancache.py AUTOPREP tier) so
+    template reuse shows up in otb_plancache next to the compiled-
+    program tiers it feeds.  `gen` is the plan-cache generation (DDL +
+    stats + GUCs): a stale entry counts as a miss and rebuilds.  A
+    None result is cached too — a template that can't bind with
+    abstract params is remembered, so the failed bind is paid once."""
+    from .plancache import AUTOPREP
+    full = (id(cluster), key)
+    ent = AUTOPREP.peek(full)
+    if ent is not None and ent[0] == gen:
+        AUTOPREP.count(hit=True)
+        return ent[1]
+    AUTOPREP.count(hit=False)
+    prep = build()
+    AUTOPREP.put(full, (gen, prep))
+    return prep

@@ -112,6 +112,15 @@ class ClusterSession:
             ev.clear()
             raise ExecError("canceling statement due to user request")
 
+    def _resq_owner(self) -> str:
+        """Stable per-session acquirer identity for GTM resource-group
+        slots (reference: gtm_resqueue ties slots to connections)."""
+        o = getattr(self, "_resq_owner_id", None)
+        if o is None:
+            import os as _os
+            o = self._resq_owner_id = f"cn{_os.getpid()}-{id(self):x}"
+        return o
+
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> list[Result]:
         out = []
@@ -653,8 +662,80 @@ class ClusterSession:
     def _exec_prepare(self, stmt: A.PrepareStmt) -> Result:
         ptypes = {i + 1: T.type_from_name(nm, targs)
                   for i, (nm, targs) in enumerate(stmt.types)}
-        self.prepared[stmt.name] = self._build_prepared(stmt.stmt, ptypes)
+        prep = self._build_prepared(stmt.stmt, ptypes)
+        self.prepared[stmt.name] = prep
+        self._schedule_warm(prep)
         return Result("PREPARE")
+
+    def _schedule_warm(self, prep: Prepared, params: dict = None) -> None:
+        """AOT warmup at PREPARE time (ISSUE 1): trace+compile the
+        statement's mesh program on the background warmup thread, so
+        the first EXECUTE lands warm instead of paying the multi-second
+        XLA compile on the query path.  Numeric/date params ride as
+        traced inputs, so the warmed program serves EVERY later binding
+        (zero-valued dummies stand in when no binding is known);
+        TEXT/BOOL params bake into program structure and can't be
+        abstracted — those preps warm on first execution instead.
+        Router (FQS) preps run single-node eager plans: nothing to
+        compile ahead of time."""
+        if prep.mode != "plan" or prep.router is not None \
+                or prep.dp is None:
+            return
+        if params is None:
+            params = {}
+            for i, t in prep.param_types.items():
+                if t.kind in (TypeKind.TEXT, TypeKind.BOOL):
+                    return
+                params[f"__bindparam{i}"] = (0, t)
+        self._schedule_warm_dp(prep.dp, params)
+
+    def _schedule_warm_dp(self, dp: DistPlan, params: dict) -> None:
+        c = self.cluster
+        if c.gucs.get("enable_mesh_exchange", "on") == "off":
+            return
+        from .mesh_exec import mesh_runner_for
+        from .plancache import warm_async
+
+        def job():
+            runner = mesh_runner_for(c)
+            if runner is not None:
+                runner.warm(dp, int(c.gtm.next_gts()), params)
+        warm_async(job)
+
+    def warm_statement(self, sql: str) -> int:
+        """Hot-statement AOT warmup — the restart story's other half:
+        after `ctl start` (or any cluster attach), feed the workload's
+        hot statements here and their mesh programs compile on the
+        background warmup thread THROUGH THE SAME autoprep template the
+        first real execution will hit, so that execution finds the
+        template, the staged tables, the learned size-class ladder, and
+        (with the persistent XLA cache) the compiled executable all
+        warm.  Returns how many statements were scheduled."""
+        from ..sql.parser import parse_sql
+        c = self.cluster
+        n = 0
+        for stmt in parse_sql(sql):
+            if not isinstance(stmt, A.SelectStmt):
+                continue
+            prep = params = None
+            if not (c.catalog.global_indexes
+                    or c.gucs.get("enable_autoprepare", "on") == "off"
+                    or c.gucs.get("enable_spm", "off") == "on"
+                    or c.gucs.get("spm_capture", "off") == "on"):
+                prep, params = self._autoprep_template(stmt)
+            if prep is not None and prep.mode == "plan" \
+                    and prep.router is None and prep.dp is not None:
+                self._schedule_warm(prep, params)
+                n += 1
+                continue
+            try:
+                dp = self._plan_distributed(stmt)
+            except Exception:
+                continue
+            if dp.fqs_node is None:
+                self._schedule_warm_dp(dp, {})
+                n += 1
+        return n
 
     def _prep_gen(self):
         """Prepared-plan staleness key: DDL, stats, AND GUCs — a SET
@@ -870,10 +951,19 @@ class ClusterSession:
             if ginfo and ginfo.get("concurrency", 0) > 0:
                 cap = int(ginfo["concurrency"])
                 deadline = _t.monotonic() + 30.0
+                # slots carry this coordinator's identity + a lease so
+                # a crashed CN can't permanently shrink the group's
+                # cluster-wide concurrency (the GTM reaps on lease
+                # expiry and on connection close; ADVICE r5 #3)
+                owner = self._resq_owner()
+                try:
+                    lease = float(c.gucs.get("resgroup_lease_s", "30"))
+                except ValueError:
+                    lease = 30.0
                 # exponential backoff: a saturated group must not
                 # hammer the GTM (GTS/commit traffic shares it)
                 delay = 0.002
-                while not c.gtm.resq_acquire(group, cap):
+                while not c.gtm.resq_acquire(group, cap, owner, lease):
                     if _t.monotonic() > deadline:
                         raise ExecError(
                             f"resource group {group!r} queue wait "
@@ -914,7 +1004,7 @@ class ClusterSession:
                 u["queries"] += 1
             if gtm_held:
                 try:
-                    c.gtm.resq_release(group)
+                    c.gtm.resq_release(group, self._resq_owner())
                 except Exception:
                     pass
             if queue is not None:
@@ -973,13 +1063,34 @@ class ClusterSession:
                 or c.gucs.get("enable_spm", "off") == "on" \
                 or c.gucs.get("spm_capture", "off") == "on":
             return None
-        from .autoprep import parameterize
+        prep, params = self._autoprep_template(stmt)
+        if prep is None or prep.mode != "plan" or params is None:
+            return None     # normal plan path (original stmt)
+        self.plan_cache_hits += 1
+        node = prep.router(params) if prep.router is not None else None
+        if node is not None:
+            dp = DistPlan([Fragment(0, prep.planned.plan, "dn")], [], 0,
+                          prep.planned.init_plans,
+                          prep.planned.output_names, fqs_node=node)
+        else:
+            dp = prep.dp
+        res, _ex = self._run_select_dp(dp, t, params)
+        return res
+
+    def _autoprep_template(self, stmt: A.SelectStmt):
+        """(Prepared, bound params) for the statement's autoprep
+        template, or (None, None).  The SHARED core of the ad-hoc fast
+        path and warm_statement — both must build the same template
+        under the same cache key so warmup compiles exactly the program
+        the first execution looks up."""
+        c = self.cluster
+        from .autoprep import cached_template, parameterize
         try:
             hit = parameterize(stmt)
         except Exception:
-            return None
+            return None, None
         if hit is None:
-            return None
+            return None, None
         template, arg_nodes, ptypes = hit
         from ..sql.fingerprint import fingerprint
         try:
@@ -991,45 +1102,25 @@ class ClusterSession:
                    tuple(str(ptypes[i])
                          for i in range(1, len(ptypes) + 1)))
         except Exception:
-            return None
-        gen = self._plan_gen()
-        cache = getattr(c, "_auto_prep", None)
-        if cache is None:
-            cache = {}
-            c._auto_prep = cache
-        ent = cache.get(key)
-        if ent is None or ent[0] != gen:
+            return None, None
+
+        def build():
             try:
-                prep = self._build_prepared(template, ptypes)
+                return self._build_prepared(template, ptypes)
             except Exception:
-                prep = None     # remember: this template can't bind
-            try:
-                cache[key] = (gen, prep)
-                while len(cache) > 256:
-                    cache.pop(next(iter(cache)))
-            except (KeyError, RuntimeError):
-                pass
-        else:
-            prep = ent[1]
-        if prep is None or prep.mode != "plan":
-            return None     # normal plan path (original stmt)
+                return None     # remember: this template can't bind
+
+        prep = cached_template(c, key, self._plan_gen(), build)
+        if prep is None:
+            return None, None
         params = {}
         try:
             for i, arg in enumerate(arg_nodes, start=1):
                 params[f"__bindparam{i}"] = (
                     self._bind_arg(arg, ptypes[i]), ptypes[i])
         except Exception:
-            return None
-        self.plan_cache_hits += 1
-        node = prep.router(params) if prep.router is not None else None
-        if node is not None:
-            dp = DistPlan([Fragment(0, prep.planned.plan, "dn")], [], 0,
-                          prep.planned.init_plans,
-                          prep.planned.output_names, fqs_node=node)
-        else:
-            dp = prep.dp
-        res, _ex = self._run_select_dp(dp, t, params)
-        return res
+            return prep, None
+        return prep, params
 
     def _exec_select_for_update(self, stmt: A.SelectStmt) -> Result:
         """Cluster SELECT ... FOR UPDATE [NOWAIT]: lock matching rows
@@ -1163,6 +1254,15 @@ class ClusterSession:
                         row.append(-float(v.arg.value)
                                    if "." in str(v.arg.value)
                                    else -int(v.arg.value))
+                    elif isinstance(v, A.FuncCall) \
+                            and v.name == "nextval" \
+                            and len(v.args) == 1 \
+                            and isinstance(v.args[0], A.Const):
+                        # GTM-served sequence draw (reference:
+                        # gtm_seq.c — nextval in a VALUES list is the
+                        # standard serial-column INSERT shape)
+                        row.append(int(self.cluster.gtm.seq_next(
+                            str(v.args[0].value))))
                     else:
                         raise ExecError("INSERT values must be literals")
                 rows.append(row)

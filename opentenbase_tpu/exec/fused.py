@@ -13,25 +13,38 @@ Mechanics: `try_fused` pattern-matches a traceable subtree (single
 SeqScan leaf, no operators that need host-side dynamic output sizing),
 stages the scan's device columns once (outside the trace), and runs the
 REGULAR Executor over the plan inside `jax.jit` with `_traced=True` —
-host-sync size classes switch to static worst-case shapes.  Compiled
-programs are memoized on (plan structure, dictionary lengths, init-plan
-params); jax re-traces per array shape automatically.
+host-sync size classes switch to static worst-case shapes.
+
+Compiled programs live in the shared program cache (exec/plancache.py
+FUSED tier) under a CANONICAL FRAGMENT SIGNATURE: numeric/date literals
+in scan filters and quals are masked out of the plan and ride as traced
+program inputs instead, so `WHERE l_shipdate <= X` with a different
+constant reuses the compiled executable (the reference's generic-plan
+arm, taken further: the plan cache there saves planning, this saves the
+XLA compile).  jax re-traces per array shape automatically — the
+pow2/quarter-step size classes bound that — and the cache's global
+live-executable budget evicts LRU programs deterministically.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..catalog.types import TypeKind
 from ..plan import exprs as E
 from ..plan import physical as P
+from ..plan.planner import rewrite as rewrite_expr
+from ..sql.fingerprint import struct_key
+from . import plancache
 
-# (key) -> (jitted fn, meta dict captured at trace time)
-_CACHE: dict = {}
-_CACHE_LIMIT = 256
+# plan shapes whose literal-masked trace host-synced (a masked value
+# fed a host branch): retried and cached baked instead
+_MASK_REFUSED: set = set()
 
 # Observability hook: when set, called as EXPORT_HOOK(tag, fn, args)
 # after each successful fused execution — the TPU lowering proof
@@ -139,8 +152,52 @@ def _needed_columns(node, alias: str) -> set[str]:
     return need
 
 
+# literal kinds that mask out of the fragment signature and ride as
+# traced inputs (TEXT/BOOL/NULL literals change program structure —
+# dictionary predicates, 3VL — and stay baked)
+_LIFT_KINDS = (TypeKind.INT32, TypeKind.INT64, TypeKind.FLOAT64,
+               TypeKind.DECIMAL, TypeKind.DATE)
+
+
+def _mask_expr(e, lits: list):
+    def sub(x):
+        if isinstance(x, E.Lit) and x.value is not None \
+                and not isinstance(x.value, bool) \
+                and isinstance(x.value, (int, float)) \
+                and x.type.kind in _LIFT_KINDS:
+            name = f"__fraglit{len(lits)}"
+            lits.append((name, x.value, x.type))
+            return E.Col(name, x.type)
+        return None
+    return rewrite_expr(e, sub)
+
+
+def _mask_node(node, lits: list):
+    """Canonical fragment form: clone the fusable chain with numeric
+    predicate literals replaced by __fraglitN parameter columns (walk
+    order = positional identity, so equal-shaped fragments bind their
+    literals to the same traced slots)."""
+    if isinstance(node, P.SeqScan):
+        if not node.filters:
+            return node
+        return dataclasses.replace(
+            node, filters=[_mask_expr(f, lits) for f in node.filters])
+    if isinstance(node, P.Filter):
+        return dataclasses.replace(
+            node, quals=[_mask_expr(q, lits) for q in node.quals],
+            child=_mask_node(node.child, lits))
+    if isinstance(node, (P.Project, P.Agg, P.Sort, P.Limit)):
+        return dataclasses.replace(node,
+                                   child=_mask_node(node.child, lits))
+    return node
+
+
 def try_fused(executor, node) -> Optional[object]:
     """Execute `node` as one jitted program, or None if unsupported."""
+    return _try_fused(executor, node, allow_mask=True)
+
+
+def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
     if not isinstance(node, (P.Agg, P.Project, P.Filter, P.Sort, P.Limit)):
         return None   # bare SeqScan gains nothing; joins unsupported
     scan = _find_scan(node)
@@ -150,17 +207,24 @@ def try_fused(executor, node) -> Optional[object]:
     store = ctx.stores.get(scan.table.name)
     if store is None or (ctx.staged and scan.table.name in ctx.staged):
         return None
-    key = _key_of(node)
-    if key is None:
+    if _key_of(node) is None:
         return None
     if _has_transformed_dup_dict(node, store):
         return None
 
+    # canonical fragment signature: literal-masked plan + dtypes; the
+    # masked literals ride as traced inputs alongside numeric init-plan
+    # params (re-planned scalar subquery values must not recompile the
+    # fragment either); everything else (strings, NULLs — they change
+    # program structure) is baked and keyed
+    lits: list = []
+    exec_node_plan = _mask_node(node, lits) if allow_mask else node
+    key = _key_of(exec_node_plan)
+    if key is None:
+        return None
+
     dict_lens = tuple(sorted((c, len(d.values))
                              for c, d in store.dicts.items()))
-    # numeric init-plan params ride as TRACED inputs (re-planned scalar
-    # subquery values must not recompile the fragment); everything else
-    # (strings, NULLs — they change program structure) is baked and keyed
     traced_names = tuple(sorted(
         k for k, (v, _t) in ctx.params.items()
         if isinstance(v, (int, float)) and not isinstance(v, bool)))
@@ -172,21 +236,29 @@ def try_fused(executor, node) -> Optional[object]:
     if len(baked_key) != len(baked):
         return None  # non-scalar param: don't risk a stale closure
     types_key = tuple((k, ctx.params[k][1]) for k in traced_names)
+    lit_types = tuple(t for _n, _v, t in lits)
+    full_key = (key, id(store), dict_lens, baked_key, types_key,
+                lit_types)
     try:
-        full_key = hash((key, id(store), dict_lens, baked_key, types_key))
+        hash(full_key)
     except TypeError:
         return None  # unhashable plan content (e.g. an unrewritten link)
+    if lits and struct_key(full_key) in _MASK_REFUSED:
+        return _try_fused(executor, node, allow_mask=False)
 
     # stage ONCE outside the trace (device cache, version-keyed)
     needed = sorted(_needed_columns(node, scan.alias))
     arrs, n = ctx.cache.get(store, needed)
 
-    hit = _CACHE.get(full_key)
+    hit = plancache.FUSED.get(full_key)
     if hit is None:
         from .executor import ExecContext, Executor
 
         meta: dict = {}
-        traced_types = [ctx.params[k][1] for k in traced_names]
+        traced_types = [ctx.params[k][1] for k in traced_names] \
+            + [t for _n, _v, t in lits]
+        all_traced = list(traced_names) + [nm for nm, _v, _t in lits]
+        frag_plan = exec_node_plan
 
         def run(arrs_in, snap, txid, pvals, n_live):
             # n_live is TRACED: the row count changes with every write,
@@ -194,7 +266,7 @@ def try_fused(executor, node) -> Optional[object]:
             # insert-then-read cycle (the OLTP pattern); only the padded
             # shape (power-of-two) retraces
             sub_params = dict(baked)
-            for name, pv, t in zip(traced_names, pvals, traced_types):
+            for name, pv, t in zip(all_traced, pvals, traced_types):
                 sub_params[name] = (pv, t)
             sub_ctx = ExecContext(
                 ctx.stores, snap, txid, ctx.cache,
@@ -202,19 +274,20 @@ def try_fused(executor, node) -> Optional[object]:
                 staged={scan.table.name: (arrs_in, n_live)})
             sub = Executor(sub_ctx)
             sub._traced = True
-            b = sub.exec_node(node)
+            b = sub.exec_node(frag_plan)
             meta["types"] = b.types
             meta["dicts"] = b.dicts
             return b.cols, b.valid, b.nulls
 
         fn = jax.jit(run)
-        _CACHE[full_key] = hit = (fn, meta)
-        if len(_CACHE) > _CACHE_LIMIT:
-            _CACHE.pop(next(iter(_CACHE)))
+        hit = plancache.FUSED.put(full_key, (fn, meta))
     fn, meta = hit
     if fn is None:
         return None  # permanently fell back for this plan shape
-    pvals = tuple(jnp.asarray(ctx.params[k][0]) for k in traced_names)
+    pvals = tuple(
+        [jnp.asarray(ctx.params[k][0]) for k in traced_names]
+        + [jnp.asarray(v) for _n, v, _t in lits])
+    t0 = time.perf_counter()
     try:
         cols, valid, nulls = fn(arrs, jnp.int64(ctx.snapshot_ts),
                                 jnp.int64(ctx.txid), pvals,
@@ -222,13 +295,22 @@ def try_fused(executor, node) -> Optional[object]:
     except (jax.errors.TracerBoolConversionError,
             jax.errors.ConcretizationTypeError,
             jax.errors.TracerArrayConversionError):
+        if lits:
+            # a MASKED literal fed a host-sync (value-dependent program
+            # structure): remember and retry with literals baked
+            _MASK_REFUSED.add(struct_key(full_key))
+            if len(_MASK_REFUSED) > 512:
+                _MASK_REFUSED.clear()
+            plancache.FUSED.pop(full_key)
+            return _try_fused(executor, node, allow_mask=False)
         # a host-sync slipped through the fusability screen: permanently
         # fall back for this plan shape
-        _CACHE[full_key] = (None, None)
+        plancache.FUSED.replace(full_key, (None, None))
         return None
     except Exception:
-        _CACHE.pop(full_key, None)
+        plancache.FUSED.pop(full_key)
         raise
+    plancache.FUSED.record_call(fn, t0)
     if EXPORT_HOOK is not None:
         EXPORT_HOOK("fused", fn,
                     (arrs, jnp.int64(ctx.snapshot_ts),

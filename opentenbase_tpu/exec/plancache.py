@@ -1,30 +1,390 @@
-"""Generic ad-hoc plan cache shared by the single-node and cluster
-sessions.
+"""Compiled-program subsystem: one cache for every execution tier.
 
-Reference analog: the generic-plan arm of CachedPlanSource
-(utils/cache/plancache.c) applied to UNNAMED statements: repeated
-identical SELECTs reuse the planned tree — and, through the fused/mesh
-tiers' program memoization, the compiled XLA program.  Keyed by the
-EXACT statement (literals included, sql/fingerprint.py unmasked mode)
-plus a generation tuple covering DDL, stats, and the GUCs that shape
-planning.  Mutation is defensive: sessions on a CN server share one
-cluster-level cache across handler threads, so eviction races must
-never fail a query.
+Reference analog: CachedPlanSource (utils/cache/plancache.c) generalized
+to the thing that actually costs seconds here — compiled XLA programs.
+The round-5 ladder paid 11-12s of XLA compile against <1s of engine
+time per cold mesh query, and an unmanaged live-executable population
+segfaulted XLA:CPU at a few hundred programs.  Four pieces:
+
+1. ProgramCache — a bounded LRU of live compiled programs, shared by
+   the fused tier (exec/fused.py) and the mesh tier (exec/mesh_exec.py),
+   with a GLOBAL live-executable budget (OTB_MAX_LIVE_PROGRAMS):
+   eviction calls PjitFunction.clear_cache() so the XLA executable is
+   actually released, deterministically, instead of the old
+   "drop every cache every 25 tests" workaround in the TPC-DS suite.
+   Keys are canonical fragment signatures: literal-masked plan
+   structure + dtype tuple + size-class bucket (the pow2/quarter-step
+   classes of storage/batch.py), so `WHERE k <= X` with a different
+   constant — or the same fragment over a different-but-same-size-class
+   batch — reuses the compiled executable.
+
+2. Persistent compilation cache — enable_persistent_cache() points
+   jax_compilation_cache_dir under the cluster datadir so process
+   restarts, `ctl start`, and repeated bench runs skip the XLA compile
+   entirely (bench.py's warm2 arm measures it).
+
+3. AOT warmup — warm_async() runs lower-and-compile jobs on a
+   background daemon thread, off the query path: PREPARE warms its
+   mesh program (dist_session._warm_prepared), cluster start re-stages
+   recovered tables (parallel/cluster.py), aot_compile() does
+   jit(...).lower(args).compile() without executing.
+
+4. Telemetry — per-tier hit/miss/compile/compile_ms/eviction counters
+   surfaced by the otb_plancache stat view (parallel/statviews.py).
+
+The exact-statement plan cache (get_or_build, used by both sessions)
+keeps its holder-attached storage but now feeds the same counters.
+Mutation stays defensive: sessions on a CN server share these caches
+across handler threads, so races must never fail a query.
 """
 
 from __future__ import annotations
 
+import itertools
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
 from ..sql.fingerprint import fingerprint
 
+_LOCK = threading.RLock()
+_SEQ = itertools.count()
+_REGISTRY: list = []          # jit-bearing caches under the global budget
+
+
+def _live_budget() -> int:
+    """Global cap on live compiled executables across all program
+    tiers — set below the population where XLA:CPU's jit compiler was
+    observed to segfault (a few hundred; round 5 hit it at ~66% of the
+    TPC-DS suite)."""
+    try:
+        return int(os.environ.get("OTB_MAX_LIVE_PROGRAMS", "224"))
+    except ValueError:
+        return 224
+
+
+def _fn_live(fn) -> int:
+    """Live executables held by a jitted function (0 for tombstones)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return 1 if fn is not None else 0
+
+
+def _entry_fns(value):
+    """Jitted functions inside a cache value ((fn, meta) tuples or a
+    bare fn); tolerant of None tombstones."""
+    vals = value if isinstance(value, (tuple, list)) else (value,)
+    return [v for v in vals if hasattr(v, "clear_cache")]
+
+
+class ProgramCache:
+    """Bounded LRU keyed by canonical fragment signature.  `jit=True`
+    caches hold compiled programs and participate in the global
+    live-executable budget; `jit=False` caches (plan/template tiers)
+    only bound entry count and feed counters."""
+
+    def __init__(self, name: str, max_entries: int, jit: bool = True):
+        self.name = name
+        self.max_entries = max_entries
+        self.jit = jit
+        self._d: dict = {}            # key -> [seq, value]
+        self.hits = 0
+        self.misses = 0
+        self.compiles = 0
+        self.compile_ms = 0.0
+        self.evictions = 0
+        with _LOCK:
+            if jit:
+                _REGISTRY.append(self)
+
+    # -- lookup / insert ------------------------------------------------
+    def get(self, key):
+        with _LOCK:
+            ent = self._d.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            ent[0] = next(_SEQ)
+            self.hits += 1
+            return ent[1]
+
+    def peek(self, key):
+        """Lookup that refreshes LRU order but defers hit/miss
+        accounting to count() — for callers whose hit criterion is
+        richer than key presence (generation-checked entries)."""
+        with _LOCK:
+            ent = self._d.get(key)
+            if ent is None:
+                return None
+            ent[0] = next(_SEQ)
+            return ent[1]
+
+    def count(self, hit: bool):
+        with _LOCK:
+            if hit:
+                self.hits += 1
+            else:
+                self.misses += 1
+
+    def put(self, key, value):
+        with _LOCK:
+            try:
+                self._d[key] = [next(_SEQ), value]
+            except TypeError:
+                return value          # unhashable key: just don't cache
+            while len(self._d) > self.max_entries:
+                self._evict_lru()
+        if self.jit:
+            trim_live()
+        return value
+
+    def replace(self, key, value):
+        """Swap a value in place (permanent-fallback tombstones) without
+        touching LRU order or eviction."""
+        with _LOCK:
+            ent = self._d.get(key)
+            if ent is not None:
+                for fn in _entry_fns(ent[1]):
+                    try:
+                        fn.clear_cache()
+                    except Exception:
+                        pass
+                ent[1] = value
+
+    def pop(self, key):
+        with _LOCK:
+            ent = self._d.pop(key, None)
+        if ent is not None:
+            for fn in _entry_fns(ent[1]):
+                try:
+                    fn.clear_cache()
+                except Exception:
+                    pass
+
+    # -- accounting -----------------------------------------------------
+    def note_compile(self, n: int = 1, ms: float = 0.0):
+        with _LOCK:
+            self.compiles += n
+            self.compile_ms += ms
+
+    def record_call(self, fn, t0: float):
+        """Post-execution compile detection: a grown per-fn cache means
+        this call traced+compiled (a new shape/dtype bucket); attribute
+        the call's wall time to compile_ms and re-check the budget."""
+        after = _fn_live(fn)
+        before = getattr(fn, "_otb_seen", 0)
+        if after > before:
+            self.note_compile(after - before,
+                              (time.perf_counter() - t0) * 1e3)
+            try:
+                fn._otb_seen = after
+            except Exception:
+                pass
+            trim_live()
+
+    def live(self) -> int:
+        with _LOCK:
+            return sum(_fn_live(fn) for _s, v in self._d.values()
+                       for fn in _entry_fns(v))
+
+    def __len__(self):
+        return len(self._d)
+
+    def clear(self):
+        with _LOCK:
+            keys = list(self._d)
+        for k in keys:
+            self.pop(k)
+
+    # -- eviction -------------------------------------------------------
+    def _evict_lru(self):
+        # caller holds _LOCK
+        if not self._d:
+            return
+        key = min(self._d, key=lambda k: self._d[k][0])
+        _s, value = self._d.pop(key)
+        self.evictions += 1
+        for fn in _entry_fns(value):
+            try:
+                fn.clear_cache()
+            except Exception:
+                pass
+
+
+def trim_live():
+    """Enforce the global live-executable budget: evict globally-LRU
+    entries (across every jit cache) that actually hold executables
+    until the population fits.  Deterministic, targeted — replaces the
+    conftest hack of dropping every cache every N tests."""
+    budget = _live_budget()
+    with _LOCK:
+        for _ in range(4096):
+            total = sum(c.live() for c in _REGISTRY)
+            if total <= budget:
+                return
+            best = None
+            for c in _REGISTRY:
+                for k, (seq, v) in c._d.items():
+                    if not any(_fn_live(fn) for fn in _entry_fns(v)):
+                        continue
+                    if best is None or seq < best[0]:
+                        best = (seq, c, k)
+            if best is None:
+                return
+            _seq, c, k = best
+            _s, value = c._d.pop(k)
+            c.evictions += 1
+            for fn in _entry_fns(value):
+                try:
+                    fn.clear_cache()
+                except Exception:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# tier singletons
+# ---------------------------------------------------------------------------
+FUSED = ProgramCache("fused", max_entries=192)
+MESH = ProgramCache("mesh", max_entries=128)
+PLAN = ProgramCache("plan", max_entries=256, jit=False)
+AUTOPREP = ProgramCache("autoprep", max_entries=256, jit=False)
+
+
+def stats() -> list:
+    """Per-tier counters for the otb_plancache view:
+    (tier, hits, misses, compiles, compile_ms, evictions, live)."""
+    out = []
+    for c in (FUSED, MESH, PLAN, AUTOPREP):
+        live = c.live() if c.jit else len(c)
+        out.append((c.name, c.hits, c.misses, c.compiles,
+                    round(c.compile_ms, 3), c.evictions, live))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# persistent XLA compilation cache
+# ---------------------------------------------------------------------------
+_persist_dir: Optional[str] = None
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point jax's compilation cache at `path` (or $OTB_COMPILE_CACHE)
+    so XLA compiles survive process restarts.  First caller wins — the
+    cache dir is process-global; later calls with a different path are
+    no-ops (the already-armed dir keeps serving)."""
+    global _persist_dir
+    env = os.environ.get("OTB_COMPILE_CACHE", "").strip()
+    if env.lower() in ("0", "off", "none"):
+        return None            # explicit operator opt-out
+    if env:
+        path = env             # env pins one dir across every caller
+    if not path:
+        return _persist_dir
+    if _persist_dir is not None:
+        return _persist_dir
+    import jax
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # default thresholds skip sub-second/small programs — exactly
+        # the fragment programs this engine compiles by the hundreds
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+    except Exception:
+        return _persist_dir
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches",
+                          "all")
+    except Exception:
+        pass      # older jax: the executable cache alone still works
+    _persist_dir = path
+    return _persist_dir
+
+
+def persistent_cache_dir() -> Optional[str]:
+    return _persist_dir
+
+
+# $OTB_COMPILE_CACHE arms the cache for ANY deployment shape (bench
+# children, ad-hoc scripts, datadir-less sessions) without a call site
+if os.environ.get("OTB_COMPILE_CACHE", "").strip():
+    enable_persistent_cache()
+
+
+# ---------------------------------------------------------------------------
+# AOT warmup (background, off the query path)
+# ---------------------------------------------------------------------------
+_warm_q: "queue.Queue" = queue.Queue()
+_warm_thread: Optional[threading.Thread] = None
+
+
+def _warm_loop():
+    while True:
+        job = _warm_q.get()
+        try:
+            job()
+        except Exception:
+            pass          # warmup must never surface errors
+        finally:
+            _warm_q.task_done()
+
+
+def warm_async(job) -> None:
+    """Run `job` (a no-arg callable that compiles something) on the
+    warmup daemon thread."""
+    global _warm_thread
+    with _LOCK:
+        if _warm_thread is None or not _warm_thread.is_alive():
+            _warm_thread = threading.Thread(
+                target=_warm_loop, daemon=True, name="plancache-warm")
+            _warm_thread.start()
+    _warm_q.put(job)
+
+
+def warm_drain(timeout: float = 60.0) -> bool:
+    """Block until queued warmup jobs finish (tests/bench)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if _warm_q.unfinished_tasks == 0:
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def aot_compile(fn, *args) -> bool:
+    """jit(...).lower(args).compile() without executing: populates the
+    persistent XLA cache so a later call of the same program skips the
+    XLA compile (args may be jax.ShapeDtypeStructs — no data needed).
+    Warm paths that hold REAL staged arrays prefer running the jitted
+    fn once instead, which also fills its dispatch cache."""
+    try:
+        fn.lower(*args).compile()
+        return True
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# exact-statement plan cache (the CachedPlanSource generic-plan arm)
+# ---------------------------------------------------------------------------
 _MAX = 256
 
 
 def get_or_build(holder, attr: str, stmt, gen, build,
                  cacheable=lambda obj: True):
     """Return the cached object for (stmt, gen) on `holder.attr`, or
-    build, insert, and return it.  `build()` runs at most once per
-    call; uncacheable statements/objects just build (e.g. FQS/gidx
-    plans, whose target node was chosen from DATA at plan time)."""
+    build, insert, and return it.  Keyed by the EXACT statement
+    (literals included, sql/fingerprint.py unmasked mode) plus a
+    generation tuple covering DDL, stats, and the GUCs that shape
+    planning.  `build()` runs at most once per call; uncacheable
+    statements/objects just build (e.g. FQS/gidx plans, whose target
+    node was chosen from DATA at plan time).  Feeds the PLAN tier's
+    hit/miss counters (otb_plancache)."""
     cache = getattr(holder, attr, None)
     if cache is None:
         cache = {}
@@ -35,7 +395,11 @@ def get_or_build(holder, attr: str, stmt, gen, build,
         return build()
     hit = cache.get(fp)
     if hit is not None and hit[0] == gen:
+        with _LOCK:
+            PLAN.hits += 1
         return hit[1]
+    with _LOCK:
+        PLAN.misses += 1
     obj = build()
     if obj is None or not cacheable(obj):
         return obj

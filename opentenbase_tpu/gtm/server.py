@@ -114,30 +114,63 @@ class GtmCore:
     # ---- cluster-wide resource queues (reference: gtm_resqueue.c —
     # the GTM is the one place every coordinator already talks to, so
     # per-group concurrency caps enforced here hold across ALL CNs,
-    # not per-process) ----
-    def seq_list(self) -> dict:
-        return self.call(op="seq_list")["seqs"]
+    # not per-process).  Each slot records its acquirer identity and a
+    # lease deadline: a coordinator that crashes (or loses its GTM
+    # connection) between acquire and release can no longer leak the
+    # slot forever — expired leases are reaped at the next acquire, and
+    # the TCP server reaps a connection's owners on disconnect,
+    # mirroring gtm_resqueue.c's per-connection cleanup (ADVICE r5 #3).
+    def _resq_slots(self, group: str) -> list:
+        # caller holds self._lock; slots: [owner, lease_deadline]
+        rq = getattr(self, "_resq", None)
+        if rq is None:
+            rq = self._resq = {}
+        slots = rq.setdefault(group, [])
+        now = time.monotonic()
+        slots[:] = [s for s in slots if s[1] > now]
+        return slots
 
-    def resq_acquire(self, group: str, cap: int) -> bool:
+    def resq_acquire(self, group: str, cap: int, owner: str = "",
+                     lease_s: float = 30.0) -> bool:
         with self._lock:
-            rq = getattr(self, "_resq", None)
-            if rq is None:
-                rq = self._resq = {}
-            n = rq.get(group, 0)
-            if cap > 0 and n >= cap:
+            slots = self._resq_slots(group)
+            if cap > 0 and len(slots) >= cap:
                 return False
-            rq[group] = n + 1
+            slots.append([owner,
+                          time.monotonic() + max(float(lease_s), 0.001)])
             return True
 
-    def resq_release(self, group: str) -> None:
+    def resq_release(self, group: str, owner: str = "") -> None:
         with self._lock:
-            rq = getattr(self, "_resq", None)
-            if rq and rq.get(group, 0) > 0:
-                rq[group] -= 1
+            slots = self._resq_slots(group)
+            for i, s in enumerate(slots):
+                if s[0] == owner:
+                    del slots[i]
+                    return
+            # identity-less legacy caller: positional release.  An
+            # IDENTIFIED owner whose slot was already lease-reaped must
+            # NOT pop someone else's slot — no-op instead.
+            if slots and not owner:
+                del slots[0]
+
+    def resq_disconnect(self, owner: str) -> int:
+        """Reap every slot held by `owner` (connection closed / session
+        gone).  Returns how many were freed."""
+        if not owner:
+            return 0
+        freed = 0
+        with self._lock:
+            for group in list(getattr(self, "_resq", None) or {}):
+                slots = self._resq_slots(group)
+                kept = [s for s in slots if s[0] != owner]
+                freed += len(slots) - len(kept)
+                slots[:] = kept
+        return freed
 
     def resq_counts(self) -> dict:
         with self._lock:
-            return dict(getattr(self, "_resq", None) or {})
+            return {g: len(self._resq_slots(g))
+                    for g in list(getattr(self, "_resq", None) or {})}
 
     # ---- API ----
     def next_gts(self) -> int:
@@ -251,6 +284,10 @@ class GtmServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # owners whose resq slots were acquired over THIS
+                # connection: reaped in finish() on disconnect
+                # (reference: gtm_resqueue per-connection cleanup)
+                self.resq_owners: set = set()
                 while True:
                     try:
                         msg = recv_msg(self.request)
@@ -309,13 +346,21 @@ class GtmServer:
                         elif op == "seq_list":
                             resp = {"seqs": core_ref.seq_list()}
                         elif op == "resq_acquire":
+                            owner = msg.get("owner", "")
+                            if owner:
+                                self.resq_owners.add(owner)
                             resp = {"ok2": core_ref.resq_acquire(
-                                msg["group"], msg["cap"])}
+                                msg["group"], msg["cap"], owner,
+                                msg.get("lease_s", 30.0))}
                         elif op == "resq_release":
-                            core_ref.resq_release(msg["group"])
+                            core_ref.resq_release(msg["group"],
+                                                  msg.get("owner", ""))
                             resp = {"ok": True}
                         elif op == "resq_counts":
                             resp = {"counts": core_ref.resq_counts()}
+                        elif op == "resq_disconnect":
+                            resp = {"freed": core_ref.resq_disconnect(
+                                msg.get("owner", ""))}
                         elif op == "cat_gen":
                             resp = {"gen": core_ref.catalog_gen()}
                         elif op == "cat_gen_bump":
@@ -327,6 +372,14 @@ class GtmServer:
                     except Exception as e:  # serve errors, don't die
                         resp = {"error": str(e)}
                     send_msg(self.request, resp)
+
+            def finish(self):
+                for owner in getattr(self, "resq_owners", ()):
+                    try:
+                        core_ref.resq_disconnect(owner)
+                    except Exception:
+                        pass
+                super().finish()
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -434,12 +487,16 @@ class GtmClient:
     def seq_list(self) -> dict:
         return self.call(op="seq_list")["seqs"]
 
-    def resq_acquire(self, group: str, cap: int) -> bool:
-        return self.call(op="resq_acquire", group=group,
-                         cap=cap)["ok2"]
+    def resq_acquire(self, group: str, cap: int, owner: str = "",
+                     lease_s: float = 30.0) -> bool:
+        return self.call(op="resq_acquire", group=group, cap=cap,
+                         owner=owner, lease_s=lease_s)["ok2"]
 
-    def resq_release(self, group: str) -> None:
-        self.call(op="resq_release", group=group)
+    def resq_release(self, group: str, owner: str = "") -> None:
+        self.call(op="resq_release", group=group, owner=owner)
+
+    def resq_disconnect(self, owner: str) -> int:
+        return self.call(op="resq_disconnect", owner=owner)["freed"]
 
     def resq_counts(self) -> dict:
         return self.call(op="resq_counts")["counts"]

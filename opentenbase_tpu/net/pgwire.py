@@ -116,6 +116,66 @@ def _row_description(names, rows) -> bytes:
     return out
 
 
+_KIND_OID = None
+
+
+def _oid_of_type(t) -> int:
+    """SqlType -> pg_type OID (0 = unknown, which drivers treat as
+    text — matching the text-format values we send)."""
+    global _KIND_OID
+    if _KIND_OID is None:
+        from ..catalog.types import TypeKind as K
+        _KIND_OID = {K.BOOL: OID_BOOL, K.INT32: OID_INT4,
+                     K.INT64: OID_INT8, K.FLOAT64: OID_FLOAT8,
+                     K.DECIMAL: OID_NUMERIC, K.DATE: OID_DATE,
+                     K.TEXT: OID_TEXT}
+    return _KIND_OID.get(getattr(t, "kind", None), 0)
+
+
+def _describe_select(sess, stmt):
+    """RowDescription payload for a SELECT WITHOUT executing it: bind +
+    plan (through the session's plan cache) for the output names, with
+    column type OIDs where the plan's top node exposes typed outputs
+    (reference: exec_describe_portal_message driving printtup's
+    descriptor from the planned targetlist).  None when planning fails
+    — the caller answers NoData and the later Execute surfaces the
+    real error."""
+    try:
+        if hasattr(sess, "_plan_distributed"):
+            dp = sess._plan_distributed(stmt)
+            names = list(dp.output_names)
+            plans = [f.plan for f in dp.fragments]
+        else:
+            planned = sess._plan_select(stmt)
+            names = list(planned.output_names)
+            plans = [planned.plan]
+    except Exception:
+        return None
+    # the CN-side top fragment is often a bare exchange consumer; the
+    # typed targetlist lives on the producer — walk every fragment and
+    # let later (downstream) assignments win per output name
+    types = {}
+
+    def walk(node):
+        if node is None or not hasattr(node, "__dataclass_fields__"):
+            return
+        for attr in ("child", "left", "right"):
+            walk(getattr(node, attr, None))
+        for c in getattr(node, "inputs", None) or []:
+            walk(c)
+        for nm, e in (getattr(node, "outputs", None) or []):
+            t = getattr(e, "type", None)
+            if t is not None:
+                types[nm] = t
+    for p in plans:
+        walk(p)
+    out = struct.pack("!H", len(names))
+    for n in names:
+        out += n.encode() + b"\x00" + struct.pack(
+            "!IhIhih", 0, 0, _oid_of_type(types.get(n)), -1, -1, 0)
+    return out
+
+
 def _command_tag(res) -> bytes:
     cmd = res.command or "SELECT"
     if cmd == "SELECT":
@@ -330,7 +390,11 @@ class PgWireServer:
         from ..sql import ast as A
         from ..sql.parser import parse_sql
         prepared: dict = {}     # name -> (stmt ast, n_params)
-        portals: dict = {}      # name -> (stmt ast with bound params,)
+        # name -> {"stmt": bound ast, "res": Result|None, "sent": n} —
+        # a row-limited Execute suspends the portal (PortalSuspended)
+        # and a later Execute resumes from `sent` (reference:
+        # exec_execute_message's portal re-entry)
+        portals: dict = {}
         self._ready(conn, sess)
         while True:
             typ, payload = conn.read_message()
@@ -374,7 +438,8 @@ class PgWireServer:
             elif typ == b"B":
                 try:
                     portal, stmt = self._do_bind(payload, prepared)
-                    portals[portal] = stmt
+                    portals[portal] = {"stmt": stmt, "res": None,
+                                       "sent": 0}
                     conn.msg(b"2")
                 except Exception as e:
                     self._error(conn, "08P01", str(e))
@@ -382,28 +447,36 @@ class PgWireServer:
             elif typ == b"D":
                 kind = payload[0:1]
                 name, _ = _cstr(payload, 1)
-                stmt = portals.get(name) if kind == b"P" \
-                    else (prepared.get(name) or (None, 0))[0]
-                if stmt is None or not isinstance(stmt, A.SelectStmt):
+                if kind == b"P":
+                    ent = portals.get(name)
+                    stmt = ent["stmt"] if ent else None
+                else:
+                    stmt, nparams = prepared.get(name) or (None, 0)
+                    # statement Describe also answers the parameter
+                    # types (unknown: the engine infers at Bind)
+                    conn.msg(b"t", struct.pack("!H", nparams)
+                             + struct.pack("!I", 0) * nparams)
+                desc = _describe_select(sess, stmt) \
+                    if isinstance(stmt, A.SelectStmt) else None
+                if desc is None:
                     conn.msg(b"n")        # NoData
                 else:
-                    # column names without executing: run with LIMIT 0
-                    # is wasteful — describe lazily as unknown TEXT
-                    conn.msg(b"n")
+                    conn.msg(b"T", desc)
             elif typ == b"E":
                 name, off = _cstr(payload, 0)
                 max_rows = struct.unpack("!i", payload[off:off + 4])[0]
-                stmt = portals.get(name)
-                if stmt is None:
+                ent = portals.get(name)
+                if ent is None:
                     self._error(conn, "34000",
                                 f"portal {name!r} does not exist")
                     self._sync_skip(conn, sess)
                     continue
                 sess.cancel_event.clear()
                 try:
-                    res = sess.execute_ast(stmt)
-                    self._send_results(conn, [res],
-                                       max_rows=max_rows or 0)
+                    if ent["res"] is None:
+                        ent["res"] = sess.execute_ast(ent["stmt"])
+                        ent["sent"] = 0
+                    self._send_portal(conn, ent, max_rows or 0)
                 except Exception as e:
                     self._error(conn, "XX000",
                                 f"{type(e).__name__}: {e}")
@@ -423,6 +496,35 @@ class PgWireServer:
                 self._error(conn, "08P01",
                             f"unsupported message {typ!r}")
                 self._ready(conn, sess)
+
+    def _send_portal(self, conn, ent: dict, max_rows: int):
+        """Emit a portal's rows honoring the Execute row limit: a
+        truncating limit sends PortalSuspended ('s') and KEEPS the
+        portal's position so the next Execute resumes — previously the
+        rows past the limit were silently lost (ADVICE r5 #4)."""
+        res = ent["res"]
+        rows = res.rows or []
+        if res.names:
+            remaining = rows[ent["sent"]:]
+            if max_rows and len(remaining) > max_rows:
+                remaining = remaining[:max_rows]
+                suspended = True
+            else:
+                suspended = False
+            for r in remaining:
+                payload = struct.pack("!H", len(r))
+                for v in r:
+                    b = _fmt(v)
+                    if b is None:
+                        payload += struct.pack("!i", -1)
+                    else:
+                        payload += struct.pack("!I", len(b)) + b
+                conn.msg(b"D", payload)
+            ent["sent"] += len(remaining)
+            if suspended:
+                conn.msg(b"s")
+                return
+        conn.msg(b"C", _command_tag(res) + b"\x00")
 
     def _sync_skip(self, conn, sess):
         """After an extended-protocol error, discard until Sync

@@ -623,6 +623,10 @@ class Cluster:
         gtm_path = os.path.join(datadir, "gtm.json") if datadir else None
         if datadir:
             os.makedirs(datadir, exist_ok=True)
+            # durable deployments keep compiled XLA programs next to the
+            # data: ctl start / process restarts skip the compile wall
+            from ..exec.plancache import enable_persistent_cache
+            enable_persistent_cache(os.path.join(datadir, "xla-cache"))
         self.gtm = GtmCore(gtm_path)
         catpath = os.path.join(datadir, "catalog.json") if datadir else None
         recovered = False
@@ -658,6 +662,26 @@ class Cluster:
         from . import statviews
         statviews.register(self)
         self._init_services()
+        if recovered:
+            self._warm_start()
+
+    def _warm_start(self):
+        """Background warmup after a restart: re-stage recovered tables
+        into the device caches (MVCC columns at their size classes) so
+        the first query pays neither host->device staging nor — with
+        the persistent compilation cache — XLA compiles (ISSUE 1 AOT
+        warmup; scheduled off the query path)."""
+        from ..exec.plancache import warm_async
+
+        def job():
+            for dn in self.datanodes:
+                if not hasattr(dn, "stores"):
+                    continue          # remote DN: stages on first query
+                for name, st in list(dn.stores.items()):
+                    if name.startswith("otb_") or st.row_count() == 0:
+                        continue
+                    dn.cache.get(st, [c.name for c in st.td.columns])
+        warm_async(job)
 
     def _init_services(self):
         import threading
@@ -674,6 +698,10 @@ class Cluster:
         self.audit = AuditLogger(audit_path)
         self._gdd = None
         self._monitor = None
+        # restart survival: persisted catalog.jobs resume scheduling as
+        # soon as the cluster initializes, not only on CREATE JOB
+        from .jobs import resume_jobs
+        resume_jobs(self)
 
     def ensure_gdd(self):
         """Start the cross-node deadlock detector on first DML that can
@@ -723,6 +751,8 @@ class Cluster:
         from ..net.dn_server import RemoteDataNode
         self = object.__new__(cls)
         self.datadir = os.path.dirname(catalog_path) or "."
+        from ..exec.plancache import enable_persistent_cache
+        enable_persistent_cache(os.path.join(self.datadir, "xla-cache"))
         self.catalog = Catalog.load(catalog_path) \
             if os.path.exists(catalog_path) else Catalog()
         if not self.catalog.datanodes():

@@ -65,6 +65,15 @@ def ensure_scheduler(cluster) -> "JobScheduler":
     return sch
 
 
+def resume_jobs(cluster) -> None:
+    """Restart survival (ADVICE r5 #2): a cluster initializing with
+    non-empty persisted catalog.jobs starts the launcher immediately —
+    previously only the CREATE JOB DDL path did, so scheduled jobs
+    silently stopped after every ctl start / Cluster(datadir=...)."""
+    if cluster.catalog.jobs:
+        ensure_scheduler(cluster)
+
+
 class JobScheduler(threading.Thread):
     """One launcher per cluster (reference: the job scheduler
     launcher process).  Ticks every `tick` seconds; a job whose

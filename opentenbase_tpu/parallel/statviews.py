@@ -11,6 +11,8 @@ Views:
 - otb_stat_gtm(current_gts, next_txid, active_txns, prepared_txns)
 - otb_prepared_xacts(gid, state, txid, commit_ts)
 - otb_nodes(name, kind, host, port, healthy)
+- otb_plancache(tier, hits, misses, compiles, compile_ms, evictions,
+  live) — the compiled-program subsystem's counters (exec/plancache.py)
 """
 
 from __future__ import annotations
@@ -48,6 +50,16 @@ STAT_TABLES = {
         ColumnDef("staging_budget_rows", T.INT64),
         ColumnDef("queries", T.INT64),
         ColumnDef("query_seconds", T.FLOAT64)],
+    # compiled-program subsystem telemetry (exec/plancache.py): one row
+    # per tier — fused / mesh hold live XLA executables (bounded by the
+    # global budget), plan / autoprep are the statement-level caches
+    # feeding them.  `live` = live executables (program tiers) or
+    # cached entries (statement tiers); compile_ms is cumulative.
+    "otb_plancache": [
+        ColumnDef("tier", T.TEXT), ColumnDef("hits", T.INT64),
+        ColumnDef("misses", T.INT64), ColumnDef("compiles", T.INT64),
+        ColumnDef("compile_ms", T.FLOAT64),
+        ColumnDef("evictions", T.INT64), ColumnDef("live", T.INT64)],
 }
 
 
@@ -117,6 +129,9 @@ def refresh(cluster, names: list[str]):
                              int(st.get("runs", 0)),
                              int(st.get("failures", 0)),
                              st.get("last_error", "")))
+        elif name == "otb_plancache":
+            from ..exec import plancache
+            rows = list(plancache.stats())
         elif name == "otb_resgroups":
             usage = getattr(cluster, "resgroup_usage", {})
             for gname, g in cluster.catalog.resource_groups.items():

@@ -45,3 +45,15 @@ def fingerprint(stmt: A.Node, mask_literals: bool = True) -> str:
     out: list = []
     _walk(stmt, out, mask_literals)
     return hashlib.sha256("\x1f".join(out).encode()).hexdigest()[:24]
+
+
+def struct_key(obj) -> str:
+    """Stable digest of an arbitrary nested structure (tuples, frozen
+    Expr dataclasses, scalars) — the canonical-fragment-signature hash
+    the compiled-program caches key on (exec/plancache.py).  Unlike
+    hash(), it never collides two distinct plan shapes into one
+    compiled executable, and unlike the raw tuple it is cheap to hold
+    as a dict key."""
+    out: list = []
+    _walk(obj, out, mask=False)
+    return hashlib.sha256("\x1f".join(out).encode()).hexdigest()[:24]

@@ -75,3 +75,29 @@ class TestJobs:
         cl2 = Cluster(datadir=d)
         assert "pj" in cl2.catalog.jobs
         assert cl2.catalog.jobs["pj"]["interval_s"] == 60.0
+
+    def test_jobs_resume_after_restart(self, tmp_path):
+        """Restart survival (ADVICE r5 #2): a cluster initializing with
+        persisted catalog.jobs runs them WITHOUT any new CREATE JOB —
+        previously the scheduler only started from the DDL path, so
+        every ctl start silently stopped all scheduled work."""
+        d = str(tmp_path)
+        cl = Cluster(n_datanodes=2, datadir=d)
+        s = ClusterSession(cl)
+        s.execute("create table rt (k bigint) distribute by shard(k)")
+        s.execute("create job rj schedule 0.2 as "
+                  "'insert into rt values (7)'")
+        cl.checkpoint()
+        cl._job_scheduler.stop()          # the "old process" dies
+        cl2 = Cluster(datadir=d)          # restart: no CREATE JOB here
+        sch = getattr(cl2, "_job_scheduler", None)
+        assert sch is not None and sch.is_alive(), \
+            "persisted jobs must restart the scheduler on cluster init"
+        s2 = ClusterSession(cl2)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if s2.query("select count(*) from rt")[0][0] >= 2:
+                break
+            time.sleep(0.1)
+        assert s2.query("select count(*) from rt")[0][0] >= 2
+        s2.execute("drop job rj")

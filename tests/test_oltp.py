@@ -229,8 +229,10 @@ class TestAutoPrepare:
         assert s.query("select v from apv where k = 11") == [(33,)]
         assert s.query("select v from apv where k = 12") == [(36,)]
         assert s.plan_cache_hits >= h0 + 2     # autoprep, not replans
-        cache = getattr(s.cluster, "_auto_prep", {})
-        assert len(cache) == 1                 # one template
+        from opentenbase_tpu.exec import plancache
+        templates = [k for k in plancache.AUTOPREP._d
+                     if k[0] == id(s.cluster)]
+        assert len(templates) == 1             # one template
 
     def test_literal_kinds(self):
         s = self._mk()

@@ -291,3 +291,104 @@ class TestExtendedProtocol:
         rows = c.extended("select 41 + $1", [1])
         assert rows == [("42",)]
         c.close()
+
+
+class TestDescribeAndFetchSize:
+    """Describe-driven drivers (JDBC, async fetch-size clients): a
+    SELECT portal Describe answers a REAL RowDescription, and a
+    row-limited Execute sends PortalSuspended and keeps the portal's
+    position for the next Execute (ADVICE r5 #4)."""
+
+    def _drive(self, c, msgs):
+        """Send raw extended-protocol messages + Sync; return the
+        ordered reply list [(type, payload)] up to ReadyForQuery."""
+        for typ, payload in msgs:
+            c._msg(typ, payload)
+        c._msg(b"S")
+        out = []
+        while True:
+            typ, payload = c._read()
+            if typ == b"Z":
+                return out
+            out.append((typ, payload))
+
+    @staticmethod
+    def _parse_rowdesc(payload):
+        ncols = struct.unpack("!H", payload[:2])[0]
+        names, oids, off = [], [], 2
+        for _ in range(ncols):
+            end = payload.index(b"\x00", off)
+            names.append(payload[off:end].decode())
+            oid = struct.unpack("!I", payload[end + 7:end + 11])[0]
+            oids.append(oid)
+            off = end + 1 + 18
+        return names, oids
+
+    def test_describe_portal_row_description(self, server):
+        c = MiniPg(server.host, server.port, "u", "pw")
+        c.query("create table pgd (k bigint primary key, nm text) "
+                "distribute by shard(k)")
+        c.query("insert into pgd values (1, 'x')")
+        sql = "select k, nm from pgd"
+        bind = b"\x00\x00" + struct.pack("!HHH", 0, 0, 0)
+        replies = self._drive(c, [
+            (b"P", b"\x00" + sql.encode() + b"\x00"
+             + struct.pack("!H", 0)),
+            (b"B", bind),
+            (b"D", b"P\x00"),
+        ])
+        kinds = [t for t, _ in replies]
+        assert b"T" in kinds, f"Describe answered {kinds}, not a " \
+            "RowDescription"
+        names, oids = self._parse_rowdesc(
+            next(p for t, p in replies if t == b"T"))
+        assert names == ["k", "nm"]
+        assert oids[0] == 20 and oids[1] == 25   # int8, text
+        c.close()
+
+    def test_describe_statement_param_description(self, server):
+        c = MiniPg(server.host, server.port, "u", "pw")
+        c.query("create table pgds (k bigint primary key) "
+                "distribute by shard(k)")
+        sql = "select k from pgds where k = $1"
+        replies = self._drive(c, [
+            (b"P", b"st1\x00" + sql.encode() + b"\x00"
+             + struct.pack("!H", 0)),
+            (b"D", b"Sst1\x00"),
+        ])
+        kinds = [t for t, _ in replies]
+        assert b"t" in kinds                     # ParameterDescription
+        tpay = next(p for t, p in replies if t == b"t")
+        assert struct.unpack("!H", tpay[:2])[0] == 1
+        c.close()
+
+    def test_fetch_size_suspends_and_resumes(self, server):
+        c = MiniPg(server.host, server.port, "u", "pw")
+        c.query("create table pgf (k bigint primary key) "
+                "distribute by shard(k)")
+        c.query("insert into pgf values (1), (2), (3), (4), (5)")
+        sql = "select k from pgf order by k"
+        bind = b"\x00\x00" + struct.pack("!HHH", 0, 0, 0)
+        replies = self._drive(c, [
+            (b"P", b"\x00" + sql.encode() + b"\x00"
+             + struct.pack("!H", 0)),
+            (b"B", bind),
+            (b"E", b"\x00" + struct.pack("!i", 2)),   # fetch 2
+            (b"E", b"\x00" + struct.pack("!i", 2)),   # next 2
+            (b"E", b"\x00" + struct.pack("!i", 0)),   # the rest
+        ])
+        kinds = [t for t, _ in replies]
+        # two suspended fetches, then the final CommandComplete —
+        # and EVERY row arrives exactly once
+        assert kinds.count(b"s") == 2
+        assert kinds.count(b"C") == 1
+        rows = [p for t, p in replies if t == b"D"]
+        vals = []
+        for p in rows:
+            ln = struct.unpack("!I", p[2:6])[0]
+            vals.append(p[6:6 + ln].decode())
+        assert vals == ["1", "2", "3", "4", "5"]
+        # suspension order: 2 rows, s, 2 rows, s, 1 row, C
+        seq = [t for t, _ in replies if t in (b"D", b"s", b"C")]
+        assert seq == [b"D", b"D", b"s", b"D", b"D", b"s", b"D", b"C"]
+        c.close()

@@ -185,3 +185,70 @@ class TestStatView:
         rows = s.query("select name, concurrency, queries from "
                        "otb_resgroups")   # query_seconds also exposed
         assert ("viewg", 4, 1) in rows
+
+
+class TestSlotLeases:
+    """Per-slot acquirer identity + lease reaping (ADVICE r5 #3): a
+    crashed coordinator can no longer permanently shrink a group's
+    cluster-wide concurrency."""
+
+    def test_lease_expiry_reaps_crashed_owner(self):
+        core = GtmCore()
+        assert core.resq_acquire("g", 1, owner="cn-dead",
+                                 lease_s=0.05)
+        # the "crashed" coordinator never releases; the cap is full
+        assert not core.resq_acquire("g", 1, owner="cn-live",
+                                     lease_s=30)
+        time.sleep(0.08)
+        # lease expired: the slot is reaped at the next acquire
+        assert core.resq_acquire("g", 1, owner="cn-live", lease_s=30)
+        assert core.resq_counts() == {"g": 1}
+        core.resq_release("g", owner="cn-live")
+        assert core.resq_counts() == {"g": 0}
+
+    def test_release_matches_owner(self):
+        core = GtmCore()
+        assert core.resq_acquire("g", 2, owner="a")
+        assert core.resq_acquire("g", 2, owner="b")
+        core.resq_release("g", owner="b")
+        assert core.resq_counts()["g"] == 1   # a's slot survives
+        assert core.resq_acquire("g", 2, owner="c")
+        assert core.resq_counts()["g"] == 2   # a + c
+        core.resq_disconnect("a")
+        core.resq_disconnect("c")
+        assert core.resq_counts()["g"] == 0
+
+    def test_connection_close_reaps_over_the_wire(self):
+        """The GTM server mirrors gtm_resqueue.c's per-connection
+        cleanup: a coordinator whose GTM connection dies gets every
+        slot it acquired over that connection reaped."""
+        from opentenbase_tpu.gtm.server import GtmClient
+        core = GtmCore()
+        srv = GtmServer(core).start()
+        try:
+            c1 = GtmClient(srv.host, srv.port)
+            assert c1.resq_acquire("w", 1, owner="cn1", lease_s=300)
+            c2 = GtmClient(srv.host, srv.port)
+            assert not c2.resq_acquire("w", 1, owner="cn2",
+                                       lease_s=300)
+            c1.close()               # cn1's process "crashes"
+            deadline = time.monotonic() + 10
+            ok = False
+            while time.monotonic() < deadline and not ok:
+                ok = c2.resq_acquire("w", 1, owner="cn2", lease_s=300)
+                if not ok:
+                    time.sleep(0.05)
+            assert ok, "disconnect must reap the dead owner's slot"
+            c2.resq_release("w", owner="cn2")
+            c2.close()
+        finally:
+            srv.stop()
+
+    def test_session_stamps_identity_on_slots(self):
+        cl, s = _mk_cluster()
+        s.execute("create resource group idg with (concurrency = 2)")
+        s.execute("set resource_group = idg")
+        assert s.query("select count(*) from rg") == [(5000,)]
+        # slots drained back to zero after the query
+        assert cl.gtm.resq_counts().get("idg", 0) == 0
+        s.execute("set resource_group = none")
