@@ -155,6 +155,10 @@ class DistExecutor:
         # when the mesh tier declined, fallback_reason says why
         self.tier: str = ""
         self.fallback_reason: str = ""
+        # staging wall time of the mesh run (ms): host->device upload
+        # cost, ~0 on a buffer-pool warm repeat (bench splits engine_ms
+        # into stage_ms vs compute_ms with it)
+        self.stage_ms: float = 0.0
 
     # ------------------------------------------------------------------
     def run(self, dp: DistPlan) -> DBatch:
@@ -240,6 +244,7 @@ class DistExecutor:
                     gathered, executed = runner.run(
                         dp, self.snapshot_ts, self.txid, self.params)
                     top = dp.fragments[dp.top_fragment]
+                    self.stage_ms = runner.last_stage_ms
                     self.tier = "mesh"   # overwritten by later subplans:
                     # the LAST _run_distplan call is the main plan, so the
                     # recorded tier is always the main plan's

@@ -94,6 +94,9 @@ class ClusterSession:
         # asserted by the mesh CI suite): 'mesh' | 'fqs' | 'host'
         self.last_tier = ""
         self.last_fallback = ""
+        # mesh staging wall time of the last SELECT (ms): ~0 when the
+        # device buffer pool served every table warm
+        self.last_stage_ms = 0.0
         # cumulative tier usage + fallback reasons: the CI proof that the
         # device data plane carries the benchmark suites with no silent
         # host fallbacks
@@ -1011,6 +1014,7 @@ class ClusterSession:
                 queue.release()
         names, rows = materialize(batch, dp.output_names)
         self.last_tier = ex.tier
+        self.last_stage_ms = ex.stage_ms
         self.last_fallback = ex.fallback_reason
         self.tier_counts[ex.tier] = self.tier_counts.get(ex.tier, 0) + 1
         if ex.tier == "host" and ex.fallback_reason:

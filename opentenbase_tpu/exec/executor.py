@@ -30,10 +30,10 @@ from ..ops import kernels as K
 from ..plan import exprs as E
 from ..plan import physical as P
 from ..plan.planner import PlannedStmt, rewrite
-from ..storage.batch import next_pow2, size_class
+from ..storage.batch import next_pow2
 from ..storage.store import ABORTED_TS, TableStore
 from ..utils.dtypes import (bits_to_float, dev_dtype, device_float,
-                            float_to_bits, stage_cast)
+                            float_to_bits)
 from ..utils.hashing import hash_columns_jax
 
 
@@ -63,77 +63,20 @@ def _empty_batch(types: dict[str, SqlType], dicts: dict) -> DBatch:
 
 
 class DeviceTableCache:
-    """Staged (padded, concatenated) device columns per table version —
-    the bufmgr analog: device HBM caches host chunks."""
-
-    def __init__(self):
-        self._cache: dict[tuple, tuple] = {}
+    """Per-node facade over the process-global device buffer pool
+    (storage/bufferpool.py) — the bufmgr analog: device HBM caches host
+    chunks, version-keyed, under one OTB_DEVICE_CACHE_BYTES budget with
+    LRU eviction and an incremental tail path for append-only growth.
+    Kept as a facade so every existing `node.cache` call site works
+    unchanged while all nodes share one budget + telemetry."""
 
     def get(self, store: TableStore, colnames: list[str]):
-        key = (id(store),)
-        ver = store.version
-        hit = self._cache.get(key)
-        nullwant = {f"__null.{c}" for c in colnames
-                    if c in store.null_columns}
-        if hit is not None and hit[0] == ver and \
-                (set(colnames) | nullwant) <= set(hit[1]):
-            return hit[1], hit[2]
-        n = store.row_count()
-        padded = size_class(max(n, 1))
-        arrs = {}
-        want = set(colnames) | {"__xmin_ts", "__xmax_ts", "__xmin_txid",
-                                "__xmax_txid"} | nullwant
-        if hit is not None and hit[0] == ver:
-            # same version, new columns: merge — keep already-staged
-            # device buffers, stage only what's missing
-            arrs.update(hit[1])
-            want -= set(arrs)
-        for name in want:
-            if name.startswith("__null."):
-                col = name[len("__null."):]
-                parts = [ch.nulls[col][:ch.nrows] if col in ch.nulls
-                         else np.zeros(ch.nrows, dtype=bool)
-                         for _, ch in store.scan_chunks()]
-                host = np.concatenate(parts) if parts else \
-                    np.zeros(0, dtype=bool)
-                buf = np.zeros(padded, dtype=bool)
-                buf[:n] = host
-                arrs[name] = jax.device_put(buf)
-                continue
-            if name == "__xmin_ts":
-                parts = [ch.xmin_ts[:ch.nrows] for _, ch in
-                         store.scan_chunks()]
-                dt = np.int64
-            elif name == "__xmax_ts":
-                parts = [ch.xmax_ts[:ch.nrows] for _, ch in
-                         store.scan_chunks()]
-                dt = np.int64
-            elif name == "__xmin_txid":
-                parts = [ch.xmin_txid[:ch.nrows] for _, ch in
-                         store.scan_chunks()]
-                dt = np.int64
-            elif name == "__xmax_txid":
-                parts = [ch.xmax_txid[:ch.nrows] for _, ch in
-                         store.scan_chunks()]
-                dt = np.int64
-            else:
-                parts = [ch.columns[name][:ch.nrows] for _, ch in
-                         store.scan_chunks()]
-                ct = store.td.column(name).type
-                dt = dev_dtype(ct)
-                if not parts:
-                    parts = [np.empty((0, *ct.shape_suffix), dt)]
-            if not parts:
-                parts = [np.empty(0, dt)]
-            host = stage_cast(np.concatenate(parts))
-            buf = np.zeros((padded, *host.shape[1:]), dtype=host.dtype)
-            buf[:n] = host
-            arrs[name] = jax.device_put(buf)
-        self._cache[key] = (ver, arrs, n)
-        return arrs, n
+        from ..storage.bufferpool import POOL
+        return POOL.get_device(store, colnames)
 
     def invalidate(self, store: TableStore):
-        self._cache.pop((id(store),), None)
+        from ..storage.bufferpool import POOL
+        POOL.invalidate(store)
 
 
 @dataclasses.dataclass

@@ -28,6 +28,12 @@ TEXT columns cross exchanges as dictionary CODES: staging builds one
 UNION dictionary per column across all datanodes (host work proportional
 to dictionary size, not rows), so no decode/re-encode ever touches the
 row data — the host exchange tier's remaining python cost disappears.
+
+Staged tables are DEVICE-RESIDENT across queries via the shared buffer
+pool (storage/bufferpool.py): entries are keyed by the per-DN version
+tuple, so a warm repeat stages nothing at all, append-only growth
+uploads only the per-DN tail rows (union dictionaries extend in place),
+and any other mutation drops the stale arrays lazily.
 """
 
 from __future__ import annotations
@@ -109,8 +115,12 @@ class MeshRunner:
         self.cluster = cluster
         self.mesh = make_mesh(cluster.ndn)
         self.axis = self.mesh.axis_names[0]
-        self._staged: dict = {}
+        # staged tables live in the SHARED device buffer pool
+        # (storage/bufferpool.py): version-keyed residency across
+        # queries under one byte budget, with an incremental tail path
+        # for append-only growth — this runner only assembles entries
         self._snapshots: dict = {}   # (dn_index, table) -> snapshot
+        self.last_stage_ms = 0.0     # staging wall time of the last run
         # compiled shard_map programs live in the SHARED program cache
         # (exec/plancache.py MESH tier: bounded LRU, global
         # live-executable budget, hit/miss telemetry), keyed per
@@ -221,18 +231,15 @@ class MeshRunner:
     # ------------------------------------------------------------------
     def _snapshot(self, dn, name: str) -> dict:
         """One DN's live columns + dictionaries at its current version —
-        direct for in-process stores, over the wire for TCP datanodes
-        (version-cached, so an unchanged table never re-ships)."""
+        the shared buffer-pool host snapshot for in-process stores, over
+        the wire for TCP datanodes (both version-cached, so an unchanged
+        table never re-concatenates or re-ships)."""
         if hasattr(dn, "stores"):
             st = dn.stores.get(name)
             if st is None:
                 raise MeshUnsupported(f"table {name} missing on dn")
-            cols = st.host_live_columns([c.name for c in st.td.columns])
-            n = len(next(iter(cols.values()))) if cols \
-                else st.row_count()
-            return {"version": st.version, "count": n, "cols": cols,
-                    "dicts": {c: d.values for c, d in st.dicts.items()},
-                    "null_columns": set(st.null_columns)}
+            from ..storage.bufferpool import POOL
+            return POOL.host_snapshot(st)
         key = (dn.index, name)
         cached = self._snapshots.get(key)
         ver = dn.table_version(name)
@@ -267,26 +274,37 @@ class MeshRunner:
         return v
 
     def _stage_table(self, name: str) -> _StagedTable:
+        from ..storage.bufferpool import POOL, MeshEntry
         vkey = tuple(self._version_of(dn, name)
                      for dn in self.cluster.datanodes)
-        hit = self._staged.get(name)
-        if hit is not None and hit.vkey == vkey:
-            return hit
+        ent = POOL.mesh_get(self, name, vkey)
+        if ent is not None:
+            return ent.staged
+        stale = POOL.mesh_peek(self, name)
+        if stale is not None:
+            entry = self._stage_incremental(name, stale, vkey)
+            if entry is not None:
+                POOL.mesh_put(self, name, entry)
+                return entry.staged
         snaps = [self._snapshot(dn, name)
                  for dn in self.cluster.datanodes]
         vkey = tuple(s["version"] for s in snaps)
         td = self.cluster.catalog.table(name)
         ndn = len(snaps)
 
-        # union dictionaries + per-store code LUTs
+        # union dictionaries + per-store code LUTs; the index/LUT state
+        # rides along in the pool entry so append-only growth can EXTEND
+        # the union (existing codes stay valid) instead of rebuilding
         union_dicts: dict[str, list] = {}
         luts: dict[str, list[np.ndarray]] = {}
+        dict_state: dict[str, dict] = {}
         for c in td.columns:
             if c.type.kind != TypeKind.TEXT:
                 continue
             values: list[str] = []
             index: dict[str, int] = {}
             col_luts = []
+            dn_lens = []
             for s in snaps:
                 vals = s["dicts"].get(c.name, [])
                 lut = np.empty(max(len(vals), 1), dtype=np.int32)
@@ -298,8 +316,14 @@ class MeshRunner:
                         index[v] = j
                     lut[i] = j
                 col_luts.append(lut)
+                dn_lens.append(len(vals))
             union_dicts[c.name] = values
             luts[c.name] = col_luts
+            dict_state[c.name] = {
+                "index": index,
+                "luts": [col_luts[i][:dn_lens[i]].copy()
+                         for i in range(ndn)],
+                "dn_lens": dn_lens}
 
         null_columns = set()
         for s in snaps:
@@ -324,6 +348,7 @@ class MeshRunner:
         padded = size_class(max(max(counts), 1))
         sh = NamedSharding(self.mesh, PS(self.axis))
         arrs = {}
+        nbytes = 0
         from ..utils.dtypes import stage_cast
         for colname, sample in per_dn[0].items():
             sample = stage_cast(sample)
@@ -334,14 +359,121 @@ class MeshRunner:
                 buf[si, :len(a)] = a
             arrs[colname] = jax.device_put(
                 buf.reshape(ndn * padded, *sample.shape[1:]), sh)
+            nbytes += buf.nbytes
         nrows = jax.device_put(np.asarray(counts, np.int64), sh)
         staged = _StagedTable(arrs, nrows, padded,
                               _MeshStoreView(td, union_dicts,
                                              null_columns), vkey)
-        self._staged[name] = staged
-        if len(self._staged) > 64:
-            self._staged.pop(next(iter(self._staged)))
+        POOL.note_upload(nbytes)
+        POOL.mesh_put(self, name, MeshEntry(
+            name, vkey, staged, list(counts), dict_state,
+            set(null_columns), nbytes))
         return staged
+
+    def _stage_incremental(self, name: str, ent, vkey: tuple):
+        """Append-only growth on every DN: keep the resident sharded
+        prefix, upload only the per-DN tail rows, extend the union
+        dictionaries in place (append-only: resident codes stay valid).
+        Returns a fresh pool entry, or None when any DN changed
+        non-append-only (or shifted size class) — caller restages."""
+        from ..storage.bufferpool import MeshEntry, POOL
+        from ..storage.batch import size_class
+        from ..utils.dtypes import stage_cast
+        dns = self.cluster.datanodes
+        if any(not hasattr(dn, "stores") for dn in dns):
+            return None     # remote DNs: no mutation log to consult
+        stores = []
+        for dn in dns:
+            st = dn.stores.get(name)
+            if st is None:
+                return None
+            stores.append(st)
+        new_counts = []
+        for i, st in enumerate(stores):
+            if st.version != vkey[i]:
+                return None     # raced a writer; take the full path
+            if vkey[i] != ent.vkey[i] and not st.appended_only_since(
+                    ent.vkey[i], ent.counts[i]):
+                return None
+            new_counts.append(st.row_count())
+        ndn = len(stores)
+        P = ent.staged.padded
+        if size_class(max(max(new_counts), 1)) != P:
+            return None     # size class moved: buffers must grow
+        td = self.cluster.catalog.table(name)
+        value_cols = [c.name for c in td.columns]
+        tails = [st.host_live_columns(value_cols, start=ent.counts[i])
+                 for i, st in enumerate(stores)]
+
+        # extend union dictionaries + LUTs, remap tail codes
+        view = ent.staged.view
+        for c in td.columns:
+            if c.type.kind != TypeKind.TEXT:
+                continue
+            state = ent.dict_state[c.name]
+            values = view.dicts[c.name].values
+            index = state["index"]
+            for i, st in enumerate(stores):
+                vals = st.dicts[c.name].values
+                lold = state["dn_lens"][i]
+                if len(vals) > lold:
+                    ext = np.empty(len(vals) - lold, np.int32)
+                    for j, v in enumerate(vals[lold:]):
+                        code = index.get(v)
+                        if code is None:
+                            code = len(values)
+                            values.append(v)
+                            index[v] = code
+                        ext[j] = code
+                    state["luts"][i] = np.concatenate(
+                        [state["luts"][i], ext])
+                    state["dn_lens"][i] = len(vals)
+                tc = tails[i]
+                if len(tc[c.name]):
+                    tc[c.name] = state["luts"][i][tc[c.name]]
+
+        new_null = set(ent.null_columns)
+        for st in stores:
+            new_null |= set(st.null_columns)
+
+        sh = NamedSharding(self.mesh, PS(self.axis))
+        arrs = {}
+        up = 0
+        tail_total = sum(new_counts) - sum(ent.counts)
+
+        def tail_piece(colname, i, length):
+            t = tails[i].get(colname)
+            if t is None:     # null mask with no NULLs on this DN
+                t = np.zeros(length, bool)
+            return stage_cast(t)
+
+        for colname, devarr in ent.staged.arrs.items():
+            new = devarr
+            for i in range(ndn):
+                lo, hi = ent.counts[i], new_counts[i]
+                if hi <= lo:
+                    continue
+                t = tail_piece(colname, i, hi - lo)
+                new = new.at[i * P + lo:i * P + hi].set(jnp.asarray(t))
+                up += t.nbytes
+            arrs[colname] = jax.device_put(new, sh)
+        for c in sorted(new_null - ent.null_columns):
+            # first NULLs arrived in a tail: the prefix mask is zeros
+            buf = jnp.zeros(ndn * P, bool)
+            for i in range(ndn):
+                lo, hi = ent.counts[i], new_counts[i]
+                if hi <= lo:
+                    continue
+                buf = buf.at[i * P + lo:i * P + hi].set(
+                    jnp.asarray(tail_piece(f"__null.{c}", i, hi - lo)))
+            arrs[f"__null.{c}"] = jax.device_put(buf, sh)
+            view.null_columns.add(c)
+        nrows = jax.device_put(np.asarray(new_counts, np.int64), sh)
+        staged = _StagedTable(arrs, nrows, P, view, vkey)
+        nbytes = sum(int(a.nbytes) for a in arrs.values())
+        POOL.note_upload(up, tail_rows=tail_total)
+        return MeshEntry(name, vkey, staged, list(new_counts),
+                         ent.dict_state, new_null, nbytes)
 
     # ------------------------------------------------------------------
     # exchange collectives (inside the traced program)
@@ -488,7 +620,9 @@ class MeshRunner:
             if not isinstance(v, (int, float, str, bool, type(None))):
                 raise MeshUnsupported("non-scalar init-plan param")
 
+        t_stage = time.perf_counter()
         staged = {t: self._stage_table(t) for t in tables}
+        self.last_stage_ms = (time.perf_counter() - t_stage) * 1e3
         if not staged:
             raise MeshUnsupported("no mesh-stageable scans")
         base_pad = max((s.padded for s in staged.values()), default=64)
@@ -589,7 +723,9 @@ class MeshRunner:
                        tuple(getattr(ex, "sort_keys", None) or ()),
                        getattr(ex, "limit", None))
                       for ex in dp.exchanges),
-                tuple((t, staged[t].padded) for t in table_names),
+                tuple((t, staged[t].padded,
+                       tuple(sorted(staged[t].arrs)))
+                      for t in table_names),
             ))
         except TypeError:
             raise MeshUnsupported("unhashable plan content") from None
@@ -724,7 +860,11 @@ class MeshRunner:
                   for ex in dp.exchanges),
             tuple((t, staged[t].padded,
                    tuple(sorted((c, len(d.values)) for c, d in
-                         staged[t].view.dicts.items())))
+                         staged[t].view.dicts.items())),
+                   # the staged-array namespace: a null column appearing
+                   # after DML adds a __null input, which must recompile
+                   # (the flat-arg list and in_specs grow with it)
+                   tuple(sorted(staged[t].arrs)))
                   for t in table_names),
             tuple(sorted(factors.items())),
             tuple(sorted(mults.items())),

@@ -201,8 +201,10 @@ class SpillDriver:
 
     def _stage_for(self, subtree, infos_sel: dict):
         """Stage each scanned table's selected rows; returns ctx.staged.
-        The host concatenation is built once per (store, version) and
-        sliced per pass."""
+        The host concatenation comes from the buffer pool's snapshot
+        when a current one is resident (mesh staging / dn_server built
+        it already), else it is built once per (store, version) locally
+        and sliced per pass."""
         staged = {}
         for info, sel in infos_sel.items():
             needed = sorted(_needed_cols(subtree, info.node.alias)
@@ -210,7 +212,17 @@ class SpillDriver:
             hkey = (id(info.store), info.store.version, tuple(needed))
             host = self._host_cache.get(hkey)
             if host is None:
-                host = info.store.host_live_columns(needed)
+                from ..storage.bufferpool import POOL
+                snap = POOL.peek_host_snapshot(info.store)
+                if snap is not None:
+                    keys = set(needed) | {
+                        "__xmin_ts", "__xmax_ts", "__xmin_txid",
+                        "__xmax_txid"} | {
+                        f"__null.{c}" for c in needed
+                        if c in info.store.null_columns}
+                    host = {k: snap["cols"][k] for k in keys}
+                else:
+                    host = info.store.host_live_columns(needed)
                 self._host_cache = {hkey: host, **{
                     k: v for k, v in list(self._host_cache.items())[-3:]}}
             arrs, n = stage_padded(host, sel)

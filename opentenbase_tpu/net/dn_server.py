@@ -173,16 +173,16 @@ def _dispatch(node: DataNode, msg: dict):
         # driver-host mesh staging: ship this DN's live columns (value +
         # MVCC sys + null masks), dictionaries, and version to the mesh
         # owner (reference: the FN receiver pulling producer pages,
-        # forwardrecv.c — here one bulk snapshot instead of a stream)
+        # forwardrecv.c — here one bulk snapshot instead of a stream).
+        # Served from the shared buffer pool's version-keyed host
+        # snapshot, so an unchanged table never re-concatenates even
+        # across coordinators.
         st = node.stores.get(msg["table"])
         if st is None:
             return None
-        cols = st.host_live_columns([c.name for c in st.td.columns])
-        n = len(next(iter(cols.values()))) if cols else st.row_count()
-        return {"version": st.version, "count": n, "cols": cols,
-                "dicts": {c: list(d.values)
-                          for c, d in st.dicts.items()},
-                "null_columns": sorted(st.null_columns)}
+        from ..storage.bufferpool import POOL
+        snap = POOL.host_snapshot(st)
+        return {**snap, "null_columns": sorted(snap["null_columns"])}
     if op == "ping":
         return "pong"
     raise ValueError(f"unknown op {op!r}")

@@ -13,6 +13,9 @@ Views:
 - otb_nodes(name, kind, host, port, healthy)
 - otb_plancache(tier, hits, misses, compiles, compile_ms, evictions,
   live) — the compiled-program subsystem's counters (exec/plancache.py)
+- otb_buffercache(table_name, hits, misses, bytes_live, evictions,
+  invalidations) — the device buffer pool's per-table counters
+  (storage/bufferpool.py)
 """
 
 from __future__ import annotations
@@ -60,6 +63,17 @@ STAT_TABLES = {
         ColumnDef("misses", T.INT64), ColumnDef("compiles", T.INT64),
         ColumnDef("compile_ms", T.FLOAT64),
         ColumnDef("evictions", T.INT64), ColumnDef("live", T.INT64)],
+    # device buffer-pool telemetry (storage/bufferpool.py): one row per
+    # user table that has touched the pool — device-resident bytes and
+    # hit/miss/eviction/invalidation counters across BOTH executor
+    # tiers (single-device scans and mesh staging).  The compiled-
+    # program view's twin: plancache kills repeat compiles, this kills
+    # repeat uploads.
+    "otb_buffercache": [
+        ColumnDef("table_name", T.TEXT), ColumnDef("hits", T.INT64),
+        ColumnDef("misses", T.INT64), ColumnDef("bytes_live", T.INT64),
+        ColumnDef("evictions", T.INT64),
+        ColumnDef("invalidations", T.INT64)],
 }
 
 
@@ -132,6 +146,9 @@ def refresh(cluster, names: list[str]):
         elif name == "otb_plancache":
             from ..exec import plancache
             rows = list(plancache.stats())
+        elif name == "otb_buffercache":
+            from ..storage.bufferpool import POOL
+            rows = list(POOL.stats_rows())
         elif name == "otb_resgroups":
             usage = getattr(cluster, "resgroup_usage", {})
             for gname, g in cluster.catalog.resource_groups.items():

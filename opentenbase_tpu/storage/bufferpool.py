@@ -1,0 +1,457 @@
+"""Device-resident columnar buffer pool: version-keyed HBM residency.
+
+Reference analog: the buffer manager (src/backend/storage/buffer) — the
+reference keeps hot heap pages pinned in shared_buffers so executors
+never re-read disk for unchanged data.  Here the device HBM plays that
+role for host-RAM chunk storage: staged (padded, concatenated, possibly
+mesh-sharded) device columns stay resident ACROSS queries, keyed by the
+per-store monotonic `version` counter (storage/store.py — bumped on
+every mutation, process-globally unique so a recycled id() can never
+alias).  The round-5 bench showed why: the mesh tier re-uploaded a full
+host snapshot of every referenced table per query and ran Q1 at 0.27-
+0.51 GB/s effective bandwidth — staging, not compute, was the bottleneck.
+
+One pool serves every execution tier:
+
+- single-device entries (exec/executor.py DeviceTableCache facade):
+  per-store padded device columns, the fused tier and FQS scans read
+  them; staged once per (store, version, column set).
+- mesh entries (exec/mesh_exec.py): per-runner sharded arrays + union
+  dictionaries + per-DN counts, keyed by the per-DN version tuple.
+- host snapshots: the full live-row concatenation one store ships to
+  the mesh owner (net/dn_server.py stage_table) or slices for spill
+  passes (exec/spill.py) — version-keyed so an unchanged table never
+  re-concatenates.
+
+Budget + eviction mirror the compiled-program subsystem
+(exec/plancache.py): one byte budget (OTB_DEVICE_CACHE_BYTES) over all
+device entries, LRU eviction across both tiers; host snapshots have
+their own smaller budget (OTB_HOST_SNAPSHOT_BYTES).
+
+Invalidation is exact and lazy: DML/DDL/vacuum bump the store version,
+the stale entry is detected (and dropped or tail-patched) on next
+access; DROP/TRUNCATE paths call invalidate() eagerly so big tables
+release HBM immediately.  Append-only growth takes the incremental
+path: TableStore's mutation log proves every change since the cached
+version touched only rows past the cached count, so staging uploads
+just the tail instead of re-shipping the prefix (the dominant OLTP/
+bulk-load pattern: INSERT then re-query).
+
+Telemetry per table — hits / misses / bytes_live / evictions /
+invalidations — surfaces as the otb_buffercache stat view
+(parallel/statviews.py), next to otb_plancache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import threading
+import weakref
+
+import numpy as np
+
+_LOCK = threading.RLock()
+_SEQ = itertools.count()
+
+_SYS_COLS = ("__xmin_ts", "__xmax_ts", "__xmin_txid", "__xmax_txid")
+_NULL = "__null."
+
+
+def _budget() -> int:
+    """Byte budget over all device-resident entries (both tiers)."""
+    try:
+        return int(os.environ.get("OTB_DEVICE_CACHE_BYTES",
+                                  str(8 << 30)))
+    except ValueError:
+        return 8 << 30
+
+
+def _host_budget() -> int:
+    """Byte budget for cached host snapshots (host RAM, not HBM)."""
+    try:
+        return int(os.environ.get("OTB_HOST_SNAPSHOT_BYTES",
+                                  str(1 << 30)))
+    except ValueError:
+        return 1 << 30
+
+
+@dataclasses.dataclass
+class DevEntry:
+    """Single-device tier: one store's padded device columns."""
+    table: str
+    version: int
+    arrs: dict            # staged name -> device array [padded, ...]
+    n: int                # live (staged) row count
+    null_at_cache: set    # store.null_columns when staged
+    nbytes: int
+
+
+@dataclasses.dataclass
+class MeshEntry:
+    """Mesh tier: one table's sharded arrays + union-dict state."""
+    table: str
+    vkey: tuple           # per-DN store versions at staging time
+    staged: object        # exec/mesh_exec._StagedTable
+    counts: list          # per-DN live row counts
+    dict_state: dict      # TEXT col -> {"index", "luts", "dn_lens"}
+    null_columns: set     # union null-column set at staging time
+    nbytes: int
+
+
+class DeviceBufferPool:
+    """Version-keyed device residency with one LRU byte budget."""
+
+    def __init__(self):
+        self._dev: dict = {}    # id(store) -> [seq, DevEntry]
+        self._mesh: dict = {}   # (runner_id, table) -> [seq, MeshEntry]
+        self._host: dict = {}   # id(store) -> [seq, snapshot, nbytes]
+        # entries must not outlive their owners: a weakref per store /
+        # mesh runner drops the owner's entries at GC, so the pool never
+        # pins device arrays for dead nodes (the per-node caches this
+        # replaces died with their nodes; the shared pool must match)
+        self._refs: dict = {}   # id(owner) -> weakref
+        # table -> [hits, misses, evictions, invalidations]
+        self._stats: dict[str, list] = {}
+        self.uploaded_bytes = 0   # cumulative host->device bytes staged
+        self.tail_rows = 0        # rows staged via the incremental path
+
+    def _watch_store(self, store):
+        # caller holds _LOCK
+        key = id(store)
+        if key in self._refs:
+            return
+
+        def drop(_r, pool=weakref.ref(self), key=key):
+            p = pool()
+            if p is None:
+                return
+            with _LOCK:
+                p._dev.pop(key, None)
+                p._host.pop(key, None)
+                p._refs.pop(key, None)
+        try:
+            self._refs[key] = weakref.ref(store, drop)
+        except TypeError:
+            pass
+
+    def _watch_runner(self, runner):
+        # caller holds _LOCK
+        key = id(runner)
+        if key in self._refs:
+            return
+
+        def drop(_r, pool=weakref.ref(self), key=key):
+            p = pool()
+            if p is None:
+                return
+            with _LOCK:
+                for k in [k for k in p._mesh if k[0] == key]:
+                    p._mesh.pop(k, None)
+                p._refs.pop(key, None)
+        try:
+            self._refs[key] = weakref.ref(runner, drop)
+        except TypeError:
+            pass
+
+    # -- accounting -----------------------------------------------------
+    def _tstats(self, table: str) -> list:
+        s = self._stats.get(table)
+        if s is None:
+            s = self._stats[table] = [0, 0, 0, 0]
+        return s
+
+    def note_upload(self, nbytes: int, tail_rows: int = 0):
+        with _LOCK:
+            self.uploaded_bytes += int(nbytes)
+            self.tail_rows += int(tail_rows)
+
+    def stats_rows(self) -> list[tuple]:
+        """(table, hits, misses, bytes_live, evictions, invalidations)
+        rows for the otb_buffercache view (system otb_ tables omitted)."""
+        with _LOCK:
+            live: dict[str, int] = {}
+            for _s, e in self._dev.values():
+                live[e.table] = live.get(e.table, 0) + e.nbytes
+            for _s, e in self._mesh.values():
+                live[e.table] = live.get(e.table, 0) + e.nbytes
+            rows = []
+            for t in sorted(set(self._stats) | set(live)):
+                if t.startswith("otb_"):
+                    continue
+                h, m, ev, inv = self._stats.get(t, (0, 0, 0, 0))
+                rows.append((t, h, m, live.get(t, 0), ev, inv))
+            return rows
+
+    def totals(self) -> dict:
+        with _LOCK:
+            return {
+                "hits": sum(s[0] for s in self._stats.values()),
+                "misses": sum(s[1] for s in self._stats.values()),
+                "evictions": sum(s[2] for s in self._stats.values()),
+                "invalidations": sum(s[3] for s in self._stats.values()),
+                "bytes_live": sum(e.nbytes for _s, e in
+                                  self._dev.values())
+                + sum(e.nbytes for _s, e in self._mesh.values()),
+                "uploaded_bytes": self.uploaded_bytes,
+                "tail_rows": self.tail_rows,
+            }
+
+    def clear(self):
+        """Drop everything (tests)."""
+        with _LOCK:
+            self._dev.clear()
+            self._mesh.clear()
+            self._host.clear()
+            self._refs.clear()
+
+    # -- eviction -------------------------------------------------------
+    def trim(self):
+        """Enforce the device byte budget: evict globally-LRU entries
+        (across the single-device AND mesh tiers) until the resident
+        population fits.  A lone over-budget entry stays — the active
+        query holds references anyway, so evicting it frees nothing."""
+        budget = _budget()
+        with _LOCK:
+            while True:
+                items = ([("dev", k, s, e)
+                          for k, (s, e) in self._dev.items()]
+                         + [("mesh", k, s, e)
+                            for k, (s, e) in self._mesh.items()])
+                if len(items) <= 1:
+                    return
+                if sum(e.nbytes for _k1, _k2, _s, e in items) <= budget:
+                    return
+                kind, key, _s, e = min(items, key=lambda it: it[2])
+                (self._dev if kind == "dev" else self._mesh).pop(key,
+                                                                 None)
+                self._tstats(e.table)[2] += 1
+
+    def _trim_host(self):
+        budget = _host_budget()
+        with _LOCK:
+            while len(self._host) > 1 and \
+                    sum(nb for _s, _snap, nb in
+                        self._host.values()) > budget:
+                key = min(self._host, key=lambda k: self._host[k][0])
+                self._host.pop(key)
+
+    # -- invalidation ---------------------------------------------------
+    def invalidate(self, store):
+        """Eagerly drop every entry backed by this store (DROP TABLE,
+        TRUNCATE, vacuum, ALTER fan-out); mesh entries of the same table
+        go too — their per-DN version tuple is stale by construction."""
+        table = store.td.name
+        with _LOCK:
+            hit = self._dev.pop(id(store), None) is not None
+            self._host.pop(id(store), None)
+            for key in [k for k, (_s, e) in self._mesh.items()
+                        if e.table == table]:
+                self._mesh.pop(key)
+                hit = True
+            if hit:
+                self._tstats(table)[3] += 1
+
+    # ------------------------------------------------------------------
+    # single-device tier (exec/executor.py scans, fused tier, FQS)
+    # ------------------------------------------------------------------
+    def get_device(self, store, colnames):
+        """Staged (padded, concatenated) device columns for a store at
+        its current version: value columns + MVCC sys columns + null
+        masks.  Returns (arrs, n).  Warm path is a dict lookup; version
+        drift re-stages — incrementally (tail only) when the store's
+        mutation log proves append-only growth."""
+        table = store.td.name
+        ver = store.version
+        nullwant = {_NULL + c for c in colnames
+                    if c in store.null_columns}
+        want = set(colnames) | set(_SYS_COLS) | nullwant
+        with _LOCK:
+            ent = self._dev.get(id(store))
+            e = ent[1] if ent is not None else None
+            if ent is not None:
+                ent[0] = next(_SEQ)
+            if e is not None and e.version == ver \
+                    and want <= set(e.arrs):
+                self._tstats(table)[0] += 1
+                return e.arrs, e.n
+        # stage outside the lock (defensive: racing stagers both build,
+        # last put wins — same policy as the compiled-program caches)
+        if e is not None and e.version == ver:
+            # same version, new columns: keep the resident buffers,
+            # stage only what is missing
+            padded = int(next(iter(e.arrs.values())).shape[0])
+            add, up = self._stage_columns(store, want - set(e.arrs),
+                                          e.n, padded)
+            arrs = dict(e.arrs)
+            arrs.update(add)
+            n, tail = e.n, 0
+        elif e is not None and store.appended_only_since(e.version, e.n):
+            arrs, n, up, tail = self._tail_stage(store, e, want)
+        else:
+            from .batch import size_class
+            n = store.row_count()
+            padded = size_class(max(n, 1))
+            arrs, up = self._stage_columns(store, want, n, padded)
+            tail = 0
+        nbytes = sum(int(a.nbytes) for a in arrs.values())
+        with _LOCK:
+            st = self._tstats(table)
+            st[1] += 1
+            if e is not None and e.version != ver and tail == 0:
+                st[3] += 1    # stale residency fully replaced
+            self.uploaded_bytes += up
+            self.tail_rows += tail
+            self._dev[id(store)] = [next(_SEQ), DevEntry(
+                table, ver, arrs, n, set(store.null_columns), nbytes)]
+            self._watch_store(store)
+        self.trim()
+        return arrs, n
+
+    def _stage_columns(self, store, names, n: int, padded: int):
+        """Full staging of rows [0:n] for the given staged-namespace
+        names (value columns / __xmin_ts... / __null.c) into padded
+        device arrays.  Returns (arrs, bytes_uploaded)."""
+        import jax
+
+        from ..utils.dtypes import stage_cast
+        plain = sorted({nm for nm in names if not nm.startswith("__")}
+                       | {nm[len(_NULL):] for nm in names
+                          if nm.startswith(_NULL)})
+        host = store.host_live_columns(plain)
+        arrs = {}
+        up = 0
+        for name in names:
+            h = stage_cast(host[name])
+            buf = np.zeros((padded, *h.shape[1:]), dtype=h.dtype)
+            buf[:n] = h[:n]
+            arrs[name] = jax.device_put(buf)
+            up += buf.nbytes
+        return arrs, up
+
+    def _tail_stage(self, store, e: DevEntry, want):
+        """Append-only growth: keep the device prefix, upload only rows
+        [e.n:n].  Columns never staged before (or null masks that
+        already had prefix NULLs) stage in full; masks whose first NULL
+        arrived in the tail get a zeros prefix for free."""
+        import jax.numpy as jnp
+
+        from ..utils.dtypes import stage_cast
+        from .batch import size_class
+        n = store.row_count()
+        padded = size_class(max(n, 1))
+        all_names = set(e.arrs) | set(want)
+        fresh_nulls = {nm for nm in all_names - set(e.arrs)
+                       if nm.startswith(_NULL)
+                       and nm[len(_NULL):] not in e.null_at_cache}
+        full_names = all_names - set(e.arrs) - fresh_nulls
+        plain = sorted({nm for nm in e.arrs if not nm.startswith("__")}
+                       | {nm[len(_NULL):] for nm in fresh_nulls})
+        tail_host = store.host_live_columns(plain, start=e.n)
+        arrs = {}
+        up = 0
+        for name, old in e.arrs.items():
+            if int(old.shape[0]) != padded:
+                buf = jnp.zeros((padded, *old.shape[1:]), old.dtype)
+                old = buf.at[:e.n].set(old[:e.n])
+            if n > e.n:
+                t = stage_cast(tail_host[name])
+                old = old.at[e.n:n].set(jnp.asarray(t))
+                up += t.nbytes
+            arrs[name] = old
+        for name in fresh_nulls:
+            buf = jnp.zeros(padded, bool)
+            t = tail_host.get(name)
+            if t is not None and n > e.n:
+                buf = buf.at[e.n:n].set(jnp.asarray(t))
+                up += t.nbytes
+            arrs[name] = buf
+        if full_names:
+            add, up2 = self._stage_columns(store, full_names, n, padded)
+            arrs.update(add)
+            up += up2
+        return arrs, n, up, n - e.n
+
+    # ------------------------------------------------------------------
+    # mesh tier (exec/mesh_exec.py staging)
+    # ------------------------------------------------------------------
+    def mesh_get(self, runner, table: str, vkey: tuple):
+        """Entry for (runner, table) at exactly this per-DN version
+        tuple, or None.  Counts the hit/miss; a stale entry counts an
+        invalidation but stays resident for mesh_peek's incremental
+        tail-patch."""
+        with _LOCK:
+            ent = self._mesh.get((id(runner), table))
+            st = self._tstats(table)
+            if ent is not None and ent[1].vkey == vkey:
+                ent[0] = next(_SEQ)
+                st[0] += 1
+                return ent[1]
+            st[1] += 1
+            if ent is not None:
+                st[3] += 1
+            return None
+
+    def mesh_peek(self, runner, table: str):
+        """The resident entry regardless of version (incremental path)."""
+        with _LOCK:
+            ent = self._mesh.get((id(runner), table))
+            return ent[1] if ent is not None else None
+
+    def mesh_put(self, runner, table: str, entry: MeshEntry):
+        with _LOCK:
+            self._mesh[(id(runner), table)] = [next(_SEQ), entry]
+            self._watch_runner(runner)
+        self.trim()
+
+    # ------------------------------------------------------------------
+    # host snapshots (dn_server stage_table wire op, spill passes)
+    # ------------------------------------------------------------------
+    def host_snapshot(self, store) -> dict:
+        """One store's live columns + dictionaries at its current
+        version — {"version", "count", "cols", "dicts",
+        "null_columns"}.  Version-cached: an unchanged table never
+        re-concatenates (the shared staging source for the dn_server
+        stage_table op and the mesh runner's in-process snapshots)."""
+        snap = self.peek_host_snapshot(store)
+        if snap is not None:
+            return snap
+        ver = store.version
+        cols = store.host_live_columns([c.name for c in
+                                        store.td.columns])
+        n = len(next(iter(cols.values()))) if cols else store.row_count()
+        snap = {"version": ver, "count": n, "cols": cols,
+                "dicts": {c: list(d.values)
+                          for c, d in store.dicts.items()},
+                "null_columns": set(store.null_columns)}
+        nbytes = sum(int(a.nbytes) for a in cols.values())
+        if nbytes <= _host_budget():
+            with _LOCK:
+                self._host[id(store)] = [next(_SEQ), snap, nbytes]
+                self._watch_store(store)
+            self._trim_host()
+        return snap
+
+    def resident(self, store) -> bool:
+        """Does this store have a device entry at its CURRENT version?
+        (warm-start assertions, tests)."""
+        with _LOCK:
+            ent = self._dev.get(id(store))
+            return ent is not None and ent[1].version == store.version
+
+    def peek_host_snapshot(self, store):
+        """The cached host snapshot IF current, else None (never
+        builds) — spill passes reuse it instead of re-concatenating."""
+        with _LOCK:
+            ent = self._host.get(id(store))
+            if ent is not None and ent[1]["version"] == store.version:
+                ent[0] = next(_SEQ)
+                return ent[1]
+        return None
+
+
+#: process-global pool — every LocalNode / DataNode / MeshRunner in the
+#: process shares one budget (entries are keyed by store identity, so
+#: nodes never alias each other's tables)
+POOL = DeviceBufferPool()

@@ -163,6 +163,10 @@ class Chunk:
         return self.cap - self.nrows
 
 
+# "no existing row touched" marker for the mutation log (pure append)
+NO_ROW = 1 << 62
+
+
 class TableStore:
     """All chunks of one table on one datanode."""
 
@@ -174,6 +178,20 @@ class TableStore:
         # per-tuple atomicity from buffer-page locks, bufmgr.c)
         self._mu = threading.RLock()
         self.version = next(_VERSION_COUNTER)  # bumped on any mutation
+        # prefix-mutation log: (version, lowest scan-order row touched)
+        # for every mutation that rewrote EXISTING rows.  The device
+        # buffer pool replays it to prove a cached snapshot's prefix is
+        # still byte-exact (no entry past the cached version touches a
+        # row below the cached count) and stage just the appended tail
+        # (storage/bufferpool.py).  Pure tail appends are never logged —
+        # they cannot invalidate any earlier prefix — so arbitrarily
+        # long append bursts stay provable; _trim_floor marks how far
+        # back the bounded log still covers, and the row high-water mark
+        # forces logging of appends that follow a shrink (truncate/
+        # vacuum), whose base may undercut an older snapshot's count.
+        self._dirty_log: list[tuple[int, int]] = []
+        self._trim_floor = 0
+        self._rows_high_water = 0
         self.dicts: dict[str, StringDict] = {
             c.name: StringDict() for c in td.columns
             if c.type.kind == TypeKind.TEXT}
@@ -190,6 +208,59 @@ class TableStore:
         self.btree_indexes: dict[str, dict] = {}
 
     # ------------------------------------------------------------------
+    def _note_mutation(self, min_row: int) -> None:
+        """Bump the store version; log the mutation when it could
+        invalidate some snapshot's prefix (it touched a row below the
+        high-water row count — pure appends at the current tail never
+        do, so they stay unlogged and cost O(1))."""
+        self.version = next(_VERSION_COUNTER)
+        hw = max(self._rows_high_water, self.row_count())
+        if min_row < hw:
+            self._dirty_log.append((self.version, int(min_row)))
+            if len(self._dirty_log) > 128:
+                drop = len(self._dirty_log) - 128
+                self._trim_floor = self._dirty_log[drop - 1][0]
+                del self._dirty_log[:drop]
+        self._rows_high_water = hw
+
+    def _chunk_start(self, ci: int) -> int:
+        """Scan-order position of chunk `ci`'s first row.  Stable under
+        append-only history (inserts only extend the last chunk / append
+        new ones); the ops that DO shift it (vacuum, truncate) log
+        min_row=0 and force a full restage anyway."""
+        return sum(c.nrows for c in self.chunks[:ci])
+
+    def _spans_min_row(self, spans) -> int:
+        """Lowest scan-order row in a backfill span list [(ci, lo, hi)]."""
+        m = NO_ROW
+        for ci, lo, _hi in spans:
+            m = min(m, self._chunk_start(ci) + lo)
+        return m
+
+    def _idx_spans_min_row(self, spans) -> int:
+        """Lowest scan-order row in a delete span list [(ci, idx)]."""
+        m = NO_ROW
+        for ci, idx in spans:
+            if len(idx):
+                m = min(m, self._chunk_start(ci) + int(idx.min()))
+        return m
+
+    def appended_only_since(self, version: int, nrows: int) -> bool:
+        """True when every mutation after `version` touched only rows
+        at scan positions >= nrows — i.e. a snapshot of the first
+        `nrows` rows taken at `version` is still byte-exact and only
+        the tail needs (re)staging.  Conservative: returns False when
+        the bounded log no longer covers the gap (prefix entries were
+        trimmed past the asked-for version)."""
+        if self.version == version:
+            return True
+        if version < self._trim_floor:
+            return False      # entries in the gap may have been dropped
+        for v, r in self._dirty_log:
+            if v > version and r < nrows:
+                return False
+        return True
+
     def row_count(self) -> int:
         return sum(c.nrows for c in self.chunks)
 
@@ -281,7 +352,9 @@ class TableStore:
 
     def _insert_locked(self, columns, nrows, txid, shardids,
                        commit_ts, nulls):
-        self.version = next(_VERSION_COUNTER)
+        # pure append: the lowest affected row is where the new rows
+        # begin (nothing before it changes)
+        self._note_mutation(self.row_count())
         spans = []
         done = 0
         born_ts = INF_TS if commit_ts is None else np.int64(commit_ts)
@@ -340,7 +413,8 @@ class TableStore:
                         f"row locked by in-progress txn "
                         f"{int(lk[lconf][0])}", holder=lk[lconf][0])
             ch.xmax_txid[idx] = txid
-            self.version = next(_VERSION_COUNTER)
+            self._note_mutation(self._idx_spans_min_row(
+                [(chunk_idx, idx)]))
             return (chunk_idx, idx)
 
     def lock_rows(self, chunk_idx: int, row_mask: np.ndarray,
@@ -382,7 +456,7 @@ class TableStore:
             self.ann_indexes = {}
             self.btree_indexes = {}
             self.null_columns = set()
-            self.version = next(_VERSION_COUNTER)
+            self._note_mutation(0)
 
     def clear_locks(self, spans):
         for ci, idx in spans:
@@ -395,22 +469,22 @@ class TableStore:
     #    defers via csnlog.c + tqual.c hint-bit stamping).  All backfills
     #    are span-driven: commit cost is O(rows touched), not O(table). --
     def backfill_insert(self, spans, ts: np.int64):
-        self.version = next(_VERSION_COUNTER)
+        self._note_mutation(self._spans_min_row(spans))
         for ci, lo, hi in spans:
             self.chunks[ci].xmin_ts[lo:hi] = ts
 
     def abort_insert(self, spans):
-        self.version = next(_VERSION_COUNTER)
+        self._note_mutation(self._spans_min_row(spans))
         for ci, lo, hi in spans:
             self.chunks[ci].xmin_ts[lo:hi] = ABORTED_TS
 
     def backfill_delete(self, spans, ts: np.int64):
-        self.version = next(_VERSION_COUNTER)
+        self._note_mutation(self._idx_spans_min_row(spans))
         for ci, idx in spans:
             self.chunks[ci].xmax_ts[idx] = ts
 
     def revert_delete(self, spans):
-        self.version = next(_VERSION_COUNTER)
+        self._note_mutation(self._idx_spans_min_row(spans))
         for ci, idx in spans:
             self.chunks[ci].xmax_txid[idx] = NO_TXID
 
@@ -436,7 +510,7 @@ class TableStore:
                 filled = True
         if filled:
             self.null_columns.add(cd.name)
-        self.version = next(_VERSION_COUNTER)
+        self._note_mutation(0)
 
     def alter_drop_column(self, name: str) -> None:
         self.td.columns = [c for c in self.td.columns if c.name != name]
@@ -445,7 +519,7 @@ class TableStore:
             ch.nulls.pop(name, None)
         self.dicts.pop(name, None)
         self.null_columns.discard(name)
-        self.version = next(_VERSION_COUNTER)
+        self._note_mutation(0)
 
     def alter_rename_column(self, old: str, new: str) -> None:
         for c in self.td.columns:
@@ -461,7 +535,7 @@ class TableStore:
         if old in self.null_columns:
             self.null_columns.discard(old)
             self.null_columns.add(new)
-        self.version = next(_VERSION_COUNTER)
+        self._note_mutation(0)
 
     # ------------------------------------------------------------------
     def scan_chunks(self) -> Iterator[tuple[int, Chunk]]:
@@ -502,7 +576,7 @@ class TableStore:
             if kept.nrows:
                 new_chunks.append(kept)
         self.chunks = new_chunks
-        self.version = next(_VERSION_COUNTER)
+        self._note_mutation(0)
         return reclaimed
 
     def rows_of_shards(self, shard_ids: set) -> dict:
@@ -646,27 +720,38 @@ class TableStore:
             keys, hi, side="left" if hi_strict else "right"))
         return np.sort(idx["pos"][a:b])
 
-    def host_live_columns(self, colnames) -> dict[str, np.ndarray]:
+    def host_live_columns(self, colnames,
+                          start: int = 0) -> dict[str, np.ndarray]:
         """Live-row concatenation (scan order) of the given value
         columns plus MVCC sys columns and null masks — the ONE host
         source the staging tiers (spill slabs/partitions, mesh sharding,
-        index-scan subsets) slice from."""
+        index-scan subsets) slice from.  With `start`, only rows at scan
+        positions >= start are returned — the buffer pool's incremental
+        tail-staging path (appended_only_since proves the prefix is
+        already resident, so only the tail ever touches the host)."""
         want = set(colnames)
         nullcols = {c for c in want if c in self.null_columns}
         host: dict[str, np.ndarray] = {}
-        chunks = list(self.scan_chunks())
+        chunks: list[tuple[Chunk, int]] = []   # (chunk, row offset)
+        cum = 0
+        for _, ch in self.scan_chunks():
+            lo = max(0, start - cum)
+            cum += ch.nrows
+            if lo < ch.nrows:
+                chunks.append((ch, lo))
         for name in want:
             cd = self.td.column(name)
-            arrs = [ch.columns[name][:ch.nrows] for _, ch in chunks]
+            arrs = [ch.columns[name][lo:ch.nrows] for ch, lo in chunks]
             host[name] = np.concatenate(arrs) if arrs else \
                 np.empty((0, *cd.type.shape_suffix), cd.type.np_dtype)
         for sys in ("xmin_ts", "xmax_ts", "xmin_txid", "xmax_txid"):
-            arrs = [getattr(ch, sys)[:ch.nrows] for _, ch in chunks]
+            arrs = [getattr(ch, sys)[lo:ch.nrows] for ch, lo in chunks]
             host[f"__{sys}"] = np.concatenate(arrs) if arrs else \
                 np.empty(0, np.int64)
         for name in nullcols:
-            arrs = [ch.nulls[name][:ch.nrows] if name in ch.nulls
-                    else np.zeros(ch.nrows, bool) for _, ch in chunks]
+            arrs = [ch.nulls[name][lo:ch.nrows] if name in ch.nulls
+                    else np.zeros(ch.nrows - lo, bool)
+                    for ch, lo in chunks]
             host[f"__null.{name}"] = np.concatenate(arrs) if arrs else \
                 np.zeros(0, bool)
         return host

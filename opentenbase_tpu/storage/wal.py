@@ -168,8 +168,9 @@ def restore_store(store, path: str):
         for v in values:
             d.encode_one(v)
         store.dicts[name] = d
-    from .store import _VERSION_COUNTER
-    store.version = next(_VERSION_COUNTER)
+    # goes through the mutation log (min_row=0): a restore rebuilds the
+    # whole chunk list, so no cached device prefix may survive it
+    store._note_mutation(0)
 
 
 def _grow(arr: np.ndarray) -> np.ndarray:

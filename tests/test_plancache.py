@@ -161,8 +161,10 @@ class TestAotWarmup:
         cl2 = Cluster(datadir=d)
         assert plancache.warm_drain(timeout=120)
         # the restart warm staged the recovered tables' device columns
+        # into the shared buffer pool (storage/bufferpool.py)
+        from opentenbase_tpu.storage.bufferpool import POOL
         staged = any(
-            ("wt" in getattr(st, "td").name or True) and dn.cache._cache
+            POOL.resident(st)
             for dn in cl2.datanodes if hasattr(dn, "cache")
             for st in [dn.stores.get("wt")] if st is not None)
         assert staged
