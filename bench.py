@@ -5,9 +5,14 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "ladder"}.
   device-mesh data plane (or single-node fused when only one config runs)
 - vs_baseline: speedup over the CPU control arm (pandas, BASELINE.md's
   "CPU DataNode" stand-in) on the same machine & data
-- ladder: per-config results — Q1 single-node fused (BASELINE config 1)
-  plus Q1/Q3/Q5 through the mesh tier (config 2: joins + all_to_all
-  redistribution as ONE shard_map program per query).  Mesh entries
+- ladder: per-config results — Q1/Q3/Q5 single-node fused (BASELINE
+  config 1; Q3/Q5 run as fused JOIN fragments — late-materialized
+  index-composition joins in one XLA program) plus Q1/Q3/Q5 through
+  the mesh tier (config 2: joins + all_to_all redistribution as ONE
+  shard_map program per query).  Every query entry reports the
+  late-materialization counters (mat_deferred_cols / mat_eager_cols /
+  mat_cols_gathered / mat_bytes_gathered / join_host_syncs) for its
+  timed runs.  Mesh entries
   split a warm repeat into stage_ms (host->device upload; ~0 when the
   device buffer pool serves every table resident) vs compute_ms, and
   report the pool hit rate + bytes staged on that repeat
@@ -164,6 +169,22 @@ def _oltp_latencies(s, n=200):
             float(np.median(prep) * 1e3))
 
 
+def _mat_counters(x0, x1):
+    """Ladder-entry materialization telemetry: deferred vs. eager
+    column-gathers and bytes gathered between two exec_stats snapshots
+    (exec/executor.py EXEC_STATS; trace-time counts for compiled
+    tiers)."""
+    return {
+        "mat_deferred_cols": x1["deferred_cols"] - x0["deferred_cols"],
+        "mat_eager_cols": x1["eager_cols"] - x0["eager_cols"],
+        "mat_cols_gathered": x1["cols_materialized"]
+        - x0["cols_materialized"],
+        "mat_bytes_gathered": x1["bytes_materialized"]
+        - x0["bytes_materialized"],
+        "join_host_syncs": x1["host_syncs"] - x0["host_syncs"],
+    }
+
+
 def _save_data(data, path):
     np.savez(path, **{f"{t}::{c}": v for t, cols in data.items()
                       for c, v in cols.items()})
@@ -294,23 +315,36 @@ def main():
     ladder = []
     notes = []
 
-    # ---- config 1: Q1 single node (fused scan+agg kernel path) ----
+    # ---- config 1: Q1/Q3/Q5 single node (fused fragment path: Q1 is
+    # the scan+agg kernel program, Q3/Q5 are fused JOIN fragments —
+    # late-materialized index-composition joins in one XLA program,
+    # exec/fused.py) ----
+    from opentenbase_tpu.exec.executor import exec_stats_snapshot
+    controls = {1: _pandas_q1, 3: _pandas_q3, 5: _pandas_q5}
     if mode in ("ladder", "single"):
         from opentenbase_tpu.exec.session import LocalNode, Session
         node = LocalNode()
         s1 = Session(node)
         s1.execute(SCHEMA)
-        td = node.catalog.table("lineitem")
-        s1._insert_rows(td, node.stores["lineitem"], data["lineitem"],
-                        n_rows)
-        eng, cold = _time(lambda: s1.query(Q[1]), repeat)
-        ctl, _ = _time(lambda: _pandas_q1(dfs), max(2, repeat // 2))
-        gb1 = _gb_touched(1, data)
-        ladder.append({"config": "Q1 single", "engine_ms": eng * 1e3,
-                       "cold_ms": cold * 1e3,
-                       "mrows_s": n_rows / eng / 1e6,
-                       "vs_pandas": ctl / eng,
-                       "gb_touched": gb1, "gb_per_s": gb1 / eng})
+        for tname in ("region", "nation", "supplier", "customer",
+                      "orders", "lineitem"):
+            td = node.catalog.table(tname)
+            nn = len(next(iter(data[tname].values())))
+            s1._insert_rows(td, node.stores[tname], data[tname], nn)
+        for qn in (1, 3, 5):
+            x0 = exec_stats_snapshot()
+            eng, cold = _time(lambda: s1.query(Q[qn]), repeat)
+            x1 = exec_stats_snapshot()
+            ctl, _ = _time(lambda: controls[qn](dfs),
+                           max(2, repeat // 2))
+            gb = _gb_touched(qn, data)
+            entry = {"config": f"Q{qn} single", "engine_ms": eng * 1e3,
+                     "cold_ms": cold * 1e3,
+                     "mrows_s": n_rows / eng / 1e6,
+                     "vs_pandas": ctl / eng,
+                     "gb_touched": gb, "gb_per_s": gb / eng}
+            entry.update(_mat_counters(x0, x1))
+            ladder.append(entry)
         del s1, node
 
     # ---- config 2: Q1/Q3/Q5 through the device-mesh data plane ----
@@ -319,9 +353,10 @@ def main():
         from opentenbase_tpu.storage.bufferpool import POOL
         ndn = max(len(jax.devices()), 1)
         s2 = _mesh_session(data)
-        controls = {1: _pandas_q1, 3: _pandas_q3, 5: _pandas_q5}
         for qn in (1, 3, 5):
+            x0 = exec_stats_snapshot()
             eng, cold = _time(lambda: s2.query(Q[qn]), repeat)
+            x1 = exec_stats_snapshot()
             ctl, _ = _time(lambda: controls[qn](dfs), max(2, repeat // 2))
             gb = _gb_touched(qn, data)
             # warm-repeat arm: one more run against the populated
@@ -348,6 +383,7 @@ def main():
                      "gb_touched": gb,
                      "gb_per_s": gb / eng,
                      "tier": s2.last_tier}
+            entry.update(_mat_counters(x0, x1))
             if s2.last_tier != "mesh":
                 entry["fallback"] = s2.last_fallback
             ladder.append(entry)

@@ -63,6 +63,7 @@ class HostBatch:
 
 
 def _to_host(b: DBatch) -> HostBatch:
+    b.ensure_all()   # exchange boundary: rows physically move
     valid = np.asarray(b.valid)
     idx = np.nonzero(valid)[0]
     cols = {}

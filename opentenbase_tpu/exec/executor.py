@@ -17,7 +17,9 @@ Volcano loop).  Architectural differences (SURVEY.md §7.1):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import os
 from typing import Optional
 
 import jax
@@ -41,6 +43,95 @@ class ExecError(Exception):
     pass
 
 
+# ---------------------------------------------------------------------------
+# executor telemetry (surfaced by the otb_execstats view,
+# parallel/statviews.py).  Per-tier counter bundles: "single" is the
+# eager per-operator dispatch, "fused"/"mesh" count TRACE-time events
+# (a cached program re-executes without re-tracing, so those tiers'
+# structural counters grow once per compile) plus program-hit counts.
+# Best-effort under CN-server thread concurrency: a lost increment is
+# acceptable telemetry noise, never a wrong query result.
+# ---------------------------------------------------------------------------
+STAT_FIELDS = ("joins", "index_compositions", "deferred_cols",
+               "eager_cols", "cols_materialized", "bytes_materialized",
+               "host_syncs", "fused_join_hits")
+EXEC_STATS: dict = {t: {f: 0 for f in STAT_FIELDS}
+                    for t in ("single", "fused", "mesh")}
+_CUR_TIER = ["single"]
+
+#: late-materialization master switch — off reverts joins to the eager
+#: full-width gather path (the bit-identical baseline the tests compare
+#: against)
+LATE_MAT = os.environ.get("OTB_LATE_MAT", "1") != "0"
+
+
+def _stats() -> dict:
+    return EXEC_STATS[_CUR_TIER[0]]
+
+
+@contextlib.contextmanager
+def stats_tier(tier: str):
+    """Attribute executor counters to `tier` for the duration (the
+    fused/mesh tiers wrap their trace + execution in this)."""
+    prev = _CUR_TIER[0]
+    _CUR_TIER[0] = tier
+    try:
+        yield
+    finally:
+        _CUR_TIER[0] = prev
+
+
+def exec_stats_rows() -> list:
+    """(tier, *STAT_FIELDS) rows for the otb_execstats view."""
+    return [(t, *(EXEC_STATS[t][f] for f in STAT_FIELDS))
+            for t in ("single", "fused", "mesh")]
+
+
+def exec_stats_snapshot() -> dict:
+    """Flat totals across tiers (bench delta accounting)."""
+    return {f: sum(EXEC_STATS[t][f] for t in EXEC_STATS)
+            for f in STAT_FIELDS}
+
+
+def _arr_bytes(a, n: int) -> int:
+    """Bytes of an n-row gather of a column shaped like `a` (works on
+    tracers: shape/dtype only)."""
+    per = a.dtype.itemsize
+    for d in a.shape[1:]:
+        per *= int(d)
+    return per * n
+
+
+@dataclasses.dataclass
+class LazyCol:
+    """A deferred (late-materialized) column: `src` holds the payload in
+    SOURCE row space and `idx` maps output positions to source rows.
+    Joins compose `idx` instead of gathering `src`, so a left-deep join
+    chain moves O(out_size) indices per join instead of O(width x
+    out_size) payload values (reference contrast: ExecHashJoin copies
+    minimal tuples into the hash/output slots at every join).
+
+    `null_src` is the source-space null mask (gathered through `idx` at
+    materialization); `null_out` is an OUTPUT-space mask OR'd on top —
+    outer-join null extension, which exists only in the join's row
+    space."""
+    src: object
+    idx: object
+    null_src: object = None
+    null_out: object = None
+
+    def value(self):
+        return self.src[self.idx]
+
+    def null(self):
+        m = None
+        if self.null_src is not None:
+            m = self.null_src[self.idx]
+        if self.null_out is not None:
+            m = self.null_out if m is None else (m | self.null_out)
+        return m
+
+
 @dataclasses.dataclass
 class DBatch:
     cols: dict[str, object]            # name -> jnp array [P]
@@ -48,6 +139,10 @@ class DBatch:
     types: dict[str, SqlType]
     dicts: dict[str, list]             # TEXT col name -> code->str list
     nulls: dict[str, object] = dataclasses.field(default_factory=dict)
+    # late materialization: deferred columns living behind an
+    # indirection (see LazyCol).  `cols`/`nulls` hold only materialized
+    # columns; `types`/`dicts` always cover every column.
+    lazy: dict[str, LazyCol] = dataclasses.field(default_factory=dict)
 
     @property
     def padded(self) -> int:
@@ -55,6 +150,93 @@ class DBatch:
 
     def count(self) -> int:
         return int(jnp.sum(self.valid))
+
+    # -- late-materialization surface ----------------------------------
+    def names(self) -> list[str]:
+        return list(self.cols) + [n for n in self.lazy
+                                  if n not in self.cols]
+
+    def has_col(self, name: str) -> bool:
+        return name in self.cols or name in self.lazy
+
+    def maybe_null(self, name: str) -> bool:
+        """Whether the column can carry a null mask (no materialization)."""
+        if name in self.nulls:
+            return True
+        lc = self.lazy.get(name)
+        return lc is not None and (lc.null_src is not None
+                                   or lc.null_out is not None)
+
+    def _materialize_one(self, name: str):
+        lc = self.lazy.pop(name)
+        st = _stats()
+        st["cols_materialized"] += 1
+        st["bytes_materialized"] += _arr_bytes(lc.src,
+                                               int(lc.idx.shape[0]))
+        self.cols[name] = lc.value()
+        m = lc.null()
+        if m is not None:
+            self.nulls[name] = m
+
+    def ensure(self, names) -> "DBatch":
+        """Materialize exactly the named columns (unknown names are
+        fine: init-plan params etc. are not batch columns)."""
+        if self.lazy:
+            for n in names:
+                if n in self.lazy:
+                    self._materialize_one(n)
+        return self
+
+    def ensure_all(self) -> "DBatch":
+        """The single materialization pass: a width-consuming operator
+        (Sort, Window, exchange, final projection) needs real columns."""
+        if self.lazy:
+            for n in list(self.lazy):
+                self._materialize_one(n)
+        return self
+
+    def col(self, name: str):
+        if name in self.lazy:
+            self._materialize_one(name)
+        return self.cols[name]
+
+    def col_opt(self, name: str):
+        if name in self.lazy:
+            self._materialize_one(name)
+        return self.cols.get(name)
+
+    def gather_rows(self, take):
+        """(cols, nulls) gathered at output positions `take`, composing
+        straight through any indirection — a len(take)-row consumer
+        (e.g. the mesh gather compaction) never pays a full-width
+        materialization of the source row space."""
+        cols, nulls = {}, {}
+        composed: dict = {}
+        st = _stats()
+        for n, a in self.cols.items():
+            cols[n] = a[take]
+        for n, m in self.nulls.items():
+            nulls[n] = m[take]
+        for n, lc in self.lazy.items():
+            key = id(lc.idx)
+            src_idx = composed.get(key)
+            if src_idx is None:
+                src_idx = lc.idx[take]
+                composed[key] = src_idx
+                st["index_compositions"] += 1
+            st["cols_materialized"] += 1
+            st["bytes_materialized"] += _arr_bytes(
+                lc.src, int(take.shape[0]))
+            cols[n] = lc.src[src_idx]
+            m = None
+            if lc.null_src is not None:
+                m = lc.null_src[src_idx]
+            if lc.null_out is not None:
+                no = lc.null_out[take]
+                m = no if m is None else (m | no)
+            if m is not None:
+                nulls[n] = m
+        return cols, nulls
 
 
 def _empty_batch(types: dict[str, SqlType], dicts: dict) -> DBatch:
@@ -163,17 +345,29 @@ class Executor:
             env[NULLKEY + n] = m
         return env
 
+    def _ensure_expr(self, e: E.Expr, batch: DBatch) -> E.Expr:
+        """Prep `e` and materialize exactly the deferred columns it
+        touches — expression eval gathers on demand, never the whole
+        carried width.  Must run BEFORE compile: the null-awareness set
+        (frozenset(batch.nulls)) is part of the compiled program."""
+        pe = self._prep(e)
+        if batch.lazy:
+            batch.ensure(_cols_of(pe))
+        return pe
+
     def _eval(self, e: E.Expr, batch: DBatch):
         """Value-only eval (garbage at NULL positions)."""
         from .expr_compile import compile_expr
-        return compile_expr(self._prep(e), self._dictviews(batch),
+        pe = self._ensure_expr(e, batch)
+        return compile_expr(pe, self._dictviews(batch),
                             frozenset(batch.nulls))(self._env(batch))
 
     def _eval_pair(self, e: E.Expr, batch: DBatch):
         """(value, null_mask|None) eval; the mask is broadcast to batch
         shape so downstream gathers can index it."""
         from .expr_compile import compile_pair
-        vf, nf = compile_pair(self._prep(e), self._dictviews(batch),
+        pe = self._ensure_expr(e, batch)
+        vf, nf = compile_pair(pe, self._dictviews(batch),
                               frozenset(batch.nulls))
         env = self._env(batch)
         val = vf(env)
@@ -187,7 +381,8 @@ class Executor:
     def _eval_pred(self, e: E.Expr, batch: DBatch):
         """SQL 3VL predicate eval: True where definitely true."""
         from .expr_compile import compile_pred
-        return compile_pred(self._prep(e), self._dictviews(batch),
+        pe = self._ensure_expr(e, batch)
+        return compile_pred(pe, self._dictviews(batch),
                             frozenset(batch.nulls))(self._env(batch))
 
     # ------------------------------------------------------------------
@@ -372,7 +567,7 @@ class Executor:
         valid = b.valid
         for q in node.quals:
             valid = valid & self._eval_pred(q, b)
-        return DBatch(b.cols, valid, b.types, b.dicts, b.nulls)
+        return DBatch(b.cols, valid, b.types, b.dicts, b.nulls, b.lazy)
 
     def _exec_project(self, node: P.Project) -> DBatch:
         b = self.exec_node(node.child)
@@ -401,6 +596,8 @@ class Executor:
         the hash IS the equality.  Returns (key, recheck_mask) where
         recheck_mask[i] says key i can be re-verified by value."""
         from .expr_compile import _text_hash_fn
+        for k in keys:
+            self._ensure_expr(k, b)
         arrs, nulls, recheckable = [], None, []
         env = self._env(b)
         for k in keys:
@@ -428,6 +625,78 @@ class Executor:
         if nulls is not None:
             a = jnp.where(nulls, K.INT64_MAX, a)
         return a, hashed, recheckable
+
+    def _defer_side(self, batch: DBatch, take, out: DBatch,
+                    extra_null=None):
+        """Late materialization: carry one join input's columns into the
+        output batch as LazyCols behind `take` (output -> input row
+        indices) instead of gathering payloads.  Existing indirections
+        compose — ONE index gather per distinct source index vector,
+        shared by every column riding it.  `extra_null` is an
+        output-space mask (outer-join null extension) OR'd onto every
+        carried column's null."""
+        st = _stats()
+        composed: dict = {}
+        for n_, a in batch.cols.items():
+            out.lazy[n_] = LazyCol(a, take, batch.nulls.get(n_),
+                                   extra_null)
+            out.types[n_] = batch.types[n_]
+            if n_ in batch.dicts:
+                out.dicts[n_] = batch.dicts[n_]
+            st["deferred_cols"] += 1
+        for n_, lc in batch.lazy.items():
+            key = id(lc.idx)
+            nidx = composed.get(key)
+            if nidx is None:
+                nidx = K.compose_index(lc.idx, take)
+                composed[key] = nidx
+                st["index_compositions"] += 1
+            no = lc.null_out[take] if lc.null_out is not None else None
+            if extra_null is not None:
+                no = extra_null if no is None else (no | extra_null)
+            out.lazy[n_] = LazyCol(lc.src, nidx, lc.null_src, no)
+            out.types[n_] = batch.types[n_]
+            if n_ in batch.dicts:
+                out.dicts[n_] = batch.dicts[n_]
+            st["deferred_cols"] += 1
+
+    def _gather_side(self, batch: DBatch, take, out: DBatch,
+                     extra_null=None):
+        """Eager (pre-late-materialization) path: gather every carried
+        column of one input through `take` — kept as the bit-identical
+        baseline (LATE_MAT off)."""
+        st = _stats()
+        batch.ensure_all()
+        for n_, a in batch.cols.items():
+            out.cols[n_] = a[take]
+            out.types[n_] = batch.types[n_]
+            if n_ in batch.dicts:
+                out.dicts[n_] = batch.dicts[n_]
+            nm = batch.nulls[n_][take] if n_ in batch.nulls else None
+            if extra_null is not None:
+                nm = extra_null if nm is None else (nm | extra_null)
+            if nm is not None:
+                out.nulls[n_] = nm
+            st["eager_cols"] += 1
+
+    def _carry_side(self, batch, take, out, extra_null=None):
+        if LATE_MAT:
+            self._defer_side(batch, take, out, extra_null)
+        else:
+            self._gather_side(batch, take, out, extra_null)
+
+    @staticmethod
+    def _or_null_out(out: DBatch, names, mask):
+        """OR an output-space null mask onto the named columns (lazy or
+        materialized) — the outer-join revert path."""
+        for n_ in names:
+            lc = out.lazy.get(n_)
+            if lc is not None:
+                lc.null_out = mask if lc.null_out is None \
+                    else (lc.null_out | mask)
+            else:
+                m = out.nulls.get(n_)
+                out.nulls[n_] = mask if m is None else (m | mask)
 
     def _exec_hashjoin(self, node: P.HashJoin) -> DBatch:
         left = self.exec_node(node.left)
@@ -460,12 +729,13 @@ class Executor:
                 zip(zip(node.left_keys, node.right_keys), lcheck, rcheck)
                 if lok and rok]
 
+        _stats()["joins"] += 1
         if node.kind in ("semi", "anti") and not node.residual \
                 and not hash_recheck:
             mask = K.semi_mask(counts) if node.kind == "semi" \
                 else K.anti_mask(counts, left.valid)
             return DBatch(left.cols, left.valid & mask, left.types,
-                          left.dicts, left.nulls)
+                          left.dicts, left.nulls, left.lazy)
 
         left_outer = node.kind in ("left", "full")
         total = jnp.sum(jnp.where(left.valid, jnp.maximum(counts, 1), 0)) \
@@ -491,30 +761,21 @@ class Executor:
                                     left_outer=left_outer,
                                     probe_valid=left.valid)
         if not self._traced:
+            _stats()["host_syncs"] += 1
             tot = int(tot)
         valid = jnp.arange(out_size) < tot
         null_right = (bi < 0) if left_outer else None
         bi_safe = jnp.where(bi < 0, 0, bi) if left_outer else bi
 
-        cols, types, dicts, nulls = {}, {}, {}, {}
-        for n_, a in left.cols.items():
-            cols[n_] = a[pi]
-            types[n_] = left.types[n_]
-            if n_ in left.dicts:
-                dicts[n_] = left.dicts[n_]
-            if n_ in left.nulls:
-                nulls[n_] = left.nulls[n_][pi]
-        for n_, a in right.cols.items():
-            cols[n_] = a[bi_safe]
-            types[n_] = right.types[n_]
-            if n_ in right.dicts:
-                dicts[n_] = right.dicts[n_]
-            rn = right.nulls[n_][bi_safe] if n_ in right.nulls else None
-            if left_outer:
-                rn = null_right if rn is None else (rn | null_right)
-            if rn is not None:
-                nulls[n_] = rn
-        out = DBatch(cols, valid, types, dicts, nulls)
+        # late materialization: the join output carries both inputs'
+        # columns behind the fresh pair indices (pi / bi) — prior
+        # indirections compose, payloads stay untouched until a
+        # width-consuming operator materializes (SURVEY: move indices,
+        # not payloads)
+        out = DBatch({}, valid, {}, {}, {})
+        self._carry_side(left, pi, out)
+        self._carry_side(right, bi_safe, out, extra_null=null_right)
+        right_names = right.names()
 
         # residual quals (incl. hash recheck for multi-key joins)
         res_valid = out.valid
@@ -532,7 +793,7 @@ class Executor:
             mask = hits > 0 if node.kind == "semi" else \
                 (left.valid & (hits == 0))
             return DBatch(left.cols, left.valid & mask, left.types,
-                          left.dicts, left.nulls)
+                          left.dicts, left.nulls, left.lazy)
         if left_outer:
             null_ext = null_right
             if hash_recheck or node.residual:
@@ -556,10 +817,7 @@ class Executor:
                     num_segments=left.valid.shape[0])
                 is_first = out.valid & (idx == first_idx[pi])
                 to_null = is_first & need_null[pi]
-                for n_ in right.cols:
-                    rn = out.nulls.get(n_)
-                    out.nulls[n_] = to_null if rn is None \
-                        else (rn | to_null)
+                self._or_null_out(out, right_names, to_null)
                 out.valid = real_surv | to_null
                 null_ext = null_ext | to_null
             if node.kind != "full":
@@ -567,7 +825,10 @@ class Executor:
             # FULL: append the unmatched BUILD rows null-extended on the
             # left — computed AFTER recheck/revert so pairs killed there
             # count their build row as unmatched (reference: ExecHashJoin
-            # HJ_FILL_INNER / ExecScanHashTableForUnmatched)
+            # HJ_FILL_INNER / ExecScanHashTableForUnmatched).  The tail
+            # concat is width-consuming: materialize both row spaces.
+            out.ensure_all()
+            right.ensure_all()
             bhits = jax.ops.segment_sum(
                 (out.valid & ~null_ext).astype(jnp.int32), bi_safe,
                 num_segments=right.padded)
@@ -597,12 +858,10 @@ class Executor:
         lidx = jnp.repeat(jnp.arange(left.padded), right.padded)
         ridx = jnp.tile(jnp.arange(right.padded), left.padded)
         valid = left.valid[lidx] & right.valid[ridx]
-        cols = {n: a[lidx] for n, a in left.cols.items()}
-        cols.update({n: a[ridx] for n, a in right.cols.items()})
-        nulls = {n: a[lidx] for n, a in left.nulls.items()}
-        nulls.update({n: a[ridx] for n, a in right.nulls.items()})
-        return DBatch(cols, valid, {**left.types, **right.types},
-                      {**left.dicts, **right.dicts}, nulls)
+        out = DBatch({}, valid, {}, {}, {})
+        self._carry_side(left, lidx, out)
+        self._carry_side(right, ridx, out)
+        return out
 
     def _exec_batchsource(self, node) -> DBatch:
         return node.batch
@@ -690,7 +949,7 @@ class Executor:
             from .dist import _concat_host, _to_device, _to_host
             parts = [_to_host(self.exec_node(c)) for c in node.inputs]
             return _to_device(_concat_host(parts))
-        parts = [self.exec_node(c) for c in node.inputs]
+        parts = [self.exec_node(c).ensure_all() for c in node.inputs]
         first = parts[0]
         out_cols, out_dicts, out_nulls = {}, {}, {}
         for nme in first.cols:
@@ -814,7 +1073,7 @@ class Executor:
                 if ac.func == "avg":
                     arg_arr = null_mask = None
                 else:
-                    arg_arr = b.cols.get(name)
+                    arg_arr = b.col_opt(name)
                     null_mask = b.nulls.get(name)
             elif ac.arg is not None:
                 arg_arr, null_mask = self._eval_pair(ac.arg, b)
@@ -839,10 +1098,10 @@ class Executor:
                 scale = ac.arg.type.scale \
                     if ac.arg.type.kind == TypeKind.DECIMAL else 0
                 kinds.append("sumf")
-                inputs.append(b.cols[name + "__s"] if final
+                inputs.append(b.col(name + "__s") if final
                               else non_null(arg_arr, 0))
                 kinds.append("sum")
-                inputs.append(b.cols[name + "__c"] if final
+                inputs.append(b.col(name + "__c") if final
                               else base.astype(jnp.int64))
                 if node.mode == "partial":
                     # components travel separately to the final agg
@@ -1169,7 +1428,7 @@ class Executor:
         aggregates via prefix sums over the SQL default frame (RANGE
         UNBOUNDED PRECEDING..CURRENT ROW — peers share values), results
         scattered back to input row order."""
-        b = self.exec_node(node.child)
+        b = self.exec_node(node.child).ensure_all()
         n = b.padded
         iota = jnp.arange(n, dtype=jnp.int64)
         new_cols: dict = {}
@@ -1440,7 +1699,8 @@ class Executor:
 
     # ---- sort / limit ----
     def _exec_sort(self, node: P.Sort) -> DBatch:
-        b = self.exec_node(node.child)
+        # width-consuming: every carried column rides the sort payload
+        b = self.exec_node(node.child).ensure_all()
         key_arrs, descs = [], []
         for ke, desc in node.keys:
             arr, nm = self._eval_pair(ke, b)
@@ -1484,7 +1744,7 @@ class Executor:
             keep = keep & (idx > node.offset)
         if node.count is not None:
             keep = keep & (idx <= (node.count + node.offset))
-        return DBatch(b.cols, keep, b.types, b.dicts, b.nulls)
+        return DBatch(b.cols, keep, b.types, b.dicts, b.nulls, b.lazy)
 
     def _exec_result(self, node: P.Result) -> DBatch:
         cols, types, nulls = {}, {}, {}
@@ -1549,6 +1809,7 @@ def scalar_from_batch(b: DBatch):
     """One value or SQL NULL (None) from a scalar-subquery result — an
     empty subquery is NULL, not 0 (reference: ExecScanSubPlan's
     unset-param NULL).  Shared by the local and distributed executors."""
+    b.ensure_all()
     name = next(iter(b.cols))
     valid = np.asarray(b.valid)
     vals = np.asarray(b.cols[name])[valid]
@@ -1562,9 +1823,12 @@ def scalar_from_batch(b: DBatch):
 
 
 def materialize(b: DBatch, names: Optional[list[str]] = None):
-    """DBatch -> (column_names, list of python row tuples), decoded."""
+    """DBatch -> (column_names, list of python row tuples), decoded.
+    The final-projection materialization point: only the REQUESTED
+    columns leave the indirection layer."""
     if names is None:
-        names = list(b.cols.keys())
+        names = b.names()
+    b.ensure(names)
     valid = np.asarray(b.valid)
     rows_idx = np.nonzero(valid)[0]
     out_cols = []

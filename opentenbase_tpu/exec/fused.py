@@ -3,17 +3,27 @@
 Reference analog: this is where the rebuild's "XLA is the JIT" thesis
 pays — the reference interprets plans tuple-at-a-time (ExecProcNode) and
 JITs only expressions (src/backend/jit/llvm); here an entire
-SeqScan → Filter/Project → Agg → Sort/Limit fragment compiles into ONE
-jitted program, so XLA fuses visibility, quals, projections, aggregate
-transition and sort into a single pass over the columns with no
-intermediate materialization (the eager per-operator dispatch this
-replaces left ~10 full-column temporaries per query on the hot path).
+SeqScan → Filter/Project → [HashJoin...] → Agg → Sort/Limit fragment
+compiles into ONE jitted program, so XLA fuses visibility, quals,
+projections, join index-composition, aggregate transition and sort into
+a single pass over the columns with no intermediate materialization
+(the eager per-operator dispatch this replaces left ~10 full-column
+temporaries per query on the hot path).
 
-Mechanics: `try_fused` pattern-matches a traceable subtree (single
-SeqScan leaf, no operators that need host-side dynamic output sizing),
-stages the scan's device columns once (outside the trace), and runs the
-REGULAR Executor over the plan inside `jax.jit` with `_traced=True` —
-host-sync size classes switch to static worst-case shapes.
+Mechanics: `try_fused` pattern-matches a traceable subtree (SeqScan
+leaves — join subtrees with multiple scans included — no operators that
+need host-side dynamic output sizing), stages every leaf table's device
+columns once (outside the trace), and runs the REGULAR Executor over
+the plan inside `jax.jit` with `_traced=True` — host-sync size classes
+switch to static worst-case shapes.  Join outputs inside the trace use
+the SAME static size-class ladder the mesh tier runs under shard_map
+(exec/executor.py _exec_hashjoin `_traced` branch): a join's output
+class starts at a quarter of its larger input, the program reports
+per-join required totals, and the host retraces one step up on
+overflow — the learned factors persist in _JOIN_LADDER keyed by the
+literal-masked fragment shape, so steady state is one program call with
+ZERO per-join device→host syncs (the eager path pays one `int(total)`
+sync per join per query).
 
 Compiled programs live in the shared program cache (exec/plancache.py
 FUSED tier) under a CANONICAL FRAGMENT SIGNATURE: numeric/date literals
@@ -21,16 +31,21 @@ in scan filters and quals are masked out of the plan and ride as traced
 program inputs instead, so `WHERE l_shipdate <= X` with a different
 constant reuses the compiled executable (the reference's generic-plan
 arm, taken further: the plan cache there saves planning, this saves the
-XLA compile).  jax re-traces per array shape automatically — the
-pow2/quarter-step size classes bound that — and the cache's global
-live-executable budget evicts LRU programs deterministically.
+XLA compile).  Multi-table fragments key per-table components (store
+identity + TEXT dictionary lengths — dictionaries are trace constants).
+jax re-traces per array shape automatically — the pow2/quarter-step
+size classes bound that — and the cache's global live-executable budget
+evicts LRU programs deterministically.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Optional
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -43,14 +58,41 @@ from ..sql.fingerprint import struct_key
 from . import plancache
 
 # plan shapes whose literal-masked trace host-synced (a masked value
-# fed a host branch): retried and cached baked instead
-_MASK_REFUSED: set = set()
+# fed a host branch): retried and cached baked instead.  Bounded FIFO
+# (insertion-ordered dict): the oldest learned fallback is evicted one
+# at a time — a wholesale clear() would drop every learned entry at
+# once and force a burst of doomed literal-masked retraces.
+_MASK_REFUSED: dict = {}
+_MASK_REFUSED_MAX = 512
+
+# learned join-size ladder: literal-masked fragment shape -> {join id:
+# factor} — the single-device twin of MeshRunner._ladder, so a join
+# fragment's second statement (any literal binding) starts at the
+# right output class instead of replaying the overflow walk
+_JOIN_LADDER: dict = {}
+_JOIN_LADDER_MAX = 512
 
 # Observability hook: when set, called as EXPORT_HOOK(tag, fn, args)
 # after each successful fused execution — the TPU lowering proof
 # (utils/lowering_check.py) uses it to AOT-export the very programs the
 # engine ran.
 EXPORT_HOOK = None
+
+
+def _fuse_join_min_rows() -> int:
+    """Row floor (summed across the fragment's leaf tables) below which
+    join subtrees stay on the eager path — read per call so tests and
+    operators can flip it live."""
+    try:
+        return int(os.environ.get("OTB_FUSE_JOIN_MIN_ROWS", "8192"))
+    except ValueError:
+        return 8192
+
+
+def _mask_refused_add(k):
+    _MASK_REFUSED[k] = True
+    while len(_MASK_REFUSED) > _MASK_REFUSED_MAX:
+        _MASK_REFUSED.pop(next(iter(_MASK_REFUSED)))
 
 
 def _key_of_expr(e) -> tuple:
@@ -80,29 +122,59 @@ def _key_of(node) -> Optional[tuple]:
     if isinstance(node, P.Limit):
         c = _key_of(node.child)
         return None if c is None else (t, node.count, node.offset, c)
+    if isinstance(node, P.HashJoin):
+        lk, rk = _key_of(node.left), _key_of(node.right)
+        if lk is None or rk is None:
+            return None
+        return (t, node.kind, tuple(node.left_keys),
+                tuple(node.right_keys), tuple(node.residual or ()),
+                lk, rk)
     return None
 
 
-def _find_scan(node) -> Optional[P.SeqScan]:
-    """The single SeqScan leaf of a fusable chain, or None."""
-    seen_agg = False
-    while True:
-        if isinstance(node, P.SeqScan):
-            return node
-        if isinstance(node, (P.Filter, P.Project, P.Sort, P.Limit)):
-            node = node.child
-            continue
-        if isinstance(node, P.Agg):
-            if node.mode == "final":
-                return None  # operates on exchange input
-            if seen_agg:
-                return None
-            if any(ac.distinct for _, ac in node.aggs):
-                return None  # host-driven two-pass path
-            seen_agg = True
-            node = node.child
-            continue
-        return None
+def _find_scans(node) -> Optional[list]:
+    """The SeqScan leaves of a fusable subtree, or None.  Join subtrees
+    (multi-scan fragments) fuse: every leaf must bottom out in a
+    SeqScan through Filter/Project/Sort/Limit chains; one non-distinct
+    Agg is allowed above the joins (the Q3/Q5 shape)."""
+    scans: list = []
+    state = {"agg": False}
+
+    def chain(nd, under_join: bool) -> bool:
+        while True:
+            if isinstance(nd, P.SeqScan):
+                scans.append(nd)
+                return True
+            if isinstance(nd, (P.Filter, P.Project, P.Sort, P.Limit)):
+                nd = nd.child
+                continue
+            if isinstance(nd, P.Agg):
+                if nd.mode == "final":
+                    return False  # operates on exchange input
+                if state["agg"] or under_join:
+                    return False
+                if any(ac.distinct for _, ac in nd.aggs):
+                    return False  # host-driven two-pass path
+                state["agg"] = True
+                nd = nd.child
+                continue
+            if isinstance(nd, P.HashJoin):
+                if nd.kind == "cross":
+                    return False  # output sized by a host count
+                return chain(nd.left, True) and chain(nd.right, True)
+            return False
+
+    return scans if chain(node, False) else None
+
+
+def _plan_has_join(node) -> bool:
+    if isinstance(node, P.HashJoin):
+        return True
+    for attr in ("child", "left", "right"):
+        c = getattr(node, attr, None)
+        if isinstance(c, P.PhysNode) and _plan_has_join(c):
+            return True
+    return False
 
 
 def _has_transformed_dup_dict(node, store) -> bool:
@@ -173,7 +245,7 @@ def _mask_expr(e, lits: list):
 
 
 def _mask_node(node, lits: list):
-    """Canonical fragment form: clone the fusable chain with numeric
+    """Canonical fragment form: clone the fusable subtree with numeric
     predicate literals replaced by __fraglitN parameter columns (walk
     order = positional identity, so equal-shaped fragments bind their
     literals to the same traced slots)."""
@@ -186,6 +258,13 @@ def _mask_node(node, lits: list):
         return dataclasses.replace(
             node, quals=[_mask_expr(q, lits) for q in node.quals],
             child=_mask_node(node.child, lits))
+    if isinstance(node, P.HashJoin):
+        return dataclasses.replace(
+            node,
+            residual=[_mask_expr(q, lits)
+                      for q in (node.residual or [])],
+            left=_mask_node(node.left, lits),
+            right=_mask_node(node.right, lits))
     if isinstance(node, (P.Project, P.Agg, P.Sort, P.Limit)):
         return dataclasses.replace(node,
                                    child=_mask_node(node.child, lits))
@@ -198,33 +277,43 @@ def try_fused(executor, node) -> Optional[object]:
 
 
 def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
-    if not isinstance(node, (P.Agg, P.Project, P.Filter, P.Sort, P.Limit)):
-        return None   # bare SeqScan gains nothing; joins unsupported
-    scan = _find_scan(node)
-    if scan is None:
+    if not isinstance(node, (P.Agg, P.Project, P.Filter, P.Sort,
+                             P.Limit, P.HashJoin)):
+        return None   # bare SeqScan gains nothing
+    scans = _find_scans(node)
+    if not scans:
         return None
     ctx = executor.ctx
-    store = ctx.stores.get(scan.table.name)
-    if store is None or (ctx.staged and scan.table.name in ctx.staged):
-        return None
+    stores: dict = {}
+    for scan in scans:
+        store = ctx.stores.get(scan.table.name)
+        if store is None or \
+                (ctx.staged and scan.table.name in ctx.staged):
+            return None
+        stores[scan.table.name] = store
     if _key_of(node) is None:
         return None
-    if _has_transformed_dup_dict(node, store):
-        return None
+    for store in stores.values():
+        if _has_transformed_dup_dict(node, store):
+            return None
 
-    # canonical fragment signature: literal-masked plan + dtypes; the
-    # masked literals ride as traced inputs alongside numeric init-plan
-    # params (re-planned scalar subquery values must not recompile the
-    # fragment either); everything else (strings, NULLs — they change
-    # program structure) is baked and keyed
+    # canonical fragment signature: literal-masked plan + per-table
+    # components (store identity + dictionary lengths — dictionaries
+    # are baked trace constants) + dtypes; the masked literals ride as
+    # traced inputs alongside numeric init-plan params (re-planned
+    # scalar subquery values must not recompile the fragment either);
+    # everything else (strings, NULLs — they change program structure)
+    # is baked and keyed
     lits: list = []
     exec_node_plan = _mask_node(node, lits) if allow_mask else node
     key = _key_of(exec_node_plan)
     if key is None:
         return None
 
-    dict_lens = tuple(sorted((c, len(d.values))
-                             for c, d in store.dicts.items()))
+    table_sig = tuple(
+        (t, id(st), tuple(sorted((c, len(d.values))
+                                 for c, d in st.dicts.items())))
+        for t, st in sorted(stores.items()))
     traced_names = tuple(sorted(
         k for k, (v, _t) in ctx.params.items()
         if isinstance(v, (int, float)) and not isinstance(v, bool)))
@@ -237,84 +326,163 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
         return None  # non-scalar param: don't risk a stale closure
     types_key = tuple((k, ctx.params[k][1]) for k in traced_names)
     lit_types = tuple(t for _n, _v, t in lits)
-    full_key = (key, id(store), dict_lens, baked_key, types_key,
-                lit_types)
+    base_key = (key, table_sig, baked_key, types_key, lit_types)
     try:
-        hash(full_key)
+        hash(base_key)
     except TypeError:
         return None  # unhashable plan content (e.g. an unrewritten link)
-    if lits and struct_key(full_key) in _MASK_REFUSED:
+    if lits and struct_key(base_key) in _MASK_REFUSED:
         return _try_fused(executor, node, allow_mask=False)
 
-    # stage ONCE outside the trace (device cache, version-keyed)
-    needed = sorted(_needed_columns(node, scan.alias))
-    arrs, n = ctx.cache.get(store, needed)
+    has_join = _plan_has_join(exec_node_plan)
+    if has_join and sum(
+            st.row_count() for st in stores.values()) \
+            < _fuse_join_min_rows():
+        # tiny join fragments: the eager path's per-join host sync
+        # costs microseconds while a fresh XLA compile costs seconds —
+        # fusing only pays above a row floor (0 = always fuse)
+        return None
 
-    hit = plancache.FUSED.get(full_key)
-    if hit is None:
-        from .executor import ExecContext, Executor
+    # stage ONCE outside the trace (device cache, version-keyed); a
+    # self-join's scans share one staged entry per table with the union
+    # of their needed columns
+    need_by_table: dict = {}
+    for scan in scans:
+        need_by_table.setdefault(scan.table.name, set()).update(
+            _needed_columns(node, scan.alias))
+    staged_arrs: dict = {}
+    staged_ns: dict = {}
+    for t, need in sorted(need_by_table.items()):
+        arrs, n = ctx.cache.get(stores[t], sorted(need))
+        staged_arrs[t] = arrs
+        staged_ns[t] = jnp.int64(n)
 
-        meta: dict = {}
-        traced_types = [ctx.params[k][1] for k in traced_names] \
-            + [t for _n, _v, t in lits]
-        all_traced = list(traced_names) + [nm for nm, _v, _t in lits]
-        frag_plan = exec_node_plan
+    lkey = struct_key(base_key)
+    factors: dict = dict(_JOIN_LADDER.get(lkey, {})) if has_join else {}
 
-        def run(arrs_in, snap, txid, pvals, n_live):
-            # n_live is TRACED: the row count changes with every write,
-            # and a static count would recompile the fragment per
-            # insert-then-read cycle (the OLTP pattern); only the padded
-            # shape (power-of-two) retraces
-            sub_params = dict(baked)
-            for name, pv, t in zip(all_traced, pvals, traced_types):
-                sub_params[name] = (pv, t)
-            sub_ctx = ExecContext(
-                ctx.stores, snap, txid, ctx.cache,
-                params=sub_params,
-                staged={scan.table.name: (arrs_in, n_live)})
-            sub = Executor(sub_ctx)
-            sub._traced = True
-            b = sub.exec_node(frag_plan)
-            meta["types"] = b.types
-            meta["dicts"] = b.dicts
-            return b.cols, b.valid, b.nulls
-
-        fn = jax.jit(run)
-        hit = plancache.FUSED.put(full_key, (fn, meta))
-    fn, meta = hit
-    if fn is None:
-        return None  # permanently fell back for this plan shape
     pvals = tuple(
         [jnp.asarray(ctx.params[k][0]) for k in traced_names]
         + [jnp.asarray(v) for _n, v, _t in lits])
-    t0 = time.perf_counter()
-    try:
-        cols, valid, nulls = fn(arrs, jnp.int64(ctx.snapshot_ts),
-                                jnp.int64(ctx.txid), pvals,
-                                jnp.int64(n))
-    except (jax.errors.TracerBoolConversionError,
-            jax.errors.ConcretizationTypeError,
-            jax.errors.TracerArrayConversionError):
-        if lits:
-            # a MASKED literal fed a host-sync (value-dependent program
-            # structure): remember and retry with literals baked
-            _MASK_REFUSED.add(struct_key(full_key))
-            if len(_MASK_REFUSED) > 512:
-                _MASK_REFUSED.clear()
+    from .executor import EXEC_STATS, stats_tier
+
+    for _attempt in range(24):
+        full_key = base_key + (tuple(sorted(factors.items())),)
+        hit = plancache.FUSED.get(full_key)
+        if hit is None:
+            hit = plancache.FUSED.put(
+                full_key, _build_program(ctx, exec_node_plan, baked,
+                                         traced_names, lits, factors))
+        elif has_join and hit[0] is not None:
+            EXEC_STATS["fused"]["fused_join_hits"] += 1
+        fn, meta = hit
+        if fn is None:
+            return None  # permanently fell back for this plan shape
+        t0 = time.perf_counter()
+        try:
+            with stats_tier("fused"):
+                # trace-time executor counters attribute to the fused
+                # tier (re-executions don't re-trace)
+                cols, valid, nulls, join_req = fn(
+                    staged_arrs, jnp.int64(ctx.snapshot_ts),
+                    jnp.int64(ctx.txid), pvals, staged_ns)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError):
+            if lits:
+                # a MASKED literal fed a host-sync (value-dependent
+                # program structure): remember and retry with literals
+                # baked
+                _mask_refused_add(struct_key(base_key))
+                plancache.FUSED.pop(full_key)
+                return _try_fused(executor, node, allow_mask=False)
+            # a host-sync slipped through the fusability screen:
+            # permanently fall back for this plan shape
+            plancache.FUSED.replace(full_key, (None, None))
+            return None
+        except Exception:
             plancache.FUSED.pop(full_key)
-            return _try_fused(executor, node, allow_mask=False)
-        # a host-sync slipped through the fusability screen: permanently
-        # fall back for this plan shape
-        plancache.FUSED.replace(full_key, (None, None))
-        return None
-    except Exception:
-        plancache.FUSED.pop(full_key)
-        raise
-    plancache.FUSED.record_call(fn, t0)
-    if EXPORT_HOOK is not None:
-        EXPORT_HOOK("fused", fn,
-                    (arrs, jnp.int64(ctx.snapshot_ts),
-                     jnp.int64(ctx.txid), pvals, jnp.int64(n)))
-    from .executor import DBatch
-    return DBatch(dict(cols), valid, dict(meta["types"]),
-                  dict(meta["dicts"]), dict(nulls))
+            raise
+        plancache.FUSED.record_call(fn, t0)
+
+        # join-size ladder: the program reports each traced join's
+        # required output rows; overflow grows exactly that join's
+        # factor and retraces (one host sync per program call — never
+        # per join).  Learned factors persist per fragment shape.
+        caps = meta.get("join_caps") or ()
+        if caps:
+            req = np.asarray(jax.device_get(join_req))
+            grew = False
+            for (jid, cap), r in zip(caps, req):
+                if r <= cap:
+                    continue
+                # the program reports the EXACT required rows (unlike
+                # the mesh tier's overflow bit): jump the factor
+                # straight to the class that fits — ONE retrace, not a
+                # doubling walk of compiles
+                mult = 1
+                while cap * mult < r:
+                    mult *= 2
+                factors[jid] = factors.get(jid, 1) * mult
+                if factors[jid] > 4096:
+                    return None  # ladder exhausted: eager fallback
+                grew = True
+            if grew:
+                _ladder_remember(lkey, factors)
+                continue
+        if has_join:
+            _ladder_remember(lkey, factors)
+        if EXPORT_HOOK is not None:
+            EXPORT_HOOK("fused", fn,
+                        (staged_arrs, jnp.int64(ctx.snapshot_ts),
+                         jnp.int64(ctx.txid), pvals, staged_ns))
+        from .executor import DBatch
+        return DBatch(dict(cols), valid, dict(meta["types"]),
+                      dict(meta["dicts"]), dict(nulls))
+    return None  # overflow never converged: eager fallback
+
+
+def _ladder_remember(lkey, factors: dict):
+    _JOIN_LADDER[lkey] = dict(factors)
+    while len(_JOIN_LADDER) > _JOIN_LADDER_MAX:
+        _JOIN_LADDER.pop(next(iter(_JOIN_LADDER)))
+
+
+def _build_program(ctx, frag_plan, baked, traced_names, lits, factors):
+    """jit the fragment runner.  The program's leaf tables arrive as a
+    dict-of-dicts of traced arrays; per-table live row counts are
+    traced scalars (a write changes the count every time — a static
+    count would recompile the fragment per insert-then-read cycle);
+    only the padded shapes (size classes) retrace."""
+    from .executor import ExecContext, Executor
+
+    meta: dict = {}
+    traced_types = [ctx.params[k][1] for k in traced_names] \
+        + [t for _n, _v, t in lits]
+    all_traced = list(traced_names) + [nm for nm, _v, _t in lits]
+    join_factors = dict(factors)
+
+    def run(arrs_in, snap, txid, pvals, ns_in):
+        sub_params = dict(baked)
+        for name, pv, t in zip(all_traced, pvals, traced_types):
+            sub_params[name] = (pv, t)
+        sub_ctx = ExecContext(
+            ctx.stores, snap, txid, ctx.cache,
+            params=sub_params,
+            staged={t: (arrs_in[t], ns_in[t]) for t in arrs_in},
+            join_factors=join_factors)
+        sub = Executor(sub_ctx, frag_tag="__fused")
+        sub._traced = True
+        b = sub.exec_node(frag_plan)
+        # the single deferred materialization pass: program outputs are
+        # real columns (only what survived projection/agg)
+        b.ensure_all()
+        meta["types"] = b.types
+        meta["dicts"] = b.dicts
+        meta["join_caps"] = tuple(
+            (jid, cap) for jid, _req, cap in sub.join_required)
+        join_req = jnp.stack(
+            [req for _jid, req, _cap in sub.join_required]) \
+            if sub.join_required else jnp.zeros(0, jnp.int64)
+        return b.cols, b.valid, b.nulls, join_req
+
+    return jax.jit(run), meta

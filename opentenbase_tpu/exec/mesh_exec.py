@@ -522,8 +522,11 @@ class MeshRunner:
         from .executor import DBatch
         ndn = self.cluster.ndn
         if ndn == 1:
-            # single-node mesh: routing is the identity; no collective
+            # single-node mesh: routing is the identity; no collective —
+            # and no materialization: the consumer fragment keeps
+            # composing through the indirection in the same program
             return b, jnp.int64(0)
+        b.ensure_all()   # exchange: rows physically move between shards
         src_pad = int(b.valid.shape[0])
         cap = next_pow2(src_pad)
         bucket = min(cap, max(64, next_pow2(-(-src_pad // ndn)) * mult))
@@ -569,6 +572,9 @@ class MeshRunner:
 
     def _broadcast_batch(self, b):
         from .executor import DBatch
+        if self.cluster.ndn == 1:
+            return b     # identity broadcast: keep the indirection
+        b.ensure_all()   # exchange: rows replicate to every shard
 
         def ag(arr):
             return jax.lax.all_gather(arr, self.axis, tiled=True)
@@ -745,14 +751,14 @@ class MeshRunner:
         idx = jnp.clip(
             jnp.searchsorted(csum, jnp.arange(1, gsz + 1)), 0,
             padded - 1)
-
-        def take(a):
-            return a[idx]
-
         valid = jnp.arange(gsz) < n_live
         over = (n_live > gsz).astype(jnp.int64)
-        return ({n: take(a) for n, a in b.cols.items()}, valid,
-                {n: take(a) for n, a in b.nulls.items()}, over)
+        # indirection-aware: gather_rows composes the compaction index
+        # straight through any join indirection, so a gather fragment
+        # ending in a join chain ships gsz rows WITHOUT ever
+        # materializing the full-width join output buffer
+        cols, nulls = b.gather_rows(idx)
+        return (cols, valid, nulls, over)
 
     @staticmethod
     def _topk_spec(ob, ex):
@@ -765,8 +771,8 @@ class MeshRunner:
             return None
         names, descs = [], []
         for k, desc in ex.sort_keys:
-            if not isinstance(k, E.Col) or k.name not in ob.cols \
-                    or k.name in ob.nulls \
+            if not isinstance(k, E.Col) or not ob.has_col(k.name) \
+                    or ob.maybe_null(k.name) \
                     or ob.types[k.name].kind == TypeKind.TEXT:
                 return None
             names.append(k.name)
@@ -877,9 +883,16 @@ class MeshRunner:
         except TypeError:
             raise MeshUnsupported("unhashable plan content") from None
 
+        has_join = any(
+            isinstance(n, P.HashJoin)
+            for f in dp.fragments if f.index in included
+            for n in self._walk(f.plan))
         cached = plancache.MESH.get(prog_key)
         if cached is not None:
             fn, meta = cached
+            if has_join:
+                from .executor import EXEC_STATS
+                EXEC_STATS["mesh"]["fused_join_hits"] += 1
             return self._call_program(fn, meta, gather_idx, staged,
                                       table_names, snapshot_ts, txid,
                                       params)
@@ -995,6 +1008,7 @@ class MeshRunner:
 
     def _call_program(self, fn, meta, gather_idx, staged, table_names,
                       snapshot_ts, txid, params):
+        from .executor import stats_tier
         flat_args = [jnp.int64(snapshot_ts), jnp.int64(txid)]
         for k in meta.get("traced", ()):
             v, t = params[k]
@@ -1004,7 +1018,10 @@ class MeshRunner:
                 flat_args.append(staged[t].arrs[n])
             flat_args.append(staged[t].nrows)
         t0 = time.perf_counter()
-        outs, a2a_over_vec, join_over, g_over_vec = fn(*flat_args)
+        with stats_tier("mesh"):
+            # executor counters inside the trace attribute to the mesh
+            # tier (first call of a fresh program traces here)
+            outs, a2a_over_vec, join_over, g_over_vec = fn(*flat_args)
         plancache.MESH.record_call(fn, t0)
         if EXPORT_HOOK is not None:
             EXPORT_HOOK("mesh", fn, tuple(flat_args))

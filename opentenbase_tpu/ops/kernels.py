@@ -399,6 +399,17 @@ def join_expand(lo, counts, perm, out_size: int, left_outer: bool = False,
 
 
 @jax.jit
+def compose_index(prior, take):
+    """Late-materialization index composition: `prior` maps an operator's
+    output positions to source rows, `take` re-points a downstream
+    operator's output into that space — the result maps the downstream
+    output DIRECTLY to source rows.  One int gather of len(take),
+    regardless of how many payload columns ride the indirection: this is
+    the whole-join replacement for per-column payload gathers."""
+    return prior[take]
+
+
+@jax.jit
 def semi_mask(counts):
     return counts > 0
 

@@ -16,6 +16,10 @@ Views:
 - otb_buffercache(table_name, hits, misses, bytes_live, evictions,
   invalidations) — the device buffer pool's per-table counters
   (storage/bufferpool.py)
+- otb_execstats(tier, joins, index_compositions, deferred_cols,
+  eager_cols, cols_materialized, bytes_materialized, host_syncs,
+  fused_join_hits) — the executor's late-materialization join counters
+  (exec/executor.py EXEC_STATS)
 """
 
 from __future__ import annotations
@@ -74,6 +78,28 @@ STAT_TABLES = {
         ColumnDef("misses", T.INT64), ColumnDef("bytes_live", T.INT64),
         ColumnDef("evictions", T.INT64),
         ColumnDef("invalidations", T.INT64)],
+    # executor late-materialization telemetry (exec/executor.py
+    # EXEC_STATS): one row per execution tier.  "single" counts every
+    # eager operator dispatch; "fused"/"mesh" count TRACE-time events
+    # (a cached program re-executes without re-tracing) plus compiled
+    # join-program cache-hit executions (fused_join_hits).
+    # deferred_cols = column gathers a join AVOIDED (index composition
+    # carried the column instead); eager_cols = full-width join gathers
+    # (the pre-late-materialization path, or LATE_MAT off);
+    # cols/bytes_materialized = what the deferred pass actually gathered
+    # when a width-consuming operator (Agg input, Sort, exchange, final
+    # projection) demanded real columns; host_syncs = per-join
+    # device->host size syncs on the eager path (zero when a join chain
+    # runs as one fused program).
+    "otb_execstats": [
+        ColumnDef("tier", T.TEXT), ColumnDef("joins", T.INT64),
+        ColumnDef("index_compositions", T.INT64),
+        ColumnDef("deferred_cols", T.INT64),
+        ColumnDef("eager_cols", T.INT64),
+        ColumnDef("cols_materialized", T.INT64),
+        ColumnDef("bytes_materialized", T.INT64),
+        ColumnDef("host_syncs", T.INT64),
+        ColumnDef("fused_join_hits", T.INT64)],
 }
 
 
@@ -149,6 +175,9 @@ def refresh(cluster, names: list[str]):
         elif name == "otb_buffercache":
             from ..storage.bufferpool import POOL
             rows = list(POOL.stats_rows())
+        elif name == "otb_execstats":
+            from ..exec.executor import exec_stats_rows
+            rows = list(exec_stats_rows())
         elif name == "otb_resgroups":
             usage = getattr(cluster, "resgroup_usage", {})
             for gname, g in cluster.catalog.resource_groups.items():

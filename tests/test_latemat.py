@@ -1,0 +1,153 @@
+"""Late-materialization join pipeline (exec/executor.py LazyCol /
+_defer_side): every join kind must be bit-identical to the eager
+full-width gather path, and counters must prove intermediate joins in a
+chain move INDICES, not payload columns (the "move indices, not
+payloads" invariant an accelerator join pipeline lives by)."""
+
+import pytest
+
+from opentenbase_tpu.exec import executor as X
+from opentenbase_tpu.exec import fused
+from opentenbase_tpu.exec.session import LocalNode, Session
+
+
+@pytest.fixture()
+def nofuse(monkeypatch):
+    """Force the eager per-operator dispatch (fusion off) so the join
+    executor itself — not the traced program — is under test."""
+    monkeypatch.setattr(fused, "try_fused", lambda *_a, **_k: None)
+
+
+def _sess():
+    node = LocalNode()
+    s = Session(node)
+    s.execute("create table ta (k bigint, k2 bigint, av bigint, "
+              "at text)")
+    s.execute("create table tb (k bigint, k2 bigint, bv bigint, "
+              "bt text)")
+    # duplicate keys (expansion), NULL keys (never match), NULL
+    # payloads, disjoint tails (outer-join extension on both sides)
+    s.execute("insert into ta values "
+              "(1, 10, 100, 'a1'), (1, 11, 101, 'a2'), "
+              "(2, 20, 200, 'a3'), (3, 30, null, 'a4'), "
+              "(null, 40, 400, 'a5'), (7, 70, 700, 'a7')")
+    s.execute("insert into tb values "
+              "(1, 10, 1000, 'b1'), (1, 10, 1001, 'b2'), "
+              "(2, 21, 2000, 'b3'), (4, 40, null, 'b4'), "
+              "(null, 50, 5000, 'b5'), (9, 90, 9000, 'b9')")
+    return s
+
+
+QUERIES = [
+    # inner, single key
+    "select ta.av, tb.bv, ta.at, tb.bt from ta, tb "
+    "where ta.k = tb.k order by ta.av, tb.bv",
+    # inner, multi-key (hash-combined + recheck)
+    "select ta.av, tb.bv from ta, tb "
+    "where ta.k = tb.k and ta.k2 = tb.k2 order by ta.av, tb.bv",
+    # inner + residual qual
+    "select ta.av, tb.bv from ta, tb "
+    "where ta.k = tb.k and ta.av < tb.bv order by ta.av, tb.bv",
+    # left outer, NULL keys never match, unmatched rows null-extend
+    "select ta.av, tb.bv, tb.bt from ta left join tb on ta.k = tb.k "
+    "order by ta.av, tb.bv",
+    # left outer, multi-key: revert-to-null-extension after recheck
+    "select ta.av, tb.bv from ta left join tb "
+    "on ta.k = tb.k and ta.k2 = tb.k2 order by ta.av, tb.bv",
+    # full outer: unmatched build rows append null-extended
+    "select ta.av, tb.bv from ta full join tb on ta.k = tb.k "
+    "order by ta.av, tb.bv",
+    # semi (EXISTS)
+    "select ta.av from ta where exists "
+    "(select 1 from tb where tb.k = ta.k) order by ta.av",
+    # anti (NOT EXISTS)
+    "select ta.av from ta where not exists "
+    "(select 1 from tb where tb.k = ta.k) order by ta.av",
+    # semi with correlated residual (per-probe any() over residual)
+    "select ta.av from ta where exists "
+    "(select 1 from tb where tb.k = ta.k and tb.bv > ta.av) "
+    "order by ta.av",
+]
+
+
+class TestJoinSemantics:
+    @pytest.mark.parametrize("qi", range(len(QUERIES)))
+    def test_bit_identical_vs_eager(self, nofuse, monkeypatch, qi):
+        q = QUERIES[qi]
+        monkeypatch.setattr(X, "LATE_MAT", False)
+        want = _sess().query(q)
+        monkeypatch.setattr(X, "LATE_MAT", True)
+        got = _sess().query(q)
+        assert got == want, f"late-mat drift on: {q}"
+
+    def test_eager_path_counts_eager_gathers(self, nofuse, monkeypatch):
+        monkeypatch.setattr(X, "LATE_MAT", False)
+        s = _sess()
+        x0 = X.exec_stats_snapshot()
+        s.query("select ta.av, tb.bv from ta, tb where ta.k = tb.k")
+        x1 = X.exec_stats_snapshot()
+        assert x1["eager_cols"] > x0["eager_cols"]
+        assert x1["deferred_cols"] == x0["deferred_cols"]
+
+
+class TestZeroIntermediateGathers:
+    def test_three_join_chain_composes_indices(self, nofuse):
+        """A >=3-join chain must perform ZERO full-width intermediate
+        gathers: every join defers every carried column; only the
+        columns the top of the plan actually touches materialize."""
+        node = LocalNode()
+        s = Session(node)
+        # 4 tables x 4 payload columns each = 16 carried value columns
+        for t in ("j1", "j2", "j3", "j4"):
+            s.execute(f"create table {t} (k bigint, {t}a bigint, "
+                      f"{t}b bigint, {t}c bigint)")
+            s.execute(f"insert into {t} values "
+                      + ", ".join(f"({i}, {i * 2}, {i * 3}, {i * 4})"
+                                  for i in range(40)))
+        x0 = X.exec_stats_snapshot()
+        rows = s.query(
+            "select j1.j1a, j4.j4c from j1, j2, j3, j4 "
+            "where j1.k = j2.k and j2.k = j3.k and j3.k = j4.k "
+            "order by j1.j1a")
+        x1 = X.exec_stats_snapshot()
+        assert rows == [(i * 2, i * 4) for i in range(40)]
+        d = {f: x1[f] - x0[f] for f in x0}
+        assert d["joins"] == 3
+        # the late-materialization invariant: no join gathered ANY
+        # payload column eagerly...
+        assert d["eager_cols"] == 0
+        # ...every carried column was deferred at every join...
+        assert d["deferred_cols"] >= 16
+        # ...and the single materialization pass gathered only what the
+        # plan touches above the joins (2 projected outputs + at most
+        # one key column per downstream join), never the full width
+        assert 0 < d["cols_materialized"] <= 6
+        assert d["index_compositions"] >= 2
+
+    def test_filter_and_limit_preserve_indirection(self, nofuse):
+        """Filter/Limit are not width-consuming: a post-join filter must
+        evaluate only its own columns, leaving the rest deferred."""
+        s = _sess()
+        x0 = X.exec_stats_snapshot()
+        rows = s.query("select ta.at, tb.bt from ta, tb "
+                       "where ta.k = tb.k and ta.av >= 200 "
+                       "order by ta.at, tb.bt")
+        x1 = X.exec_stats_snapshot()
+        assert rows == [("a3",) * 1 + ("b3",)] or rows == [("a3", "b3")]
+        d = {f: x1[f] - x0[f] for f in x0}
+        assert d["eager_cols"] == 0
+        assert d["deferred_cols"] >= 8
+
+
+class TestStatView:
+    def test_otb_execstats_rows(self):
+        from opentenbase_tpu.exec.dist_session import ClusterSession
+        from opentenbase_tpu.parallel.cluster import Cluster
+        s = ClusterSession(Cluster(n_datanodes=2))
+        rows = s.query("select tier, joins, deferred_cols, "
+                       "cols_materialized, host_syncs, fused_join_hits "
+                       "from otb_execstats order by tier")
+        tiers = [r[0] for r in rows]
+        assert tiers == ["fused", "mesh", "single"]
+        for r in rows:
+            assert all(isinstance(v, int) and v >= 0 for v in r[1:])
