@@ -1,0 +1,27 @@
+"""otblint — engine-invariant static analysis.
+
+The compiled-query engine lives or dies by invariants the Python
+runtime and XLA can't check for us (Flare/Tailwind make the same point
+for native Spark/query-accelerator stacks, PAPERS.md):
+
+- code reachable from a traced program (jax.jit / shard_map) must not
+  host-sync a traced value (``int(total)`` inside a join kernel turns
+  one compiled program into a ping-pong of device round-trips — or a
+  TracerBoolConversionError at trace time);
+- traced code must be PURE: an ``os.environ`` read mid-trace bakes a
+  flag into a cached executable that outlives the flag;
+- every input that shapes a compiled program must reach that program's
+  cache key (PR 2's staged-array-namespace crash: a post-DML ``__null``
+  input changed the program arity under an unchanged key);
+- module-level mutable state shared by the threaded CN/DN/GTM servers
+  must be written under its declared lock (``# guarded_by: <lock>``).
+
+``python -m opentenbase_tpu.analysis.lint`` runs the four AST passes
+over the package and reports JSON findings (rule id + file:line), gated
+by a checked-in baseline (``baseline.json``) so pre-existing findings
+are explicit and ratcheted — new code scans clean or fails CI.
+``analysis/hlo_audit.py`` extends the same rule/report machinery to the
+StableHLO of every exported kernel and live fused/mesh program.
+"""
+
+from .core import Finding, Project  # noqa: F401

@@ -1,0 +1,30 @@
+"""``python -m opentenbase_tpu.analysis`` — lint + HLO audit gate.
+
+Runs the four otblint passes and (unless ``--no-hlo``) the StableHLO
+kernel audit; exits nonzero when either leaves unsuppressed findings,
+so a single command gates CI.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    run_hlo = "--no-hlo" not in argv
+    argv = [a for a in argv if a != "--no-hlo"]
+
+    from . import lint
+    rc = lint.main(argv)
+
+    if run_hlo and not any(a.startswith("--write-baseline")
+                           for a in argv):
+        from . import hlo_audit
+        rc_hlo = hlo_audit.main(["--kernels-only"])
+        rc = rc or rc_hlo
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

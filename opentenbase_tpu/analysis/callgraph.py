@@ -1,0 +1,314 @@
+"""Traced-region call graph: which functions can run under a trace.
+
+Roots are discovered, not configured:
+
+- any local function passed to ``jax.jit`` / ``jit`` / ``shard_map``
+  (exec/fused.py ``_build_program.run``, exec/mesh_exec.py
+  ``_execute.prog``);
+- every public top-level function of ``ops.kernels`` (the jit-inlined
+  kernel library — each is traced whenever an engine program uses it).
+
+Edges are name-resolved over the package's ASTs:
+
+- plain calls to same-module or imported functions;
+- ``mod.fn(...)`` through import aliases;
+- ``self.m(...)`` to the enclosing class (plus same-module classes);
+- ``obj.m(...)`` to any scanned class method named ``m`` when the name
+  is distinctive (a blocklist keeps ``get``/``put``/``items``/... from
+  wiring the closure to the whole repo);
+- the executor's ``getattr(self, f"_exec_{...}")`` dispatch expands to
+  every same-class method matching the literal prefix.
+
+Calls inside an EAGER region — an ``if not self._traced:`` branch, the
+``else`` of ``if self._traced:``, or the else-arm of a ``_traced``
+ternary — do not create edges: that is the engine's sanctioned
+traced/eager split (exec/executor.py).  Functions marked
+``# otblint: eager-only`` are asserted host-side and stop the walk.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import FuncInfo, Project
+
+#: method names too generic to resolve across classes by name alone
+GENERIC_NAMES = frozenset({
+    "get", "put", "pop", "push", "add", "items", "keys", "values",
+    "append", "extend", "update", "clear", "sort", "sorted", "copy",
+    "setdefault", "remove", "discard", "insert", "index", "count",
+    "join", "split", "rsplit", "strip", "lstrip", "rstrip", "replace",
+    "startswith", "endswith", "format", "encode", "decode", "lower",
+    "upper", "title", "find", "rfind", "search", "match", "fullmatch",
+    "group", "groups", "findall", "finditer", "sub", "read", "write",
+    "close", "flush", "send", "recv", "sendall", "connect", "bind",
+    "listen", "accept", "acquire", "release", "wait", "notify", "set",
+    "is_set", "start", "run", "cancel", "result", "done", "next",
+    "item", "tolist", "astype", "reshape", "sum", "min", "max", "mean",
+    "any", "all", "exists", "mkdir", "open",
+})
+
+_JIT_NAMES = {"jit", "shard_map", "pjit", "checkpoint", "remat"}
+
+
+def _call_name(func) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def is_traced_guard_test(test) -> Optional[str]:
+    """Classify an ``if`` test against the engine's _traced idiom:
+    returns "traced" when the true-branch is the traced side, "eager"
+    when the true-branch is the eager side, None when unrelated.  A
+    conjunction counts if any conjunct does."""
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        for v in test.values:
+            r = is_traced_guard_test(v)
+            if r is not None:
+                return r
+        return None
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = is_traced_guard_test(test.operand)
+        if inner == "traced":
+            return "eager"
+        if inner == "eager":
+            return "traced"
+        return None
+    if isinstance(test, ast.Attribute) and test.attr == "_traced":
+        return "traced"
+    if isinstance(test, ast.Name) and test.id == "_traced":
+        return "traced"
+    return None
+
+
+class _GuardedWalker:
+    """Shared statement walker that tracks whether the current position
+    is inside an eager-only region of a function body.  Subclass hooks:
+    ``on_call``, ``on_stmt``, ``on_expr`` (all optional)."""
+
+    def walk_function(self, fn_node):
+        for st in fn_node.body:
+            self._stmt(st, eager=False)
+
+    # -- statements -----------------------------------------------------
+    def _stmt(self, st, eager: bool):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested defs are separate call-graph nodes
+        self.on_stmt(st, eager)
+        if isinstance(st, ast.If):
+            side = is_traced_guard_test(st.test)
+            self._expr(st.test, eager)
+            body_eager = eager or side == "eager"
+            else_eager = eager or side == "traced"
+            for s in st.body:
+                self._stmt(s, body_eager)
+            for s in st.orelse:
+                self._stmt(s, else_eager)
+            return
+        for field in ("body", "orelse", "finalbody"):
+            for s in getattr(st, field, []) or []:
+                self._stmt(s, eager)
+        for h in getattr(st, "handlers", []) or []:
+            for s in h.body:
+                self._stmt(s, eager)
+        for e in ast.iter_child_nodes(st):
+            if isinstance(e, ast.expr):
+                self._expr(e, eager)
+            elif isinstance(e, (ast.withitem,)):
+                self._expr(e.context_expr, eager)
+            elif isinstance(e, ast.ExceptHandler) and e.type:
+                self._expr(e.type, eager)
+
+    # -- expressions ----------------------------------------------------
+    def _expr(self, e, eager: bool):
+        if isinstance(e, ast.IfExp):
+            side = is_traced_guard_test(e.test)
+            self._expr(e.test, eager)
+            self._expr(e.body, eager or side == "eager")
+            self._expr(e.orelse, eager or side == "traced")
+            return
+        if isinstance(e, (ast.Lambda,)):
+            self._expr(e.body, eager)
+            return
+        if isinstance(e, ast.Call):
+            self.on_call(e, eager)
+        self.on_expr(e, eager)
+        for c in ast.iter_child_nodes(e):
+            if isinstance(c, ast.expr):
+                self._expr(c, eager)
+            elif isinstance(c, ast.comprehension):
+                self._expr(c.iter, eager)
+                for cond in c.ifs:
+                    self._expr(cond, eager)
+
+    # -- hooks ----------------------------------------------------------
+    def on_call(self, call, eager: bool):
+        pass
+
+    def on_stmt(self, st, eager: bool):
+        pass
+
+    def on_expr(self, e, eager: bool):
+        pass
+
+
+class _EdgeCollector(_GuardedWalker):
+    def __init__(self, graph: "TracedClosure", fi: FuncInfo):
+        self.g = graph
+        self.fi = fi
+        self.edges: list = []
+
+    def on_call(self, call, eager: bool):
+        if eager:
+            return
+        self.edges.extend(self.g.resolve_call(self.fi, call))
+
+
+class TracedClosure:
+    """Computes and holds the set of FuncInfos reachable from traced
+    roots; shared by the host-sync and trace-purity passes."""
+
+    def __init__(self, project: Project,
+                 kernel_modules: tuple = ("ops.kernels",)):
+        self.project = project
+        self.roots: list = []
+        self.reachable: dict = {}   # (module, qual) -> FuncInfo
+        self.root_keys: set = set()
+        self._edges_cache: dict = {}
+        self._find_roots(kernel_modules)
+        self._close()
+
+    # -- root discovery -------------------------------------------------
+    def _find_roots(self, kernel_modules):
+        for mi in self.project.modules.values():
+            short = mi.dotted.split(".", 1)[-1]
+            if short in kernel_modules:
+                for fi in mi.top_level_functions():
+                    if not fi.name.startswith("_"):
+                        self._add_root(fi)
+            for fi in mi.functions.values():
+                for call in ast.walk(fi.node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    if _call_name(call.func) not in _JIT_NAMES:
+                        continue
+                    if not call.args:
+                        continue
+                    a0 = call.args[0]
+                    if isinstance(a0, ast.Name):
+                        # a local def of the same enclosing function,
+                        # or any same-module function of that name
+                        target = mi.functions.get(
+                            f"{fi.qualname}.{a0.id}") \
+                            or mi.functions.get(a0.id)
+                        if target is not None:
+                            self._add_root(target)
+
+    def _add_root(self, fi: FuncInfo):
+        key = (fi.module, fi.qualname)
+        if key not in self.root_keys:
+            self.root_keys.add(key)
+            self.roots.append(fi)
+
+    # -- call resolution ------------------------------------------------
+    def resolve_call(self, fi: FuncInfo, call) -> list:
+        out = []
+        func = call.func
+        mi = self.project.modules[fi.module]
+
+        if isinstance(func, ast.Name):
+            name = func.id
+            # getattr(self, f"_exec_...") dispatch
+            if name == "getattr" and len(call.args) >= 2:
+                out.extend(self._resolve_getattr(fi, call))
+            local = mi.functions.get(f"{fi.qualname}.{name}")
+            if local is None and fi.class_name:
+                local = mi.functions.get(f"{fi.class_name}.{name}")
+            if local is None:
+                local = mi.functions.get(name)
+            if local is not None:
+                out.append(local)
+            elif name in mi.import_symbols:
+                dmod, attr = mi.import_symbols[name]
+                tgt = self.project.function(dmod, attr)
+                if tgt is not None:
+                    out.append(tgt)
+            return out
+
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            val = func.value
+            if isinstance(val, ast.Name):
+                if val.id in ("self", "cls") and fi.class_name:
+                    tgt = mi.functions.get(f"{fi.class_name}.{attr}")
+                    if tgt is not None:
+                        return [tgt]
+                alias = val.id
+                if alias in mi.import_modules or \
+                        alias in mi.import_symbols:
+                    dmod = mi.import_modules.get(alias)
+                    if dmod is None:
+                        base, sub = mi.import_symbols[alias]
+                        dmod = f"{base}.{sub}" if base else sub
+                    tgt = self.project.function(dmod, attr)
+                    return [tgt] if tgt is not None else []
+            # distinctive method name: any scanned class method
+            if attr not in GENERIC_NAMES:
+                return list(self.project.methods.get(attr, ()))
+        return out
+
+    def _resolve_getattr(self, fi: FuncInfo, call) -> list:
+        """getattr(self, <f-string with literal prefix>) — the executor
+        operator dispatch: expand to matching same-class methods."""
+        obj, key = call.args[0], call.args[1]
+        if not (isinstance(obj, ast.Name) and obj.id == "self"
+                and fi.class_name):
+            return []
+        prefix = None
+        if isinstance(key, ast.JoinedStr) and key.values and \
+                isinstance(key.values[0], ast.Constant):
+            prefix = str(key.values[0].value)
+        elif isinstance(key, ast.Constant) and isinstance(key.value,
+                                                          str):
+            prefix = key.value
+        if not prefix:
+            return []
+        mi = self.project.modules[fi.module]
+        cls_prefix = fi.class_name + "."
+        return [f for q, f in mi.functions.items()
+                if q.startswith(cls_prefix)
+                and f.name.startswith(prefix)]
+
+    def edges_of(self, fi: FuncInfo) -> list:
+        key = (fi.module, fi.qualname)
+        hit = self._edges_cache.get(key)
+        if hit is None:
+            col = _EdgeCollector(self, fi)
+            col.walk_function(fi.node)
+            hit = self._edges_cache[key] = col.edges
+        return hit
+
+    # -- closure --------------------------------------------------------
+    def _close(self):
+        stack = [fi for fi in self.roots if not fi.eager_only]
+        for fi in stack:
+            self.reachable[(fi.module, fi.qualname)] = fi
+        while stack:
+            fi = stack.pop()
+            for tgt in self.edges_of(fi):
+                key = (tgt.module, tgt.qualname)
+                if tgt.eager_only or key in self.reachable:
+                    continue
+                self.reachable[key] = tgt
+                stack.append(tgt)
+
+    def __contains__(self, key) -> bool:
+        return key in self.reachable
+
+    def functions(self):
+        return list(self.reachable.values())

@@ -1,0 +1,374 @@
+"""otblint core: findings, pragmas, module index, baseline ratchet.
+
+The framework is deliberately small: every pass works on plain `ast`
+trees plus a per-file pragma table parsed out of comments.  Comment
+conventions the passes understand:
+
+``# otblint: disable=rule1,rule2``
+    suppress the named rules (or all, with bare ``disable``) on this
+    line; on a ``def`` line, for the whole function.
+``# otblint: eager-only``  (synonym: ``host-only``)
+    on a ``def`` line: this function is never called under a trace —
+    the call-graph closure stops here.  Used for executor operators the
+    fusability screens reject (cross joins, index/ANN scans) and for
+    host-side facades (device-cache staging).
+``# guarded_by: <lock>``
+    on a module-level container assignment: writes from function scope
+    must hold the named module lock.
+``# holds: <lock1>[, lock2]``
+    on a ``def`` line: callers are required to hold these locks (the
+    plancache ``_evict_lru`` convention), so writes inside are covered.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Optional
+
+#: rule id -> one-line description (the JSON report echoes these)
+RULES = {
+    "host-sync": "device->host sync of a traced value inside a "
+                 "traced region",
+    "trace-purity": "impure operation (env/time/RNG/global mutation) "
+                    "inside a traced region",
+    "program-key": "compiled-program input does not reach the "
+                   "program-cache key",
+    "lock-discipline": "module-level mutable state written without "
+                       "its guarded_by lock",
+    "hlo-f64": "f64 tensor type in exported StableHLO",
+    "hlo-host-transfer": "host transfer / callback op in exported "
+                         "StableHLO",
+    "hlo-dynamic-shape": "dynamic-shape op in exported StableHLO",
+}
+
+_PRAGMA = re.compile(r"#\s*otblint:\s*([a-z\-]+)(?:=([\w\-,\s]+))?")
+_GUARDED = re.compile(r"#\s*guarded_by:\s*(\w+)")
+_HOLDS = re.compile(r"#\s*holds:\s*([\w,\s]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    file: str          # repo-relative path (or HLO program label)
+    line: int
+    symbol: str        # enclosing function qualname ("" = module)
+    message: str
+    suppressed: bool = False
+
+    def key(self) -> tuple:
+        """Line-number-free identity used by the baseline ratchet, so
+        unrelated edits moving a finding a few lines don't churn it."""
+        return (self.rule, self.file, self.symbol)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        sup = " (baseline)" if self.suppressed else ""
+        return (f"{self.file}:{self.line}: {self.rule}{sym} "
+                f"{self.message}{sup}")
+
+
+class SourceFile:
+    """One parsed source file + its comment-pragma tables."""
+
+    def __init__(self, root: str, rel: str, text: Optional[str] = None):
+        self.rel = rel
+        self.path = os.path.join(root, rel)
+        if text is None:
+            with open(self.path, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        # line -> set of disabled rules ({"*"} = all)
+        self.disables: dict[int, set] = {}
+        # line -> marker set ({"eager-only"})
+        self.markers: dict[int, set] = {}
+        self.guarded_by: dict[int, str] = {}    # line -> lock name
+        self.holds: dict[int, tuple] = {}       # line -> lock names
+        for i, ln in enumerate(self.lines, 1):
+            if "#" not in ln:
+                continue
+            for m in _PRAGMA.finditer(ln):
+                kind, args = m.group(1), m.group(2)
+                if kind == "disable":
+                    rules = {"*"} if not args else {
+                        a.strip() for a in args.split(",") if a.strip()}
+                    self.disables.setdefault(i, set()).update(rules)
+                elif kind in ("eager-only", "host-only"):
+                    self.markers.setdefault(i, set()).add("eager-only")
+            m = _GUARDED.search(ln)
+            if m:
+                self.guarded_by[i] = m.group(1)
+            m = _HOLDS.search(ln)
+            if m:
+                self.holds[i] = tuple(
+                    a.strip() for a in m.group(1).split(",")
+                    if a.strip())
+
+    def disabled(self, line: int, rule: str) -> bool:
+        d = self.disables.get(line)
+        return bool(d) and ("*" in d or rule in d)
+
+
+def _stmt_pragma_lines(node: ast.AST):
+    """Candidate comment lines for a statement: its first line and,
+    for a def, the decorator lines above (pragmas ride either)."""
+    lines = {node.lineno}
+    for d in getattr(node, "decorator_list", []) or []:
+        lines.add(d.lineno)
+    return lines
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: str            # dotted module name
+    qualname: str          # e.g. "Executor._exec_hashjoin"
+    node: ast.AST          # FunctionDef / AsyncFunctionDef / Lambda
+    class_name: Optional[str]
+    src: SourceFile
+    eager_only: bool = False
+    holds: tuple = ()
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+class ModuleIndex:
+    """Per-module symbol tables the passes share: functions (by
+    qualname), import aliases, and module-level assigned names."""
+
+    def __init__(self, dotted: str, src: SourceFile):
+        self.dotted = dotted
+        self.src = src
+        self.functions: dict[str, FuncInfo] = {}
+        # alias -> dotted module ("jnp" -> "jax.numpy")
+        self.import_modules: dict[str, str] = {}
+        # alias -> (dotted module, attr)  (from X import Y [as Z])
+        self.import_symbols: dict[str, tuple] = {}
+        self.module_names: set = set()       # all module-level targets
+        self.containers: dict[str, dict] = {}  # mutable module state
+        self.locks: set = set()              # module-level lock names
+        self._collect()
+
+    # -- construction ---------------------------------------------------
+    def _collect(self):
+        tree, src = self.src.tree, self.src
+
+        def add_func(node, qual, cls):
+            fi = FuncInfo(self.dotted, qual, node, cls, src)
+            for ln in _stmt_pragma_lines(node):
+                if "eager-only" in src.markers.get(ln, ()):
+                    fi.eager_only = True
+                if ln in src.holds:
+                    fi.holds = fi.holds + src.holds[ln]
+            self.functions[qual] = fi
+
+        def walk_body(body, prefix, cls):
+            for st in body:
+                if isinstance(st, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{st.name}"
+                    add_func(st, qual, cls)
+                    walk_body(st.body, qual + ".", cls)
+                elif isinstance(st, ast.ClassDef):
+                    walk_body(st.body, f"{prefix}{st.name}.",
+                              f"{prefix}{st.name}")
+                elif isinstance(st, (ast.If, ast.Try, ast.With,
+                                     ast.For, ast.While)):
+                    for blk in (getattr(st, "body", []),
+                                getattr(st, "orelse", []),
+                                getattr(st, "finalbody", [])):
+                        walk_body(blk, prefix, cls)
+                    for h in getattr(st, "handlers", []):
+                        walk_body(h.body, prefix, cls)
+
+        walk_body(tree.body, "", None)
+
+        pkg_parts = self.dotted.split(".")
+        for st in ast.walk(tree):
+            if isinstance(st, ast.Import):
+                for al in st.names:
+                    self.import_modules[al.asname or
+                                        al.name.split(".")[0]] = al.name
+            elif isinstance(st, ast.ImportFrom):
+                base = st.module or ""
+                if st.level:
+                    # resolve "from ..ops import kernels" relative to
+                    # this module's package
+                    anchor = pkg_parts[:-st.level]
+                    base = ".".join(anchor + ([base] if base else []))
+                for al in st.names:
+                    name = al.asname or al.name
+                    self.import_symbols[name] = (base, al.name)
+
+        for st in tree.body:
+            targets = []
+            if isinstance(st, ast.Assign):
+                targets = [t for t in st.targets
+                           if isinstance(t, ast.Name)]
+                value = st.value
+            elif isinstance(st, ast.AnnAssign) and st.value is not None \
+                    and isinstance(st.target, ast.Name):
+                targets, value = [st.target], st.value
+            else:
+                continue
+            for t in targets:
+                self.module_names.add(t.id)
+                if _is_container_expr(value):
+                    self.containers[t.id] = {
+                        "line": st.lineno,
+                        "lock": src.guarded_by.get(st.lineno),
+                    }
+                if _is_lock_expr(value):
+                    self.locks.add(t.id)
+
+    def top_level_functions(self):
+        return [fi for q, fi in self.functions.items() if "." not in q]
+
+
+_CONTAINER_CALLS = {"dict", "list", "set", "defaultdict",
+                    "OrderedDict", "deque", "Counter"}
+
+
+def _is_container_expr(v) -> bool:
+    if isinstance(v, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                      ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(v, ast.Call):
+        f = v.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        return name in _CONTAINER_CALLS
+    return False
+
+
+def _is_lock_expr(v) -> bool:
+    if not isinstance(v, ast.Call):
+        return False
+    f = v.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    return name in ("Lock", "RLock", "Condition", "Semaphore")
+
+
+class Project:
+    """The scanned file set: by default every ``*.py`` under the
+    ``opentenbase_tpu`` package, as one module index per file."""
+
+    def __init__(self, root: str, package: str,
+                 rels: Optional[list] = None):
+        self.root = root
+        self.package = package
+        if rels is None:
+            rels = []
+            pkg_dir = os.path.join(root, package)
+            for dirpath, _dirs, files in os.walk(pkg_dir):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        rels.append(os.path.relpath(
+                            os.path.join(dirpath, f), root))
+        self.modules: dict[str, ModuleIndex] = {}
+        self.by_rel: dict[str, ModuleIndex] = {}
+        for rel in sorted(rels):
+            dotted = rel[:-3].replace(os.sep, ".")
+            if dotted.endswith(".__init__"):
+                dotted = dotted[:-len(".__init__")]
+            mi = ModuleIndex(dotted, SourceFile(root, rel))
+            self.modules[dotted] = mi
+            self.by_rel[rel] = mi
+        # global method index: simple name -> [FuncInfo] (class methods
+        # only), for distinctive-name attribute-call resolution
+        self.methods: dict[str, list] = {}
+        for mi in self.modules.values():
+            for fi in mi.functions.values():
+                if fi.class_name is not None:
+                    self.methods.setdefault(fi.name, []).append(fi)
+
+    def function(self, module: str, qual: str) -> Optional[FuncInfo]:
+        mi = self.modules.get(module)
+        return mi.functions.get(qual) if mi else None
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet
+# ---------------------------------------------------------------------------
+class Baseline:
+    """Checked-in suppression file: pre-existing findings are explicit
+    and RATCHETED — each (rule, file, symbol) carries the count that
+    existed when the baseline was written; any growth is unsuppressed.
+    Fixing a finding without refreshing the baseline is always safe
+    (stale allowances never fail the gate, they just stop being used)."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.allow: dict[tuple, int] = {}
+        if path and os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            for ent in data.get("suppressions", []):
+                key = (ent["rule"], ent["file"], ent.get("symbol", ""))
+                self.allow[key] = int(ent.get("count", 1))
+
+    def apply(self, findings: list) -> None:
+        """Mark findings covered by the baseline as suppressed, oldest
+        (lowest line) first within each key group."""
+        groups: dict[tuple, list] = {}
+        for f in findings:
+            groups.setdefault(f.key(), []).append(f)
+        for key, fs in groups.items():
+            quota = self.allow.get(key, 0)
+            for f in sorted(fs, key=lambda x: x.line)[:quota]:
+                f.suppressed = True
+
+    @staticmethod
+    def write(path: str, findings: list) -> dict:
+        groups: dict[tuple, int] = {}
+        for f in findings:
+            groups[f.key()] = groups.get(f.key(), 0) + 1
+        data = {
+            "comment": "otblint baseline — pre-existing findings, "
+                       "ratcheted; regenerate with --write-baseline",
+            "suppressions": [
+                {"rule": r, "file": fl, "symbol": s, "count": n}
+                for (r, fl, s), n in sorted(groups.items())],
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.write("\n")
+        return data
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def make_report(findings: list, files: int,
+                baseline: Optional[Baseline]) -> dict:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    unsup = [f for f in findings if not f.suppressed]
+    return {
+        "files": files,
+        "findings": [f.as_dict() for f in
+                     sorted(findings, key=lambda x: (x.file, x.line))],
+        "counts": counts,
+        "total": len(findings),
+        "suppressed": len(findings) - len(unsup),
+        "unsuppressed": len(unsup),
+        "baseline": baseline.path if baseline else None,
+        "ok": not unsup,
+    }

@@ -1,0 +1,205 @@
+"""StableHLO program audit — otblint rules over exported MLIR.
+
+Extends utils/lowering_check.py's f64 scan into the shared rule/report
+machinery: every exported kernel and live fused/mesh program is scanned
+for
+
+- ``hlo-f64``            — f64 tensor types (no native TPU support);
+- ``hlo-host-transfer``  — genuine host round-trips: send/recv,
+  infeed/outfeed, host callbacks.  (``custom_call @Sharding`` is the
+  partitioner's layout annotation, not a transfer, and is not flagged);
+- ``hlo-dynamic-shape``  — dynamic-shape ops / ``?``-dim tensor types,
+  which break AOT compilation caching on TPU.
+
+``python -m opentenbase_tpu.analysis.hlo_audit`` exports the kernel
+battery (add ``--full`` for the live query battery with fused/mesh
+program capture) and exits nonzero on findings.  The legacy report keys
+(``mode``/``f64``/``export_errors``/``kernels``/``programs``/
+``battery``/``ok``) are preserved — tests/test_tpu_lowering.py keeps
+working against ``utils.lowering_check``, which now delegates here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+from .core import Finding
+
+# element type in both scalar (tensor<f64>) and shaped (tensor<4xf64>)
+# spellings — a plain \b misses the latter ('x' is a word character)
+_F64 = re.compile(r"(?:\b|(?<=x))f64\b")
+_TRANSFER = re.compile(
+    r"stablehlo\.(send|recv|infeed|outfeed)\b"
+    r"|custom_call\s*@(xla_python_cpu_callback|xla_ffi_python_cpu_"
+    r"callback|HostCompute|xla\.host_transfer)"
+    r"|mhlo\.(send|recv)\b")
+_DYNSHAPE = re.compile(
+    r"stablehlo\.(real_dynamic_slice|dynamic_reshape|dynamic_pad"
+    r"|dynamic_broadcast_in_dim|dynamic_gather|dynamic_iota"
+    r"|dynamic_conv)\b"
+    r"|tensor<(\?|\d+x\?|[0-9x]*\?x)")
+
+
+def scan_hlo_text(label: str, txt: str) -> list:
+    """Scan one exported program's MLIR text; one finding per rule per
+    program, at the first offending line."""
+    findings = []
+    for rule, rx, msg in (
+            ("hlo-f64", _F64,
+             "f64 tensor type in exported StableHLO"),
+            ("hlo-host-transfer", _TRANSFER,
+             "host transfer / callback op in exported StableHLO"),
+            ("hlo-dynamic-shape", _DYNSHAPE,
+             "dynamic-shape op in exported StableHLO")):
+        m = rx.search(txt)
+        if m:
+            line = txt.count("\n", 0, m.start()) + 1
+            findings.append(Finding(rule, label, line, "",
+                                    f"{msg} ({m.group(0).strip()})"))
+    return findings
+
+
+def _sds_of(tree):
+    import jax
+
+    def leaf(a):
+        a = jax.numpy.asarray(a)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+    return jax.tree.map(leaf, tree)
+
+
+def export_check(fn, args, label: str, report: dict):
+    """Export `fn(*args)` for platform 'tpu'; scan the StableHLO and
+    record findings (f64 hits also land in the legacy report keys)."""
+    import jax
+    from jax import export
+    try:
+        exp = export.export(
+            fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn),
+            platforms=("tpu",))(*_sds_of(args))
+        txt = exp.mlir_module()
+    except Exception as e:  # noqa: BLE001 — report, don't crash the scan
+        report.setdefault("export_errors", []).append(
+            f"{label}: {type(e).__name__}: {e}")
+        return
+    report["programs"] = report.get("programs", 0) + 1
+    for f in scan_hlo_text(label, txt):
+        report.setdefault("findings", []).append(f)
+        if f.rule == "hlo-f64":
+            report.setdefault("f64", []).append(label)
+
+
+def check_kernels(report: dict):
+    """Every ops/kernels.py kernel at two size classes."""
+    import jax.numpy as jnp
+
+    from ..ops import kernels as K
+    from ..utils.dtypes import device_float
+    DF = device_float()
+    for n in (1024, 65536):
+        f = jnp.zeros(n, DF)
+        i = jnp.zeros(n, jnp.int64)
+        v = jnp.zeros(n, bool)
+        export_check(lambda m, c: K.compact(m, c, out_size=n),
+                     (v, (i, f)), f"compact/{n}", report)
+        export_check(
+            lambda g, m, a: K.grouped_agg_dense(
+                g, m, a, num_groups=64,
+                agg_kinds=("sum", "count", "min", "max", "sumf")),
+            (i, v, (i, i, i, f, f)), f"grouped_agg_dense/{n}", report)
+        export_check(
+            lambda k, m, a: K.grouped_agg_sort(
+                k, m, a, max_groups=n,
+                agg_kinds=("sum", "count", "min", "max", "sumf")),
+            ((i, i), v, (i, i, i, f, f)),
+            f"grouped_agg_sort/{n}", report)
+        export_check(K.join_build, (i, v), f"join_build/{n}", report)
+        export_check(K.join_probe_counts, (i, i, v),
+                     f"join_probe_counts/{n}", report)
+        export_check(
+            lambda lo, c, p: K.join_expand(lo, c, p, out_size=2 * n,
+                                           left_outer=True,
+                                           probe_valid=None),
+            (i, i, i), f"join_expand/{n}", report)
+        export_check(K.semi_mask, (i,), f"semi_mask/{n}", report)
+        export_check(lambda c, pv: K.anti_mask(c, pv), (i, v),
+                     f"anti_mask/{n}", report)
+        export_check(
+            lambda k1, k2, m, p1, p2: K.sort_rows(
+                (k1, k2), m, (p1, p2), descs=(False, True), limit=128),
+            (i, f, v, i, f), f"sort_rows/{n}", report)
+        export_check(
+            lambda c1, c2: K.bucket_ids((c1, c2), num_buckets=4096),
+            (i, i), f"bucket_ids/{n}", report)
+        export_check(
+            lambda a, b, c, d: K.visibility_mask(
+                a, b, c, d, jnp.int64(5), jnp.int64(7), jnp.int64(-1)),
+            (i, i, i, i), f"visibility_mask/{n}", report)
+    report["kernels"] = report.get("programs", 0)
+
+
+def audit(full: bool = True) -> dict:
+    """Run the audit; returns the combined legacy+findings report."""
+    from ..utils.dtypes import mode
+
+    report: dict = {"mode": mode(), "f64": [], "export_errors": [],
+                    "findings": []}
+    check_kernels(report)
+
+    if full:
+        from ..exec import fused, mesh_exec
+        from ..utils.lowering_check import run_battery
+        seen: set = set()
+
+        def hook(tag, fn, args):
+            key = (tag, id(fn))
+            if key in seen:
+                return
+            seen.add(key)
+            export_check(fn, args, f"{tag}/{len(seen)}", report)
+
+        fused.EXPORT_HOOK = hook
+        mesh_exec.EXPORT_HOOK = hook
+        try:
+            results = run_battery()
+        finally:
+            fused.EXPORT_HOOK = None
+            mesh_exec.EXPORT_HOOK = None
+        report["battery"] = {k: (v if isinstance(v, str) else len(v))
+                             for k, v in results.items()}
+
+    # f64 is the documented CONTRACT of x64 mode (bit-matching the CPU
+    # oracles) — the hlo-f64 rule only bites under the tpu dtype mode.
+    if report["mode"] == "x64":
+        for f in report["findings"]:
+            if f.rule == "hlo-f64":
+                f.suppressed = True
+    unsup = [f for f in report["findings"] if not f.suppressed]
+    report["unsuppressed"] = len(unsup)
+    report["ok"] = (not unsup and not report["export_errors"]
+                    and (report["mode"] == "x64" or not report["f64"]))
+    report["findings"] = [f.as_dict() for f in report["findings"]]
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="opentenbase_tpu.analysis.hlo_audit",
+        description="StableHLO audit of exported engine programs")
+    ap.add_argument("--full", action="store_true",
+                    help="also run the live query battery and audit "
+                         "captured fused/mesh programs")
+    ap.add_argument("--kernels-only", action="store_true",
+                    help="audit only the kernel battery (fast path "
+                         "used by the CI gate)")
+    args = ap.parse_args(argv)
+    report = audit(full=args.full and not args.kernels_only)
+    print(json.dumps(report, default=str))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

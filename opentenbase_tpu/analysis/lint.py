@@ -1,0 +1,122 @@
+"""otblint driver: scan the package, apply the baseline, report.
+
+Usage::
+
+    python -m opentenbase_tpu.analysis.lint [--json] [--root DIR]
+        [--baseline PATH | --no-baseline] [--write-baseline]
+        [--rules r1,r2]
+
+Exit status is nonzero when unsuppressed findings remain, so the
+command gates CI directly (tests/test_lint.py runs it as a subprocess
+the same way tests/test_tpu_lowering.py runs the HLO audit).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .callgraph import TracedClosure
+from .core import (Baseline, Project, RULES, default_baseline_path,
+                   make_report)
+from .passes import (HostSyncPass, LockDisciplinePass, ProgramKeyPass,
+                     TracePurityPass)
+
+
+def repo_root() -> str:
+    """Directory containing the ``opentenbase_tpu`` package."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg_dir)
+
+
+def run_passes(project: Project, rules=None) -> list:
+    closure = TracedClosure(project)
+    passes = [
+        HostSyncPass(project, closure),
+        TracePurityPass(project, closure),
+        ProgramKeyPass(project),
+        LockDisciplinePass(project),
+    ]
+    findings = []
+    for p in passes:
+        if rules is None or p.rule in rules:
+            findings.extend(p.run())
+    return findings
+
+
+def lint(root=None, package: str = "opentenbase_tpu",
+         baseline_path=None, rules=None, rels=None) -> dict:
+    """Programmatic entry point; returns the report dict."""
+    root = root or repo_root()
+    project = Project(root, package, rels=rels)
+    findings = run_passes(project, rules=rules)
+    baseline = Baseline(baseline_path) if baseline_path else None
+    if baseline:
+        baseline.apply(findings)
+    return make_report(findings, len(project.modules), baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="opentenbase_tpu.analysis.lint",
+        description="engine-invariant static analysis (otblint)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full JSON report on stdout")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline suppression file "
+                         "(default: analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report everything")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from this scan and "
+                         "exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset "
+                         f"(known: {', '.join(sorted(RULES))})")
+    args = ap.parse_args(argv)
+
+    root = args.root or repo_root()
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    bl_path = args.baseline or default_baseline_path()
+    project = Project(root, "opentenbase_tpu")
+    findings = run_passes(project, rules=rules)
+
+    if args.write_baseline:
+        data = Baseline.write(bl_path, findings)
+        print(f"wrote {bl_path}: "
+              f"{len(data['suppressions'])} suppression keys, "
+              f"{len(findings)} findings")
+        return 0
+
+    baseline = None if args.no_baseline else Baseline(bl_path)
+    if baseline:
+        baseline.apply(findings)
+    report = make_report(findings, len(project.modules), baseline)
+
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        for f in sorted(findings, key=lambda x: (x.file, x.line)):
+            print(f.render())
+        print(f"otblint: {report['files']} files, "
+              f"{report['total']} findings "
+              f"({report['suppressed']} baseline, "
+              f"{report['unsuppressed']} unsuppressed)")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,904 @@
+"""The four otblint passes.
+
+host-sync
+    Inside functions reachable from a traced region, a device value
+    must never be forced to the host: ``int()/float()/bool()/len()``
+    over a traced expression, ``.item()/.tolist()``, ``np.asarray``,
+    ``jax.device_get``, or branching (``if``/``while``) on a traced
+    value.  Device-ness is tracked by a light intraprocedural taint:
+    results of ``jnp.* / jax.* / ops.kernels / utils.hashing`` calls
+    (and anything derived from them) are traced; ``.shape/.dtype``
+    reads and static kernel parameters (jit ``static_argnames``,
+    int/bool/str-annotated args) are not.  Proven-traced only — the
+    pass prefers missing a sync over crying wolf.
+
+trace-purity
+    Traced code must be replayable: no ``os.environ`` reads, no
+    wall-clock (``time.*``/``datetime.*``), no RNG, no writes to
+    module-level state.  Env flags are read at module import or at
+    program-key construction — never mid-trace.
+
+program-key
+    At every ``ProgramCache.put(key, builder)`` site, each input the
+    builder captures (closure free variables, call arguments) must be
+    derivable from names that reach the key expression — the
+    compiled program's identity must cover everything that shaped it.
+    This is the PR-2 staged-array-namespace bug class, enforced.
+
+lock-discipline
+    A module-level mutable container in the threaded trees (exec/,
+    storage/, gtm/, net/, utils/) that is written from function scope
+    must declare ``# guarded_by: <lock>`` on its definition, and every
+    such write must hold that lock (lexical ``with <lock>:`` or a
+    ``# holds: <lock>`` contract on the enclosing def).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Optional
+
+from .callgraph import TracedClosure, is_traced_guard_test
+from .core import Finding, FuncInfo, Project, _stmt_pragma_lines
+
+_BUILTINS = frozenset(dir(builtins))
+
+#: attribute reads that return static metadata, not device data
+_DETAINT_ATTRS = frozenset({"shape", "dtype", "ndim", "itemsize",
+                            "names", "types", "dicts"})
+#: method calls that force a traced receiver to the host
+_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+#: container-mutating method names (lock-discipline / trace-purity)
+_MUTATORS = frozenset({"append", "add", "update", "pop", "clear",
+                       "setdefault", "extend", "remove", "discard",
+                       "insert", "popitem", "appendleft", "popleft"})
+_SCALAR_ANNOTS = frozenset({"int", "bool", "str", "float", "bytes"})
+#: jax/jnp helpers that inspect dtypes statically — their results are
+#: host booleans/infos, not traced values
+_INTROSPECT = frozenset({"issubdtype", "iinfo", "finfo", "result_type",
+                         "promote_types", "can_cast", "isdtype",
+                         "dtype"})
+#: identity/membership comparisons yield host bools (``x is None``,
+#: ``name in batch.cols``) — never tracers
+_HOST_CMP = (ast.Is, ast.IsNot, ast.In, ast.NotIn)
+
+_IMPURE_CALL_PREFIXES = ("time.", "datetime.", "random.", "secrets.",
+                         "numpy.random.", "uuid.")
+
+
+def _dotted(expr, mi) -> Optional[str]:
+    """Resolve an attribute chain to a dotted name, mapping the root
+    through the module's import aliases (``jnp.sum`` -> ``jax.numpy.sum``,
+    ``K.compact`` -> ``<pkg>.ops.kernels.compact``)."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    root = expr.id
+    if root in mi.import_modules:
+        base = mi.import_modules[root]
+    elif root in mi.import_symbols:
+        mod, attr = mi.import_symbols[root]
+        base = f"{mod}.{attr}" if mod else attr
+    else:
+        base = root
+    return ".".join([base] + list(reversed(parts)))
+
+
+def _func_locals(fn_node) -> set:
+    """Names bound inside a function (params + assignments + loop/with
+    targets + nested defs); ``global``-declared names are excluded."""
+    out, globals_ = set(), set()
+    a = fn_node.args
+    for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        out.add(arg.arg)
+
+    def targets_of(t):
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets_of(e)
+        elif isinstance(t, ast.Starred):
+            targets_of(t.value)
+
+    for st in ast.walk(fn_node):
+        if st is fn_node:
+            continue
+        if isinstance(st, ast.Global):
+            globals_.update(st.names)
+        elif isinstance(st, (ast.Assign,)):
+            for t in st.targets:
+                targets_of(t)
+        elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+            targets_of(st.target)
+        elif isinstance(st, ast.For):
+            targets_of(st.target)
+        elif isinstance(st, ast.withitem) and st.optional_vars:
+            targets_of(st.optional_vars)
+        elif isinstance(st, ast.comprehension):
+            targets_of(st.target)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(st.name)
+        elif isinstance(st, ast.NamedExpr):
+            targets_of(st.target)
+        elif isinstance(st, ast.ExceptHandler) and st.name:
+            out.add(st.name)
+    return out - globals_
+
+
+def free_vars(fn_node) -> set:
+    """Loaded names in a function body that are not bound locally —
+    what a closure captures from its environment."""
+    bound = _func_locals(fn_node)
+    loads = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            loads.add(n.id)
+    return loads - bound - _BUILTINS
+
+
+def _fn_disabled(fi: FuncInfo, rule: str) -> bool:
+    return any(fi.src.disabled(ln, rule)
+               for ln in _stmt_pragma_lines(fi.node))
+
+
+class _Emitter:
+    def __init__(self, rule: str):
+        self.rule = rule
+        self.findings: list = []
+        self._seen: set = set()
+
+    def emit(self, fi: FuncInfo, line: int, message: str):
+        if fi.src.disabled(line, self.rule) or \
+                _fn_disabled(fi, self.rule):
+            return
+        key = (fi.src.rel, line, message)
+        if key in self._seen:   # loop bodies are walked twice
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(
+            self.rule, fi.src.rel, line, fi.qualname, message))
+
+
+# ===========================================================================
+# host-sync
+# ===========================================================================
+class HostSyncPass:
+    """Taint walk over every function in the traced closure."""
+
+    rule = "host-sync"
+
+    def __init__(self, project: Project, closure: TracedClosure):
+        self.project = project
+        self.closure = closure
+
+    def run(self) -> list:
+        em = _Emitter(self.rule)
+        for fi in self.closure.functions():
+            self._check(fi, em,
+                        taint_params=(fi.module, fi.qualname)
+                        in self.closure.root_keys)
+        return em.findings
+
+    # -- taint seeds ----------------------------------------------------
+    def _static_params(self, fi: FuncInfo) -> set:
+        """Params that are static config, not traced data: jit
+        static_argnames + scalar-annotated + kwonly args."""
+        out = set()
+        node = fi.node
+        for dec in getattr(node, "decorator_list", []) or []:
+            for kw in getattr(dec, "keywords", []) or []:
+                if kw.arg == "static_argnames":
+                    for el in getattr(kw.value, "elts", []) or []:
+                        if isinstance(el, ast.Constant):
+                            out.add(str(el.value))
+        a = node.args
+        for arg in a.kwonlyargs:
+            out.add(arg.arg)
+        for arg in list(a.posonlyargs) + list(a.args):
+            ann = arg.annotation
+            if isinstance(ann, ast.Name) and ann.id in _SCALAR_ANNOTS:
+                out.add(arg.arg)
+            elif isinstance(ann, ast.BinOp):  # "int | None"
+                names = {n.id for n in ast.walk(ann)
+                         if isinstance(n, ast.Name)}
+                if names & _SCALAR_ANNOTS:
+                    out.add(arg.arg)
+        return out
+
+    def _check(self, fi: FuncInfo, em: _Emitter, taint_params: bool):
+        mi = self.project.modules[fi.module]
+        env: dict = {}
+        if taint_params:
+            static = self._static_params(fi)
+            a = fi.node.args
+            for arg in list(a.posonlyargs) + list(a.args) \
+                    + ([a.vararg] if a.vararg else []):
+                if arg.arg not in static and \
+                        arg.arg not in ("self", "cls"):
+                    env[arg.arg] = True
+
+        pkg = self.project.package
+        #: local names currently bound to plain Python containers
+        #: (list/dict literals) — len()/truthiness on them is host-safe
+        #: even when they hold traced elements
+        py_containers: set = set()
+
+        def producer(call) -> bool:
+            d = _dotted(call.func, mi)
+            if d is None:
+                return False
+            if d.split(".")[-1] in _INTROSPECT:
+                return False
+            return (d.startswith("jax.")
+                    or d == "jax"
+                    or d.startswith(f"{pkg}.ops.kernels.")
+                    or d.startswith(f"{pkg}.utils.hashing."))
+
+        def taint(e) -> bool:
+            if isinstance(e, ast.Name):
+                return env.get(e.id, False)
+            if isinstance(e, ast.Attribute):
+                if e.attr in _DETAINT_ATTRS:
+                    return False
+                return taint(e.value)
+            if isinstance(e, ast.Subscript):
+                return taint(e.value)
+            if isinstance(e, ast.Call):
+                if producer(e):
+                    return True
+                if isinstance(e.func, ast.Name) and \
+                        e.func.id == "getattr" and len(e.args) >= 2 \
+                        and isinstance(e.args[1], ast.Constant) \
+                        and e.args[1].value in _DETAINT_ATTRS:
+                    return False
+                args = list(e.args) + [kw.value for kw in e.keywords]
+                if any(taint(x) for x in args):
+                    return True
+                # method on a traced receiver stays traced (.astype,
+                # .at[..].set, ...)
+                if isinstance(e.func, ast.Attribute) and \
+                        taint(e.func.value):
+                    return True
+                return False
+            if isinstance(e, (ast.BinOp,)):
+                return taint(e.left) or taint(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return taint(e.operand)
+            if isinstance(e, ast.BoolOp):
+                return any(taint(v) for v in e.values)
+            if isinstance(e, ast.Compare):
+                if all(isinstance(op, _HOST_CMP) for op in e.ops):
+                    return False
+                return taint(e.left) or any(taint(c)
+                                            for c in e.comparators)
+            if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+                return any(taint(x) for x in e.elts)
+            if isinstance(e, ast.IfExp):
+                return taint(e.body) or taint(e.orelse)
+            if isinstance(e, ast.NamedExpr):
+                return taint(e.value)
+            if isinstance(e, ast.Starred):
+                return taint(e.value)
+            return False
+
+        def check_expr(e, eager: bool):
+            """Recursive sink scan (guard-aware via `eager`)."""
+            if isinstance(e, ast.IfExp):
+                side = is_traced_guard_test(e.test)
+                check_expr(e.test, eager)
+                check_expr(e.body, eager or side == "eager")
+                check_expr(e.orelse, eager or side == "traced")
+                if not eager and taint(e.test) and side is None:
+                    em.emit(fi, e.lineno,
+                            "traced value in conditional expression")
+                return
+            if isinstance(e, ast.Call) and not eager:
+                f = e.func
+                if isinstance(f, ast.Name) and e.args:
+                    a0 = e.args[0]
+                    if f.id in ("int", "float", "bool", "len") and \
+                            taint(a0) and not (
+                                isinstance(a0, ast.Name)
+                                and a0.id in py_containers):
+                        em.emit(fi, e.lineno,
+                                f"{f.id}() forces a traced value to "
+                                f"the host")
+                if isinstance(f, ast.Attribute):
+                    d = _dotted(f, mi) or ""
+                    if d in ("jax.device_get", "jax.block_until_ready"):
+                        em.emit(fi, e.lineno,
+                                f"{d}() inside a traced region")
+                    elif d.startswith("numpy.") and \
+                            d.split(".")[-1] in ("asarray", "array",
+                                                 "copy") and \
+                            e.args and taint(e.args[0]):
+                        em.emit(fi, e.lineno,
+                                "np.%s() copies a traced value to the "
+                                "host" % d.split(".")[-1])
+                    elif f.attr in _SYNC_METHODS and taint(f.value):
+                        em.emit(fi, e.lineno,
+                                f".{f.attr}() forces a traced value "
+                                f"to the host")
+            for c in ast.iter_child_nodes(e):
+                if isinstance(c, ast.expr):
+                    check_expr(c, eager)
+                elif isinstance(c, ast.comprehension):
+                    check_expr(c.iter, eager)
+                    for cond in c.ifs:
+                        check_expr(cond, eager)
+
+        def assign_target(t, v: bool):
+            if isinstance(t, ast.Name):
+                env[t.id] = env.get(t.id, False) or v
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for x in t.elts:
+                    assign_target(x, v)
+            elif isinstance(t, ast.Starred):
+                assign_target(t.value, v)
+            elif isinstance(t, (ast.Subscript, ast.Attribute)):
+                # storing a traced value into a container taints the
+                # container (cols[n] = a[take])
+                root = t
+                while isinstance(root, (ast.Subscript, ast.Attribute)):
+                    root = root.value
+                if isinstance(root, ast.Name) and v:
+                    env[root.id] = True
+
+        def host_truthy(test) -> bool:
+            """Truthiness of a plain Python container is host-safe."""
+            if isinstance(test, ast.UnaryOp) and \
+                    isinstance(test.op, ast.Not):
+                return host_truthy(test.operand)
+            return isinstance(test, ast.Name) and \
+                test.id in py_containers
+
+        def is_py_container(v) -> bool:
+            if isinstance(v, (ast.List, ast.ListComp, ast.Dict,
+                              ast.DictComp, ast.Set, ast.SetComp)):
+                return True
+            return (isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)
+                    and v.func.id in ("list", "dict", "set", "sorted"))
+
+        def for_targets(st, eager: bool):
+            """``for a, b in zip(xs, ys)`` taints a from xs and b from
+            ys — not everything from everything (the kernels'
+            ``zip(agg_kinds, agg_inputs)`` walks a static kind list
+            next to traced columns)."""
+            it = st.iter
+            if isinstance(it, ast.Call) and \
+                    isinstance(it.func, ast.Name) and \
+                    isinstance(st.target, ast.Tuple):
+                elts = st.target.elts
+                if it.func.id == "zip" and len(elts) == len(it.args):
+                    for t, src in zip(elts, it.args):
+                        assign_target(t, taint(src))
+                    return
+                if it.func.id == "enumerate" and len(elts) == 2 \
+                        and it.args:
+                    assign_target(elts[0], False)
+                    assign_target(elts[1], taint(it.args[0]))
+                    return
+            assign_target(st.target, taint(it))
+
+        def walk(stmts, eager: bool):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.Assign):
+                    check_expr(st.value, eager)
+                    v = taint(st.value)
+                    for t in st.targets:
+                        assign_target(t, v)
+                        if isinstance(t, ast.Name):
+                            if is_py_container(st.value):
+                                py_containers.add(t.id)
+                            else:
+                                py_containers.discard(t.id)
+                elif isinstance(st, ast.AnnAssign):
+                    if st.value is not None:
+                        check_expr(st.value, eager)
+                        assign_target(st.target, taint(st.value))
+                        if isinstance(st.target, ast.Name) and \
+                                is_py_container(st.value):
+                            py_containers.add(st.target.id)
+                elif isinstance(st, ast.AugAssign):
+                    check_expr(st.value, eager)
+                    assign_target(st.target,
+                                  taint(st.value) or taint(st.target))
+                elif isinstance(st, ast.If):
+                    side = is_traced_guard_test(st.test)
+                    check_expr(st.test, eager)
+                    if not eager and side is None and \
+                            taint(st.test) and not host_truthy(st.test):
+                        em.emit(fi, st.lineno,
+                                "branching on a traced value "
+                                "(TracerBoolConversionError at trace "
+                                "time)")
+                    walk(st.body, eager or side == "eager")
+                    walk(st.orelse, eager or side == "traced")
+                elif isinstance(st, ast.While):
+                    check_expr(st.test, eager)
+                    if not eager and taint(st.test) and \
+                            not host_truthy(st.test):
+                        em.emit(fi, st.lineno,
+                                "while-loop over a traced value")
+                    walk(st.body, eager)
+                    walk(st.body, eager)   # loop-carried taint
+                    walk(st.orelse, eager)
+                elif isinstance(st, ast.For):
+                    check_expr(st.iter, eager)
+                    for_targets(st, eager)
+                    walk(st.body, eager)
+                    walk(st.body, eager)   # loop-carried taint
+                    walk(st.orelse, eager)
+                elif isinstance(st, ast.With):
+                    for item in st.items:
+                        check_expr(item.context_expr, eager)
+                        if item.optional_vars is not None:
+                            assign_target(item.optional_vars,
+                                          taint(item.context_expr))
+                    walk(st.body, eager)
+                elif isinstance(st, ast.Try):
+                    walk(st.body, eager)
+                    for h in st.handlers:
+                        walk(h.body, eager)
+                    walk(st.orelse, eager)
+                    walk(st.finalbody, eager)
+                else:
+                    for e in ast.iter_child_nodes(st):
+                        if isinstance(e, ast.expr):
+                            check_expr(e, eager)
+
+        walk(fi.node.body, eager=False)
+
+
+# ===========================================================================
+# trace-purity
+# ===========================================================================
+class TracePurityPass:
+    rule = "trace-purity"
+
+    def __init__(self, project: Project, closure: TracedClosure):
+        self.project = project
+        self.closure = closure
+
+    def run(self) -> list:
+        em = _Emitter(self.rule)
+        for fi in self.closure.functions():
+            self._check(fi, em)
+        return em.findings
+
+    def _module_global(self, fi: FuncInfo, mi, name: str,
+                       locals_: set) -> bool:
+        """Whether `name` (not shadowed locally) refers to module-level
+        state — of this module or imported from a scanned one."""
+        if name in locals_:
+            return False
+        if name in mi.module_names:
+            return True
+        if name in mi.import_symbols:
+            dmod, attr = mi.import_symbols[name]
+            other = self.project.modules.get(dmod)
+            return other is not None and attr in other.module_names
+        return False
+
+    def _check(self, fi: FuncInfo, em: _Emitter):
+        mi = self.project.modules[fi.module]
+        locals_ = _func_locals(fi.node)
+        globals_decl: set = set()
+
+        def check_expr(e, eager: bool):
+            if isinstance(e, ast.IfExp):
+                side = is_traced_guard_test(e.test)
+                check_expr(e.test, eager)
+                check_expr(e.body, eager or side == "eager")
+                check_expr(e.orelse, eager or side == "traced")
+                return
+            if not eager:
+                if isinstance(e, ast.Attribute):
+                    d = _dotted(e, mi) or ""
+                    if d in ("os.environ",):
+                        em.emit(fi, e.lineno,
+                                "os.environ read mid-trace — snapshot "
+                                "at import or into the program key")
+                if isinstance(e, ast.Call):
+                    d = _dotted(e.func, mi) or ""
+                    if d == "os.getenv":
+                        em.emit(fi, e.lineno,
+                                "os.getenv() mid-trace — snapshot at "
+                                "import or into the program key")
+                    elif d.startswith(_IMPURE_CALL_PREFIXES):
+                        em.emit(fi, e.lineno,
+                                f"impure call {d}() inside a traced "
+                                f"region")
+                    elif isinstance(e.func, ast.Attribute) and \
+                            e.func.attr in _MUTATORS:
+                        root = e.func.value
+                        while isinstance(root, (ast.Subscript,
+                                                ast.Attribute)):
+                            root = root.value
+                        if isinstance(root, ast.Name) and \
+                                self._module_global(fi, mi, root.id,
+                                                    locals_):
+                            em.emit(fi, e.lineno,
+                                    f"mutation of module-level "
+                                    f"'{root.id}' inside a traced "
+                                    f"region")
+            for c in ast.iter_child_nodes(e):
+                if isinstance(c, ast.expr):
+                    check_expr(c, eager)
+                elif isinstance(c, ast.comprehension):
+                    check_expr(c.iter, eager)
+                    for cond in c.ifs:
+                        check_expr(cond, eager)
+
+        def check_write(target, lineno: int, eager: bool):
+            if eager:
+                return
+            root = target
+            while isinstance(root, (ast.Subscript, ast.Attribute)):
+                root = root.value
+            if not isinstance(root, ast.Name):
+                return
+            name = root.id
+            if name in globals_decl or (
+                    not isinstance(target, ast.Name)
+                    and self._module_global(fi, mi, name, locals_)):
+                em.emit(fi, lineno,
+                        f"write to module-level '{name}' inside a "
+                        f"traced region")
+
+        def walk(stmts, eager: bool):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.Global):
+                    globals_decl.update(st.names)
+                elif isinstance(st, ast.If):
+                    side = is_traced_guard_test(st.test)
+                    check_expr(st.test, eager)
+                    walk(st.body, eager or side == "eager")
+                    walk(st.orelse, eager or side == "traced")
+                    continue
+                elif isinstance(st, ast.Assign):
+                    check_expr(st.value, eager)
+                    for t in st.targets:
+                        check_write(t, st.lineno, eager)
+                elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+                    if getattr(st, "value", None) is not None:
+                        check_expr(st.value, eager)
+                    check_write(st.target, st.lineno, eager)
+                elif isinstance(st, ast.Delete):
+                    for t in st.targets:
+                        check_write(t, st.lineno, eager)
+                else:
+                    for e in ast.iter_child_nodes(st):
+                        if isinstance(e, ast.expr):
+                            check_expr(e, eager)
+                for field in ("body", "orelse", "finalbody"):
+                    for s in getattr(st, field, []) or []:
+                        walk([s], eager)
+                for h in getattr(st, "handlers", []) or []:
+                    walk(h.body, eager)
+
+        walk(fi.node.body, eager=False)
+
+
+# ===========================================================================
+# program-key
+# ===========================================================================
+class ProgramKeyPass:
+    rule = "program-key"
+
+    def __init__(self, project: Project):
+        self.project = project
+        # every module-level name bound to a ProgramCache() anywhere
+        self.cache_names: set = set()
+        for mi in project.modules.values():
+            for st in mi.src.tree.body:
+                if isinstance(st, ast.Assign) and \
+                        isinstance(st.value, ast.Call):
+                    f = st.value.func
+                    nm = f.id if isinstance(f, ast.Name) else (
+                        f.attr if isinstance(f, ast.Attribute) else None)
+                    if nm == "ProgramCache":
+                        for t in st.targets:
+                            if isinstance(t, ast.Name):
+                                self.cache_names.add(t.id)
+
+    def run(self) -> list:
+        em = _Emitter(self.rule)
+        for mi in self.project.modules.values():
+            for fi in mi.functions.values():
+                for call in ast.walk(fi.node):
+                    if isinstance(call, ast.Call) and \
+                            self._is_cache_put(call):
+                        self._check_put(mi, fi, call, em)
+        return em.findings
+
+    def _is_cache_put(self, call) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "put"
+                and len(call.args) >= 2):
+            return False
+        owner = f.value
+        name = owner.id if isinstance(owner, ast.Name) else (
+            owner.attr if isinstance(owner, ast.Attribute) else None)
+        return name in self.cache_names
+
+    # -- local data-flow ------------------------------------------------
+    @staticmethod
+    def _assignments(fn_node) -> dict:
+        """name -> list of RHS-name sets, from every binding form in the
+        function (subscript stores contribute to their root name)."""
+        out: dict = {}
+
+        def names_of(e) -> set:
+            return {n.id for n in ast.walk(e)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)}
+
+        def bind(t, rhs_names: set):
+            if isinstance(t, ast.Name):
+                out.setdefault(t.id, []).append(rhs_names)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for x in t.elts:
+                    bind(x, rhs_names)
+            elif isinstance(t, ast.Starred):
+                bind(t.value, rhs_names)
+            elif isinstance(t, (ast.Subscript, ast.Attribute)):
+                root = t
+                extra = set()
+                while isinstance(root, (ast.Subscript, ast.Attribute)):
+                    if isinstance(root, ast.Subscript):
+                        extra |= names_of(root.slice)
+                    root = root.value
+                if isinstance(root, ast.Name):
+                    out.setdefault(root.id, []).append(
+                        rhs_names | extra)
+
+        for st in ast.walk(fn_node):
+            if isinstance(st, ast.Assign):
+                for t in st.targets:
+                    bind(t, names_of(st.value))
+            elif isinstance(st, (ast.AnnAssign, ast.AugAssign)) and \
+                    getattr(st, "value", None) is not None:
+                bind(st.target, names_of(st.value))
+            elif isinstance(st, ast.For):
+                bind(st.target, names_of(st.iter))
+            elif isinstance(st, ast.NamedExpr):
+                bind(st.target, names_of(st.value))
+            elif isinstance(st, ast.withitem) and st.optional_vars:
+                bind(st.optional_vars, names_of(st.context_expr))
+        return out
+
+    def _check_put(self, mi, fi: FuncInfo, call, em: _Emitter):
+        assigns = self._assignments(fi.node)
+        key_expr, value_expr = call.args[0], call.args[1]
+
+        # reverse closure: every name that reaches the key expression
+        key_names = {n.id for n in ast.walk(key_expr)
+                     if isinstance(n, ast.Name)}
+        changed = True
+        while changed:
+            changed = False
+            for nm in list(key_names):
+                for rhs in assigns.get(nm, ()):
+                    new = rhs - key_names
+                    if new:
+                        key_names |= new
+                        changed = True
+
+        module_level = (set(mi.module_names) | set(mi.functions)
+                        | set(mi.import_modules)
+                        | set(mi.import_symbols)
+                        | {f.name for f in mi.top_level_functions()})
+
+        memo: dict = {}
+
+        def covered(name: str, stack: frozenset) -> bool:
+            if name in memo:
+                return memo[name]
+            if name in key_names or name in _BUILTINS or \
+                    name in module_level:
+                memo[name] = True
+                return True
+            if name in stack:
+                return False
+            # a nested def used as the builder: its captures must be
+            # covered
+            nested = mi.functions.get(f"{fi.qualname}.{name}")
+            if nested is not None:
+                ok = all(covered(n, stack | {name})
+                         for n in free_vars(nested.node))
+                memo[name] = ok
+                return ok
+            # derivable through a local assignment whose inputs are all
+            # covered
+            for rhs in assigns.get(name, ()):
+                if all(covered(n, stack | {name}) for n in rhs):
+                    memo[name] = True
+                    return True
+            memo[name] = False
+            return False
+
+        value_names = {n.id for n in ast.walk(value_expr)
+                       if isinstance(n, ast.Name)
+                       and isinstance(n.ctx, ast.Load)}
+        for nm in sorted(value_names):
+            if not covered(nm, frozenset()):
+                em.emit(fi, call.lineno,
+                        f"program builder input '{nm}' does not reach "
+                        f"the cache key — a change in it would reuse a "
+                        f"stale compiled program")
+
+
+# ===========================================================================
+# lock-discipline
+# ===========================================================================
+class LockDisciplinePass:
+    rule = "lock-discipline"
+
+    def __init__(self, project: Project,
+                 trees: tuple = ("exec", "storage", "gtm", "net",
+                                 "utils")):
+        self.project = project
+        self.trees = trees
+        # (module, name) -> {"line", "lock", "module"}
+        self.registry: dict = {}
+        for mi in project.modules.values():
+            if self._in_scope(mi.dotted):
+                for name, info in mi.containers.items():
+                    self.registry[(mi.dotted, name)] = info
+
+    def _in_scope(self, dotted: str) -> bool:
+        parts = dotted.split(".")
+        return len(parts) >= 2 and parts[1] in self.trees
+
+    def run(self) -> list:
+        em = _Emitter(self.rule)
+        mutated_unannotated: dict = {}   # (module, name) -> first site
+        for mi in self.project.modules.values():
+            if not self._in_scope(mi.dotted):
+                continue
+            for fi in mi.functions.values():
+                self._check_fn(mi, fi, em, mutated_unannotated)
+        # one finding per unannotated container, at its definition
+        for (dmod, name), (fi, line) in sorted(
+                mutated_unannotated.items()):
+            info = self.registry[(dmod, name)]
+            dmi = self.project.modules[dmod]
+            def_line = info["line"]
+            if dmi.src.disabled(def_line, self.rule):
+                continue
+            em.findings.append(Finding(
+                self.rule, dmi.src.rel, def_line, "",
+                f"module-level mutable '{name}' is written from "
+                f"function scope ({fi.src.rel}:{line}) but has no "
+                f"# guarded_by: <lock> annotation"))
+        # annotations must reference a real module-level lock
+        for (dmod, name), info in sorted(self.registry.items()):
+            lock = info["lock"]
+            dmi = self.project.modules[dmod]
+            if lock is not None and lock not in dmi.locks and \
+                    not dmi.src.disabled(info["line"], self.rule):
+                em.findings.append(Finding(
+                    self.rule, dmi.src.rel, info["line"], "",
+                    f"'{name}' is guarded_by '{lock}' but no "
+                    f"module-level lock of that name exists"))
+        return em.findings
+
+    def _resolve(self, mi, name: str) -> Optional[tuple]:
+        """(module, name) of a registered container this name refers
+        to, following from-imports."""
+        if (mi.dotted, name) in self.registry:
+            return (mi.dotted, name)
+        if name in mi.import_symbols:
+            dmod, attr = mi.import_symbols[name]
+            if (dmod, attr) in self.registry:
+                return (dmod, attr)
+        return None
+
+    def _check_fn(self, mi, fi: FuncInfo, em: _Emitter,
+                  unannotated: dict):
+        locals_ = _func_locals(fi.node)
+        held0 = tuple(fi.holds)
+
+        def lock_name(e) -> Optional[str]:
+            if isinstance(e, ast.Name):
+                return e.id
+            if isinstance(e, ast.Attribute):
+                return e.attr
+            if isinstance(e, ast.Call):
+                return None
+            return None
+
+        def mutation_root(node) -> Optional[ast.Name]:
+            root = node
+            while isinstance(root, (ast.Subscript, ast.Attribute)):
+                root = root.value
+            return root if isinstance(root, ast.Name) else None
+
+        def report(name: str, line: int, held):
+            if name in locals_:
+                return
+            key = self._resolve(mi, name)
+            if key is None:
+                return
+            info = self.registry[key]
+            lock = info["lock"]
+            if lock is None:
+                unannotated.setdefault(key, (fi, line))
+                return
+            if lock not in held:
+                em.emit(fi, line,
+                        f"write to '{name}' without holding its "
+                        f"guarded_by lock '{lock}'")
+
+        def walk(stmts, held: tuple):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.With):
+                    add = [lock_name(item.context_expr)
+                           for item in st.items]
+                    walk(st.body, held + tuple(a for a in add if a))
+                    continue
+                if isinstance(st, ast.Assign):
+                    for t in st.targets:
+                        if not isinstance(t, ast.Name):
+                            r = mutation_root(t)
+                            if r is not None:
+                                report(r.id, st.lineno, held)
+                elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+                    t = st.target
+                    if not isinstance(t, ast.Name):
+                        r = mutation_root(t)
+                        if r is not None:
+                            report(r.id, st.lineno, held)
+                elif isinstance(st, ast.Delete):
+                    for t in st.targets:
+                        r = mutation_root(t)
+                        if r is not None and not isinstance(t, ast.Name):
+                            report(r.id, st.lineno, held)
+                # mutating method calls in THIS statement's own
+                # expressions — nested statements (e.g. a `with lock:`
+                # block under an `if`) are walked by the recursion
+                # below with their correct held-lock set
+                stack: list = [v for f, v in ast.iter_fields(st)
+                               if f not in ("body", "orelse",
+                                            "finalbody", "handlers")]
+                while stack:
+                    x = stack.pop()
+                    if isinstance(x, list):
+                        stack.extend(x)
+                        continue
+                    if not isinstance(x, ast.AST) or \
+                            isinstance(x, ast.stmt):
+                        continue
+                    if isinstance(x, ast.Call) and \
+                            isinstance(x.func, ast.Attribute) and \
+                            x.func.attr in _MUTATORS:
+                        r = mutation_root(x.func.value)
+                        if r is not None:
+                            report(r.id, x.lineno, held)
+                    stack.extend(v for _, v in ast.iter_fields(x))
+                for field in ("body", "orelse", "finalbody"):
+                    for s in getattr(st, field, []) or []:
+                        walk([s], held)
+                for h in getattr(st, "handlers", []) or []:
+                    walk(h.body, held)
+
+        walk(fi.node.body, held0)

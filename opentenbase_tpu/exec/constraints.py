@@ -17,6 +17,8 @@ values pass (MATCH SIMPLE).
 
 from __future__ import annotations
 
+import threading
+
 from ..sql import ast as A
 from ..sql.parser import Parser
 from .executor import ExecError
@@ -26,17 +28,20 @@ class ConstraintViolation(ExecError):
     pass
 
 
-_check_cache: dict[tuple, A.Node] = {}
+_check_lock = threading.Lock()
+_check_cache: dict[tuple, A.Node] = {}   # guarded_by: _check_lock
 
 
 def _parse_check(table: str, src: str) -> A.Node:
     key = (table, src)
-    expr = _check_cache.get(key)
+    with _check_lock:
+        expr = _check_cache.get(key)
     if expr is None:
         expr = Parser(src).expr()
-        _check_cache[key] = expr
-        if len(_check_cache) > 512:
-            _check_cache.pop(next(iter(_check_cache)))
+        with _check_lock:
+            _check_cache[key] = expr
+            if len(_check_cache) > 512:
+                _check_cache.pop(next(iter(_check_cache)))
     return expr
 
 

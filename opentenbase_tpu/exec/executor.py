@@ -20,6 +20,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import threading
 from typing import Optional
 
 import jax
@@ -49,15 +50,17 @@ class ExecError(Exception):
 # eager per-operator dispatch, "fused"/"mesh" count TRACE-time events
 # (a cached program re-executes without re-tracing, so those tiers'
 # structural counters grow once per compile) plus program-hit counts.
-# Best-effort under CN-server thread concurrency: a lost increment is
-# acceptable telemetry noise, never a wrong query result.
+# All increments go through bump_stat() under STATS_LOCK, and the
+# attribution tier is thread-local, so concurrent CN-server threads
+# neither lose increments nor cross-attribute each other's tiers.
 # ---------------------------------------------------------------------------
 STAT_FIELDS = ("joins", "index_compositions", "deferred_cols",
                "eager_cols", "cols_materialized", "bytes_materialized",
                "host_syncs", "fused_join_hits")
-EXEC_STATS: dict = {t: {f: 0 for f in STAT_FIELDS}
+STATS_LOCK = threading.Lock()
+EXEC_STATS: dict = {t: {f: 0 for f in STAT_FIELDS}   # guarded_by: STATS_LOCK
                     for t in ("single", "fused", "mesh")}
-_CUR_TIER = ["single"]
+_TIER = threading.local()   # per-thread counter attribution
 
 #: late-materialization master switch — off reverts joins to the eager
 #: full-width gather path (the bit-identical baseline the tests compare
@@ -65,32 +68,46 @@ _CUR_TIER = ["single"]
 LATE_MAT = os.environ.get("OTB_LATE_MAT", "1") != "0"
 
 
-def _stats() -> dict:
-    return EXEC_STATS[_CUR_TIER[0]]
+def _cur_tier() -> str:
+    return getattr(_TIER, "value", "single")
+
+
+# Trace-time counter bumps are sanctioned: they fire once per compile
+# (Python side of the trace), never inside the compiled program.
+def bump_stat(tier: str, field: str, n: int = 1):  # otblint: disable=trace-purity
+    with STATS_LOCK:
+        EXEC_STATS[tier][field] += n
+
+
+def _bump(field: str, n: int = 1):
+    """Thread-safe increment against the current attribution tier."""
+    bump_stat(_cur_tier(), field, n)
 
 
 @contextlib.contextmanager
 def stats_tier(tier: str):
     """Attribute executor counters to `tier` for the duration (the
     fused/mesh tiers wrap their trace + execution in this)."""
-    prev = _CUR_TIER[0]
-    _CUR_TIER[0] = tier
+    prev = _cur_tier()
+    _TIER.value = tier
     try:
         yield
     finally:
-        _CUR_TIER[0] = prev
+        _TIER.value = prev
 
 
 def exec_stats_rows() -> list:
     """(tier, *STAT_FIELDS) rows for the otb_execstats view."""
-    return [(t, *(EXEC_STATS[t][f] for f in STAT_FIELDS))
-            for t in ("single", "fused", "mesh")]
+    with STATS_LOCK:
+        return [(t, *(EXEC_STATS[t][f] for f in STAT_FIELDS))
+                for t in ("single", "fused", "mesh")]
 
 
 def exec_stats_snapshot() -> dict:
     """Flat totals across tiers (bench delta accounting)."""
-    return {f: sum(EXEC_STATS[t][f] for t in EXEC_STATS)
-            for f in STAT_FIELDS}
+    with STATS_LOCK:
+        return {f: sum(EXEC_STATS[t][f] for t in EXEC_STATS)
+                for f in STAT_FIELDS}
 
 
 def _arr_bytes(a, n: int) -> int:
@@ -169,10 +186,9 @@ class DBatch:
 
     def _materialize_one(self, name: str):
         lc = self.lazy.pop(name)
-        st = _stats()
-        st["cols_materialized"] += 1
-        st["bytes_materialized"] += _arr_bytes(lc.src,
-                                               int(lc.idx.shape[0]))
+        _bump("cols_materialized")
+        _bump("bytes_materialized",
+              _arr_bytes(lc.src, int(lc.idx.shape[0])))
         self.cols[name] = lc.value()
         m = lc.null()
         if m is not None:
@@ -212,7 +228,6 @@ class DBatch:
         materialization of the source row space."""
         cols, nulls = {}, {}
         composed: dict = {}
-        st = _stats()
         for n, a in self.cols.items():
             cols[n] = a[take]
         for n, m in self.nulls.items():
@@ -223,10 +238,10 @@ class DBatch:
             if src_idx is None:
                 src_idx = lc.idx[take]
                 composed[key] = src_idx
-                st["index_compositions"] += 1
-            st["cols_materialized"] += 1
-            st["bytes_materialized"] += _arr_bytes(
-                lc.src, int(take.shape[0]))
+                _bump("index_compositions")
+            _bump("cols_materialized")
+            _bump("bytes_materialized",
+                  _arr_bytes(lc.src, int(take.shape[0])))
             cols[n] = lc.src[src_idx]
             m = None
             if lc.null_src is not None:
@@ -470,7 +485,9 @@ class Executor:
                 out_dicts[name] = d
         return DBatch(out_cols, vis, out_types, out_dicts, out_nulls)
 
-    def _exec_indexscan(self, node: P.IndexScan) -> DBatch:
+    # Index scans never fuse: neither tier's screen admits P.IndexScan
+    # (fused._key_of returns None; mesh _ALLOWED excludes it).
+    def _exec_indexscan(self, node: P.IndexScan) -> DBatch:  # otblint: eager-only
         """Index scan: host binary search -> gather only the candidate
         rows -> the regular fused scan path over that staged subset
         (reference: ExecIndexScan; visibility/filters re-verify on the
@@ -500,7 +517,9 @@ class Executor:
         finally:
             self.ctx.staged = old
 
-    def _exec_annsearch(self, node) -> DBatch:
+    # ANN search is host-driven (HNSW graph walk, int() sizing) and is
+    # rejected by both fusability screens — asserted eager-only.
+    def _exec_annsearch(self, node) -> DBatch:  # otblint: eager-only
         """Top-k vector search: visibility+filters mask, IVF probe when an
         index exists, exact distances otherwise, lax.top_k, gather."""
         from ..ops import ann as ANN
@@ -635,7 +654,6 @@ class Executor:
         shared by every column riding it.  `extra_null` is an
         output-space mask (outer-join null extension) OR'd onto every
         carried column's null."""
-        st = _stats()
         composed: dict = {}
         for n_, a in batch.cols.items():
             out.lazy[n_] = LazyCol(a, take, batch.nulls.get(n_),
@@ -643,14 +661,14 @@ class Executor:
             out.types[n_] = batch.types[n_]
             if n_ in batch.dicts:
                 out.dicts[n_] = batch.dicts[n_]
-            st["deferred_cols"] += 1
+            _bump("deferred_cols")
         for n_, lc in batch.lazy.items():
             key = id(lc.idx)
             nidx = composed.get(key)
             if nidx is None:
                 nidx = K.compose_index(lc.idx, take)
                 composed[key] = nidx
-                st["index_compositions"] += 1
+                _bump("index_compositions")
             no = lc.null_out[take] if lc.null_out is not None else None
             if extra_null is not None:
                 no = extra_null if no is None else (no | extra_null)
@@ -658,14 +676,13 @@ class Executor:
             out.types[n_] = batch.types[n_]
             if n_ in batch.dicts:
                 out.dicts[n_] = batch.dicts[n_]
-            st["deferred_cols"] += 1
+            _bump("deferred_cols")
 
     def _gather_side(self, batch: DBatch, take, out: DBatch,
                      extra_null=None):
         """Eager (pre-late-materialization) path: gather every carried
         column of one input through `take` — kept as the bit-identical
         baseline (LATE_MAT off)."""
-        st = _stats()
         batch.ensure_all()
         for n_, a in batch.cols.items():
             out.cols[n_] = a[take]
@@ -677,7 +694,7 @@ class Executor:
                 nm = extra_null if nm is None else (nm | extra_null)
             if nm is not None:
                 out.nulls[n_] = nm
-            st["eager_cols"] += 1
+            _bump("eager_cols")
 
     def _carry_side(self, batch, take, out, extra_null=None):
         if LATE_MAT:
@@ -729,7 +746,7 @@ class Executor:
                 zip(zip(node.left_keys, node.right_keys), lcheck, rcheck)
                 if lok and rok]
 
-        _stats()["joins"] += 1
+        _bump("joins")
         if node.kind in ("semi", "anti") and not node.residual \
                 and not hash_recheck:
             mask = K.semi_mask(counts) if node.kind == "semi" \
@@ -761,7 +778,7 @@ class Executor:
                                     left_outer=left_outer,
                                     probe_valid=left.valid)
         if not self._traced:
-            _stats()["host_syncs"] += 1
+            _bump("host_syncs")
             tot = int(tot)
         valid = jnp.arange(out_size) < tot
         null_right = (bi < 0) if left_outer else None
@@ -866,7 +883,10 @@ class Executor:
     def _exec_batchsource(self, node) -> DBatch:
         return node.batch
 
-    def _exec_setop(self, node: P.SetOp) -> DBatch:
+    # SetOps size their output with host syncs (int(ng), int(total));
+    # P.SetOp is outside fused._key_of and mesh _ALLOWED, so this
+    # operator only ever runs on the eager tier.
+    def _exec_setop(self, node: P.SetOp) -> DBatch:  # otblint: eager-only
         """INTERSECT/EXCEPT [ALL]: side-tagged merge, per-group per-side
         counts by sort, then emit min(c1,c2) / max(c1-c2,0) copies (the
         reference's hashed SETOPCMD_* counting, nodeSetOp.c:49-66).

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 from typing import Optional
 
@@ -57,19 +58,23 @@ from ..plan.planner import rewrite as rewrite_expr
 from ..sql.fingerprint import struct_key
 from . import plancache
 
+# one lock for this module's learned-state dicts: CN-server threads
+# share them, and the add-then-evict sequences below must be atomic
+_STATE_LOCK = threading.Lock()
+
 # plan shapes whose literal-masked trace host-synced (a masked value
 # fed a host branch): retried and cached baked instead.  Bounded FIFO
 # (insertion-ordered dict): the oldest learned fallback is evicted one
 # at a time — a wholesale clear() would drop every learned entry at
 # once and force a burst of doomed literal-masked retraces.
-_MASK_REFUSED: dict = {}
+_MASK_REFUSED: dict = {}    # guarded_by: _STATE_LOCK
 _MASK_REFUSED_MAX = 512
 
 # learned join-size ladder: literal-masked fragment shape -> {join id:
 # factor} — the single-device twin of MeshRunner._ladder, so a join
 # fragment's second statement (any literal binding) starts at the
 # right output class instead of replaying the overflow walk
-_JOIN_LADDER: dict = {}
+_JOIN_LADDER: dict = {}     # guarded_by: _STATE_LOCK
 _JOIN_LADDER_MAX = 512
 
 # Observability hook: when set, called as EXPORT_HOOK(tag, fn, args)
@@ -90,9 +95,10 @@ def _fuse_join_min_rows() -> int:
 
 
 def _mask_refused_add(k):
-    _MASK_REFUSED[k] = True
-    while len(_MASK_REFUSED) > _MASK_REFUSED_MAX:
-        _MASK_REFUSED.pop(next(iter(_MASK_REFUSED)))
+    with _STATE_LOCK:
+        _MASK_REFUSED[k] = True
+        while len(_MASK_REFUSED) > _MASK_REFUSED_MAX:
+            _MASK_REFUSED.pop(next(iter(_MASK_REFUSED)))
 
 
 def _key_of_expr(e) -> tuple:
@@ -363,7 +369,7 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
     pvals = tuple(
         [jnp.asarray(ctx.params[k][0]) for k in traced_names]
         + [jnp.asarray(v) for _n, v, _t in lits])
-    from .executor import EXEC_STATS, stats_tier
+    from .executor import bump_stat, stats_tier
 
     for _attempt in range(24):
         full_key = base_key + (tuple(sorted(factors.items())),)
@@ -373,7 +379,7 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
                 full_key, _build_program(ctx, exec_node_plan, baked,
                                          traced_names, lits, factors))
         elif has_join and hit[0] is not None:
-            EXEC_STATS["fused"]["fused_join_hits"] += 1
+            bump_stat("fused", "fused_join_hits")
         fn, meta = hit
         if fn is None:
             return None  # permanently fell back for this plan shape
@@ -442,9 +448,10 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
 
 
 def _ladder_remember(lkey, factors: dict):
-    _JOIN_LADDER[lkey] = dict(factors)
-    while len(_JOIN_LADDER) > _JOIN_LADDER_MAX:
-        _JOIN_LADDER.pop(next(iter(_JOIN_LADDER)))
+    with _STATE_LOCK:
+        _JOIN_LADDER[lkey] = dict(factors)
+        while len(_JOIN_LADDER) > _JOIN_LADDER_MAX:
+            _JOIN_LADDER.pop(next(iter(_JOIN_LADDER)))
 
 
 def _build_program(ctx, frag_plan, baked, traced_names, lits, factors):
@@ -480,7 +487,10 @@ def _build_program(ctx, frag_plan, baked, traced_names, lits, factors):
         meta["dicts"] = b.dicts
         meta["join_caps"] = tuple(
             (jid, cap) for jid, _req, cap in sub.join_required)
-        join_req = jnp.stack(
+        # join_required is a host-side Python list (one entry per join
+        # in the fragment, fixed at trace time) — its truthiness is not
+        # a device read
+        join_req = jnp.stack(  # otblint: disable=host-sync
             [req for _jid, req, _cap in sub.join_required]) \
             if sub.join_required else jnp.zeros(0, jnp.int64)
         return b.cols, b.valid, b.nulls, join_req

@@ -891,8 +891,8 @@ class MeshRunner:
         if cached is not None:
             fn, meta = cached
             if has_join:
-                from .executor import EXEC_STATS
-                EXEC_STATS["mesh"]["fused_join_hits"] += 1
+                from .executor import bump_stat
+                bump_stat("mesh", "fused_join_hits")
             return self._call_program(fn, meta, gather_idx, staged,
                                       table_names, snapshot_ts, txid,
                                       params)

@@ -51,7 +51,7 @@ from ..sql.fingerprint import fingerprint
 
 _LOCK = threading.RLock()
 _SEQ = itertools.count()
-_REGISTRY: list = []          # jit-bearing caches under the global budget
+_REGISTRY: list = []   # guarded_by: _LOCK  (jit caches under the budget)
 
 
 def _live_budget() -> int:

@@ -21,26 +21,31 @@ affected row, not per touched byte.
 
 from __future__ import annotations
 
+import threading
+
 from ..sql import ast as A
 from ..sql.parser import parse_sql
 from .executor import ExecError
 
 _MAX_DEPTH = 8
 
-_body_cache: dict[str, list] = {}
+_body_lock = threading.Lock()
+_body_cache: dict[str, list] = {}   # guarded_by: _body_lock
 
 
 def _parse_body(name: str, body: str) -> list:
-    hit = _body_cache.get(body)
+    with _body_lock:
+        hit = _body_cache.get(body)
     if hit is None:
         try:
             hit = parse_sql(body)
         except Exception as e:
             raise ExecError(f"function {name!r} body does not parse: "
                             f"{e}") from None
-        _body_cache[body] = hit
-        if len(_body_cache) > 256:
-            _body_cache.pop(next(iter(_body_cache)))
+        with _body_lock:
+            _body_cache[body] = hit
+            if len(_body_cache) > 256:
+                _body_cache.pop(next(iter(_body_cache)))
     return hit
 
 

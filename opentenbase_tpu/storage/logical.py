@@ -56,11 +56,13 @@ from ..catalog.types import TypeKind
 # in-process connection registry: CREATE SUBSCRIPTION ... CONNECTION
 # 'local:<key>' resolves here (tests and single-host deployments);
 # 'tcp:host:port' goes over the wire
-_LOCAL_PUBLISHERS: dict[str, "LogicalPublisher"] = {}
+_publishers_lock = threading.Lock()
+_LOCAL_PUBLISHERS: dict[str, "LogicalPublisher"] = {}  # guarded_by: _publishers_lock
 
 
 def register_local_publisher(key: str, pub: "LogicalPublisher"):
-    _LOCAL_PUBLISHERS[key] = pub
+    with _publishers_lock:
+        _LOCAL_PUBLISHERS[key] = pub
 
 
 def _dec_str(v: int, scale: int) -> str:

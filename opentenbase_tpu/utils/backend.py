@@ -97,7 +97,9 @@ def force_cpu():
     jax.config.update("jax_platforms", "cpu")
 
 
-def connect(timeout_s: float | None = None) -> str:
+# Runs at most once per process, before any program is traced (memoized
+# startup probe) — its env reads and timers never land inside a trace.
+def connect(timeout_s: float | None = None) -> str:  # otblint: eager-only
     """Idempotent backend selection; safe (non-hanging) at import time.
 
     Returns the selected platform label: "tpu" or "cpu".  The decision is

@@ -36,15 +36,21 @@ import numpy as np
 
 _mode: str | None = None
 
+# Snapshot the env override ONCE at import: mode() is reachable from
+# traced code (kernels -> device_float), and a mid-trace os.environ
+# read would make compiled programs depend on ambient process state.
+_ENV_MODE = os.environ.get("OTB_DTYPE_MODE", "").strip().lower()
 
-def mode() -> str:
+
+# The memo write below runs at most once per process, on the Python
+# side of the first trace — never per-execution of a compiled program.
+def mode() -> str:  # otblint: disable=trace-purity
     """'x64' or 'tpu'.  Resolved once per process: OTB_DTYPE_MODE wins,
     else follows the selected jax backend (utils/backend.connect)."""
     global _mode
     if _mode is None:
-        m = os.environ.get("OTB_DTYPE_MODE", "").strip().lower()
-        if m in ("x64", "tpu"):
-            _mode = m
+        if _ENV_MODE in ("x64", "tpu"):
+            _mode = _ENV_MODE
         else:
             from .backend import connect
             _mode = "tpu" if connect() == "tpu" else "x64"

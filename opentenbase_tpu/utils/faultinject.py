@@ -9,9 +9,10 @@ toggled by config.  Tests arm a point; the code path calls
 
 from __future__ import annotations
 
+import os
 import threading
 
-_armed: dict[str, int] = {}
+_armed: dict[str, int] = {}   # guarded_by: _lock
 _lock = threading.Lock()
 
 # the 2PC windows (named after the reference's stub points)
@@ -52,3 +53,23 @@ def fault_point(point: str):
             if _armed[point] == 0:
                 del _armed[point]
             raise InjectedFault(point)
+
+
+def _arm_from_env():
+    """Read the env switch ONCE at import (never inside fault_point,
+    which sits on hot 2PC paths): OTB_FAULT_INJECT='POINT[:times],...'
+    pre-arms the named points for whole-process crash tests."""
+    spec = os.environ.get("OTB_FAULT_INJECT", "").strip()
+    if not spec:
+        return
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, times = part.partition(":")
+        name = name.strip().upper()
+        if name in POINTS:
+            arm(name, int(times) if times.strip().isdigit() else 1)
+
+
+_arm_from_env()

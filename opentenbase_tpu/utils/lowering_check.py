@@ -4,8 +4,8 @@ Run as a module (python -m opentenbase_tpu.utils.lowering_check) under
 OTB_DTYPE_MODE=tpu: exports every engine kernel AND the actual fused /
 mesh programs executed by a live query battery for the **tpu** platform
 via jax.export (cross-platform lowering — no TPU hardware needed), and
-scans the emitted StableHLO for f64 tensor types.  Output: one JSON
-line {"kernels": n, "programs": n, "f64": [...], "export_errors": [...]}.
+audits the emitted StableHLO.  Output: one JSON line with
+{"kernels": n, "programs": n, "f64": [...], "export_errors": [...], ...}.
 
 This is the committed proof that the engine's device path compiles for
 a TPU target (SURVEY.md §7.1 design mapping; BASELINE.md north star):
@@ -14,93 +14,33 @@ a TPU target (SURVEY.md §7.1 design mapping; BASELINE.md north star):
   program — the dtype a TPU lacks natively;
 - int64 stays (XLA emulates it exactly; the storage contract needs it).
 
-tests/test_tpu_lowering.py runs this in a subprocess and asserts the
-report is clean.
+The scan itself lives in analysis/hlo_audit.py, where the f64 check is
+one of three StableHLO rules (hlo-f64 / hlo-host-transfer /
+hlo-dynamic-shape) sharing otblint's finding/report machinery; this
+module keeps the query battery (also used by the dtype-mode equivalence
+test) and the historical entry point.  tests/test_tpu_lowering.py runs
+this in a subprocess and asserts the report is clean.
 """
 
 from __future__ import annotations
 
 import json
-import re
 import sys
-
-_F64 = re.compile(r"\bf64\b")
 
 
 def _sds_of(tree):
-    import jax
-
-    def leaf(a):
-        a = jax.numpy.asarray(a)
-        return jax.ShapeDtypeStruct(a.shape, a.dtype)
-    return jax.tree.map(leaf, tree)
+    from ..analysis.hlo_audit import _sds_of as impl
+    return impl(tree)
 
 
 def export_check(fn, args, label: str, report: dict):
-    """Export `fn(*args)` for platform 'tpu'; record f64 hits/errors."""
-    import jax
-    from jax import export
-    try:
-        exp = export.export(
-            fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn),
-            platforms=("tpu",))(*_sds_of(args))
-        txt = exp.mlir_module()
-    except Exception as e:  # noqa: BLE001 — report, don't crash the scan
-        report.setdefault("export_errors", []).append(
-            f"{label}: {type(e).__name__}: {e}")
-        return
-    report["programs"] = report.get("programs", 0) + 1
-    if _F64.search(txt):
-        report.setdefault("f64", []).append(label)
+    from ..analysis.hlo_audit import export_check as impl
+    return impl(fn, args, label, report)
 
 
 def check_kernels(report: dict):
-    """Every ops/kernels.py kernel at two size classes."""
-    import jax.numpy as jnp
-
-    from ..ops import kernels as K
-    from .dtypes import device_float
-    DF = device_float()
-    for n in (1024, 65536):
-        f = jnp.zeros(n, DF)
-        i = jnp.zeros(n, jnp.int64)
-        v = jnp.zeros(n, bool)
-        export_check(lambda m, c: K.compact(m, c, out_size=n),
-                     (v, (i, f)), f"compact/{n}", report)
-        export_check(
-            lambda g, m, a: K.grouped_agg_dense(
-                g, m, a, num_groups=64,
-                agg_kinds=("sum", "count", "min", "max", "sumf")),
-            (i, v, (i, i, i, f, f)), f"grouped_agg_dense/{n}", report)
-        export_check(
-            lambda k, m, a: K.grouped_agg_sort(
-                k, m, a, max_groups=n,
-                agg_kinds=("sum", "count", "min", "max", "sumf")),
-            ((i, i), v, (i, i, i, f, f)),
-            f"grouped_agg_sort/{n}", report)
-        export_check(K.join_build, (i, v), f"join_build/{n}", report)
-        export_check(K.join_probe_counts, (i, i, v),
-                     f"join_probe_counts/{n}", report)
-        export_check(
-            lambda lo, c, p: K.join_expand(lo, c, p, out_size=2 * n,
-                                           left_outer=True,
-                                           probe_valid=None),
-            (i, i, i), f"join_expand/{n}", report)
-        export_check(K.semi_mask, (i,), f"semi_mask/{n}", report)
-        export_check(lambda c, pv: K.anti_mask(c, pv), (i, v),
-                     f"anti_mask/{n}", report)
-        export_check(
-            lambda k1, k2, m, p1, p2: K.sort_rows(
-                (k1, k2), m, (p1, p2), descs=(False, True), limit=128),
-            (i, f, v, i, f), f"sort_rows/{n}", report)
-        export_check(
-            lambda c1, c2: K.bucket_ids((c1, c2), num_buckets=4096),
-            (i, i), f"bucket_ids/{n}", report)
-        export_check(
-            lambda a, b, c, d: K.visibility_mask(
-                a, b, c, d, jnp.int64(5), jnp.int64(7), jnp.int64(-1)),
-            (i, i, i, i), f"visibility_mask/{n}", report)
-    report["kernels"] = report.get("programs", 0)
+    from ..analysis.hlo_audit import check_kernels as impl
+    return impl(report)
 
 
 def run_battery(cluster_ndn: int = 3):
@@ -168,31 +108,9 @@ def run_battery(cluster_ndn: int = 3):
 
 
 def main():
-    from ..exec import fused, mesh_exec
-    from .dtypes import mode
+    from ..analysis.hlo_audit import audit
 
-    report: dict = {"mode": mode(), "f64": [], "export_errors": []}
-    check_kernels(report)
-
-    seen: set = set()
-
-    def hook(tag, fn, args):
-        key = (tag, id(fn))
-        if key in seen:
-            return
-        seen.add(key)
-        export_check(fn, args, f"{tag}/{len(seen)}", report)
-
-    fused.EXPORT_HOOK = hook
-    mesh_exec.EXPORT_HOOK = hook
-    try:
-        results = run_battery()
-    finally:
-        fused.EXPORT_HOOK = None
-        mesh_exec.EXPORT_HOOK = None
-    report["battery"] = {k: (v if isinstance(v, str) else len(v))
-                         for k, v in results.items()}
-    report["ok"] = not report["f64"] and not report["export_errors"]
+    report = audit(full=True)
     print(json.dumps(report, default=str))
     return 0 if report["ok"] else 1
 

@@ -1,0 +1,294 @@
+"""otblint proof (analysis/): each pass catches its known violation,
+stays silent on the clean twin, and the repo itself scans clean.
+
+Three layers:
+- fixture packages written to tmp_path with exactly one violation per
+  rule next to a clean twin — no false negatives, no false positives;
+- scan_hlo_text unit tests on canned MLIR (no jax.export needed);
+- the real gate: ``python -m opentenbase_tpu.analysis.lint --json`` as
+  a subprocess over the whole repo must exit 0 with zero unsuppressed
+  findings in well under the 30s CI budget, and the checked-in
+  baseline must be empty for the exec/ and storage/ trees.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from opentenbase_tpu.analysis.hlo_audit import scan_hlo_text
+from opentenbase_tpu.analysis.lint import lint
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def _write_pkg(root, files: dict):
+    for rel, src in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(textwrap.dedent(src))
+
+
+def _scan(root, rule):
+    report = lint(root=str(root), package="fixpkg", rules={rule})
+    return [(f["rule"], f["file"]) for f in report["findings"]]
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: one violation + one clean twin
+# ---------------------------------------------------------------------------
+
+class TestHostSyncPass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/hot.py": """\
+            import jax
+
+            def run(x):
+                return helper(x)
+
+            def helper(x):
+                y = jax.numpy.cumsum(x)
+                n = int(y)        # host sync on a traced value
+                return n
+
+            def build():
+                return jax.jit(run)
+        """,
+        "fixpkg/exec/cold.py": """\
+            import jax
+
+            def run(x):
+                return helper(x)
+
+            def helper(x):
+                y = jax.numpy.cumsum(x)
+                n = int(y.shape[0])   # shape is static metadata
+                return n
+
+            def build():
+                return jax.jit(run)
+        """,
+    }
+
+    def test_violation_and_clean_twin(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        got = _scan(tmp_path, "host-sync")
+        assert got == [("host-sync", "fixpkg/exec/hot.py")], got
+
+    def test_pragma_suppresses(self, tmp_path):
+        files = dict(self.FILES)
+        files["fixpkg/exec/hot.py"] = files["fixpkg/exec/hot.py"].replace(
+            "n = int(y)        #",
+            "n = int(y)  # otblint: disable=host-sync #")
+        _write_pkg(tmp_path, files)
+        assert _scan(tmp_path, "host-sync") == []
+
+    def test_eager_only_cuts_closure(self, tmp_path):
+        files = dict(self.FILES)
+        files["fixpkg/exec/hot.py"] = files["fixpkg/exec/hot.py"].replace(
+            "def helper(x):",
+            "def helper(x):  # otblint: eager-only")
+        _write_pkg(tmp_path, files)
+        assert _scan(tmp_path, "host-sync") == []
+
+
+class TestTracePurityPass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/hot.py": """\
+            import jax
+            import os
+
+            def run(x):
+                lim = os.environ.get("FIX_LIMIT", "0")  # mid-trace env
+                return x + int(lim)
+
+            def build():
+                return jax.jit(run)
+        """,
+        "fixpkg/exec/cold.py": """\
+            import jax
+            import os
+
+            _LIMIT = int(os.environ.get("FIX_LIMIT", "0"))  # at import
+
+            def run(x):
+                return x + _LIMIT
+
+            def build():
+                return jax.jit(run)
+        """,
+    }
+
+    def test_violation_and_clean_twin(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        got = _scan(tmp_path, "trace-purity")
+        assert got == [("trace-purity", "fixpkg/exec/hot.py")], got
+
+
+class TestProgramKeyPass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/caches.py": """\
+            from opentenbase_tpu.exec.plancache import ProgramCache
+
+            CACHE = ProgramCache(8)
+
+            def build_prog(v):
+                return v
+
+            def put_bad(key, flavor):
+                prog = build_prog(flavor)   # flavor not in the key
+                CACHE.put(key, prog)
+
+            def put_good(key):
+                prog = build_prog(key)
+                CACHE.put(key, prog)
+        """,
+    }
+
+    def test_violation_and_clean_twin(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"program-key"})
+        got = [(f["rule"], f["file"], f["symbol"])
+               for f in report["findings"]]
+        assert got == [("program-key", "fixpkg/exec/caches.py",
+                        "put_bad")], got
+        assert "cache key" in report["findings"][0]["message"]
+
+
+class TestLockDisciplinePass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/state.py": """\
+            import threading
+
+            _LOCK = threading.Lock()
+            _GOOD: dict = {}   # guarded_by: _LOCK
+            _BAD: dict = {}    # guarded_by: _LOCK
+
+            def good(k, v):
+                with _LOCK:
+                    _GOOD[k] = v
+                    if len(_GOOD) > 8:
+                        _GOOD.pop(next(iter(_GOOD)))
+
+            def bad(k, v):
+                _BAD[k] = v    # write outside the declared lock
+        """,
+        "fixpkg/exec/naked.py": """\
+            _REG: list = []    # mutated, never annotated
+
+            def add(x):
+                _REG.append(x)
+        """,
+    }
+
+    def test_violation_and_clean_twin(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        got = sorted(_scan(tmp_path, "lock-discipline"))
+        assert got == [("lock-discipline", "fixpkg/exec/naked.py"),
+                       ("lock-discipline", "fixpkg/exec/state.py")], got
+
+    def test_locked_pop_under_if_is_clean(self, tmp_path):
+        # regression: a mutator call nested under `if` inside `with`
+        # must inherit the held lock
+        files = {k: v for k, v in self.FILES.items()
+                 if "naked" not in k}
+        _write_pkg(tmp_path, files)
+        got = [f for f in _scan(tmp_path, "lock-discipline")
+               if "_GOOD" in f[1] or "pop" in f[1]]
+        assert got == []
+
+
+# ---------------------------------------------------------------------------
+# HLO text scan (no jax export involved)
+# ---------------------------------------------------------------------------
+
+class TestScanHloText:
+    def test_f64(self):
+        txt = ("module @m {\n"
+               "  func.func @main(%a: tensor<4xf64>) -> tensor<4xf64>\n"
+               "}\n")
+        assert [f.rule for f in scan_hlo_text("k", txt)] == ["hlo-f64"]
+        assert scan_hlo_text("k", txt)[0].line == 2
+
+    def test_host_transfer(self):
+        txt = ('  %0 = stablehlo.custom_call '
+               '@xla_python_cpu_callback(%arg0)\n')
+        assert [f.rule for f in scan_hlo_text("k", txt)] == \
+            ["hlo-host-transfer"]
+        txt2 = '  "stablehlo.send"(%arg0, %tok)\n'
+        assert [f.rule for f in scan_hlo_text("k", txt2)] == \
+            ["hlo-host-transfer"]
+
+    def test_dynamic_shape(self):
+        txt = ("  %1 = stablehlo.real_dynamic_slice %a, %s, %l, %st :"
+               " tensor<?xf32>\n")
+        assert [f.rule for f in scan_hlo_text("k", txt)] == \
+            ["hlo-dynamic-shape"]
+
+    def test_clean_program(self):
+        txt = ("module @m {\n"
+               "  func.func @main(%a: tensor<64xf32>) {\n"
+               "    %0 = stablehlo.custom_call @Sharding(%a)\n"
+               "    %1 = stablehlo.dynamic_slice %0, %c\n"
+               "  }\n}\n")
+        assert scan_hlo_text("k", txt) == []
+
+
+# ---------------------------------------------------------------------------
+# the repo itself scans clean (the actual CI gate), fast
+# ---------------------------------------------------------------------------
+
+class TestRepoGate:
+    def test_repo_scans_clean_under_budget(self):
+        t0 = time.monotonic()
+        out = subprocess.run(
+            [sys.executable, "-m", "opentenbase_tpu.analysis.lint",
+             "--json"],
+            capture_output=True, text=True, env=_ENV, cwd=_REPO,
+            timeout=120)
+        took = time.monotonic() - t0
+        assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+        report = json.loads(out.stdout)
+        assert report["ok"] is True
+        assert report["unsuppressed"] == 0
+        assert report["files"] > 50
+        assert took < 30, f"lint took {took:.1f}s (budget 30s)"
+
+    def test_combined_gate_lint_plus_hlo(self):
+        # the actual CI entry: lint + kernel-battery HLO audit
+        t0 = time.monotonic()
+        out = subprocess.run(
+            [sys.executable, "-m", "opentenbase_tpu.analysis"],
+            capture_output=True, text=True, env=_ENV, cwd=_REPO,
+            timeout=120)
+        took = time.monotonic() - t0
+        assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+        hlo = json.loads(out.stdout.strip().splitlines()[-1])
+        assert hlo["ok"] is True and hlo["export_errors"] == []
+        assert hlo["kernels"] >= 20
+        assert took < 30, f"gate took {took:.1f}s (budget 30s)"
+
+    def test_baseline_empty_for_exec_and_storage(self):
+        path = os.path.join(_REPO, "opentenbase_tpu", "analysis",
+                            "baseline.json")
+        with open(path) as fh:
+            data = json.load(fh)
+        burned = [s for s in data["suppressions"]
+                  if s["file"].startswith(("opentenbase_tpu/exec/",
+                                           "opentenbase_tpu/storage/"))]
+        assert burned == [], burned
