@@ -27,6 +27,12 @@ Modes via env:
   one arm (the r1/r2 behavior) for quick checks
 - BENCH_OLTP=1: additionally measure the point-op latency path (FQS
   INSERT/SELECT p50) — the reference's execLight.c OLTP story
+- --trace: after each timed arm, dump the full last-query span tree
+  (obs/trace.py) as one JSON line on stderr; every ladder entry also
+  carries a "phases" breakdown (plan/stage/execute/exchange/finalize
+  ms of the arm's last warm run), and the final JSON gains a
+  "latency" block with p50/p95/p99 per tier from the unified metrics
+  registry's otb_query_ms histograms (obs/metrics.py)
 - BENCH_WARM2=1 (default): the warm-restart arm — after the ladder, a
   FRESH python process re-runs Q1/Q3/Q5 against the persistent XLA
   compilation cache the first run populated (exec/plancache.py), and
@@ -167,6 +173,48 @@ def _oltp_latencies(s, n=200):
         prep.append(time.perf_counter() - t0)
     return (float(np.median(ins) * 1e3), float(np.median(raw) * 1e3),
             float(np.median(prep) * 1e3))
+
+
+TRACE_DUMP = "--trace" in sys.argv[1:]
+
+
+def _phases(qs):
+    """Span-tree phase breakdown of the arm's last warm run
+    (session.last_query_stats(); all zeros when OTB_TRACE=0)."""
+    return {k: round(float(qs.get(k, 0.0)), 3)
+            for k in ("plan_ms", "stage_ms", "execute_ms",
+                      "exchange_ms", "finalize_ms")}
+
+
+def _dump_trace(cfg):
+    """--trace: full last-query span tree, one JSON line on stderr
+    (stdout stays the single bench JSON line)."""
+    if not TRACE_DUMP:
+        return
+    from opentenbase_tpu.obs import trace as obs_trace
+    qt = obs_trace.last_trace()
+    if qt is not None:
+        print(json.dumps({"trace_for": cfg, **qt.to_dict()}),
+              file=sys.stderr)
+
+
+def _latency_block():
+    """p50/p95/p99 per tier from the otb_query_ms histograms — the
+    registry aggregates EVERY query the process ran, not just the
+    min-of-warm arms the ladder reports."""
+    from opentenbase_tpu.obs.metrics import REGISTRY
+    out = {}
+    for name, labels, kind, value in REGISTRY.samples():
+        if kind != "histogram" or \
+                not name.startswith("otb_query_ms_"):
+            continue
+        tag = name[len("otb_query_ms_"):]
+        if tag not in ("count", "p50", "p95", "p99"):
+            continue
+        lbl = ",".join(f"{k}={v}" for k, v in labels) or "all"
+        out.setdefault(lbl, {})[tag] = (
+            int(value) if tag == "count" else round(float(value), 3))
+    return out
 
 
 def _mat_counters(x0, x1):
@@ -335,6 +383,8 @@ def main():
             x0 = exec_stats_snapshot()
             eng, cold = _time(lambda: s1.query(Q[qn]), repeat)
             x1 = exec_stats_snapshot()
+            phases = _phases(s1.last_query_stats())
+            _dump_trace(f"Q{qn} single")
             ctl, _ = _time(lambda: controls[qn](dfs),
                            max(2, repeat // 2))
             gb = _gb_touched(qn, data)
@@ -342,7 +392,8 @@ def main():
                      "cold_ms": cold * 1e3,
                      "mrows_s": n_rows / eng / 1e6,
                      "vs_pandas": ctl / eng,
-                     "gb_touched": gb, "gb_per_s": gb / eng}
+                     "gb_touched": gb, "gb_per_s": gb / eng,
+                     "phases": phases}
             entry.update(_mat_counters(x0, x1))
             ladder.append(entry)
         del s1, node
@@ -367,6 +418,8 @@ def main():
             s2.query(Q[qn])
             warm_ms = (time.perf_counter() - t_run) * 1e3
             t1 = POOL.totals()
+            phases = _phases(s2.last_query_stats())
+            _dump_trace(f"Q{qn} mesh")
             dh = t1["hits"] - t0["hits"]
             dm = t1["misses"] - t0["misses"]
             stage = s2.last_stage_ms
@@ -382,7 +435,8 @@ def main():
                      "vs_pandas": ctl / eng,
                      "gb_touched": gb,
                      "gb_per_s": gb / eng,
-                     "tier": s2.last_tier}
+                     "tier": s2.last_tier,
+                     "phases": phases}
             entry.update(_mat_counters(x0, x1))
             if s2.last_tier != "mesh":
                 entry["fallback"] = s2.last_fallback
@@ -457,6 +511,7 @@ def main():
         "plancache": [dict(zip(("tier", "hits", "misses", "compiles",
                                 "compile_ms", "evictions", "live"), r))
                       for r in plancache.stats()],
+        "latency": _latency_block(),
     }
     from opentenbase_tpu.storage.bufferpool import POOL
     out["buffercache"] = [

@@ -39,6 +39,8 @@ RULES = {
                    "program-cache key",
     "lock-discipline": "module-level mutable state written without "
                        "its guarded_by lock",
+    "obs-purity": "tracing/metrics instrumentation call inside a "
+                  "traced region",
     "hlo-f64": "f64 tensor type in exported StableHLO",
     "hlo-host-transfer": "host transfer / callback op in exported "
                          "StableHLO",

@@ -21,8 +21,8 @@ import sys
 from .callgraph import TracedClosure
 from .core import (Baseline, Project, RULES, default_baseline_path,
                    make_report)
-from .passes import (HostSyncPass, LockDisciplinePass, ProgramKeyPass,
-                     TracePurityPass)
+from .passes import (HostSyncPass, LockDisciplinePass, ObsPurityPass,
+                     ProgramKeyPass, TracePurityPass)
 
 
 def repo_root() -> str:
@@ -36,6 +36,7 @@ def run_passes(project: Project, rules=None) -> list:
     passes = [
         HostSyncPass(project, closure),
         TracePurityPass(project, closure),
+        ObsPurityPass(project, closure),
         ProgramKeyPass(project),
         LockDisciplinePass(project),
     ]

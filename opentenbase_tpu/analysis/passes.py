@@ -27,10 +27,18 @@ program-key
 
 lock-discipline
     A module-level mutable container in the threaded trees (exec/,
-    storage/, gtm/, net/, utils/) that is written from function scope
-    must declare ``# guarded_by: <lock>`` on its definition, and every
-    such write must hold that lock (lexical ``with <lock>:`` or a
+    storage/, gtm/, net/, utils/, obs/) that is written from function
+    scope must declare ``# guarded_by: <lock>`` on its definition, and
+    every such write must hold that lock (lexical ``with <lock>:`` or a
     ``# holds: <lock>`` contract on the enclosing def).
+
+obs-purity
+    Instrumentation must observe the engine, never become part of it:
+    no ``obs.trace`` / ``obs.metrics`` call may be reachable inside a
+    traced closure (spans would be captured at trace time, re-execute
+    never, and their timers would read as zero — silently wrong).
+    Spans/events belong at host boundaries only; eager-only regions
+    (``if not self._traced:`` branches) are exempt.
 """
 
 from __future__ import annotations
@@ -39,7 +47,8 @@ import ast
 import builtins
 from typing import Optional
 
-from .callgraph import TracedClosure, is_traced_guard_test
+from .callgraph import (TracedClosure, _GuardedWalker,
+                        is_traced_guard_test)
 from .core import Finding, FuncInfo, Project, _stmt_pragma_lines
 
 _BUILTINS = frozenset(dir(builtins))
@@ -596,6 +605,55 @@ class TracePurityPass:
 
 
 # ===========================================================================
+# obs-purity
+# ===========================================================================
+class ObsPurityPass:
+    """No tracing/metrics call may execute under a trace: a span opened
+    inside a jitted closure is captured once at trace time, never
+    re-executed, and times nothing — and ``event()`` would mutate the
+    thread-local stack mid-trace.  Flags (a) any call in the traced
+    closure resolving into ``<pkg>.obs.`` and (b) any ``obs`` module
+    function that becomes reachable from a traced root at all."""
+
+    rule = "obs-purity"
+
+    def __init__(self, project: Project, closure: TracedClosure):
+        self.project = project
+        self.closure = closure
+        self.obs_root = f"{project.package}.obs"
+
+    def run(self) -> list:
+        em = _Emitter(self.rule)
+        for fi in self.closure.functions():
+            if fi.module == self.obs_root or \
+                    fi.module.startswith(self.obs_root + "."):
+                em.emit(fi, fi.lineno,
+                        f"obs function '{fi.qualname}' is reachable "
+                        f"from a traced root — instrumentation became "
+                        f"part of the program")
+                continue
+            self._check(fi, em)
+        return em.findings
+
+    def _check(self, fi: FuncInfo, em: _Emitter):
+        mi = self.project.modules[fi.module]
+        prefix = self.obs_root + "."
+        obs_root = self.obs_root
+
+        class _W(_GuardedWalker):
+            def on_call(self, call, eager: bool):
+                if eager:
+                    return
+                d = _dotted(call.func, mi) or ""
+                if d == obs_root or d.startswith(prefix):
+                    em.emit(fi, call.lineno,
+                            f"instrumentation call {d}() inside a "
+                            f"traced region")
+
+        _W().walk_function(fi.node)
+
+
+# ===========================================================================
 # program-key
 # ===========================================================================
 class ProgramKeyPass:
@@ -752,7 +810,7 @@ class LockDisciplinePass:
 
     def __init__(self, project: Project,
                  trees: tuple = ("exec", "storage", "gtm", "net",
-                                 "utils")):
+                                 "utils", "obs")):
         self.project = project
         self.trees = trees
         # (module, name) -> {"line", "lock", "module"}

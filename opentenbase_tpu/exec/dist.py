@@ -23,12 +23,14 @@ from __future__ import annotations
 
 import copy as _copy
 import dataclasses
+import time as _time
 from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..catalog.types import SqlType, TypeKind
+from ..obs import trace as obs_trace
 from ..parallel.cluster import Cluster
 from ..plan import exprs as E
 from ..plan.distribute import (BatchSource, DistPlan, Exchange, ExchangeRef,
@@ -242,10 +244,24 @@ class DistExecutor:
                     "cluster not mesh-capable"
             else:
                 try:
+                    t_run = _time.perf_counter()
                     gathered, executed = runner.run(
                         dp, self.snapshot_ts, self.txid, self.params)
+                    mesh_ms = (_time.perf_counter() - t_run) * 1e3
                     top = dp.fragments[dp.top_fragment]
                     self.stage_ms = runner.last_stage_ms
+                    if self.instrument:
+                        # mesh fragments execute as ONE shard_map
+                        # program — each gathered fragment reports its
+                        # own output rows but shares the program's
+                        # wall time (EXPLAIN ANALYZE annotation)
+                        for ex_ in dp.exchanges:
+                            b = gathered.get(ex_.index)
+                            if b is not None:
+                                self.stats[(ex_.source_fragment,
+                                            "mesh")] = {
+                                    "ms": mesh_ms,
+                                    "rows": int(b.count())}
                     self.tier = "mesh"   # overwritten by later subplans:
                     # the LAST _run_distplan call is the main plan, so the
                     # recorded tier is always the main plan's
@@ -337,19 +353,23 @@ class DistExecutor:
                     else _concat_host(parts)
             batch = self._exec_fragment_on(frag, dp, "cn", ex_out)
             hb = _to_host(batch)
-            for ex in consumers:
-                if ex.kind in ("gather", "gather_one"):
-                    ex_out[(ex.index, "cn")] = hb
-                elif ex.kind == "broadcast":
-                    ex_out[(ex.index, "cn")] = hb
-                    for d in range(self.cluster.ndn):
-                        ex_out[(ex.index, d)] = hb
-                elif ex.kind == "redistribute":
-                    routed = self._route([hb], ex.keys)
-                    for d in range(self.cluster.ndn):
-                        ex_out[(ex.index, d)] = routed[d]
-                else:
-                    raise ExecError(f"unknown exchange kind {ex.kind}")
+            with obs_trace.span("exchange", fragment=frag.index) as exsp:
+                for ex in consumers:
+                    if ex.kind in ("gather", "gather_one"):
+                        ex_out[(ex.index, "cn")] = hb
+                    elif ex.kind == "broadcast":
+                        ex_out[(ex.index, "cn")] = hb
+                        for d in range(self.cluster.ndn):
+                            ex_out[(ex.index, d)] = hb
+                    elif ex.kind == "redistribute":
+                        routed = self._route([hb], ex.keys)
+                        for d in range(self.cluster.ndn):
+                            ex_out[(ex.index, d)] = routed[d]
+                    else:
+                        raise ExecError(
+                            f"unknown exchange kind {ex.kind}")
+                if obs_trace.active():
+                    exsp.set(rounds=len(consumers), bytes=_hb_bytes(hb))
             return
         dn_range = [0] if only_one else list(range(self.cluster.ndn))
         remote = all(not hasattr(dn, "stores")
@@ -368,22 +388,26 @@ class DistExecutor:
         else:
             per_dn = [self._exec_fragment_on(frag, dp, dn_idx, ex_out)
                       for dn_idx in dn_range]
-        for ex in consumers:
-            if ex.kind == "gather_one":
-                ex_out[(ex.index, "cn")] = per_dn[0]
-            elif ex.kind == "gather":
-                ex_out[(ex.index, "cn")] = _concat_host(per_dn)
-            elif ex.kind == "broadcast":
-                full = _concat_host(per_dn)
-                ex_out[(ex.index, "cn")] = full
-                for d in range(self.cluster.ndn):
-                    ex_out[(ex.index, d)] = full
-            elif ex.kind == "redistribute":
-                routed = self._route(per_dn, ex.keys)
-                for d in range(self.cluster.ndn):
-                    ex_out[(ex.index, d)] = routed[d]
-            else:
-                raise ExecError(f"unknown exchange kind {ex.kind}")
+        with obs_trace.span("exchange", fragment=frag.index) as exsp:
+            for ex in consumers:
+                if ex.kind == "gather_one":
+                    ex_out[(ex.index, "cn")] = per_dn[0]
+                elif ex.kind == "gather":
+                    ex_out[(ex.index, "cn")] = _concat_host(per_dn)
+                elif ex.kind == "broadcast":
+                    full = _concat_host(per_dn)
+                    ex_out[(ex.index, "cn")] = full
+                    for d in range(self.cluster.ndn):
+                        ex_out[(ex.index, d)] = full
+                elif ex.kind == "redistribute":
+                    routed = self._route(per_dn, ex.keys)
+                    for d in range(self.cluster.ndn):
+                        ex_out[(ex.index, d)] = routed[d]
+                else:
+                    raise ExecError(f"unknown exchange kind {ex.kind}")
+            if obs_trace.active():
+                exsp.set(rounds=len(consumers),
+                         bytes=sum(_hb_bytes(h) for h in per_dn))
 
     def _route(self, per_dn: list[HostBatch],
                keys: list[E.Expr]) -> list[HostBatch]:
@@ -465,7 +489,6 @@ class DistExecutor:
         remote — its exec_plan is the RPC surface)."""
         sources = {ex_idx: hb for (ex_idx, dest), hb in ex_out.items()
                    if dest == where}
-        import time as _time
         t0 = _time.perf_counter() if self.instrument else 0
         if where == "cn":
             from .executor import DeviceTableCache
@@ -473,20 +496,38 @@ class DistExecutor:
             ctx = ExecContext({}, self.snapshot_ts, self.txid,
                               DeviceTableCache(),
                               params=dict(self.params))
-            out = Executor(ctx).exec_node(plan)
+            with obs_trace.span("execute", fragment=frag.index,
+                                where="cn"):
+                out = Executor(ctx).exec_node(plan)
             if self.instrument:
                 self.stats[(frag.index, where)] = {
                     "ms": (_time.perf_counter() - t0) * 1e3,
                     "rows": out.count()}
             return out
         dn = self.cluster.datanodes[where]
-        out = dn.exec_plan(frag.plan, self.snapshot_ts, self.txid,
-                           self.params, sources)
+        # on a remote cluster this runs from dispatch worker threads,
+        # where span() is a no-op (the trace stack is thread-local) —
+        # per-fragment timing still lands in self.stats under instrument
+        with obs_trace.span("execute", fragment=frag.index,
+                            where=f"dn{where}"):
+            out = dn.exec_plan(frag.plan, self.snapshot_ts, self.txid,
+                               self.params, sources)
         if self.instrument:
             self.stats[(frag.index, where)] = {
                 "ms": (_time.perf_counter() - t0) * 1e3,
                 "rows": out.nrows}
         return out
+
+
+def _hb_bytes(hb) -> int:
+    """Approximate exchange wire size: numpy/jax array nbytes (shape
+    metadata only — never a device sync; TEXT object columns count
+    pointer width, a stable lower bound)."""
+    try:
+        return int(sum(int(a.nbytes) for a in hb.cols.values())
+                   + sum(int(a.nbytes) for a in hb.nulls.values()))
+    except (AttributeError, TypeError):
+        return 0
 
 
 def _bind_sources_host(node: P.PhysNode, sources: dict):

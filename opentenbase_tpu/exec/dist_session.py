@@ -19,6 +19,7 @@ import copy
 from ..catalog import types as T
 from ..catalog.schema import DistType, TableDef
 from ..catalog.types import TypeKind
+from ..obs import trace as obs_trace
 from ..parallel.cluster import Cluster
 from ..plan import physical as P
 from ..plan.distribute import (DistPlan, Distributor, Fragment,
@@ -30,7 +31,7 @@ from ..sql.ddl import sequence_def_from_ast, table_def_from_ast
 from ..sql.parser import parse_sql
 from .dist import DistExecutor
 from .executor import ExecContext, ExecError, Executor, materialize
-from .session import Result
+from .session import Result, _trace_explain_lines
 
 
 @dataclasses.dataclass
@@ -91,7 +92,9 @@ class ClusterSession:
         self.txn: Optional[ClusterTxn] = None
         self.txn_aborted = False
         # data plane of the last SELECT (surfaced in EXPLAIN ANALYZE and
-        # asserted by the mesh CI suite): 'mesh' | 'fqs' | 'host'
+        # asserted by the mesh CI suite): 'mesh' | 'fqs' | 'host'.
+        # last_tier/last_fallback/last_stage_ms are DEPRECATED aliases —
+        # last_query_stats() is the trace-backed replacement
         self.last_tier = ""
         self.last_fallback = ""
         # mesh staging wall time of the last SELECT (ms): ~0 when the
@@ -127,6 +130,7 @@ class ClusterSession:
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> list[Result]:
         out = []
+        self._cur_sql = sql.strip()
         audit = getattr(self.cluster, "audit", None) \
             if self.cluster.gucs.get("audit_enabled", "off") == "on" \
             else None
@@ -144,6 +148,21 @@ class ClusterSession:
 
     def query(self, sql: str) -> list[tuple]:
         return self.execute(sql)[-1].rows
+
+    def last_query_stats(self) -> dict:
+        """Trace-backed per-phase breakdown of the most recent
+        statement on this session (plan/stage/execute/exchange/
+        finalize ms, tier, rows, bytes, pool hit counts) — the unified
+        replacement for the last_tier/last_stage_ms attribute pairs.
+        Empty when OTB_TRACE=0."""
+        qt = getattr(self, "_last_trace", None)
+        return qt.summary() if qt is not None else {}
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the unified registry (also
+        served by the CN server's 'metrics' wire op)."""
+        from ..obs.metrics import REGISTRY
+        return REGISTRY.text()
 
     def execute_ast(self, s: A.Node) -> Result:
         """Execute ONE already-parsed statement — the shared core of
@@ -186,16 +205,20 @@ class ClusterSession:
         under a FRESH snapshot; explicit (REPEATABLE READ-like) txns
         surface PG's serialization error instead."""
         from ..storage.store import SerializationConflict
-        for _attempt in range(100):
-            try:
-                return self._exec_stmt(s)
-            except SerializationConflict as e:
-                if self.txn is not None:
-                    raise ExecError(str(e)) from None
-                continue
-        raise ExecError(
-            "could not serialize access due to concurrent update "
-            "(retries exhausted)")
+        sig = getattr(self, "_cur_sql", "") or type(s).__name__
+        with obs_trace.trace_query(sig[:200]) as qt:
+            if qt is not None:
+                self._last_trace = qt
+            for _attempt in range(100):
+                try:
+                    return self._exec_stmt(s)
+                except SerializationConflict as e:
+                    if self.txn is not None:
+                        raise ExecError(str(e)) from None
+                    continue
+            raise ExecError(
+                "could not serialize access due to concurrent update "
+                "(retries exhausted)")
 
     # ---- txn helpers ----
     def _begin_implicit(self) -> tuple[ClusterTxn, bool]:
@@ -857,10 +880,11 @@ class ClusterSession:
             not getattr(self, "_unmasked_reads", False) and \
             c0.gucs.get("bypass_datamask", "off") != "on"
         gen = (self._plan_gen(), masks)
-        return get_or_build(
-            c0, "_dp_cache", stmt, gen,
-            lambda: self._plan_distributed_uncached(stmt, txn, masks),
-            cacheable=lambda dp: dp.fqs_node is None)
+        with obs_trace.span("plan"):
+            return get_or_build(
+                c0, "_dp_cache", stmt, gen,
+                lambda: self._plan_distributed_uncached(stmt, txn, masks),
+                cacheable=lambda dp: dp.fqs_node is None)
 
     def _plan_distributed_uncached(self, stmt: A.SelectStmt,
                                    txn: "ClusterTxn" = None,
@@ -1013,12 +1037,26 @@ class ClusterSession:
             if queue is not None:
                 queue.release()
         names, rows = materialize(batch, dp.output_names)
+        # deprecated aliases (trace-backed last_query_stats() is the
+        # replacement surface; bench's mesh arm still reads these)
         self.last_tier = ex.tier
         self.last_stage_ms = ex.stage_ms
         self.last_fallback = ex.fallback_reason
         self.tier_counts[ex.tier] = self.tier_counts.get(ex.tier, 0) + 1
         if ex.tier == "host" and ex.fallback_reason:
             self.fallbacks.append(ex.fallback_reason)
+        qt = obs_trace.current_trace()
+        if qt is not None:
+            qt.tier = ex.tier or qt.tier
+            qt.rows = len(rows)
+            if ex.fallback_reason:
+                qt.root.attrs.setdefault("fallback", ex.fallback_reason)
+            for (fidx, where), st in sorted(
+                    ex.stats.items(),
+                    key=lambda kv: (kv[0][0], str(kv[0][1]))):
+                obs_trace.event("fragment", index=fidx,
+                                where=str(where), rows=st["rows"],
+                                ms=round(st["ms"], 3))
         return Result("SELECT", names=names, rows=rows,
                       rowcount=len(rows)), ex
 
@@ -1908,6 +1946,41 @@ class ClusterSession:
             t0 = time.perf_counter()
             _, ex, dp2 = self._exec_select(stmt.stmt, instrument=True)
             total = (time.perf_counter() - t0) * 1e3
+            # re-render the fragment plans with per-fragment actuals on
+            # the fragment ROOT nodes (DN fragments execute whole — the
+            # reference ships per-fragment instrumentation DN->CN, not
+            # per plan node; commands/explain_dist.c)
+            agg: dict = {}
+            for (fidx, where), st in ex.stats.items():
+                a = agg.setdefault(fidx, {"rows": 0, "ms": 0.0})
+                a["rows"] += int(st["rows"])
+                a["ms"] = max(a["ms"], float(st["ms"]))
+            roots = {id(f.plan): f.index for f in dp2.fragments}
+
+            def ann(nd):
+                st = agg.get(roots.get(id(nd)))
+                if st is None:
+                    return ""
+                return (f" (actual rows={st['rows']} "
+                        f"time={st['ms']:.2f} ms)")
+
+            lines2 = []
+            if dp2.via_gidx:
+                lines2.append(f"Global Index Route via {dp2.via_gidx} "
+                              f"-> dn{dp2.fqs_node}")
+            elif dp2.fqs_node is not None:
+                lines2.append(f"Fast Query Shipping -> dn{dp2.fqs_node}")
+            for frag in reversed(dp2.fragments):
+                loc = "CN" if frag.index == dp2.top_fragment \
+                    and dp2.fqs_node is None else \
+                    (f"dn{dp2.fqs_node}" if dp2.fqs_node is not None
+                     else "all DNs")
+                lines2.append(f"Fragment {frag.index} [{loc}]:")
+                lines2.append(P.explain(frag.plan, annotate=ann))
+            for ex_ in dp2.exchanges:
+                lines2.append(f"Exchange {ex_.index}: {ex_.kind} "
+                              f"(from fragment {ex_.source_fragment})")
+            text = "\n".join(lines2)
             # the data plane that actually carried the query + why the
             # device tier declined, if it did (reference: FN vs PQ
             # protocol choice surfaced per fragment)
@@ -1916,11 +1989,14 @@ class ClusterSession:
                 text += f" (mesh fallback: {ex.fallback_reason})"
             # per-fragment DN instrumentation shipped back to the CN
             # (reference: commands/explain_dist.c)
-            for (fidx, where), st in sorted(ex.stats.items(),
-                                            key=lambda kv: kv[0][0]):
-                loc = "CN" if where == "cn" else f"dn{where}"
+            for (fidx, where), st in sorted(
+                    ex.stats.items(),
+                    key=lambda kv: (kv[0][0], str(kv[0][1]))):
+                loc = "CN" if where == "cn" else \
+                    ("mesh" if where == "mesh" else f"dn{where}")
                 text += (f"\n  Fragment {fidx} @ {loc}: "
                          f"rows={st['rows']} time={st['ms']:.2f} ms")
+            text += _trace_explain_lines()
             text += f"\nExecution Time: {total:.2f} ms"
         return Result("EXPLAIN", names=["QUERY PLAN"],
                       rows=[(ln,) for ln in text.split("\n")], text=text)

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..catalog import types as T
 from ..catalog.types import SqlType, TypeKind
+from ..obs import trace as obs_trace
 from ..ops import kernels as K
 from ..plan import exprs as E
 from ..plan import physical as P
@@ -303,6 +304,9 @@ class Executor:
     #: True inside a jit trace (exec/fused.py): host-sync shortcuts like
     #: count()-sized output classes switch to static worst-case shapes
     _traced = False
+    #: False disables whole-fragment fusion (InstrumentedExecutor: the
+    #: EXPLAIN ANALYZE path runs eagerly so EVERY node gets actuals)
+    _fuse = True
 
     def __init__(self, ctx: ExecContext, frag_tag=None):
         self.ctx = ctx
@@ -402,7 +406,7 @@ class Executor:
 
     # ------------------------------------------------------------------
     def exec_node(self, node: P.PhysNode) -> DBatch:
-        if not self._traced:
+        if not self._traced and self._fuse:
             from .fused import try_fused
             out = try_fused(self, node)
             if out is not None:
@@ -1846,6 +1850,13 @@ def materialize(b: DBatch, names: Optional[list[str]] = None):
     """DBatch -> (column_names, list of python row tuples), decoded.
     The final-projection materialization point: only the REQUESTED
     columns leave the indirection layer."""
+    if not obs_trace.ENABLED:
+        return _materialize(b, names)
+    with obs_trace.span("finalize"):
+        return _materialize(b, names)
+
+
+def _materialize(b: DBatch, names: Optional[list[str]] = None):
     if names is None:
         names = b.names()
     b.ensure(names)
@@ -1883,7 +1894,65 @@ def materialize(b: DBatch, names: Optional[list[str]] = None):
             vals = [None if m else v for v, m in zip(vals, nullm)]
         out_cols.append(vals)
     rows = list(zip(*out_cols)) if out_cols else []
+    if obs_trace.active():
+        # nbytes is array metadata (never a device sync); the columns
+        # were just ensured, so this is the statement's true
+        # host-materialized footprint
+        nb = sum(int(getattr(b.cols[n], "nbytes", 0)) for n in names
+                 if n in b.cols)
+        obs_trace.annotate(rows=len(rows), bytes=int(nb))
     return names, rows
+
+
+class InstrumentedExecutor(Executor):
+    """EXPLAIN ANALYZE executor: wall time + output rows per plan node
+    (the reference's InstrumentOption timers, commands/explain.c).
+
+    Eager-only by construction — built solely on the session ANALYZE
+    path, never inside a trace — so the per-node ``count()`` syncs
+    below are a sanctioned instrumentation price, exactly like the
+    reference's per-node gettimeofday pairs.  Whole-fragment fusion is
+    disabled (``_fuse``): a compiled program's interior is opaque, and
+    ANALYZE promises actuals on EVERY node — the reference's
+    tuple-at-a-time instrumentation has the same "observed run is the
+    slow run" caveat."""
+
+    _fuse = False
+
+    def __init__(self, ctx, frag_tag=None):  # otblint: eager-only
+        super().__init__(ctx, frag_tag)
+        self.node_stats: dict = {}   # id(plan node) -> {"rows","ms","calls"}
+
+    def exec_node(self, node):  # otblint: eager-only
+        import time
+        t0 = time.perf_counter()
+        b = super().exec_node(node)
+        ms = (time.perf_counter() - t0) * 1e3
+        try:
+            rows = int(b.count())
+        except Exception:
+            rows = -1
+        st = self.node_stats.get(id(node))
+        if st is None:
+            self.node_stats[id(node)] = {"rows": rows, "ms": ms,
+                                         "calls": 1}
+        else:     # rescanned node (init plans / subplans): accumulate
+            st["rows"] = rows
+            st["ms"] += ms
+            st["calls"] += 1
+        return b
+
+
+def _metrics_samples():
+    """Registry collector: EXEC_STATS as labeled samples
+    (obs/metrics.py — one pane with plancache/bufferpool)."""
+    for tier, *vals in exec_stats_rows():
+        for f, v in zip(STAT_FIELDS, vals):
+            yield (f"otb_execstats_{f}", {"tier": tier}, v)
+
+
+from ..obs.metrics import REGISTRY as _METRICS  # noqa: E402
+_METRICS.register_collector("execstats", _metrics_samples)
 
 
 def _dense_bound(key_types: list[SqlType], key_dicts: list) -> Optional[int]:

@@ -55,6 +55,7 @@ from ..catalog.types import TypeKind
 from ..plan import exprs as E
 from ..plan import physical as P
 from ..plan.planner import rewrite as rewrite_expr
+from ..obs import trace as obs_trace
 from ..sql.fingerprint import struct_key
 from . import plancache
 
@@ -384,66 +385,73 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
         if fn is None:
             return None  # permanently fell back for this plan shape
         t0 = time.perf_counter()
-        try:
-            with stats_tier("fused"):
-                # trace-time executor counters attribute to the fused
-                # tier (re-executions don't re-trace)
-                cols, valid, nulls, join_req = fn(
-                    staged_arrs, jnp.int64(ctx.snapshot_ts),
-                    jnp.int64(ctx.txid), pvals, staged_ns)
-        except (jax.errors.TracerBoolConversionError,
-                jax.errors.ConcretizationTypeError,
-                jax.errors.TracerArrayConversionError):
-            if lits:
-                # a MASKED literal fed a host-sync (value-dependent
-                # program structure): remember and retry with literals
-                # baked
-                _mask_refused_add(struct_key(base_key))
+        # the execute span covers the program call AND the join-overflow
+        # device_get below — that device read is the tier's ONE legal
+        # sync boundary, so the span's wall time includes device work
+        with obs_trace.span("execute", tier="fused") \
+                if obs_trace.ENABLED else obs_trace.NULL_SPAN:
+            try:
+                with stats_tier("fused"):
+                    # trace-time executor counters attribute to the
+                    # fused tier (re-executions don't re-trace)
+                    cols, valid, nulls, join_req = fn(
+                        staged_arrs, jnp.int64(ctx.snapshot_ts),
+                        jnp.int64(ctx.txid), pvals, staged_ns)
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError):
+                if lits:
+                    # a MASKED literal fed a host-sync (value-dependent
+                    # program structure): remember and retry with
+                    # literals baked
+                    _mask_refused_add(struct_key(base_key))
+                    plancache.FUSED.pop(full_key)
+                    return _try_fused(executor, node, allow_mask=False)
+                # a host-sync slipped through the fusability screen:
+                # permanently fall back for this plan shape
+                plancache.FUSED.replace(full_key, (None, None))
+                return None
+            except Exception:
                 plancache.FUSED.pop(full_key)
-                return _try_fused(executor, node, allow_mask=False)
-            # a host-sync slipped through the fusability screen:
-            # permanently fall back for this plan shape
-            plancache.FUSED.replace(full_key, (None, None))
-            return None
-        except Exception:
-            plancache.FUSED.pop(full_key)
-            raise
-        plancache.FUSED.record_call(fn, t0)
+                raise
+            plancache.FUSED.record_call(fn, t0)
 
-        # join-size ladder: the program reports each traced join's
-        # required output rows; overflow grows exactly that join's
-        # factor and retraces (one host sync per program call — never
-        # per join).  Learned factors persist per fragment shape.
-        caps = meta.get("join_caps") or ()
-        if caps:
-            req = np.asarray(jax.device_get(join_req))
-            grew = False
-            for (jid, cap), r in zip(caps, req):
-                if r <= cap:
+            # join-size ladder: the program reports each traced join's
+            # required output rows; overflow grows exactly that join's
+            # factor and retraces (one host sync per program call —
+            # never per join).  Learned factors persist per shape.
+            caps = meta.get("join_caps") or ()
+            if caps:
+                req = np.asarray(jax.device_get(join_req))
+                grew = False
+                for (jid, cap), r in zip(caps, req):
+                    if r <= cap:
+                        continue
+                    # the program reports the EXACT required rows
+                    # (unlike the mesh tier's overflow bit): jump the
+                    # factor straight to the class that fits — ONE
+                    # retrace, not a doubling walk of compiles
+                    mult = 1
+                    while cap * mult < r:
+                        mult *= 2
+                    factors[jid] = factors.get(jid, 1) * mult
+                    if factors[jid] > 4096:
+                        return None  # ladder exhausted: eager fallback
+                    grew = True
+                if grew:
+                    _ladder_remember(lkey, factors)
+                    obs_trace.event("retrace", tier="fused",
+                                    factors=dict(factors))
                     continue
-                # the program reports the EXACT required rows (unlike
-                # the mesh tier's overflow bit): jump the factor
-                # straight to the class that fits — ONE retrace, not a
-                # doubling walk of compiles
-                mult = 1
-                while cap * mult < r:
-                    mult *= 2
-                factors[jid] = factors.get(jid, 1) * mult
-                if factors[jid] > 4096:
-                    return None  # ladder exhausted: eager fallback
-                grew = True
-            if grew:
+            if has_join:
                 _ladder_remember(lkey, factors)
-                continue
-        if has_join:
-            _ladder_remember(lkey, factors)
-        if EXPORT_HOOK is not None:
-            EXPORT_HOOK("fused", fn,
-                        (staged_arrs, jnp.int64(ctx.snapshot_ts),
-                         jnp.int64(ctx.txid), pvals, staged_ns))
-        from .executor import DBatch
-        return DBatch(dict(cols), valid, dict(meta["types"]),
-                      dict(meta["dicts"]), dict(nulls))
+            if EXPORT_HOOK is not None:
+                EXPORT_HOOK("fused", fn,
+                            (staged_arrs, jnp.int64(ctx.snapshot_ts),
+                             jnp.int64(ctx.txid), pvals, staged_ns))
+            from .executor import DBatch
+            return DBatch(dict(cols), valid, dict(meta["types"]),
+                          dict(meta["dicts"]), dict(nulls))
     return None  # overflow never converged: eager fallback
 
 

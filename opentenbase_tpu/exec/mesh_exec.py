@@ -55,6 +55,7 @@ except ImportError:  # pragma: no cover
 
 from ..catalog.schema import NUM_SHARDS
 from ..catalog.types import TypeKind
+from ..obs import trace as obs_trace
 from ..plan import exprs as E
 from ..plan import physical as P
 from ..plan.distribute import BatchSource, DistPlan, ExchangeRef
@@ -627,7 +628,11 @@ class MeshRunner:
                 raise MeshUnsupported("non-scalar init-plan param")
 
         t_stage = time.perf_counter()
-        staged = {t: self._stage_table(t) for t in tables}
+        staged = {}
+        for t in sorted(tables):
+            with obs_trace.span("stage", table=t, tier="mesh") as sp:
+                staged[t] = self._stage_table(t)
+                sp.set(padded=staged[t].padded)
         self.last_stage_ms = (time.perf_counter() - t_stage) * 1e3
         if not staged:
             raise MeshUnsupported("no mesh-stageable scans")
@@ -688,16 +693,24 @@ class MeshRunner:
                 if len(self._ladder) > 256:
                     self._ladder.pop(next(iter(self._ladder)))
                 result = {}
-                for gi, (cols, valid, nulls) in out.items():
-                    gmeta = meta[gi]
-                    result[gi] = DBatch(
-                        {n: jnp.asarray(np.asarray(a))
-                         for n, a in cols.items()},
-                        jnp.asarray(np.asarray(valid)),
-                        dict(gmeta["types"]), dict(gmeta["dicts"]),
-                        {n: jnp.asarray(np.asarray(a))
-                         for n, a in nulls.items()})
+                # the gather span times the device→host pull of every
+                # CN-bound exchange output — the mesh tier's terminal
+                # materialization boundary
+                with obs_trace.span("gather", tier="mesh"):
+                    for gi, (cols, valid, nulls) in out.items():
+                        gmeta = meta[gi]
+                        result[gi] = DBatch(
+                            {n: jnp.asarray(np.asarray(a))
+                             for n, a in cols.items()},
+                            jnp.asarray(np.asarray(valid)),
+                            dict(gmeta["types"]), dict(gmeta["dicts"]),
+                            {n: jnp.asarray(np.asarray(a))
+                             for n, a in nulls.items()})
                 return result, included
+            obs_trace.event("retrace", tier="mesh",
+                            joins=len(over_jids),
+                            exchanges=len(a2a_over),
+                            gathers=len(g_over))
         raise MeshUnsupported("size-class ladder exhausted")
 
     def warm(self, dp: DistPlan, snapshot_ts: int, params: dict) -> bool:
@@ -1018,23 +1031,28 @@ class MeshRunner:
                 flat_args.append(staged[t].arrs[n])
             flat_args.append(staged[t].nrows)
         t0 = time.perf_counter()
-        with stats_tier("mesh"):
-            # executor counters inside the trace attribute to the mesh
-            # tier (first call of a fresh program traces here)
-            outs, a2a_over_vec, join_over, g_over_vec = fn(*flat_args)
-        plancache.MESH.record_call(fn, t0)
-        if EXPORT_HOOK is not None:
-            EXPORT_HOOK("mesh", fn, tuple(flat_args))
-        over_vec = np.asarray(jax.device_get(join_over))
-        over_jids = sorted({jid for jid, ov in
-                            zip(meta.get("jid_order", ()), over_vec)
-                            if ov > 0})
-        av = np.asarray(jax.device_get(a2a_over_vec))
-        a2a_over = sorted({ei for ei, ov in
-                           zip(meta.get("ex_order", ()), av) if ov > 0})
-        gv = np.asarray(jax.device_get(g_over_vec))
-        g_over = sorted({gi for gi, ov in
-                         zip(meta.get("gi_order", ()), gv) if ov > 0})
+        # the execute span covers the program call and the overflow
+        # device_gets — the mesh tier's one legal sync point per call,
+        # so the span's wall time includes the device work
+        with obs_trace.span("execute", tier="mesh"):
+            with stats_tier("mesh"):
+                # executor counters inside the trace attribute to the
+                # mesh tier (first call of a fresh program traces here)
+                outs, a2a_over_vec, join_over, g_over_vec = fn(*flat_args)
+            plancache.MESH.record_call(fn, t0)
+            if EXPORT_HOOK is not None:
+                EXPORT_HOOK("mesh", fn, tuple(flat_args))
+            over_vec = np.asarray(jax.device_get(join_over))
+            over_jids = sorted({jid for jid, ov in
+                                zip(meta.get("jid_order", ()), over_vec)
+                                if ov > 0})
+            av = np.asarray(jax.device_get(a2a_over_vec))
+            a2a_over = sorted({ei for ei, ov in
+                               zip(meta.get("ex_order", ()), av)
+                               if ov > 0})
+            gv = np.asarray(jax.device_get(g_over_vec))
+            g_over = sorted({gi for gi, ov in
+                             zip(meta.get("gi_order", ()), gv) if ov > 0})
         return (dict(zip(gather_idx, outs)), meta, over_jids,
                 a2a_over, g_over)
 

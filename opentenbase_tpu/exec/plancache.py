@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Optional
 
+from ..obs import trace as obs_trace
 from ..sql.fingerprint import fingerprint
 
 _LOCK = threading.RLock()
@@ -106,10 +107,13 @@ class ProgramCache:
             ent = self._d.get(key)
             if ent is None:
                 self.misses += 1
-                return None
-            ent[0] = next(_SEQ)
-            self.hits += 1
-            return ent[1]
+            else:
+                ent[0] = next(_SEQ)
+                self.hits += 1
+        if obs_trace.ENABLED:
+            obs_trace.event("program", tier=self.name,
+                            hit=ent is not None)
+        return None if ent is None else ent[1]
 
     def peek(self, key):
         """Lookup that refreshes LRU order but defers hit/miss
@@ -177,8 +181,9 @@ class ProgramCache:
         after = _fn_live(fn)
         before = getattr(fn, "_otb_seen", 0)
         if after > before:
-            self.note_compile(after - before,
-                              (time.perf_counter() - t0) * 1e3)
+            dt = (time.perf_counter() - t0) * 1e3
+            self.note_compile(after - before, dt)
+            obs_trace.event("compile", tier=self.name, ms=round(dt, 3))
             try:
                 fn._otb_seen = after
             except Exception:
@@ -262,6 +267,24 @@ def stats() -> list:
         out.append((c.name, c.hits, c.misses, c.compiles,
                     round(c.compile_ms, 3), c.evictions, live))
     return out
+
+
+def _metrics_samples():
+    """Registry collector: the plancache counters as labeled samples
+    (obs/metrics.py — the unified pane behind otb_metrics and the
+    Prometheus exposition)."""
+    for tier, hits, misses, compiles, compile_ms, ev, live in stats():
+        lbl = {"tier": tier}
+        yield ("otb_plancache_hits", lbl, hits)
+        yield ("otb_plancache_misses", lbl, misses)
+        yield ("otb_plancache_compiles", lbl, compiles)
+        yield ("otb_plancache_compile_ms", lbl, compile_ms)
+        yield ("otb_plancache_evictions", lbl, ev)
+        yield ("otb_plancache_live", lbl, live)
+
+
+from ..obs.metrics import REGISTRY as _METRICS  # noqa: E402
+_METRICS.register_collector("plancache", _metrics_samples)
 
 
 # ---------------------------------------------------------------------------
@@ -397,9 +420,12 @@ def get_or_build(holder, attr: str, stmt, gen, build,
     if hit is not None and hit[0] == gen:
         with _LOCK:
             PLAN.hits += 1
+        if obs_trace.ENABLED:
+            obs_trace.event("plancache", hit=True)
         return hit[1]
     with _LOCK:
         PLAN.misses += 1
+    obs_trace.event("plancache", hit=False)
     obj = build()
     if obj is None or not cacheable(obj):
         return obj

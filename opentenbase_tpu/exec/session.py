@@ -21,6 +21,7 @@ import numpy as np
 from ..catalog.catalog import Catalog, CatalogError
 from ..catalog.schema import DistType, NodeDef, TableDef
 from ..catalog.types import TypeKind
+from ..obs import trace as obs_trace
 from ..parallel.locator import Locator
 from ..plan import physical as P
 from ..plan.planner import PlannedStmt, Planner
@@ -352,6 +353,31 @@ class LocalNode:
             self.wal.append(rec, sync=sync)
 
 
+def _trace_explain_lines() -> str:
+    """EXPLAIN ANALYZE footer from the open query trace: staging,
+    program-cache, buffer-pool and exchange activity of the inner run
+    (empty when OTB_TRACE=0 — the per-node actuals don't need it)."""
+    qt = obs_trace.current_trace()
+    if qt is None:
+        return ""
+    lines = [
+        f"Stage: {qt.phase_ms('stage'):.2f} ms "
+        f"({int(qt.sum_attr('upload', 'bytes'))} bytes uploaded)",
+        f"Programs: hits={qt.count_events('program', hit=True)} "
+        f"compiles={qt.count_events('compile')} "
+        f"compile_ms={qt.sum_attr('compile', 'ms'):.1f}",
+        f"Buffer Pool: hits={qt.count_events('pool', hit=True)} "
+        f"misses={qt.count_events('pool', hit=False)}",
+    ]
+    rounds = int(qt.sum_attr("exchange", "rounds"))
+    if rounds:
+        lines.append(
+            f"Exchanges: rounds={rounds} "
+            f"bytes={int(qt.sum_attr('exchange', 'bytes'))} "
+            f"time={qt.phase_ms('exchange'):.2f} ms")
+    return "".join("\n" + ln for ln in lines)
+
+
 class Session:
     def __init__(self, node: LocalNode):
         self.node = node
@@ -361,6 +387,7 @@ class Session:
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> list[Result]:
         out = []
+        self._cur_sql = sql.strip()
         for s in parse_sql(sql):
             if self.txn is not None and self.txn_aborted \
                     and not isinstance(s, A.TxnStmt) \
@@ -392,21 +419,38 @@ class Session:
         serialization error (REPEATABLE READ semantics, PG's 'could
         not serialize access due to concurrent update')."""
         from ..storage.store import SerializationConflict
-        for _attempt in range(100):
-            try:
-                return self._exec_stmt(s)
-            except SerializationConflict as e:
-                if self.txn is not None:
-                    raise ExecError(str(e)) from None
-                continue
-        raise ExecError(
-            "could not serialize access due to concurrent update "
-            "(retries exhausted)")
+        sig = getattr(self, "_cur_sql", "") or type(s).__name__
+        with obs_trace.trace_query(sig[:200]) as qt:
+            if qt is not None:
+                self._last_trace = qt
+            for _attempt in range(100):
+                try:
+                    return self._exec_stmt(s)
+                except SerializationConflict as e:
+                    if self.txn is not None:
+                        raise ExecError(str(e)) from None
+                    continue
+            raise ExecError(
+                "could not serialize access due to concurrent update "
+                "(retries exhausted)")
 
     def query(self, sql: str) -> list[tuple]:
         """Convenience: single SELECT -> rows."""
         res = self.execute(sql)
         return res[-1].rows
+
+    def last_query_stats(self) -> dict:
+        """Trace-backed per-phase breakdown of the most recent
+        statement on this session (plan/stage/execute/finalize ms,
+        rows, bytes, pool hit counts).  Empty when OTB_TRACE=0."""
+        qt = getattr(self, "_last_trace", None)
+        return qt.summary() if qt is not None else {}
+
+    @property
+    def last_stage_ms(self) -> float:
+        # deprecated alias: staging time now comes from the trace
+        # (kept for callers that predate last_query_stats())
+        return float(self.last_query_stats().get("stage_ms", 0.0))
 
     # ------------------------------------------------------------------
     def _begin_implicit(self) -> tuple[TxnState, bool]:
@@ -981,15 +1025,24 @@ class Session:
                         apply_masks=masks).bind_select(stmt)
             return Planner(node.catalog).plan(bq)
 
-        return get_or_build(node, "_plan_cache", stmt,
-                            (gen, masks), build)
+        with obs_trace.span("plan") \
+                if obs_trace.ENABLED else obs_trace.NULL_SPAN:
+            return get_or_build(node, "_plan_cache", stmt,
+                                (gen, masks), build)
 
-    def _exec_select(self, stmt: A.SelectStmt) -> Result:
+    def _exec_select(self, stmt: A.SelectStmt,
+                     instrument: bool = False):
+        """Plain SELECT.  With ``instrument`` (the EXPLAIN ANALYZE
+        path) the eager tier runs under an InstrumentedExecutor and
+        the return value is ``(Result, executor_or_None, planned)`` —
+        per-node actuals ride ``executor.node_stats``."""
         if stmt.for_update:
-            return self._exec_select_for_update(stmt)
+            res = self._exec_select_for_update(stmt)
+            return (res, None, None) if instrument else res
         planned = self._plan_select(stmt)
         t, implicit = self._begin_implicit()
         batch = None
+        exe = None
         raw_budget = self.node.gucs.get("work_mem_rows", "")
         if raw_budget.isdigit() and int(raw_budget) > 0:
             # beyond-HBM tier: multi-pass partitioned execution when a
@@ -1017,9 +1070,23 @@ class Session:
         if batch is None:
             ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,
                               self.node.cache)
-            batch = Executor(ctx).run(planned)
+            with obs_trace.span("execute", tier="single") \
+                    if obs_trace.ENABLED else obs_trace.NULL_SPAN:
+                if instrument:
+                    from .executor import InstrumentedExecutor
+                    exe = InstrumentedExecutor(ctx)
+                    batch = exe.run(planned)
+                else:
+                    batch = Executor(ctx).run(planned)
         names, rows = materialize(batch, planned.output_names)
-        return Result("SELECT", names=names, rows=rows, rowcount=len(rows))
+        qt = obs_trace.current_trace() if obs_trace.ENABLED else None
+        if qt is not None:
+            qt.rows = len(rows)
+        res = Result("SELECT", names=names, rows=rows,
+                     rowcount=len(rows))
+        if instrument:
+            return res, exe, planned
+        return res
 
     # ---- DML ----
     def _exec_insert(self, stmt: A.InsertStmt) -> Result:
@@ -1518,7 +1585,21 @@ class Session:
         text = P.explain(planned.plan)
         if stmt.analyze:
             t0 = time.perf_counter()
-            self._exec_select(stmt.stmt)
-            text += f"\nExecution Time: {(time.perf_counter()-t0)*1e3:.2f} ms"
+            _res, exe, planned2 = self._exec_select(stmt.stmt,
+                                                    instrument=True)
+            total = (time.perf_counter() - t0) * 1e3
+            if exe is not None:
+                stats = exe.node_stats
+
+                def ann(nd):
+                    st = stats.get(id(nd))
+                    if st is None:
+                        return ""
+                    return (f" (actual rows={st['rows']} "
+                            f"time={st['ms']:.2f} ms)")
+
+                text = P.explain(planned2.plan, annotate=ann)
+            text += _trace_explain_lines()
+            text += f"\nExecution Time: {total:.2f} ms"
         return Result("EXPLAIN", names=["QUERY PLAN"],
                       rows=[(line,) for line in text.split("\n")], text=text)

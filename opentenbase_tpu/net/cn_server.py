@@ -163,6 +163,16 @@ class CnServer:
                 msg = recv_msg(sock)
                 if msg is None or msg.get("op") == "terminate":
                     return
+                if msg.get("op") == "metrics":
+                    # Prometheus text exposition over the wire (the
+                    # reference exposes pg_stat_* via SQL only; a
+                    # scrape endpoint is table stakes here)
+                    try:
+                        send_msg(sock, {"ok": sess.metrics_text()})
+                    except Exception as e:
+                        send_msg(sock, {"error":
+                                        f"{type(e).__name__}: {e}"})
+                    continue
                 if msg.get("op") != "query":
                     send_msg(sock, {"error":
                                     f"unknown op {msg.get('op')!r}"})
@@ -222,6 +232,16 @@ class CnClient:
 
     def query(self, sql: str) -> list[tuple]:
         return [tuple(r) for r in self.execute(sql)[-1]["rows"]]
+
+    def metrics(self) -> str:
+        """Fetch the server's Prometheus text exposition."""
+        send_msg(self._sock, {"op": "metrics"})
+        resp = recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("server closed connection")
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["ok"]
 
     def cancel(self):
         """Cancel the in-flight statement from ANOTHER connection (the

@@ -1,0 +1,306 @@
+"""Query tracing — lightweight span trees over the read path.
+
+Reference analog: the per-node InstrumentOption timers that feed
+EXPLAIN ANALYZE (instrument.c) generalized to the whole CN pipeline:
+parse+plan, plancache hit/compile, bufferpool staging, fused/mesh
+program dispatch, exchanges, host gather/finalize.
+
+Design constraints (TPU-first):
+- Device phases are timed ONLY at the existing materialization /
+  sync boundaries (program-call overflow ``device_get``s, ``DBatch``
+  materialization, gather conversion) — instrumentation never adds a
+  host sync, and never appears inside a traced closure (enforced by
+  the otblint ``obs-purity`` pass).
+- ~zero overhead when disabled (``OTB_TRACE=0``): ``span()`` returns a
+  shared no-op singleton, no Span objects are allocated, no locks are
+  taken on the statement path.
+- Thread-safe by construction: the active span stack is thread-local
+  (each CN server session is a thread); only trace FINISH touches the
+  shared ring, under ``_LOCK``.
+
+Env vars: ``OTB_TRACE`` (default on), ``OTB_SLOW_MS`` (slow-query log
+threshold, 0 = off), ``OTB_TRACE_RING`` (recent-trace ring size).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+ENABLED = os.environ.get("OTB_TRACE", "1").strip().lower() \
+    not in ("0", "off", "false")
+SLOW_MS = float(os.environ.get("OTB_SLOW_MS", "0") or "0")
+SLOW_STREAM = sys.stderr        # swappable in tests / by embedders
+RING_CAP = int(os.environ.get("OTB_TRACE_RING", "64") or "64")
+
+_TLS = threading.local()        # .stack: list[Span], .trace: QueryTrace
+_LOCK = threading.Lock()
+_RING: deque = deque(maxlen=RING_CAP)   # guarded_by: _LOCK
+_LAST: list = [None]                    # guarded_by: _LOCK
+_IDS = itertools.count(1)
+
+# canonical phase names summarized per query (otb_stat_query columns)
+PHASES = ("plan", "stage", "execute", "exchange", "finalize")
+
+
+class Span:
+    """One timed region.  Context-manager protocol only: creation via
+    ``span()`` attaches nothing — ``__enter__`` pushes onto the
+    thread's stack, ``__exit__`` pops and stamps ``ms``."""
+
+    __slots__ = ("name", "attrs", "ms", "children", "_t0")
+
+    def __init__(self, name: str, attrs: Optional[dict] = None):
+        self.name = name
+        self.attrs = attrs if attrs else {}
+        self.ms = 0.0
+        self.children: list = []
+        self._t0 = 0.0
+
+    def set(self, **kw) -> "Span":
+        self.attrs.update(kw)
+        return self
+
+    def __enter__(self) -> "Span":
+        st = _TLS.stack
+        st[-1].children.append(self)
+        st.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        self.ms = (time.perf_counter() - self._t0) * 1e3
+        _TLS.stack.pop()
+        return False
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "ms": round(self.ms, 4)}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class _NullSpan:
+    """The disabled-path span: one shared instance, every operation a
+    no-op — the zero-allocation fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        return False
+
+    def set(self, **kw):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+def _stack() -> Optional[list]:
+    return getattr(_TLS, "stack", None)
+
+
+def active() -> bool:
+    """True when a query trace is open on THIS thread."""
+    return bool(getattr(_TLS, "stack", None))
+
+
+def span(name: str, **attrs):
+    """Open a child span under the current one.  Use as a context
+    manager.  No active trace (or tracing disabled) → the shared
+    no-op singleton."""
+    st = getattr(_TLS, "stack", None)
+    if not st:
+        return NULL_SPAN
+    return Span(name, attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Record a zero-duration child (cache hit/miss, retrace, upload)."""
+    st = getattr(_TLS, "stack", None)
+    if st:
+        st[-1].children.append(Span(name, attrs))
+
+
+def annotate(**kw) -> None:
+    """Attach attributes to the innermost open span, if any."""
+    st = getattr(_TLS, "stack", None)
+    if st:
+        st[-1].attrs.update(kw)
+
+
+class QueryTrace:
+    """One statement's span tree plus identity/summary fields."""
+
+    __slots__ = ("qid", "signature", "root", "tier", "rows", "started")
+
+    def __init__(self, signature: str):
+        self.qid = next(_IDS)
+        self.signature = signature
+        self.root = Span("query")
+        self.tier = ""
+        self.rows = 0
+        self.started = time.time()
+
+    @property
+    def total_ms(self) -> float:
+        return self.root.ms
+
+    def phase_ms(self, name: str) -> float:
+        """Sum of ms over spans named `name`, counting only the
+        outermost of any nested same-name runs."""
+        total = 0.0
+        work = [self.root]
+        while work:
+            s = work.pop()
+            for c in s.children:
+                if c.name == name:
+                    total += c.ms
+                else:
+                    work.append(c)
+        return total
+
+    def sum_attr(self, span_name: str, key: str) -> float:
+        total = 0.0
+        work = [self.root]
+        while work:
+            s = work.pop()
+            if s.name == span_name:
+                total += float(s.attrs.get(key, 0) or 0)
+            work.extend(s.children)
+        return total
+
+    def count_events(self, span_name: str, **match) -> int:
+        n = 0
+        work = [self.root]
+        while work:
+            s = work.pop()
+            if s.name == span_name and all(
+                    s.attrs.get(k) == v for k, v in match.items()):
+                n += 1
+            work.extend(s.children)
+        return n
+
+    def summary(self) -> dict:
+        d = {
+            "qid": self.qid,
+            "signature": self.signature,
+            "tier": self.tier or "single",
+            "total_ms": self.total_ms,
+            "rows": self.rows,
+            "bytes_staged": int(self.sum_attr("upload", "bytes")),
+            "bytes_materialized": int(
+                self.sum_attr("finalize", "bytes")),
+            "pool_hits": self.count_events("pool", hit=True),
+            "pool_misses": self.count_events("pool", hit=False),
+        }
+        for ph in PHASES:
+            d[f"{ph}_ms"] = self.phase_ms(ph)
+        return d
+
+    def to_dict(self) -> dict:
+        d = self.summary()
+        d["spans"] = self.root.to_dict()
+        return d
+
+
+class _TraceCtx:
+    """``trace_query`` context: opens a fresh QueryTrace unless one is
+    already active on this thread (nested statements — triggers, the
+    EXPLAIN ANALYZE inner run — ride the enclosing trace)."""
+
+    __slots__ = ("signature", "owned")
+
+    def __init__(self, signature: str):
+        self.signature = signature
+        self.owned = None
+
+    def __enter__(self) -> Optional[QueryTrace]:
+        if not ENABLED:
+            return None
+        st = _stack()
+        if st is None:
+            st = _TLS.stack = []
+        if st:                       # nested: join the active trace
+            return getattr(_TLS, "trace", None)
+        qt = QueryTrace(self.signature)
+        self.owned = qt
+        _TLS.trace = qt
+        st.append(qt.root)
+        qt.root._t0 = time.perf_counter()
+        return qt
+
+    def __exit__(self, et, ev, tb):
+        qt = self.owned
+        if qt is not None:
+            qt.root.ms = (time.perf_counter() - qt.root._t0) * 1e3
+            _TLS.stack.pop()
+            _TLS.trace = None
+            _finish(qt, failed=et is not None)
+        return False
+
+
+class _NullTraceCtx:
+    """Disabled-path trace context: one shared instance, yields None."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, et, ev, tb):
+        return False
+
+
+_NULL_CTX = _NullTraceCtx()
+
+
+def trace_query(signature: str = ""):
+    if not ENABLED:
+        return _NULL_CTX
+    return _TraceCtx(signature)
+
+
+def current_trace() -> Optional[QueryTrace]:
+    """The trace open on this thread, else None."""
+    return getattr(_TLS, "trace", None) if active() else None
+
+
+def last_trace() -> Optional[QueryTrace]:
+    """The most recently FINISHED trace (any thread)."""
+    with _LOCK:
+        return _LAST[0]
+
+
+def recent() -> list:
+    """Finished traces, oldest → newest (the otb_stat_query backing)."""
+    with _LOCK:
+        return list(_RING)
+
+
+def _finish(qt: QueryTrace, failed: bool = False) -> None:
+    with _LOCK:
+        _RING.append(qt)
+        _LAST[0] = qt
+    from . import metrics
+    metrics.observe_query(qt)
+    if SLOW_MS > 0 and qt.total_ms >= SLOW_MS and not failed:
+        metrics.REGISTRY.counter("otb_slow_queries_total").inc()
+        rec = qt.summary()
+        rec["event"] = "slow_query"
+        try:
+            SLOW_STREAM.write(json.dumps(rec, sort_keys=True) + "\n")
+            SLOW_STREAM.flush()
+        except (OSError, ValueError):
+            pass                     # a closed log stream never aborts a query

@@ -100,6 +100,29 @@ STAT_TABLES = {
         ColumnDef("bytes_materialized", T.INT64),
         ColumnDef("host_syncs", T.INT64),
         ColumnDef("fused_join_hits", T.INT64)],
+    # recent-query trace ring (obs/trace.py): one row per finished
+    # top-level statement, newest last — per-phase wall-time breakdown
+    # plus staging/materialization byte counts and buffer-pool hit
+    # counts (reference: pg_stat_activity + pg_stat_statements timing
+    # columns, backed here by the span tree instead of bespoke timers)
+    "otb_stat_query": [
+        ColumnDef("qid", T.INT64), ColumnDef("signature", T.TEXT),
+        ColumnDef("tier", T.TEXT), ColumnDef("total_ms", T.FLOAT64),
+        ColumnDef("plan_ms", T.FLOAT64), ColumnDef("stage_ms", T.FLOAT64),
+        ColumnDef("execute_ms", T.FLOAT64),
+        ColumnDef("exchange_ms", T.FLOAT64),
+        ColumnDef("finalize_ms", T.FLOAT64),
+        ColumnDef("rows", T.INT64),
+        ColumnDef("bytes_staged", T.INT64),
+        ColumnDef("bytes_materialized", T.INT64),
+        ColumnDef("pool_hits", T.INT64), ColumnDef("pool_misses", T.INT64)],
+    # the unified metrics registry (obs/metrics.py): every native
+    # counter/gauge/histogram sample plus every registered subsystem
+    # collector, flattened to (name, labels, kind, value) — the SQL
+    # twin of the Prometheus text exposition
+    "otb_metrics": [
+        ColumnDef("name", T.TEXT), ColumnDef("labels", T.TEXT),
+        ColumnDef("kind", T.TEXT), ColumnDef("value", T.FLOAT64)],
 }
 
 
@@ -178,6 +201,20 @@ def refresh(cluster, names: list[str]):
         elif name == "otb_execstats":
             from ..exec.executor import exec_stats_rows
             rows = list(exec_stats_rows())
+        elif name == "otb_stat_query":
+            from ..obs import trace as obs_trace
+            for qt in obs_trace.recent():
+                s = qt.summary()
+                rows.append((
+                    s["qid"], s["signature"], s["tier"],
+                    s["total_ms"], s["plan_ms"], s["stage_ms"],
+                    s["execute_ms"], s["exchange_ms"], s["finalize_ms"],
+                    s["rows"], s["bytes_staged"],
+                    s["bytes_materialized"], s["pool_hits"],
+                    s["pool_misses"]))
+        elif name == "otb_metrics":
+            from ..obs.metrics import REGISTRY
+            rows = list(REGISTRY.rows())
         elif name == "otb_resgroups":
             usage = getattr(cluster, "resgroup_usage", {})
             for gname, g in cluster.catalog.resource_groups.items():

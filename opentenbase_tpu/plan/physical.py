@@ -254,12 +254,17 @@ class Result(PhysNode):
     outputs: list[tuple[str, E.Expr]] = dataclasses.field(default_factory=list)
 
 
-def explain(node: PhysNode, indent: int = 0, out: Optional[list] = None) -> str:
+def explain(node: PhysNode, indent: int = 0, out: Optional[list] = None,
+            annotate=None) -> str:
+    """Render a plan tree.  ``annotate(node) -> str`` (optional)
+    appends per-node text — EXPLAIN ANALYZE actual rows/timings."""
     top = out is None
     if out is None:
         out = []
-    out.append("  " * indent + ("-> " if indent else "") + node.title())
+    extra = annotate(node) if annotate is not None else ""
+    out.append("  " * indent + ("-> " if indent else "")
+               + node.title() + (extra or ""))
     for c in node.children():
         if c is not None:
-            explain(c, indent + 1, out)
+            explain(c, indent + 1, out, annotate)
     return "\n".join(out) if top else ""

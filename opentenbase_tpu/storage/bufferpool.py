@@ -52,6 +52,8 @@ import weakref
 
 import numpy as np
 
+from ..obs import trace as obs_trace
+
 _LOCK = threading.RLock()
 _SEQ = itertools.count()
 
@@ -166,6 +168,9 @@ class DeviceBufferPool:
         with _LOCK:
             self.uploaded_bytes += int(nbytes)
             self.tail_rows += int(tail_rows)
+        if nbytes:
+            obs_trace.event("upload", bytes=int(nbytes),
+                            tail_rows=int(tail_rows))
 
     def stats_rows(self) -> list[tuple]:
         """(table, hits, misses, bytes_live, evictions, invalidations)
@@ -275,26 +280,35 @@ class DeviceBufferPool:
             if e is not None and e.version == ver \
                     and want <= set(e.arrs):
                 self._tstats(table)[0] += 1
+                if obs_trace.ENABLED:
+                    obs_trace.event("pool", table=table, hit=True)
                 return e.arrs, e.n
+        obs_trace.event("pool", table=table, hit=False)
         # stage outside the lock (defensive: racing stagers both build,
         # last put wins — same policy as the compiled-program caches)
-        if e is not None and e.version == ver:
-            # same version, new columns: keep the resident buffers,
-            # stage only what is missing
-            padded = int(next(iter(e.arrs.values())).shape[0])
-            add, up = self._stage_columns(store, want - set(e.arrs),
-                                          e.n, padded)
-            arrs = dict(e.arrs)
-            arrs.update(add)
-            n, tail = e.n, 0
-        elif e is not None and store.appended_only_since(e.version, e.n):
-            arrs, n, up, tail = self._tail_stage(store, e, want)
-        else:
-            from .batch import size_class
-            n = store.row_count()
-            padded = size_class(max(n, 1))
-            arrs, up = self._stage_columns(store, want, n, padded)
-            tail = 0
+        stage_span = obs_trace.span("stage", table=table, tier="single")
+        with stage_span:
+            if e is not None and e.version == ver:
+                # same version, new columns: keep the resident buffers,
+                # stage only what is missing
+                padded = int(next(iter(e.arrs.values())).shape[0])
+                add, up = self._stage_columns(store, want - set(e.arrs),
+                                              e.n, padded)
+                arrs = dict(e.arrs)
+                arrs.update(add)
+                n, tail = e.n, 0
+            elif e is not None \
+                    and store.appended_only_since(e.version, e.n):
+                arrs, n, up, tail = self._tail_stage(store, e, want)
+            else:
+                from .batch import size_class
+                n = store.row_count()
+                padded = size_class(max(n, 1))
+                arrs, up = self._stage_columns(store, want, n, padded)
+                tail = 0
+        stage_span.set(rows=n, tail_rows=tail)
+        if up:
+            obs_trace.event("upload", table=table, bytes=int(up))
         nbytes = sum(int(a.nbytes) for a in arrs.values())
         with _LOCK:
             st = self._tstats(table)
@@ -387,10 +401,12 @@ class DeviceBufferPool:
             if ent is not None and ent[1].vkey == vkey:
                 ent[0] = next(_SEQ)
                 st[0] += 1
+                obs_trace.event("pool", table=table, hit=True)
                 return ent[1]
             st[1] += 1
             if ent is not None:
                 st[3] += 1
+            obs_trace.event("pool", table=table, hit=False)
             return None
 
     def mesh_peek(self, runner, table: str):
@@ -455,3 +471,13 @@ class DeviceBufferPool:
 #: process shares one budget (entries are keyed by store identity, so
 #: nodes never alias each other's tables)
 POOL = DeviceBufferPool()
+
+
+def _metrics_samples():
+    """Registry collector: pool totals as samples (obs/metrics.py)."""
+    for k, v in POOL.totals().items():
+        yield (f"otb_buffercache_{k}", {}, v)
+
+
+from ..obs.metrics import REGISTRY as _METRICS  # noqa: E402
+_METRICS.register_collector("bufferpool", _metrics_samples)

@@ -213,6 +213,65 @@ class TestLockDisciplinePass:
         assert got == []
 
 
+class TestObsPurityPass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/obs/__init__.py": "",
+        "fixpkg/obs/trace.py": """\
+            def span(name, **attrs):
+                return None
+        """,
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/hot.py": """\
+            import jax
+            from ..obs import trace as obs_trace
+
+            def run(x):
+                obs_trace.span("execute")   # span under a trace
+                return jax.numpy.cumsum(x)
+
+            def build():
+                return jax.jit(run)
+        """,
+        "fixpkg/exec/cold.py": """\
+            import jax
+            from ..obs import trace as obs_trace
+
+            def run(x):
+                return jax.numpy.cumsum(x)
+
+            def host(x):
+                # instrumentation at the host boundary is the point
+                with obs_trace.span("execute"):
+                    return run(x)
+
+            def build():
+                return jax.jit(run)
+        """,
+    }
+
+    def test_violation_and_clean_twin(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        got = sorted(_scan(tmp_path, "obs-purity"))
+        # the call site is flagged AND the obs function it pulled into
+        # the closure; cold.py's host-boundary usage stays silent
+        assert got == [("obs-purity", "fixpkg/exec/hot.py"),
+                       ("obs-purity", "fixpkg/obs/trace.py")], got
+
+    def test_eager_region_exempt(self, tmp_path):
+        # the engine's sanctioned traced/eager split: obs calls on the
+        # eager side of an `if not _traced:` guard are host-side
+        files = dict(self.FILES)
+        files["fixpkg/exec/hot.py"] = files["fixpkg/exec/hot.py"].replace(
+            '                obs_trace.span("execute")   '
+            '# span under a trace',
+            '                _traced = False\n'
+            '                if not _traced:\n'
+            '                    obs_trace.span("execute")')
+        _write_pkg(tmp_path, files)
+        assert _scan(tmp_path, "obs-purity") == []
+
+
 # ---------------------------------------------------------------------------
 # HLO text scan (no jax export involved)
 # ---------------------------------------------------------------------------

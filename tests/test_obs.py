@@ -1,0 +1,373 @@
+"""Observability subsystem proof (obs/): span trees, the unified
+metrics registry, trace-backed stat views, EXPLAIN ANALYZE actuals on
+both execution tiers, and the warm-query staging story (stage ~ 0 with
+a 100% buffer-pool hit rate once tables are device-resident).
+
+Reference analog: the instrument.c / EXPLAIN ANALYZE plumbing plus the
+pg_stat_* view family, exercised the way pg_regress drives them.
+"""
+
+import io
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opentenbase_tpu.exec.dist_session import ClusterSession
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.obs import metrics as obs_metrics
+from opentenbase_tpu.obs import trace as obs_trace
+from opentenbase_tpu.parallel.cluster import Cluster
+from opentenbase_tpu.tpch import datagen
+from opentenbase_tpu.tpch.queries import Q
+from opentenbase_tpu.tpch.schema import SCHEMA
+
+
+# ---------------------------------------------------------------------------
+# span primitives (no engine involved)
+# ---------------------------------------------------------------------------
+
+class TestSpans:
+    def test_disabled_fast_path_is_shared_singleton(self):
+        # no active trace on this thread: span() must return the one
+        # shared no-op instance — zero allocation on the hot path
+        assert obs_trace.span("execute") is obs_trace.NULL_SPAN
+        assert obs_trace.span("stage", table="t") is obs_trace.NULL_SPAN
+        obs_trace.event("pool", hit=True)       # no-ops, no error
+        obs_trace.annotate(rows=3)
+        with obs_trace.span("x") as sp:
+            assert sp is obs_trace.NULL_SPAN
+            assert sp.set(rows=1) is sp
+
+    def test_trace_disabled_globally(self, monkeypatch):
+        monkeypatch.setattr(obs_trace, "ENABLED", False)
+        with obs_trace.trace_query("select 1") as qt:
+            assert qt is None
+            assert obs_trace.span("execute") is obs_trace.NULL_SPAN
+            assert obs_trace.current_trace() is None
+
+    def test_nesting_and_phase_semantics(self):
+        with obs_trace.trace_query("q") as qt:
+            with obs_trace.span("execute", tier="single"):
+                with obs_trace.span("execute", tier="fused"):
+                    time.sleep(0.002)
+                obs_trace.event("pool", hit=True)
+                obs_trace.event("pool", hit=False)
+            with obs_trace.span("finalize") as sp:
+                sp.set(bytes=128, rows=4)
+        root = qt.root
+        assert [c.name for c in root.children] == ["execute", "finalize"]
+        inner = root.children[0].children
+        assert inner[0].name == "execute"
+        assert {c.name for c in inner[1:]} == {"pool"}
+        # nested same-name spans count ONCE (the outermost)
+        assert qt.phase_ms("execute") == pytest.approx(
+            root.children[0].ms)
+        assert qt.phase_ms("execute") >= inner[0].ms
+        assert qt.sum_attr("finalize", "bytes") == 128
+        assert qt.count_events("pool", hit=True) == 1
+        assert qt.count_events("pool") == 2
+        s = qt.summary()
+        assert s["pool_hits"] == 1 and s["pool_misses"] == 1
+        assert s["total_ms"] >= s["execute_ms"] > 0
+        # after exit: the thread stack is gone again
+        assert not obs_trace.active()
+        assert obs_trace.span("x") is obs_trace.NULL_SPAN
+
+    def test_nested_statement_joins_outer_trace(self):
+        with obs_trace.trace_query("outer") as qt1:
+            with obs_trace.trace_query("inner") as qt2:
+                assert qt2 is qt1
+                obs_trace.event("program", hit=True)
+        # only the OWNING context finished the trace (one ring entry)
+        assert obs_trace.last_trace() is qt1
+        assert qt1.count_events("program", hit=True) == 1
+
+    def test_thread_isolation(self):
+        out = {}
+
+        def worker(name):
+            with obs_trace.trace_query(name) as qt:
+                with obs_trace.span("execute", who=name):
+                    time.sleep(0.001)
+                out[name] = qt
+
+        ts = [threading.Thread(target=worker, args=(f"t{i}",))
+              for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len({id(q) for q in out.values()}) == 4
+        for name, qt in out.items():
+            assert qt.signature == name
+            assert [c.attrs.get("who") for c in qt.root.children] == [name]
+        recents = {q.signature for q in obs_trace.recent()}
+        assert {"t0", "t1", "t2", "t3"} <= recents
+
+    def test_slow_query_log(self, monkeypatch):
+        buf = io.StringIO()
+        monkeypatch.setattr(obs_trace, "SLOW_MS", 0.0001)
+        monkeypatch.setattr(obs_trace, "SLOW_STREAM", buf)
+        with obs_trace.trace_query("select pg_sleep") as qt:
+            with obs_trace.span("execute"):
+                time.sleep(0.002)
+            qt.rows = 7
+        lines = [ln for ln in buf.getvalue().splitlines() if ln]
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec["event"] == "slow_query"
+        assert rec["signature"] == "select pg_sleep"
+        assert rec["rows"] == 7 and rec["total_ms"] > 0
+
+    def test_ring_is_bounded(self):
+        for i in range(obs_trace.RING_CAP + 5):
+            with obs_trace.trace_query(f"r{i}"):
+                pass
+        assert len(obs_trace.recent()) == obs_trace.RING_CAP
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_counter_gauge(self):
+        r = obs_metrics.Registry()
+        c = r.counter("otb_test_total", tier="x")
+        c.inc()
+        c.inc(2)
+        assert c.value == 3
+        assert r.counter("otb_test_total", tier="x") is c
+        g = r.gauge("otb_test_live")
+        g.set(42)
+        assert g.value == 42
+        with pytest.raises(TypeError):
+            r.gauge("otb_test_total", tier="x")
+
+    def test_histogram_percentiles_vs_numpy(self):
+        r = obs_metrics.Registry()
+        h = r.histogram("otb_test_ms")
+        rng = np.random.default_rng(7)
+        vals = np.exp(rng.normal(2.0, 1.0, size=4000))   # lognormal ms
+        for v in vals:
+            h.observe(float(v))
+        # log-bucket width is 2^0.25 (~19%): quantile estimates must
+        # land within one bucket of the exact sample percentile
+        for q in (0.5, 0.95, 0.99):
+            exact = float(np.percentile(vals, q * 100))
+            got = h.quantile(q)
+            assert exact / 1.2 <= got <= exact * 1.2, (q, got, exact)
+        assert h.count == len(vals)
+        assert h.sum == pytest.approx(float(vals.sum()), rel=1e-6)
+
+    def test_prometheus_text_format(self):
+        r = obs_metrics.Registry()
+        r.counter("otb_q_total", tier="mesh").inc(5)
+        h = r.histogram("otb_q_ms", tier="mesh")
+        h.observe(1.0)
+        h.observe(100.0)
+        r.register_collector(
+            "fix", lambda: [("otb_fix_live", {"t": "a"}, 2.0)])
+        text = r.text()
+        assert "# TYPE otb_q_total counter" in text
+        assert 'otb_q_total{tier="mesh"} 5' in text
+        assert "# TYPE otb_q_ms histogram" in text
+        assert 'le="+Inf"' in text
+        assert "otb_q_ms_sum" in text and "otb_q_ms_count" in text
+        assert 'otb_fix_live{t="a"} 2' in text
+        # bucket lines are cumulative and end at the total count
+        buckets = [ln for ln in text.splitlines()
+                   if ln.startswith("otb_q_ms_bucket")]
+        assert buckets and buckets[-1].split()[-1] == "2"
+
+    def test_broken_collector_never_breaks_scrape(self):
+        r = obs_metrics.Registry()
+        r.counter("otb_ok_total").inc()
+
+        def boom():
+            raise RuntimeError("collector died")
+
+        r.register_collector("boom", boom)
+        assert any(n == "otb_ok_total" for n, *_ in r.samples())
+        assert "otb_ok_total" in r.text()
+
+    def test_observe_query_feeds_registry(self):
+        before = obs_metrics.REGISTRY.counter(
+            "otb_queries_total", tier="single").value
+        with obs_trace.trace_query("select 1") as qt:
+            qt.tier = "single"
+            with obs_trace.span("execute"):
+                pass
+        after = obs_metrics.REGISTRY.counter(
+            "otb_queries_total", tier="single").value
+        assert after == before + 1
+
+
+# ---------------------------------------------------------------------------
+# single-node tier: traces + EXPLAIN ANALYZE per-node actuals
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def single_env():
+    node = LocalNode()
+    s = Session(node)
+    s.execute(SCHEMA)
+    data = datagen.generate(sf=0.005)
+    datagen.load_into(s, data)
+    return s
+
+
+class TestSingleTier:
+    def test_last_query_stats(self, single_env):
+        s = single_env
+        rows = s.query(Q[1])
+        st = s.last_query_stats()
+        assert st["tier"] == "single"
+        assert st["rows"] == len(rows)
+        assert st["total_ms"] > 0
+        assert st["execute_ms"] > 0
+        assert st["total_ms"] >= st["execute_ms"]
+        assert st["signature"].lower().startswith("select")
+
+    def test_explain_analyze_q1_per_node_actuals(self, single_env):
+        s = single_env
+        r = s.execute("explain analyze " + Q[1])[0]
+        plan = [ln for ln in r.text.splitlines()
+                if "(actual rows=" in ln]
+        # EVERY plan node carries actuals (fusion is disabled on the
+        # instrumented path so interior nodes execute individually)
+        assert "SeqScan" in r.text and "Agg" in r.text
+        assert len(plan) >= 3, r.text
+        assert "Execution Time:" in r.text
+        assert "Buffer Pool:" in r.text
+        assert "Programs:" in r.text
+        m = re.search(r"actual rows=(\d+) time=([\d.]+) ms", r.text)
+        assert m and int(m.group(1)) >= 0
+
+    def test_explain_analyze_q3(self, single_env):
+        s = single_env
+        r = s.execute("explain analyze " + Q[3])[0]
+        assert r.text.count("(actual rows=") >= 4, r.text
+        assert "Join" in r.text
+        assert "Execution Time:" in r.text
+
+    def test_explain_analyze_matches_plain_result(self, single_env):
+        # ANALYZE runs the statement: row counts in the annotation of
+        # the root node match what the query actually returns
+        s = single_env
+        want = len(s.query(Q[1]))
+        r = s.execute("explain analyze " + Q[1])[0]
+        top = re.search(r"actual rows=(\d+)", r.text.splitlines()[0])
+        assert top and int(top.group(1)) == want
+
+    def test_deprecated_stage_alias(self, single_env):
+        s = single_env
+        s.query(Q[1])
+        assert s.last_stage_ms == pytest.approx(
+            s.last_query_stats().get("stage_ms", 0.0))
+
+
+# ---------------------------------------------------------------------------
+# cluster tier: views, EXPLAIN ANALYZE fragments, warm staging
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster_env():
+    cluster = Cluster(n_datanodes=2)
+    s = ClusterSession(cluster)
+    s.execute(SCHEMA)
+    data = datagen.generate(sf=0.005)
+    for tname in ("region", "nation", "supplier", "customer", "part",
+                  "partsupp", "orders", "lineitem"):
+        tbl = data[tname]
+        td = cluster.catalog.table(tname)
+        n = len(next(iter(tbl.values())))
+        s._insert_rows(td, tbl, n)
+    return s
+
+
+class TestClusterTier:
+    def test_last_query_stats(self, cluster_env):
+        s = cluster_env
+        rows = s.query(Q[1])
+        st = s.last_query_stats()
+        assert st["rows"] == len(rows)
+        assert st["tier"] in ("mesh", "host", "local", "fqs", "gidx")
+        assert st["total_ms"] > 0 and st["execute_ms"] > 0
+
+    def test_warm_q1_stage_is_zero_with_full_pool_hits(self, cluster_env):
+        s = cluster_env
+        s.query(Q[1])            # populate the device buffer pool
+        s.query(Q[1])            # warm run
+        st = s.last_query_stats()
+        qt = obs_trace.last_trace()
+        hits = qt.count_events("pool", hit=True)
+        misses = qt.count_events("pool", hit=False)
+        assert hits > 0 and misses == 0, (hits, misses)
+        # staging a pool-resident table is bookkeeping only
+        assert st["stage_ms"] < max(st["total_ms"] * 0.25, 5.0), st
+
+    def test_explain_analyze_q1_fragments(self, cluster_env):
+        s = cluster_env
+        r = s.execute("explain analyze " + Q[1])[0]
+        assert "(actual rows=" in r.text, r.text
+        assert "Fragment 0" in r.text
+        assert "Execution Time:" in r.text
+        assert "Buffer Pool:" in r.text
+        assert "Programs:" in r.text
+
+    def test_explain_analyze_q3_fragments(self, cluster_env):
+        s = cluster_env
+        r = s.execute("explain analyze " + Q[3])[0]
+        assert "(actual rows=" in r.text, r.text
+        assert "rows=" in r.text and "time=" in r.text
+        assert "Execution Time:" in r.text
+
+    def test_otb_stat_query_view(self, cluster_env):
+        s = cluster_env
+        s.query(Q[1])
+        rows = s.query("select signature, tier, total_ms, rows "
+                       "from otb_stat_query")
+        assert rows, "ring empty"
+        sigs = [r[0] for r in rows]
+        assert any(sig.lower().startswith("select") for sig in sigs)
+        assert all(r[2] >= 0 for r in rows)
+
+    def test_otb_metrics_view(self, cluster_env):
+        s = cluster_env
+        s.query(Q[1])
+        rows = s.query("select name, kind, value from otb_metrics")
+        names = {r[0] for r in rows}
+        assert "otb_queries_total" in names
+        assert any(n.startswith("otb_plancache_") for n in names)
+        assert any(n.startswith("otb_buffercache_") for n in names), names
+
+    def test_metrics_text_exposition(self, cluster_env):
+        s = cluster_env
+        s.query(Q[1])
+        text = s.metrics_text()
+        assert "# TYPE otb_queries_total counter" in text
+        assert "# TYPE otb_query_ms histogram" in text
+        assert 'le="+Inf"' in text
+
+
+def test_cn_server_metrics_op():
+    from opentenbase_tpu.net.cn_server import CnClient, CnServer
+    cluster = Cluster(n_datanodes=2)
+    srv = CnServer(lambda: ClusterSession(cluster)).start()
+    try:
+        c = CnClient(srv.host, srv.port)
+        c.execute("create table mt (k bigint primary key, v bigint) "
+                  "distribute by shard(k)")
+        c.execute("insert into mt values (1, 10), (2, 20)")
+        assert c.query("select sum(v) from mt") == [(30,)]
+        text = c.metrics()
+        assert "otb_queries_total" in text
+        assert "# TYPE" in text
+        c.close()
+    finally:
+        srv.stop()
