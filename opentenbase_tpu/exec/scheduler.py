@@ -1,0 +1,517 @@
+"""Concurrent serving tier: async admission + same-program batching.
+
+Reference analog: the coordinator's session pooler + resource queues
+(OpenTenBase pools hundreds of pgwire backends per CN and gates them
+through resource-group concurrency slots).  Here the pool is an
+admission/scheduling layer between sessions and the executor, built
+around what an accelerator-resident engine can do that a tuple-at-a-
+time one cannot: queries that share a literal-masked fused-program
+signature (exec/plancache.py keys, exec/fused.py masking) are the SAME
+compiled XLA program with different constants — so N of them arriving
+within a short window coalesce into ONE dispatch.  Their masked
+literals and MVCC (snapshot, txid) pairs stack along a leading batch
+axis and `jax.lax.map` runs the shared fragment once per batch element
+inside one executable (fused.run_fused_batch), then per-query results
+demux as device views into the stacked output.
+
+Pipelining: the dispatcher thread only classifies, coalesces, and
+launches — JAX async dispatch returns before device compute finishes,
+and materialization (the device→host sync) happens on each CLIENT
+thread.  While clients block on query i's results, the dispatcher is
+already staging and launching query i+1's batch: host staging overlaps
+device compute with no extra machinery.
+
+Admission: GTM resource-group slots (owner + lease, gtm/server.py)
+throttle concurrent dispatches per group — a coalesced batch holds one
+slot (it is one device dispatch), serial statements hold one each.
+Over-admission sheds: a full per-group queue rejects at submit, and a
+query that cannot acquire a slot before its shed deadline is dropped
+with an error, releasing nothing it does not hold.  Non-batchable
+statements (DML, DDL, multi-statement strings, open transactions,
+init-plan SELECTs) run serially on a worker pool under the same
+admission throttle; writes additionally serialize on one lane.
+
+Knobs: OTB_SCHED_WINDOW_MS (coalescing window, default 2), OTB_SCHED_
+MAX_BATCH (default 16), OTB_SCHED_QUEUE_DEPTH (per-group, default
+128), OTB_SCHED_SHED_TIMEOUT_MS (default 5000), OTB_SCHED_SLOTS
+(default admission cap when the group has no catalog entry, default
+8), OTB_SCHED_WORKERS (serial lanes, default 8).
+
+Observability: the otb_scheduler stat view (parallel/statviews.py)
+reports admitted/queued/batched/shed counts, a batch-size histogram,
+and queue-wait p50/p99 from the module-level counters below.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..sql import ast as A
+from ..sql.parser import parse_sql
+from .executor import ExecContext, ExecError, materialize
+from .fused import batch_signature, run_fused_batch
+from .session import Result
+
+# ---------------------------------------------------------------------------
+# serving-tier telemetry (surfaced by the otb_scheduler view).  Counters
+# are process-global across Scheduler instances so the view aggregates
+# every serving front-end in the process.
+# ---------------------------------------------------------------------------
+_STATS_LOCK = threading.Lock()
+_STATS: dict = {          # guarded_by: _STATS_LOCK
+    "admitted": 0,        # queries that passed admission and executed
+    "batched": 0,         # queries served by a multi-query dispatch
+    "shed": 0,            # rejected: queue full or shed-deadline passed
+    "dispatches": 0,      # device dispatches (a batch counts once)
+    "batch_dispatches": 0,
+}
+_HIST: dict = {}          # guarded_by: _STATS_LOCK — batch size -> count
+_WAITS: collections.deque = collections.deque(  # guarded_by: _STATS_LOCK
+    maxlen=4096)          # recent queue waits (ms), submit -> execution
+_SCHEDULERS: list = []    # guarded_by: _STATS_LOCK — live instances
+
+
+def _pct(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return float(sorted_vals[idx])
+
+
+def stats_snapshot() -> dict:
+    """Aggregate serving-tier counters (otb_scheduler view backing)."""
+    with _STATS_LOCK:
+        d = dict(_STATS)
+        waits = sorted(_WAITS)
+        hist = dict(sorted(_HIST.items()))
+        scheds = list(_SCHEDULERS)
+    d["queued"] = sum(s.queue_depth() for s in scheds)
+    d["queue_wait_p50_ms"] = _pct(waits, 0.50)
+    d["queue_wait_p99_ms"] = _pct(waits, 0.99)
+    d["batch_hist"] = " ".join(f"{k}:{v}" for k, v in hist.items())
+    d["hist"] = hist
+    return d
+
+
+def stats_rows() -> list:
+    """One row for the otb_scheduler view."""
+    d = stats_snapshot()
+    return [(d["admitted"], d["queued"], d["batched"], d["shed"],
+             d["dispatches"], d["batch_dispatches"],
+             d["queue_wait_p50_ms"], d["queue_wait_p99_ms"],
+             d["batch_hist"])]
+
+
+def reset_stats():
+    with _STATS_LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+        _HIST.clear()
+        _WAITS.clear()
+
+
+def _bump(field: str, n: int = 1):
+    with _STATS_LOCK:
+        _STATS[field] += n
+
+
+def _note_dispatch(items, t_start: float):
+    k = len(items)
+    with _STATS_LOCK:
+        _STATS["admitted"] += k
+        _STATS["dispatches"] += 1
+        if k > 1:
+            _STATS["batched"] += k
+            _STATS["batch_dispatches"] += 1
+        _HIST[k] = _HIST.get(k, 0) + 1
+        for it in items:
+            _WAITS.append((t_start - it.t_submit) * 1e3)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class _Shed(Exception):
+    pass
+
+
+_STOP = object()
+
+
+class _Item:
+    """One submitted statement moving through the scheduler."""
+    __slots__ = ("session", "sql", "planned", "info", "group",
+                 "t_submit", "ev", "error", "results", "batch",
+                 "out_names", "is_write")
+
+    def __init__(self, session, sql):
+        self.session = session
+        self.sql = sql
+        self.planned = None
+        self.info = None          # FragSig when batchable, else None
+        self.group = "default"
+        self.t_submit = time.monotonic()
+        self.ev = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.results: Optional[list] = None   # serial path (materialized)
+        self.batch = None         # batched path: demuxed DBatch view
+        self.out_names = None
+        self.is_write = False
+
+    @property
+    def sig(self):
+        return None if self.info is None else self.info.sig
+
+
+class Scheduler:
+    """Admission + coalescing front-end over single-node sessions.
+
+    Client threads call `run(session, sql)`; a dispatcher thread drains
+    the arrival queue, groups same-signature SELECTs arriving within
+    the batch window into one compiled dispatch, and hands everything
+    else to an admission-capped serial worker pool."""
+
+    def __init__(self, node=None, gtm=None,
+                 window_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 shed_timeout_ms: Optional[float] = None,
+                 slots: Optional[int] = None,
+                 workers: Optional[int] = None,
+                 lease_s: float = 30.0):
+        self.node = node
+        if gtm is None:
+            # in-process GTM core: the same slot/lease semantics a
+            # cluster deployment gets from the GTM service
+            from ..gtm.server import GtmCore
+            gtm = GtmCore()
+        self.gtm = gtm
+        self.window_s = (_env_float("OTB_SCHED_WINDOW_MS", 2.0)
+                         if window_ms is None else window_ms) / 1e3
+        self.max_batch = _env_int("OTB_SCHED_MAX_BATCH", 16) \
+            if max_batch is None else max_batch
+        self.max_queue = _env_int("OTB_SCHED_QUEUE_DEPTH", 128) \
+            if queue_depth is None else queue_depth
+        self.shed_s = (_env_float("OTB_SCHED_SHED_TIMEOUT_MS", 5000.0)
+                       if shed_timeout_ms is None else shed_timeout_ms) \
+            / 1e3
+        self.slots = _env_int("OTB_SCHED_SLOTS", 8) \
+            if slots is None else slots
+        self.workers = _env_int("OTB_SCHED_WORKERS", 8) \
+            if workers is None else workers
+        self.lease_s = lease_s
+        self._owner = f"sched{os.getpid()}-{id(self):x}"
+        self._q: queue.Queue = queue.Queue()
+        self._deferred: collections.deque = collections.deque()
+        self._depth: dict = {}          # group -> queued count
+        self._lock = threading.Lock()
+        self._write_lock = threading.Lock()   # one write lane
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        with _STATS_LOCK:
+            _SCHEDULERS.append(self)
+
+    # -- lifecycle --------------------------------------------------------
+    def _ensure_started(self):
+        with self._lock:
+            if self._thread is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=max(1, self.workers),
+                    thread_name_prefix="otb-sched")
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="otb-sched-disp")
+                self._thread.start()
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+            started = self._thread is not None
+        if started:
+            self._q.put(_STOP)
+            self._thread.join(timeout=30)
+            self._pool.shutdown(wait=True)
+        try:
+            self.gtm.resq_disconnect(self._owner)
+        except Exception:
+            pass
+        with _STATS_LOCK:
+            if self in _SCHEDULERS:
+                _SCHEDULERS.remove(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(self._depth.values())
+
+    # -- client API -------------------------------------------------------
+    def run(self, session, sql: str) -> list:
+        """Submit and wait: the serving tier's `session.execute`."""
+        item = self.submit(session, sql)
+        return self.wait(item)
+
+    def submit(self, session, sql: str) -> _Item:
+        if self._stopped:
+            raise ExecError("scheduler is stopped")
+        self._ensure_started()
+        item = _Item(session, sql)
+        self._classify(item)
+        with self._lock:
+            depth = self._depth.get(item.group, 0)
+            if self.max_queue > 0 and depth >= self.max_queue:
+                over = True
+            else:
+                over = False
+                self._depth[item.group] = depth + 1
+        if over:
+            _bump("shed")
+            raise ExecError(
+                f"resource group '{item.group}' queue is full "
+                f"({self.max_queue} queued): query shed")
+        self._q.put(item)
+        return item
+
+    def wait(self, item: _Item, timeout: float = 600.0) -> list:
+        if not item.ev.wait(timeout):
+            raise ExecError("scheduler: query timed out awaiting dispatch")
+        if item.error is not None:
+            raise item.error
+        if item.results is not None:
+            return item.results
+        # batched path: materialize HERE, on the client thread — the
+        # device→host sync for query i happens while the dispatcher is
+        # already staging/launching query i+1
+        names, rows = materialize(item.batch, item.out_names)
+        return [Result("SELECT", names=names, rows=rows,
+                       rowcount=len(rows))]
+
+    # -- classification ---------------------------------------------------
+    def _classify(self, item: _Item):
+        """Attach the literal-masked fragment signature when the
+        statement can ride a coalesced dispatch; otherwise mark the
+        serial lane (and whether it needs the write lane)."""
+        session, sql = item.session, item.sql
+        item.group = getattr(session, "resource_group", "") or "default"
+        stmts = parse_sql(sql)
+        item.is_write = any(not isinstance(s, (A.SelectStmt, A.ShowStmt,
+                                               A.ExplainStmt))
+                            for s in stmts)
+        node = getattr(session, "node", None)
+        if (len(stmts) != 1 or not isinstance(stmts[0], A.SelectStmt)
+                or stmts[0].for_update
+                or getattr(session, "txn", None) is not None
+                or node is None or not hasattr(node, "stores")):
+            return
+        raw_budget = node.gucs.get("work_mem_rows", "")
+        if raw_budget.isdigit() and int(raw_budget) > 0:
+            return    # spill tier: serial path owns multi-pass execution
+        try:
+            planned = session._plan_select(stmts[0])
+        except Exception:
+            return    # let the serial path surface the planning error
+        if planned.init_plans:
+            return
+        ctx = ExecContext(node.stores, 0, 0, node.cache)
+        info = batch_signature(ctx, planned.plan)
+        if info is None:
+            return
+        item.planned = planned
+        item.info = info
+
+    # -- admission --------------------------------------------------------
+    def _cap(self, group: str) -> int:
+        node = self.node
+        cfg = None
+        if node is not None:
+            cfg = getattr(node.catalog, "resource_groups", {}).get(group)
+        if cfg:
+            try:
+                return int(cfg.get("concurrency", 0)) or self.slots
+            except (TypeError, ValueError):
+                pass
+        return self.slots
+
+    def _admit(self, group: str, deadline: float):
+        """Acquire one GTM slot or shed at the deadline.  Exponential
+        backoff mirrors the cluster session's resource-queue wait."""
+        delay = 0.0005
+        while not self.gtm.resq_acquire(group, self._cap(group),
+                                        owner=self._owner,
+                                        lease_s=self.lease_s):
+            if time.monotonic() >= deadline:
+                raise _Shed(
+                    f"resource group '{group}' queue wait timeout: "
+                    "query shed")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.02)
+
+    def _release(self, group: str):
+        try:
+            self.gtm.resq_release(group, owner=self._owner)
+        except Exception:
+            pass
+
+    def _shed_item(self, item: _Item, exc: _Shed):
+        _bump("shed")
+        item.error = ExecError(str(exc))
+        item.ev.set()
+
+    # -- dispatcher -------------------------------------------------------
+    def _next(self, timeout: Optional[float]):
+        if self._deferred:
+            return self._deferred.popleft()
+        try:
+            if timeout is None:
+                return self._q.get()
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _depth_dec(self, item: _Item):
+        with self._lock:
+            d = self._depth.get(item.group, 0)
+            if d > 0:
+                self._depth[item.group] = d - 1
+
+    def _loop(self):
+        while True:
+            head = self._next(None)
+            if head is _STOP:
+                self._drain_on_stop()
+                return
+            batch = [head]
+            if head.info is not None and self.max_batch > 1 \
+                    and self.window_s > 0:
+                # coalescing window: wait a beat for same-signature
+                # arrivals; non-matching items defer (FIFO preserved)
+                deadline = time.monotonic() + self.window_s
+                skipped = []
+                while len(batch) < self.max_batch:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        break
+                    nxt = self._next(rem)
+                    if nxt is None:
+                        break
+                    if nxt is _STOP:
+                        self._deferred.appendleft(_STOP)
+                        break
+                    if nxt.info is not None and nxt.sig == head.sig:
+                        batch.append(nxt)
+                    else:
+                        skipped.append(nxt)
+                self._deferred.extend(skipped)
+            for it in batch:
+                self._depth_dec(it)
+            if len(batch) > 1:
+                self._dispatch_batch(batch)
+            else:
+                self._pool.submit(self._run_serial, head)
+
+    def _drain_on_stop(self):
+        while True:
+            it = self._next(0)
+            if it is None:
+                return
+            if it is _STOP:
+                continue
+            it.error = ExecError("scheduler stopped")
+            it.ev.set()
+
+    # -- execution paths --------------------------------------------------
+    def _dispatch_batch(self, items: list):
+        group = items[0].group
+        deadline = min(it.t_submit for it in items) + self.shed_s
+        try:
+            self._admit(group, deadline)
+        except _Shed as e:
+            for it in items:
+                self._shed_item(it, e)
+            return
+        out = None
+        try:
+            t_start = time.monotonic()
+            node = items[0].session.node
+            queries = []
+            for it in items:
+                # per-query MVCC: each batch element carries its own
+                # snapshot/txid as traced inputs (drawn AFTER admission,
+                # matching when serial execution would begin)
+                txid = node.gts.next_txid()
+                snap = node.gts.next_gts()
+                queries.append(
+                    (snap, txid, [v for _n, v, _t in it.info.lits]))
+            out = run_fused_batch(items[0].info, queries)
+        except BaseException as e:
+            self._release(group)
+            for it in items:
+                it.error = e
+                it.ev.set()
+            return
+        self._release(group)
+        if out is None:
+            # batched path declined (mask refused / ladder exhausted /
+            # program error): serial fallback reproduces per-query
+            # results and attributes per-query errors
+            for it in items:
+                self._pool.submit(self._run_serial, it)
+            return
+        _note_dispatch(items, t_start)
+        for it, b in zip(items, out):
+            it.batch = b
+            it.out_names = it.planned.output_names
+            it.ev.set()
+
+    def _run_serial(self, item: _Item):
+        try:
+            self._admit(item.group, item.t_submit + self.shed_s)
+        except _Shed as e:
+            self._shed_item(item, e)
+            return
+        try:
+            _note_dispatch([item], time.monotonic())
+            if item.is_write:
+                with self._write_lock:
+                    item.results = item.session.execute(item.sql)
+            else:
+                item.results = item.session.execute(item.sql)
+        except BaseException as e:
+            item.error = e
+        finally:
+            self._release(item.group)
+            item.ev.set()
+
+
+def serve(node, host: str = "127.0.0.1", port: int = 0,
+          users_path: Optional[str] = None, **knobs):
+    """One-call serving tier over a LocalNode: starts a CN wire server
+    whose per-connection sessions all route through one Scheduler.
+    Returns (CnServer, Scheduler) — both started."""
+    from ..net.cn_server import CnServer
+    from .session import Session
+    sched = Scheduler(node=node, **knobs)
+    srv = CnServer(lambda: Session(node), users_path=users_path,
+                   host=host, port=port, scheduler=sched).start()
+    return srv, sched
