@@ -41,6 +41,11 @@ Modes via env:
   query; with the cache the second process should land near engine_ms.
 - OTB_COMPILE_CACHE: persistent cache dir (default: a fresh temp dir,
   shared with the warm2 child)
+- --chaos: SKIP the ladder; instead run point reads against a live
+  TCP cluster while one DN flaps (wire-level close faults) and print
+  p50/p99 latency, error rate, wrong-result count, and the otbguard
+  counters (net/guard.py).  Knobs: BENCH_CHAOS_OPS (400),
+  BENCH_CHAOS_FLAP_EVERY (50), plus the OTB_RPC_*/OTB_BREAKER_* envs.
 """
 
 import json
@@ -176,6 +181,102 @@ def _oltp_latencies(s, n=200):
 
 
 TRACE_DUMP = "--trace" in sys.argv[1:]
+CHAOS = "--chaos" in sys.argv[1:]
+
+
+def _chaos_arm():
+    """--chaos: point reads against a live TCP cluster while one DN
+    flaps — wire-level close faults (utils/faultinject.py) tear dn0's
+    conversations every BENCH_CHAOS_FLAP_EVERY ops.  Prints ONE JSON
+    line: p50/p99 latency, error rate, wrong-result count (must be 0:
+    a retried or failed read may error but never lie), and the
+    otbguard counters (retries, breaker trips, half-open recoveries)
+    — the ISSUE-8 acceptance numbers under sustained flapping."""
+    import shutil
+    from opentenbase_tpu.exec.dist_session import ClusterSession
+    from opentenbase_tpu.gtm.server import GtmCore, GtmServer
+    from opentenbase_tpu.obs.metrics import REGISTRY
+    from opentenbase_tpu.net.dn_server import DnServer
+    from opentenbase_tpu.parallel.cluster import Cluster
+    from opentenbase_tpu.utils import faultinject as FI
+
+    n_ops = int(os.environ.get("BENCH_CHAOS_OPS", "400"))
+    flap_every = int(os.environ.get("BENCH_CHAOS_FLAP_EVERY", "50"))
+    # fast breaker so trips AND half-open recoveries land inside the
+    # run (production defaults are read per-call from the same knobs)
+    os.environ.setdefault("OTB_BREAKER_THRESHOLD", "3")
+    os.environ.setdefault("OTB_BREAKER_COOLDOWN", "0.2")
+    os.environ.setdefault("OTB_RPC_RETRIES", "2")
+
+    d = tempfile.mkdtemp(prefix="otb-chaos-")
+    Cluster(n_datanodes=2, datadir=d).checkpoint()
+    gtm = GtmServer(GtmCore(os.path.join(d, "gtm.json"))).start()
+    catalog_path = os.path.join(d, "catalog.json")
+    servers = [DnServer(i, os.path.join(d, f"dn{i}"), catalog_path,
+                        gtm_addr=(gtm.host, gtm.port)).start()
+               for i in range(2)]
+    cluster = Cluster.connect(catalog_path,
+                              [(s.host, s.port) for s in servers],
+                              (gtm.host, gtm.port))
+    try:
+        s = ClusterSession(cluster)
+        s.execute("create table chaos_kv (k bigint primary key, "
+                  "v bigint) distribute by shard(k)")
+        s.execute("insert into chaos_kv values " + ", ".join(
+            f"({i}, {i * 3})" for i in range(64)))
+
+        lat, errors, wrong = [], 0, 0
+        t_all = time.perf_counter()
+        for i in range(n_ops):
+            if i and i % flap_every == 0:
+                # flap dn0: tear its next 6 wire conversations —
+                # enough failed attempts to trip the breaker through
+                # the retry budget, then let it half-open-recover
+                FI.arm_wire("dn0.recv", "close", times=6)
+            k = i % 64
+            t0 = time.perf_counter()
+            try:
+                rows = s.query(f"select v from chaos_kv where k = {k}")
+                if rows != [(k * 3,)]:
+                    wrong += 1
+            except Exception:   # noqa: BLE001 — the error rate IS the metric
+                errors += 1
+            lat.append(time.perf_counter() - t0)
+        wall_s = time.perf_counter() - t_all
+        FI.disarm_wire()
+
+        counters = {}
+        for name, labels, kind, value in REGISTRY.samples():
+            if kind == "counter" and name.startswith("otb_guard_"):
+                counters[name] = counters.get(name, 0) + int(value)
+        ms = np.asarray(lat) * 1e3
+        out = {
+            "metric": "chaos point-read p99 (one DN flapping)",
+            "value": round(float(np.percentile(ms, 99)), 3),
+            "unit": "ms",
+            "ops": n_ops,
+            "wall_s": round(wall_s, 2),
+            "p50_ms": round(float(np.percentile(ms, 50)), 3),
+            "p99_ms": round(float(np.percentile(ms, 99)), 3),
+            "error_rate": round(errors / n_ops, 4),
+            "wrong_results": wrong,
+            "guard_counters": dict(sorted(counters.items())),
+        }
+        if tpu_unavailable:
+            out["tpu_unavailable"] = True
+        print(json.dumps(out))
+    finally:
+        FI.disarm_wire()
+        res = getattr(cluster, "_resolver", None)
+        if res is not None:
+            res.stop()
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:   # noqa: BLE001 — best-effort teardown
+                pass
+        gtm.stop()
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def _phases(qs):
@@ -330,6 +431,9 @@ def _run_warm2(data, sf):
 
 
 def main():
+    if CHAOS:
+        _chaos_arm()
+        return
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     repeat = int(os.environ.get("BENCH_REPEAT", "5"))
     mode = os.environ.get("BENCH_MODE", "ladder")

@@ -41,6 +41,8 @@ RULES = {
                        "its guarded_by lock",
     "obs-purity": "tracing/metrics instrumentation call inside a "
                   "traced region",
+    "net-deadline": "network conversation without a deadline, or raw "
+                    "socket I/O outside the frame codec",
     "hlo-f64": "f64 tensor type in exported StableHLO",
     "hlo-host-transfer": "host transfer / callback op in exported "
                          "StableHLO",

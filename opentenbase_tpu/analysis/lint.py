@@ -21,8 +21,8 @@ import sys
 from .callgraph import TracedClosure
 from .core import (Baseline, Project, RULES, default_baseline_path,
                    make_report)
-from .passes import (HostSyncPass, LockDisciplinePass, ObsPurityPass,
-                     ProgramKeyPass, TracePurityPass)
+from .passes import (HostSyncPass, LockDisciplinePass, NetDeadlinePass,
+                     ObsPurityPass, ProgramKeyPass, TracePurityPass)
 
 
 def repo_root() -> str:
@@ -39,6 +39,7 @@ def run_passes(project: Project, rules=None) -> list:
         ObsPurityPass(project, closure),
         ProgramKeyPass(project),
         LockDisciplinePass(project),
+        NetDeadlinePass(project),
     ]
     findings = []
     for p in passes:

@@ -39,6 +39,14 @@ obs-purity
     never, and their timers would read as zero — silently wrong).
     Spans/events belong at host boundaries only; eager-only regions
     (``if not self._traced:`` branches) are exempt.
+
+net-deadline
+    Network conversations in the RPC-bearing modules (net/, gtm/,
+    storage/replication.py) must carry a deadline: ``create_connection``
+    needs ``timeout=``, and raw ``.recv``/``.sendall``/
+    ``settimeout(None)`` are reserved for the frame codecs (wire.py,
+    pgwire.py) — everything else flows through send_msg/recv_msg under
+    the net/guard.py wrapper, which owns the per-op deadline.
 """
 
 from __future__ import annotations
@@ -960,3 +968,95 @@ class LockDisciplinePass:
                     walk(h.body, held)
 
         walk(fi.node.body, held0)
+
+
+# ===========================================================================
+# net-deadline
+# ===========================================================================
+class NetDeadlinePass:
+    """Every network conversation in the RPC-bearing modules must carry
+    a deadline.  In scope (``net/``, ``gtm/``, ``storage/replication``):
+
+    - ``socket.create_connection(...)`` must pass ``timeout=`` — a
+      connect without one blocks a coordinator thread on a dead peer
+      for the kernel default (minutes), starving the pool.
+    - raw ``.recv(`` / ``.sendall(`` and ``.settimeout(None)`` are
+      reserved for the frame codecs (``net/wire.py``, ``net/pgwire.py``)
+      — everything else talks through ``send_msg``/``recv_msg`` under a
+      ``guard.guarded`` wrapper, which owns the deadline.
+
+    Per-site escapes use ``# otblint: disable=net-deadline``."""
+
+    rule = "net-deadline"
+
+    def __init__(self, project: Project):
+        self.project = project
+        pkg = project.package
+        self.scope_dirs = (f"{pkg}/net/", f"{pkg}/gtm/")
+        self.scope_files = (f"{pkg}/storage/replication.py",)
+        self.frame_codecs = (f"{pkg}/net/wire.py", f"{pkg}/net/pgwire.py")
+
+    def _in_scope(self, norm: str) -> bool:
+        return norm.startswith(self.scope_dirs) or norm in self.scope_files
+
+    def run(self) -> list:
+        import os as _os
+        findings = []
+        for rel, mi in self.project.by_rel.items():
+            norm = rel.replace(_os.sep, "/")
+            if not self._in_scope(norm):
+                continue
+            codec = norm in self.frame_codecs
+            self._check_module(mi, codec, findings)
+        return findings
+
+    # -- helpers --------------------------------------------------------
+    def _enclosing(self, mi, line: int):
+        """Innermost function containing `line` (None = module level)."""
+        best, best_start = None, -1
+        for fi in mi.functions.values():
+            node = fi.node
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end and node.lineno > best_start:
+                best, best_start = fi, node.lineno
+        return best
+
+    def _emit(self, findings, mi, line: int, message: str):
+        src = mi.src
+        if src.disabled(line, self.rule):
+            return
+        fi = self._enclosing(mi, line)
+        if fi is not None and _fn_disabled(fi, self.rule):
+            return
+        findings.append(Finding(self.rule, src.rel, line,
+                                fi.qualname if fi else "", message))
+
+    def _check_module(self, mi, codec: bool, findings):
+        for node in ast.walk(mi.src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func, mi)
+            if d == "socket.create_connection":
+                if not any(kw.arg == "timeout" for kw in node.keywords) \
+                        and len(node.args) < 2:
+                    self._emit(findings, mi, node.lineno,
+                               "socket.create_connection without a "
+                               "timeout — a dead peer blocks this "
+                               "thread for the kernel default")
+                continue
+            if codec:
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr in ("recv", "sendall"):
+                self._emit(findings, mi, node.lineno,
+                           f"raw socket .{f.attr}() outside the frame "
+                           f"codec — use send_msg/recv_msg under a "
+                           f"guard wrapper (deadline ownership)")
+            elif f.attr == "settimeout" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value is None:
+                self._emit(findings, mi, node.lineno,
+                           "settimeout(None) disables the RPC "
+                           "deadline on this socket")

@@ -280,6 +280,13 @@ class DistExecutor:
                 except MeshUnsupported as e:
                     # host-mediated tier handles everything else
                     self.fallback_reason = str(e)
+                except (ConnectionError, OSError, EOFError) as e:
+                    # a DN died under the mesh's whole-table staging:
+                    # degrade to the host fragment tier, whose per-DN
+                    # dispatch re-routes read fragments to a promoted
+                    # standby (the next statement rides the mesh again)
+                    self.fallback_reason = (
+                        f"mesh staging connection failure: {e}")
         if dp.fqs_node is not None:
             # whole-query shipped to one datanode (FQS).  An in-process
             # datanode returns the device batch directly (no host
@@ -291,8 +298,19 @@ class DistExecutor:
             if hasattr(dn, "exec_plan_device"):
                 return dn.exec_plan_device(frag.plan, self.snapshot_ts,
                                            self.txid, self.params, {})
-            return _to_device(dn.exec_plan(frag.plan, self.snapshot_ts,
-                                           self.txid, self.params, {}))
+            try:
+                return _to_device(dn.exec_plan(
+                    frag.plan, self.snapshot_ts, self.txid,
+                    self.params, {}))
+            except (ConnectionError, OSError, EOFError):
+                # whole-query-shipped read on a dead DN: same standby
+                # re-dispatch as the fragment path
+                dn2 = self._failover_target(dp.fqs_node)
+                if dn2 is None:
+                    raise
+                return _to_device(dn2.exec_plan(
+                    frag.plan, self.snapshot_ts, self.txid,
+                    self.params, {}))
         # exchange outputs, keyed (exchange_index, dest) where dest is a
         # dn index or 'cn'
         self.tier = "host"
@@ -482,6 +500,21 @@ class DistExecutor:
                         f"(got {type(k).__name__})")
 
     # ------------------------------------------------------------------
+    def _failover_target(self, dn_index: int):
+        """Resolve the replacement datanode for a read re-dispatch, or
+        None when the cluster has no standby to promote (the original
+        connection error then propagates)."""
+        fo = getattr(self.cluster, "failover_read", None)
+        if fo is None:
+            return None
+        try:
+            return fo(dn_index)
+        except Exception:
+            # promotion itself failed (standby dir gone, catalog race):
+            # surface the ORIGINAL connection error, not this one
+            return None
+
+    # ------------------------------------------------------------------
     def _exec_fragment_on(self, frag: Fragment, dp: DistPlan, where,
                           ex_out: dict):
         """Run one fragment at `where` ('cn' or dn index).  Returns a
@@ -510,8 +543,19 @@ class DistExecutor:
         # per-fragment timing still lands in self.stats under instrument
         with obs_trace.span("execute", fragment=frag.index,
                             where=f"dn{where}"):
-            out = dn.exec_plan(frag.plan, self.snapshot_ts, self.txid,
-                               self.params, sources)
+            try:
+                out = dn.exec_plan(frag.plan, self.snapshot_ts,
+                                   self.txid, self.params, sources)
+            except (ConnectionError, OSError, EOFError):
+                # read-only fragment on a dead DN: promote its standby
+                # (coalesced across racing fragment threads) and replay
+                # the fragment there — exec_plan never mutates, so the
+                # re-dispatch cannot double-apply anything
+                dn2 = self._failover_target(where)
+                if dn2 is None:
+                    raise
+                out = dn2.exec_plan(frag.plan, self.snapshot_ts,
+                                    self.txid, self.params, sources)
         if self.instrument:
             self.stats[(frag.index, where)] = {
                 "ms": (_time.perf_counter() - t0) * 1e3,

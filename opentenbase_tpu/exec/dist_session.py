@@ -987,17 +987,23 @@ class ClusterSession:
                     lease = float(c.gucs.get("resgroup_lease_s", "30"))
                 except ValueError:
                     lease = 30.0
-                # exponential backoff: a saturated group must not
-                # hammer the GTM (GTS/commit traffic shares it)
-                delay = 0.002
+                # jittered exponential backoff (net/guard.py): a
+                # saturated group must not hammer the GTM (GTS/commit
+                # traffic shares it), and concurrent waiters must not
+                # retry in lockstep.  Timing out here is the overload
+                # arm of the guard's degradation ladder — same counter
+                # surface as the scheduler's shed path.
+                from ..net.guard import backoff_s, note_shed
+                attempt = 0
                 while not c.gtm.resq_acquire(group, cap, owner, lease):
                     if _t.monotonic() > deadline:
+                        note_shed(group or "default")
                         raise ExecError(
                             f"resource group {group!r} queue wait "
                             f"timeout ({cap} slots busy cluster-wide)")
                     self._check_cancel()
-                    _t.sleep(delay)
-                    delay = min(delay * 2, 0.1)
+                    attempt += 1
+                    _t.sleep(backoff_s(attempt, base=0.002, cap=0.1))
                 gtm_held = True
         except Exception:
             # cancel / GTM error while waiting: the admission slot

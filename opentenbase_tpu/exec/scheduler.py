@@ -375,6 +375,11 @@ class Scheduler:
 
     def _shed_item(self, item: _Item, exc: _Shed):
         _bump("shed")
+        # the overload arm of the guard's degradation ladder: a shed is
+        # "this CN is degraded by load", same surface as "that DN is
+        # degraded by failures" (otb_node_health + otb_guard_shed_total)
+        from ..net.guard import note_shed
+        note_shed(getattr(item, "group", "default") or "default")
         item.error = ExecError(str(exc))
         item.ev.set()
 

@@ -418,10 +418,13 @@ class GtmClient:
             for attempt in (0, 1):
                 try:
                     s = self._conn()
-                    send_msg(s, msg)
-                    resp = recv_msg(s)
-                    if resp is None:
-                        raise ConnectionError("gtm closed connection")
+                    # chaos points: tests arm gtm.send/gtm.recv to
+                    # simulate GTM loss without killing the server
+                    send_msg(s, msg, fault="gtm.send")
+                    # expect_reply: a close while the GTM owes an
+                    # answer is a WireError, never "no message"
+                    resp = recv_msg(s, expect_reply=True,
+                                    fault="gtm.recv")
                     if "error" in resp:
                         raise RuntimeError(f"gtm error: {resp['error']}")
                     return resp

@@ -158,14 +158,17 @@ def ship_to(host: str, port: int, timeout: float = 5.0) -> Callable:
                                                    timeout=timeout)
             try:
                 send_msg(conn[0], {"op": "replicate", "state": state})
-                resp = recv_msg(conn[0])
+                # expect_reply: the standby owes an ack — a close here
+                # is a failed ship, not an idle hangup (sync replication
+                # must never report success it didn't get)
+                resp = recv_msg(conn[0], expect_reply=True)
             except (ConnectionError, OSError):
                 try:
                     conn[0].close()
                 finally:
                     conn[0] = None
                 raise
-            if resp is None or not resp.get("ok"):
+            if not resp.get("ok"):
                 raise ConnectionError(f"standby rejected state: {resp}")
 
     return ship

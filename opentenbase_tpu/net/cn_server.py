@@ -223,9 +223,9 @@ class CnClient:
 
     def execute(self, sql: str) -> list[dict]:
         send_msg(self._sock, {"op": "query", "sql": sql})
-        resp = recv_msg(self._sock)
-        if resp is None:
-            raise ConnectionError("server closed connection")
+        # expect_reply: the server owes an answer to every query — a
+        # close here is a failed conversation, not an idle hangup
+        resp = recv_msg(self._sock, expect_reply=True)
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["ok"]
@@ -236,9 +236,7 @@ class CnClient:
     def metrics(self) -> str:
         """Fetch the server's Prometheus text exposition."""
         send_msg(self._sock, {"op": "metrics"})
-        resp = recv_msg(self._sock)
-        if resp is None:
-            raise ConnectionError("server closed connection")
+        resp = recv_msg(self._sock, expect_reply=True)
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["ok"]

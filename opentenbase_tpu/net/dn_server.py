@@ -22,6 +22,7 @@ from ..catalog.catalog import Catalog
 from ..catalog.schema import TableDef
 from ..gtm.server import GtmClient
 from ..parallel.cluster import DataNode
+from . import guard
 from .wire import recv_msg, send_msg
 
 
@@ -48,7 +49,8 @@ class DnServer:
         host_ops = {"ping", "insert_raw", "delete_where", "lock_where",
                     "prepare", "commit", "abort", "wrote_in",
                     "row_count", "table_version", "wait_edges",
-                    "gdd_kill", "savepoint_mark", "rollback_to_mark"}
+                    "gdd_kill", "savepoint_mark", "rollback_to_mark",
+                    "prepared_txns"}
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
@@ -157,6 +159,8 @@ def _dispatch(node: DataNode, msg: dict):
         return node.abort(msg["txid"])
     if op == "wrote_in":
         return node.wrote_in(msg["txid"])
+    if op == "prepared_txns":
+        return node.prepared_txns()
     if op == "inflight":
         return node.inflight()
     if op == "checkpoint":
@@ -196,87 +200,170 @@ class DnConnectionPool:
     Leasing a socket per CALL (not per session) is what lets a session
     blocked in a row-lock wait coexist with the lock holder's commit on
     the same node: each RPC rides its own connection, so a long-blocked
-    lock_where cannot starve txn-resolution traffic."""
+    lock_where cannot starve txn-resolution traffic.
 
-    def __init__(self, addr: tuple, max_conns: int = 32):
+    Every entry carries the GENERATION it was opened under; ``retire``
+    bumps the generation, so sockets warmed against a DN that has since
+    restarted are closed on their way through the pool instead of being
+    handed back (a stale socket to a restarted server fails every
+    request it carries).  Accounting is exact: leases are tracked per
+    socket, release is idempotent, and a non-pool exception between
+    send and recv can never strand a slot — so a burst of broken
+    sockets can neither leak slots nor deadlock ``acquire`` at
+    ``max_conns``."""
+
+    def __init__(self, addr: tuple, max_conns: int = 32,
+                 connect_timeout: float = 5.0):
         self.addr = addr
         self.max_conns = max_conns
-        self._free: list = []
+        self.connect_timeout = connect_timeout
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._count = 0
+        self._free: list = []    # guarded_by: _lock -- [(gen, sock)]
+        self._leased: dict = {}  # guarded_by: _lock -- sock -> gen
+        self._count = 0          # guarded_by: _lock -- open sockets
+        self.gen = 0             # guarded_by: _lock -- retirement epoch
         self.leases = 0          # observability: total acquisitions
         self.created = 0         # sockets ever opened (reuse proof)
+        self.retired = 0         # stale-generation sockets closed
+
+    def _discard_locked(self, sock):
+        self._count -= 1
+        try:
+            sock.close()
+        except OSError:
+            pass
 
     def acquire(self) -> socket.socket:
         with self._cv:
             self.leases += 1
             while True:
-                if self._free:
-                    return self._free.pop()
+                while self._free:
+                    g, s = self._free.pop()
+                    if g == self.gen:
+                        self._leased[s] = g
+                        return s
+                    # opened before the last retire(): never hand back
+                    self.retired += 1
+                    self._discard_locked(s)
                 if self._count < self.max_conns:
                     self._count += 1
+                    g = self.gen
                     break
                 self._cv.wait(1.0)
         try:
-            s = socket.create_connection(self.addr, timeout=300)
+            s = socket.create_connection(self.addr,
+                                         timeout=self.connect_timeout)
         except OSError:
             with self._cv:
                 self._count -= 1
                 self._cv.notify()
             raise
-        self.created += 1
-        return s
+        with self._cv:
+            self.created += 1
+            self._leased[s] = g
+            return s
 
     def release(self, sock: socket.socket, broken: bool = False):
         with self._cv:
-            if broken:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                self._count -= 1
+            g = self._leased.pop(sock, None)
+            if g is None:
+                # double release / foreign socket: accounting already
+                # settled, never decrement twice
+                self._cv.notify()
+                return
+            if broken or g != self.gen:
+                if g != self.gen and not broken:
+                    self.retired += 1
+                self._discard_locked(sock)
             else:
-                self._free.append(sock)
+                self._free.append((g, sock))
             self._cv.notify()
 
-    def close_all(self):
+    def retire(self):
+        """Start a new generation: every pooled socket (idle now, or
+        leased and returned later) is closed instead of reused.  Called
+        when an exchange fails at the connection level — the cheapest
+        correct response to 'that DN probably restarted'."""
         with self._cv:
-            for s in self._free:
-                try:
-                    s.close()
-                except OSError:
-                    pass
-            self._count -= len(self._free)
-            self._free.clear()
+            self.gen += 1
+            while self._free:
+                _, s = self._free.pop()
+                self.retired += 1
+                self._discard_locked(s)
             self._cv.notify_all()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"open": self._count, "free": len(self._free),
+                    "leased": len(self._leased), "gen": self.gen,
+                    "leases": self.leases, "created": self.created,
+                    "retired": self.retired}
+
+    def close_all(self):
+        self.retire()
+
+
+# ops safe to re-issue after a broken exchange: pure reads, staging,
+# and probes.  DML marking and 2PC verbs are NEVER retried here — a
+# lost commit/abort is the in-doubt resolver's job, not the RPC layer's
+# (a blind re-send could double-apply on a server that processed the
+# first copy before the connection died).
+IDEMPOTENT_OPS = frozenset({
+    "ping", "row_count", "table_version", "exec_plan", "stage_table",
+    "wait_edges", "inflight", "wrote_in", "analyze_table",
+    "prepared_txns",
+})
 
 
 class RemoteDataNode:
     """Coordinator-side proxy with DataNode's service surface
     (reference: PGXCNodeHandle, pgxcnode.c, riding the pooler's
-    per-node connection slots)."""
+    per-node connection slots).  All calls flow through net/guard.py:
+    per-op deadline, breaker admission, and — for IDEMPOTENT_OPS —
+    bounded retry with jittered backoff."""
 
     def __init__(self, index: int, host: str, port: int):
         self.index = index
         self.addr = (host, port)
         self.pool = DnConnectionPool((host, port))
+        # guard state is keyed by ADDRESS so every proxy and probe to
+        # one server shares a breaker, while a promoted standby (new
+        # port) starts clean
+        self.guard_key = f"dn{index}@{host}:{port}"
+        # chaos points are keyed by INDEX: tests arm dn1.send without
+        # knowing the ephemeral port
+        self._fault_send = f"dn{index}.send"
+        self._fault_recv = f"dn{index}.recv"
 
     def _call(self, **msg):
+        op = msg.get("op", "")
+        return guard.guarded(self.guard_key,
+                             lambda: self._call_once(msg),
+                             idempotent=op in IDEMPOTENT_OPS, op=op)
+
+    def _call_once(self, msg):
         sock = self.pool.acquire()
+        broken = True   # assume the worst; cleared on a clean exchange
         try:
-            send_msg(sock, msg)
-            resp = recv_msg(sock)
+            sock.settimeout(guard.rpc_deadline())
+            send_msg(sock, msg, fault=self._fault_send)
+            # expect_reply: a close here is a broken conversation, never
+            # "no message" (the server owes an answer to every request)
+            resp = recv_msg(sock, expect_reply=True,
+                            fault=self._fault_recv)
+            broken = False
         except (ConnectionError, OSError, EOFError):
-            # never reuse a socket after a failed exchange: a late
-            # response would desync the protocol (stale answer to the
-            # next request)
-            self.pool.release(sock, broken=True)
+            # a connection-level failure usually means the DN died or
+            # restarted: retire the generation so warm-but-stale
+            # sockets are not handed to the next caller
+            self.pool.retire()
             raise
-        if resp is None:
-            self.pool.release(sock, broken=True)
-            raise ConnectionError(f"dn{self.index} closed connection")
-        self.pool.release(sock)
+        finally:
+            # exactly-once accounting even for non-connection errors
+            # (e.g. an unpicklable payload): a desynced socket is never
+            # reused, and the slot can never leak
+            self.pool.release(sock, broken=broken)
         if "error" in resp:
             et = resp.get("etype", "")
             # concurrency-control errors keep their type across the
@@ -357,6 +444,9 @@ class RemoteDataNode:
 
     def wrote_in(self, txid):
         return self._call(op="wrote_in", txid=txid)
+
+    def prepared_txns(self):
+        return self._call(op="prepared_txns")
 
     def checkpoint(self, _catalog=None):
         return self._call(op="checkpoint")

@@ -1,0 +1,414 @@
+"""otbguard — cluster-wide RPC fault tolerance for the coordinator.
+
+Reference analog: the CN's connection handling towards DNs/GTM —
+pgxc_node_send timeouts + pgxc_node_receive retry loops (pgxcnode.c),
+the cluster-monitor health map (clustermon.c) feeding pgxc_node, and
+the clean2pc launcher/workers that drive in-doubt prepared txns to a
+verdict.  Re-designed here as one explicit degradation ladder:
+
+    up ── call failures / probe misses ──> degraded (retries, backoff)
+       ── consecutive-failure threshold ─> down (breaker OPEN: fail fast)
+       ── cooldown elapses ─────────────> half-open (ONE probe admitted)
+       ── probe succeeds ───────────────> up (breaker closes)
+
+plus the overload arm: the scheduler's shed path reports here, so
+"server too busy" and "server unreachable" read off one surface
+(``otb_node_health``).
+
+Pieces:
+- ``CircuitBreaker`` / ``NodeGuard`` — per-node state keyed by address,
+  shared by every proxy/probe to that node in the process.
+- ``guarded(key, fn, idempotent=...)`` — the RPC wrapper: breaker
+  admission, per-attempt outcome recording, bounded exponential backoff
+  with jitter for idempotent ops (reads, stage, metrics — NEVER raw 2PC
+  commit sends: those are redelivered by the resolver instead).
+- ``GtmGuard`` — wraps any GTM handle (client or in-process core) with
+  the same guard; on hard loss with a registered ``GtmStandby``,
+  promotes it in place (lease/slot state carried over when reachable).
+- ``IndoubtResolver`` — background sweeper driving every prepared-but-
+  undecided gid (crash at any ``faultinject.POINTS`` window) to a
+  converged commit/abort via ``Cluster.resolve_indoubt``.
+
+Every decision increments a counter in ``obs.metrics.REGISTRY`` so the
+whole ladder is visible in ``otb_metrics`` / Prometheus exposition and
+the ``otb_node_health`` stat view.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+from ..obs.metrics import REGISTRY
+
+
+class GuardError(ConnectionError):
+    pass
+
+
+class CircuitOpen(GuardError):
+    """Fail-fast rejection: the node's breaker is open (or its one
+    half-open probe slot is taken)."""
+
+
+# errors that mean "the conversation broke", not "the statement failed":
+# only these are retried / counted against the breaker.  WireError and
+# socket.timeout are both OSError/ConnectionError subclasses.
+RETRYABLE = (ConnectionError, OSError, EOFError)
+
+
+# ---------------------------------------------------------------------------
+# knobs (env-tunable; read per call so tests can flip them)
+# ---------------------------------------------------------------------------
+
+def rpc_deadline() -> float:
+    """Per-op socket deadline in seconds (OTB_RPC_TIMEOUT)."""
+    try:
+        return float(os.environ.get("OTB_RPC_TIMEOUT", "") or 300.0)
+    except ValueError:
+        return 300.0
+
+
+def rpc_retries() -> int:
+    """Max retry attempts for IDEMPOTENT ops (OTB_RPC_RETRIES)."""
+    try:
+        return int(os.environ.get("OTB_RPC_RETRIES", "") or 2)
+    except ValueError:
+        return 2
+
+
+def _breaker_threshold() -> int:
+    try:
+        return int(os.environ.get("OTB_BREAKER_THRESHOLD", "") or 5)
+    except ValueError:
+        return 5
+
+
+def _breaker_cooldown() -> float:
+    try:
+        return float(os.environ.get("OTB_BREAKER_COOLDOWN", "") or 1.0)
+    except ValueError:
+        return 1.0
+
+
+def backoff_s(attempt: int, base: float = 0.05, cap: float = 1.0) -> float:
+    """Bounded exponential backoff with jitter (full-jitter variant:
+    uniformly in [cap/2, cap] of the exponential bound, so retry storms
+    from concurrent sessions decorrelate)."""
+    bound = min(cap, base * (2.0 ** max(attempt - 1, 0)))
+    return bound * (0.5 + random.random() / 2.0)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Consecutive-failure trip, half-open single-flight probe."""
+
+    def __init__(self, key: str, threshold: Optional[int] = None,
+                 cooldown_s: Optional[float] = None):
+        self.key = key
+        self.threshold = threshold if threshold is not None \
+            else _breaker_threshold()
+        self.cooldown_s = cooldown_s if cooldown_s is not None \
+            else _breaker_cooldown()
+        self._lock = threading.Lock()
+        self._state = "closed"   # guarded_by: _lock
+        self._fails = 0          # guarded_by: _lock
+        self._opened_at = 0.0    # guarded_by: _lock
+        self._probing = False    # guarded_by: _lock
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._fails
+
+    def admit(self) -> None:
+        """Gate one call.  Raises CircuitOpen while the node is down;
+        after the cooldown, admits exactly ONE caller as the half-open
+        probe (everyone else keeps failing fast until its verdict)."""
+        with self._lock:
+            if self._state == "closed":
+                return
+            if self._state == "open":
+                if time.monotonic() - self._opened_at < self.cooldown_s:
+                    raise CircuitOpen(
+                        f"{self.key}: circuit open (cooling down)")
+                self._state = "half_open"
+                self._probing = True
+                REGISTRY.counter("otb_guard_breaker_halfopen_total",
+                                 node=self.key).inc()
+                return          # this caller is the probe
+            # half_open: single-flight
+            if self._probing:
+                raise CircuitOpen(
+                    f"{self.key}: half-open probe in flight")
+            self._probing = True
+
+    def ok(self) -> None:
+        with self._lock:
+            self._fails = 0
+            self._probing = False
+            self._state = "closed"
+
+    def fail(self) -> None:
+        with self._lock:
+            self._fails += 1
+            now = time.monotonic()
+            if self._state == "half_open":
+                # the probe failed: back to open, restart the cooldown
+                self._state = "open"
+                self._opened_at = now
+                self._probing = False
+            elif self._state == "closed" and \
+                    self._fails >= self.threshold:
+                self._state = "open"
+                self._opened_at = now
+                REGISTRY.counter("otb_guard_breaker_trips_total",
+                                 node=self.key).inc()
+
+
+# ---------------------------------------------------------------------------
+# per-node guard registry (process-global: every proxy/probe to one
+# address shares one breaker + health record)
+# ---------------------------------------------------------------------------
+
+class NodeGuard:
+    def __init__(self, key: str):
+        self.key = key
+        self.breaker = CircuitBreaker(key)
+        self._lock = threading.Lock()
+        self.retries = 0         # guarded_by: _lock
+        self.last_ok = 0.0       # guarded_by: _lock
+        self.last_fail = 0.0     # guarded_by: _lock
+        self.last_error = ""     # guarded_by: _lock
+        self.last_shed = 0.0     # guarded_by: _lock
+
+    def note_success(self) -> None:
+        with self._lock:
+            self.last_ok = time.monotonic()
+        self.breaker.ok()
+
+    def note_failure(self, err: BaseException) -> None:
+        with self._lock:
+            self.last_fail = time.monotonic()
+            self.last_error = f"{type(err).__name__}: {err}"
+        self.breaker.fail()
+
+    def note_retry(self, op: str) -> None:
+        with self._lock:
+            self.retries += 1
+        REGISTRY.counter("otb_guard_retries_total", node=self.key).inc()
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self.last_shed = time.monotonic()
+
+    def state(self) -> str:
+        """The degradation-ladder position: down (breaker open),
+        degraded (probing, recent failures, or load shedding), up."""
+        bs = self.breaker.state
+        if bs == "open":
+            return "down"
+        now = time.monotonic()
+        with self._lock:
+            recent_fail = self.last_fail and now - self.last_fail < 10.0 \
+                and self.last_fail >= self.last_ok
+            recent_shed = self.last_shed and now - self.last_shed < 10.0
+        if bs == "half_open" or recent_fail or recent_shed:
+            return "degraded"
+        return "up"
+
+
+_GUARDS: dict[str, NodeGuard] = {}   # guarded_by: _GUARDS_LOCK
+_GUARDS_LOCK = threading.Lock()
+
+
+def guard_for(key: str) -> NodeGuard:
+    with _GUARDS_LOCK:
+        g = _GUARDS.get(key)
+        if g is None:
+            g = _GUARDS[key] = NodeGuard(key)
+        return g
+
+
+def reset(key: str = None) -> None:
+    """Drop guard state (tests; also used when a node is replaced by a
+    promoted standby — the new address starts with a clean slate)."""
+    with _GUARDS_LOCK:
+        if key is None:
+            _GUARDS.clear()
+        else:
+            _GUARDS.pop(key, None)
+
+
+def health_rows():
+    """(node, state, breaker, consecutive_failures, retries,
+    last_error) — the otb_node_health stat view's backing rows."""
+    with _GUARDS_LOCK:
+        guards = sorted(_GUARDS.items())
+    return [(k, g.state(), g.breaker.state,
+             g.breaker.consecutive_failures, g.retries, g.last_error)
+            for k, g in guards]
+
+
+def note_shed(group: str) -> None:
+    """Overload arm of the ladder: the scheduler shed a query.  Counts
+    toward otb_guard_shed_total and marks the scheduler node degraded
+    in otb_node_health."""
+    REGISTRY.counter("otb_guard_shed_total", group=group).inc()
+    guard_for("scheduler").note_shed()
+
+
+def note_failover(kind: str) -> None:
+    REGISTRY.counter("otb_guard_failovers_total", kind=kind).inc()
+
+
+# ---------------------------------------------------------------------------
+# the RPC wrapper
+# ---------------------------------------------------------------------------
+
+def guarded(key: str, fn, idempotent: bool = False,
+            retries: Optional[int] = None, op: str = ""):
+    """Run one RPC attempt function under the node's guard: breaker
+    admission first (CircuitOpen fails fast while the node is down),
+    then the call; connection-class failures count against the breaker
+    and — for idempotent ops only — retry with jittered backoff."""
+    g = guard_for(key)
+    budget = (retries if retries is not None else rpc_retries()) \
+        if idempotent else 0
+    attempt = 0
+    while True:
+        g.breaker.admit()
+        try:
+            out = fn()
+        except RETRYABLE as e:
+            g.note_failure(e)
+            if attempt < budget:
+                attempt += 1
+                g.note_retry(op)
+                time.sleep(backoff_s(attempt))
+                continue
+            raise
+        g.note_success()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# GTM guard: same ladder + standby promotion on hard loss
+# ---------------------------------------------------------------------------
+
+class GtmGuard:
+    """Transparent wrapper over a GTM handle (GtmClient or in-process
+    GtmCore).  Every method call flows through ``guarded``; when the
+    target is lost past retries AND a ``GtmStandby`` is registered, the
+    standby is promoted in place (reference: gtm_ctl promote driven by
+    gtm_standby's heartbeat).  Slot/lease state transfers when the old
+    handle is still readable (in-process); a remote corpse's leases
+    self-expire and re-acquire against the promoted core."""
+
+    _LOCAL = ("_target", "_standby", "_key", "_plock")
+
+    def __init__(self, target, standby=None, key: str = "gtm"):
+        object.__setattr__(self, "_target", target)
+        object.__setattr__(self, "_standby", standby)
+        object.__setattr__(self, "_key", key)
+        object.__setattr__(self, "_plock", threading.Lock())
+
+    # -- delegation -----------------------------------------------------
+    def __getattr__(self, name):
+        attr = getattr(self._target, name)
+        if not callable(attr):
+            return attr
+
+        def call(*a, **kw):
+            return self._invoke(name, *a, **kw)
+        return call
+
+    def __setattr__(self, name, value):
+        if name in GtmGuard._LOCAL:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._target, name, value)
+
+    # -- guts -----------------------------------------------------------
+    def _invoke(self, name, *a, **kw):
+        def attempt():
+            return getattr(self._target, name)(*a, **kw)
+        try:
+            # GTM ops are registry updates / timestamp allocations:
+            # re-issuing any of them is safe (a retried gts burns a
+            # timestamp; a retried prepare/commit re-records the same
+            # verdict), so the whole surface is retry-eligible.
+            return guarded(self._key, attempt, idempotent=True,
+                           op=name)
+        except RETRYABLE:
+            if self._standby is None:
+                raise
+            self._promote()
+            return guarded(self._key, attempt, idempotent=True,
+                           op=name)
+
+    def _promote(self):
+        with self._plock:
+            sb = self._standby
+            if sb is None:
+                return           # another caller already promoted
+            old = self._target
+            core = sb.promote()
+            # lease/slot carry-over: reachable (in-process) old cores
+            # hand their resource-queue slots to the successor so
+            # admission state survives the failover; a dead remote's
+            # leases expire on their own clock
+            resq = getattr(old, "_resq", None)
+            if resq is not None and hasattr(core, "_resq"):
+                try:
+                    core._resq.update(resq)
+                except Exception:
+                    pass
+            object.__setattr__(self, "_target", core)
+            object.__setattr__(self, "_standby", None)
+            reset(self._key)     # the promoted core starts clean
+            note_failover("gtm")
+
+
+# ---------------------------------------------------------------------------
+# in-doubt 2PC resolver (reference: clean2pc launcher + workers)
+# ---------------------------------------------------------------------------
+
+class IndoubtResolver(threading.Thread):
+    """Background sweeper: periodically walks the GTM's prepared_list
+    plus each DN's orphaned-prepared set and drives every in-doubt gid
+    to a converged commit/abort (Cluster.resolve_indoubt does the
+    actual redelivery/presumed-abort; this thread is the cadence + the
+    crash-safety loop around it)."""
+
+    def __init__(self, cluster, period_s: float = 1.0,
+                 grace_s: float = 5.0):
+        super().__init__(daemon=True, name="otb-indoubt-resolver")
+        self.cluster = cluster
+        self.period_s = period_s
+        self.grace_s = grace_s
+        self.sweeps = 0
+        self.last_error = ""
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(self.period_s):
+            try:
+                self.cluster.resolve_indoubt(orphan_grace_s=self.grace_s)
+                self.sweeps += 1
+            except Exception as e:   # a flaky node must not kill the sweeper
+                self.last_error = f"{type(e).__name__}: {e}"
+
+    def stop(self):
+        self._stop.set()

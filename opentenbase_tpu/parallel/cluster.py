@@ -10,6 +10,7 @@ form (net/dn_server.py) wraps the same DataNode behind a TCP protocol.
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional
 
 import numpy as np
@@ -44,6 +45,12 @@ class DataNode:
         self.datadir = datadir
         self.wal: Optional[Wal] = None
         self.txn_spans: dict[int, list] = {}  # txid -> [(kind, table, span)]
+        # gid -> (txid, prepared_at): live prepared txns awaiting their
+        # verdict.  The resolver sweeps these to catch the window where
+        # DNs prepared but the GTM was never told (coordinator death at
+        # REMOTE_PREPARE_AFTER_SEND) — presumed abort after a grace
+        # period, exactly the reference's clean2pc rule.
+        self.prepared_gids: dict[str, tuple] = {}
         # row-lock waits + wait-for edges (storage/lockmgr.py)
         self.lockmgr = LockManager()
         self.lock_timeout = 10.0
@@ -383,9 +390,23 @@ class DataNode:
 
     def prepare(self, gid: str, txid: int):
         self.log({"op": "prepare", "gid": gid, "txid": txid}, sync=True)
+        self.prepared_gids[gid] = (txid, time.monotonic())
+
+    def _forget_prepared(self, txid: int):
+        for g, (t, _) in list(self.prepared_gids.items()):
+            if t == txid:
+                del self.prepared_gids[g]
+
+    def prepared_txns(self) -> dict:
+        """Live prepared-but-undecided txns: gid -> {txid, age_s}
+        (resolver surface; reference: pg_prepared_xacts per node)."""
+        now = time.monotonic()
+        return {g: {"txid": t, "age_s": now - at}
+                for g, (t, at) in self.prepared_gids.items()}
 
     def commit(self, txid: int, ts: int):
         self.log({"op": "commit", "txid": txid, "ts": int(ts)}, sync=True)
+        self._forget_prepared(txid)
         for kind, table, sp in self.txn_spans.pop(txid, []):
             st = self.stores.get(table)
             if st is None:
@@ -403,6 +424,7 @@ class DataNode:
 
     def abort(self, txid: int):
         ops = self.txn_spans.pop(txid, [])
+        self._forget_prepared(txid)
         if ops:
             self.log({"op": "abort", "txid": txid})
         for kind, table, sp in ops:
@@ -698,6 +720,11 @@ class Cluster:
         self.audit = AuditLogger(audit_path)
         self._gdd = None
         self._monitor = None
+        self._resolver = None
+        # read-failover serialization: concurrent fragment threads that
+        # all hit the same dead DN coalesce into ONE promotion
+        self._failover_lock = threading.Lock()
+        self._promoted_at: dict[int, float] = {}
         # restart survival: persisted catalog.jobs resume scheduling as
         # soon as the cluster initializes, not only on CREATE JOB
         from .jobs import resume_jobs
@@ -902,7 +929,32 @@ class Cluster:
         nd.standby = None
         self._save_catalog()
         self.ddl_gen = getattr(self, "ddl_gen", 0) + 1
+        from ..net.guard import note_failover
+        note_failover("dn")
+        self._promoted_at[dn_index] = time.monotonic()
         return promoted
+
+    def failover_read(self, dn_index: int):
+        """Re-resolve `dn_index` for a READ re-dispatch after a
+        connection failure: promote its registered standby (threads
+        racing on the same dead DN coalesce into one promotion) and
+        return the replacement proxy, or None when no standby exists.
+        Only safe for reads — an executor retries the fragment on the
+        promoted node; writes go through 2PC + the resolver instead."""
+        with self._failover_lock:
+            nd = next((n for n in self.catalog.datanodes()
+                       if n.index == dn_index), None)
+            if nd is None:
+                return None
+            sb = nd.standby
+            if sb and sb.get("datadir"):
+                self.auto_failover(dn_index)
+                return self.datanodes[dn_index]
+            # no standby registered NOW — if a concurrent thread just
+            # promoted one, the current proxy is already the successor
+            if dn_index in self._promoted_at:
+                return self.datanodes[dn_index]
+            return None
 
     def create_table(self, td: TableDef, if_not_exists: bool = False):
         td = self.catalog.create_table(td, if_not_exists)
@@ -1017,12 +1069,23 @@ class Cluster:
         ts = int(self.gtm.next_gts())
         self.gtm.commit_txn(gid, ts)
         fault_point("AFTER_GTM_COMMIT_BEFORE_DN")
+        # past the GTM commit record the txn IS committed: a DN that
+        # cannot take delivery right now does not un-commit it.  Keep
+        # fanning out to the others, leave the gid registered, and let
+        # the in-doubt resolver redeliver (reference: 2PC commit sends
+        # are never retried inline — execRemote.c hands stragglers to
+        # clean2pc).  Raw send failures are therefore survivable here.
+        undelivered = []
         for k, i in enumerate(dns):
             if k == 1:
                 fault_point("REMOTE_COMMIT_PARTIAL")
-            self.datanodes[i].commit(txid, ts)
+            try:
+                self.datanodes[i].commit(txid, ts)
+            except (ConnectionError, OSError, EOFError):
+                undelivered.append(i)
         fault_point("BEFORE_GTM_FORGET")
-        self.gtm.forget_txn(gid)
+        if not undelivered:
+            self.gtm.forget_txn(gid)
         self.active_txns.discard(txid)
         # the decoders have seen this commit by now: the origin tag has
         # served its purpose (bounded set, not a leak)
@@ -1054,6 +1117,19 @@ class Cluster:
             self._subscriptions = {}
         return self._subscriptions
 
+    # ---- GTM failover: guard wrap + standby promotion on loss ----
+    def attach_gtm_standby(self, standby):
+        """Wrap the GTM handle in the guard: deadlines/retry/breaker on
+        every GTM op, and on hard loss the given GtmStandby promotes in
+        place — queries keep allocating timestamps past the failover
+        (reference: gtm_standby promotion driven by gtm_ctl)."""
+        from ..net.guard import GtmGuard
+        if not isinstance(self.gtm, GtmGuard):
+            self.gtm = GtmGuard(self.gtm, standby=standby)
+        else:
+            self.gtm._standby = standby
+        return self.gtm
+
     # ---- failover (reference: pg_ctl promote + pgxc_ctl failover) ----
     def promote_standby(self, dn_index: int, standby_datadir: str):
         """Replace a (dead) datanode with its promoted standby: normal
@@ -1075,7 +1151,19 @@ class Cluster:
                 return dn
         return None
 
-    def resolve_indoubt(self):
+    def ensure_resolver(self, period_s: float = 1.0,
+                        grace_s: float = 5.0):
+        """Start the background in-doubt sweeper (reference: the
+        clean2pc launcher — one per coordinator, walking the GTM's
+        prepared registry plus each DN's orphaned prepares)."""
+        if getattr(self, "_resolver", None) is None:
+            from ..net.guard import IndoubtResolver
+            self._resolver = IndoubtResolver(self, period_s=period_s,
+                                             grace_s=grace_s)
+            self._resolver.start()
+        return self._resolver
+
+    def resolve_indoubt(self, orphan_grace_s: float = 5.0) -> dict:
         """Resolve prepared-but-undecided global txns; still-'prepared'
         ones are presumed aborted.  A 'committed' gid is only forgotten
         after the commit has been re-delivered to EVERY participant: a
@@ -1083,11 +1171,22 @@ class Cluster:
         recovers after the forget would get verdict 'unknown' and
         presume-abort a committed txn (advisor r1).  Delivery is
         idempotent (DataNode.commit replays as a no-op when already
-        applied)."""
+        applied).
+
+        Second sweep: DN-side ORPHANED prepares — gids a datanode holds
+        prepared but the GTM has no record of (coordinator died between
+        the DN prepares and the GTM registration).  Presumed abort once
+        older than `orphan_grace_s` (the grace keeps the sweeper off
+        the back of healthy in-flight 2PCs mid-window).
+
+        Returns {"committed": n, "aborted": n} resolved this pass."""
+        from ..obs.metrics import REGISTRY
+        resolved = {"committed": 0, "aborted": 0}
         done = getattr(self, "_redelivered", None)
         if done is None:
             done = self._redelivered = set()  # (gid, participant) acked
-        for gid, info in list(self.gtm.prepared_list().items()):
+        registered = self.gtm.prepared_list()
+        for gid, info in list(registered.items()):
             if info["state"] == "committed":
                 ts = int(info["commit_ts"])
                 delivered = True
@@ -1107,6 +1206,7 @@ class Cluster:
                         delivered = False
                 if delivered:
                     self.gtm.forget_txn(gid)
+                    resolved["committed"] += 1
                     # prune acks: a reused gid must re-deliver, and the
                     # set must not grow for the cluster's lifetime
                     self._redelivered = {e for e in done if e[0] != gid}
@@ -1121,3 +1221,38 @@ class Cluster:
                         aborted_all = False
                 if aborted_all:
                     self.gtm.forget_txn(gid)
+                    resolved["aborted"] += 1
+        # ---- orphaned prepares (GTM never told) ----
+        orphans: dict[str, int] = {}
+        for dn in self.datanodes:
+            try:
+                plist = dn.prepared_txns()
+            except (ConnectionError, OSError, EOFError, RuntimeError,
+                    AttributeError):
+                continue   # unreachable / pre-upgrade node: next pass
+            for gid, ent in plist.items():
+                if gid in registered:
+                    continue   # GTM-owned: handled above
+                if ent["age_s"] >= orphan_grace_s:
+                    orphans[gid] = ent["txid"]
+        for gid, txid in orphans.items():
+            verdict = "unknown"
+            try:
+                verdict = self.gtm.txn_verdict(gid)
+            except (ConnectionError, OSError, EOFError, RuntimeError):
+                continue       # can't consult the authority: next pass
+            if verdict == "unknown":
+                aborted_all = True
+                for dn in self.datanodes:
+                    try:
+                        dn.abort(txid)
+                    except (ConnectionError, OSError, EOFError,
+                            RuntimeError):
+                        aborted_all = False
+                if aborted_all:
+                    resolved["aborted"] += 1
+        for verdict, n in resolved.items():
+            if n:
+                REGISTRY.counter("otb_guard_indoubt_resolved_total",
+                                 verdict=verdict).inc(n)
+        return resolved

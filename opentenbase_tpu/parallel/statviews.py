@@ -116,6 +116,16 @@ STAT_TABLES = {
         ColumnDef("bytes_staged", T.INT64),
         ColumnDef("bytes_materialized", T.INT64),
         ColumnDef("pool_hits", T.INT64), ColumnDef("pool_misses", T.INT64)],
+    # per-node guard health (net/guard.py): breaker state + failure
+    # accounting for every RPC peer this coordinator talks to
+    # (reference: pgxc_node health columns fed by clustermon pings;
+    # here the accounting is call-outcome-driven, no probe traffic)
+    "otb_node_health": [
+        ColumnDef("node", T.TEXT), ColumnDef("state", T.TEXT),
+        ColumnDef("breaker", T.TEXT),
+        ColumnDef("consec_failures", T.INT64),
+        ColumnDef("retries", T.INT64),
+        ColumnDef("last_error", T.TEXT)],
     # the unified metrics registry (obs/metrics.py): every native
     # counter/gauge/histogram sample plus every registered subsystem
     # collector, flattened to (name, labels, kind, value) — the SQL
@@ -212,6 +222,9 @@ def refresh(cluster, names: list[str]):
                     s["rows"], s["bytes_staged"],
                     s["bytes_materialized"], s["pool_hits"],
                     s["pool_misses"]))
+        elif name == "otb_node_health":
+            from ..net.guard import health_rows
+            rows = list(health_rows())
         elif name == "otb_metrics":
             from ..obs.metrics import REGISTRY
             rows = list(REGISTRY.rows())

@@ -142,8 +142,11 @@ class WalShip:
         with self._lock:
             try:
                 s = self._conn()
-                send_msg(s, msg)
-                resp = recv_msg(s)
+                # chaos point standby.ship; expect_reply: a standby
+                # that hangs up while it owes an ack is a failed ship
+                # (sync replication must not mistake it for success)
+                send_msg(s, msg, fault="standby.ship")
+                resp = recv_msg(s, expect_reply=True)
             except (ConnectionError, OSError):
                 try:
                     if self._sock is not None:
@@ -151,7 +154,7 @@ class WalShip:
                 finally:
                     self._sock = None
                 raise
-            if resp is None or not resp.get("ok"):
+            if not resp.get("ok"):
                 raise ConnectionError(f"standby rejected: {resp}")
 
     def frame(self, frame: bytes) -> None:

@@ -1,10 +1,19 @@
-"""Fault injection — named crash/error points in distributed-txn windows.
+"""Fault injection — named crash/error points in distributed-txn windows,
+plus a wire-level chaos layer for connection faults.
 
 Reference analog: src/backend/utils/xact_whitebox — named stub points
 covering every 2PC failure mode (xact_whitebox_stubnames.c:
 REMOTE_PREPARE_SEND_ALL_FAILED, REMOTE_COMMIT_SEND_ALL_FAILED, ...),
 toggled by config.  Tests arm a point; the code path calls
 `fault_point(name)` which raises InjectedFault when armed.
+
+The wire layer generalizes the same arm/fire contract to CONNECTION
+faults: a named point (e.g. ``dn0.send``, ``gtm.recv``) armed with a
+mode — drop (message silently lost), delay (sleep then proceed), close
+(socket torn down mid-conversation), garble (payload corrupted so the
+peer sees a checksum mismatch) — fires once-or-N-times at the matching
+``net/wire.py`` call site.  This is what lets tier-1 tests prove
+deadline/retry/breaker/failover behavior without real process kills.
 """
 
 from __future__ import annotations
@@ -13,6 +22,7 @@ import os
 import threading
 
 _armed: dict[str, int] = {}   # guarded_by: _lock
+_wire_armed: dict[str, dict] = {}   # guarded_by: _lock
 _lock = threading.Lock()
 
 # the 2PC windows (named after the reference's stub points)
@@ -53,6 +63,46 @@ def fault_point(point: str):
             if _armed[point] == 0:
                 del _armed[point]
             raise InjectedFault(point)
+
+
+# ---------------------------------------------------------------------------
+# wire-level chaos (armed per test; consulted by net/wire.py)
+# ---------------------------------------------------------------------------
+
+WIRE_MODES = ("drop", "delay", "close", "garble")
+
+
+def arm_wire(point: str, mode: str = "close", times: int = 1,
+             delay_s: float = 0.0):
+    """Arm a connection fault at a named wire point.  `point` is chosen
+    by the call site (``dn<i>.send``/``dn<i>.recv``, ``gtm.send``, ...);
+    the fault fires on the next `times` messages through that point."""
+    if mode not in WIRE_MODES:
+        raise ValueError(f"unknown wire fault mode {mode!r}")
+    with _lock:
+        _wire_armed[point] = {"mode": mode, "times": int(times),
+                              "delay_s": float(delay_s)}
+
+
+def disarm_wire(point: str = None):
+    with _lock:
+        if point is None:
+            _wire_armed.clear()
+        else:
+            _wire_armed.pop(point, None)
+
+
+def wire_action(point: str):
+    """Consume one armed firing at `point` -> {"mode", "delay_s"} or
+    None.  Decrements the remaining count (the arm self-disarms at 0)."""
+    with _lock:
+        ent = _wire_armed.get(point)
+        if ent is None:
+            return None
+        ent["times"] -= 1
+        if ent["times"] <= 0:
+            del _wire_armed[point]
+        return {"mode": ent["mode"], "delay_s": ent["delay_s"]}
 
 
 def _arm_from_env():

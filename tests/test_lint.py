@@ -272,6 +272,91 @@ class TestObsPurityPass:
         assert _scan(tmp_path, "obs-purity") == []
 
 
+class TestNetDeadlinePass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/net/__init__.py": "",
+        "fixpkg/net/wire.py": """\
+            # the frame codec: raw socket I/O is its job
+            def recv_exact(sock, n):
+                buf = b""
+                while len(buf) < n:
+                    buf += sock.recv(n - len(buf))
+                return buf
+
+            def send_msg(sock, blob):
+                sock.sendall(blob)
+        """,
+        "fixpkg/net/client.py": """\
+            import socket
+            from .wire import send_msg
+
+            def connect_bad(addr):
+                return socket.create_connection(addr)  # no deadline
+
+            def connect_good(addr):
+                return socket.create_connection(addr, timeout=5.0)
+
+            def call_bad(sock, blob):
+                sock.sendall(blob)        # raw I/O outside the codec
+                return sock.recv(4096)    # ditto
+
+            def call_good(sock, blob):
+                send_msg(sock, blob)
+
+            def unbound_bad(sock):
+                sock.settimeout(None)     # deadline disabled
+
+            def rearm_good(sock):
+                sock.settimeout(30.0)
+        """,
+    }
+
+    def test_violation_and_clean_twin(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"net-deadline"})
+        got = sorted((f["file"], f["symbol"])
+                     for f in report["findings"])
+        # wire.py (the codec) is exempt; client.py trips once per bad
+        # site: connect without timeout, raw sendall, raw recv,
+        # settimeout(None)
+        assert got == [("fixpkg/net/client.py", "call_bad"),
+                       ("fixpkg/net/client.py", "call_bad"),
+                       ("fixpkg/net/client.py", "connect_bad"),
+                       ("fixpkg/net/client.py", "unbound_bad")], got
+
+    def test_pragma_suppresses(self, tmp_path):
+        files = dict(self.FILES)
+        files["fixpkg/net/client.py"] = files[
+            "fixpkg/net/client.py"].replace(
+            "# no deadline", "# otblint: disable=net-deadline").replace(
+            "# raw I/O outside the codec",
+            "# otblint: disable=net-deadline").replace(
+            "# ditto", "# otblint: disable=net-deadline").replace(
+            "# deadline disabled", "# otblint: disable=net-deadline")
+        _write_pkg(tmp_path, files)
+        assert _scan(tmp_path, "net-deadline") == []
+
+    def test_out_of_scope_module_silent(self, tmp_path):
+        # raw socket use outside net//gtm//replication is not this
+        # rule's business (e.g. a test helper or the bench driver)
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/utils/__init__.py": "",
+            "fixpkg/utils/probe.py": """\
+                import socket
+
+                def poke(addr):
+                    s = socket.create_connection(addr)
+                    s.sendall(b"x")
+                    return s.recv(1)
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        assert _scan(tmp_path, "net-deadline") == []
+
+
 # ---------------------------------------------------------------------------
 # HLO text scan (no jax export involved)
 # ---------------------------------------------------------------------------
