@@ -1,0 +1,1277 @@
+"""otbrace: concurrency-soundness passes.
+
+Reference analog: PostgreSQL avoids LWLock deadlock by construction —
+every lock has a rank (lwlock.c) and acquisition order is a reviewed
+invariant, with ``LOCK_DEBUG`` builds asserting it at runtime.  This
+module is the same discipline for the engine's threaded surface:
+
+lock-order
+    Build the whole-repo lock-acquisition graph: an edge A->B whenever
+    code can acquire B while holding A.  Edges come from lexically
+    nested ``with lock:`` scopes (including ``with a, b:`` multi-item
+    and bare ``.acquire()``/``.release()`` pairs), from ``# holds:``
+    contracts on defs, and interprocedurally from the callgraph: a call
+    made while holding A contributes A -> every lock in the callee's
+    transitive lock footprint.  A cycle in the graph is a potential
+    deadlock; the finding shows each edge's witnessing file:line.
+    The pass also cross-checks ``analysis/lock_order.json`` — edges
+    witnessed at runtime by the ``utils/locks.py`` sanitizer — and
+    fails if the static graph is not a superset (no phantom baseline).
+
+lock-blocking
+    Inside a held-lock region, flag operations that can stall every
+    other thread queued on that lock: unbounded lock/condition waits
+    and thread joins (deadlock-capable — the awaited thread may need
+    the held lock), and RPC/socket ops, ``time.sleep``,
+    ``subprocess``, and device syncs (latency — the serving tier's
+    tail-latency killer).
+
+lock-atomicity
+    For ``# guarded_by:`` containers: a check-then-act split across a
+    lock release (read outside the region that performs the write,
+    with no re-validation inside it) and guarded-container escape
+    (returning/yielding the container or a live view of it instead of
+    a copy — the receiver iterates it unlocked).
+
+thread-daemon
+    ``threading.Thread``/``Timer`` created in library code without
+    ``daemon=True`` or an owned ``join()`` path leaks a non-daemon
+    thread that hangs interpreter exit.
+
+Lock identity is CANONICAL NAMES shared with the runtime sanitizer:
+engine locks are created via ``locks.Lock("exec.plancache._LOCK")``
+and the registry below prefers that literal string, so a runtime
+witnessed edge and a static edge over the same locks agree by
+construction.  Locks not created through the factories fall back to a
+derived ``<short-module>[.<Class>].<name>`` spelling.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Optional
+
+from .callgraph import TracedClosure
+from .core import Finding, FuncInfo, Project
+from .passes import _Emitter, _dotted, _func_locals
+
+#: subtrees whose functions get blocking/atomicity findings (the
+#: lock-order graph itself spans the whole package)
+THREAD_TREES = ("exec", "storage", "gtm", "net", "utils", "obs",
+                "catalog", "parallel")
+
+_LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore")
+_COPY_CALLS = frozenset({"list", "dict", "tuple", "set", "frozenset",
+                         "sorted", "copy", "deepcopy"})
+_LIVE_VIEWS = frozenset({"values", "keys", "items"})
+_READ_METHODS = frozenset({"get", "items", "keys", "values", "copy"})
+_MUTATORS = frozenset({"append", "add", "update", "pop", "clear",
+                       "setdefault", "extend", "remove", "discard",
+                       "insert", "popitem", "appendleft", "popleft"})
+
+#: site contract for statically-opaque calls (stored callbacks, ship
+#: hooks): ``# may-acquire: <canonical-lock>[, ...]`` trailing the
+#: statement or on the comment line directly above it declares locks
+#: the call may take, feeding the lock-order graph the same way a
+#: lexical acquisition would.
+_MAY_ACQUIRE_RE = re.compile(r"#\s*may-acquire:\s*([\w.\s,]+)")
+
+
+def _short(dotted: str) -> str:
+    """Module path minus the package root: the spelling canonical lock
+    names use (``opentenbase_tpu.exec.plancache`` -> ``exec.plancache``)."""
+    return dotted.split(".", 1)[1] if "." in dotted else dotted
+
+
+def _lock_ctor_kind(v) -> Optional[str]:
+    if not isinstance(v, ast.Call):
+        return None
+    f = v.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    return name if name in _LOCK_CTORS else None
+
+
+def _literal_lock_name(call) -> Optional[str]:
+    """The canonical-name string argument of a ``locks.Lock("...")`` /
+    ``locks.Condition(name="...")`` construction."""
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    if call.args:
+        a0 = call.args[0]
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+            return a0.value
+    return None
+
+
+def _looks_lockish(name: str) -> bool:
+    """Heuristic for ``with <name>:`` context managers that are locks
+    even when the registry cannot resolve them."""
+    low = name.lower()
+    if any(tok in low for tok in ("lock", "mutex", "cond", "sem")):
+        return True
+    return low in ("mu", "_mu", "cv", "_cv") or \
+        low.endswith(("_mu", "_cv"))
+
+
+class LockRegistry:
+    """Canonical identity for every lock the package creates.
+
+    The literal string passed to the ``utils.locks`` factories wins;
+    raw ``threading.*`` locks get a derived name.  ``Condition(lock)``
+    aliases to its constructor lock's name — at runtime the condition
+    IS that lock."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.module_locks: dict = {}   # (module, name) -> canonical
+        self.class_locks: dict = {}    # (module, class, attr) -> canonical
+        self.canon: dict = {}          # canonical -> {"kind","file","line"}
+        self._attr_canon: dict = {}    # attr -> set of canonicals
+        for mi in project.modules.values():
+            self._scan_module(mi)
+        # second pass: Condition(<lock>) aliases need the lock tables
+        for mi in project.modules.values():
+            self._scan_aliases(mi)
+
+    # -- construction ---------------------------------------------------
+    def _register(self, key_kind: str, key: tuple, canonical: str,
+                  kind: str, rel: str, line: int):
+        table = self.module_locks if key_kind == "module" \
+            else self.class_locks
+        table[key] = canonical
+        self.canon.setdefault(canonical, {
+            "kind": kind, "file": rel, "line": line})
+        self._attr_canon.setdefault(key[-1], set()).add(canonical)
+
+    def _scan_module(self, mi):
+        rel = mi.src.rel
+        for st in mi.src.tree.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                    isinstance(st.targets[0], ast.Name):
+                kind = _lock_ctor_kind(st.value)
+                if kind and not self._cond_lock_arg(st.value):
+                    name = st.targets[0].id
+                    canonical = _literal_lock_name(st.value) or \
+                        f"{_short(mi.dotted)}.{name}"
+                    self._register("module", (mi.dotted, name),
+                                   canonical, kind, rel, st.lineno)
+        for fi in mi.functions.values():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, (ast.Assign, ast.Call)):
+                    continue
+                if isinstance(node, ast.Assign):
+                    tgt = node.targets[0] if len(node.targets) == 1 \
+                        else None
+                    kind = _lock_ctor_kind(node.value)
+                    if kind is None or self._cond_lock_arg(node.value):
+                        continue
+                    if isinstance(tgt, ast.Name):
+                        # function-local literal-named lock (server
+                        # closure captures): canon entry only — scoped
+                        # resolution happens via local_locks()
+                        lit = _literal_lock_name(node.value)
+                        if lit:
+                            self.canon.setdefault(lit, {
+                                "kind": kind, "file": rel,
+                                "line": node.lineno})
+                            self._attr_canon.setdefault(
+                                tgt.id, set()).add(lit)
+                        continue
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self" and fi.class_name:
+                        canonical = _literal_lock_name(node.value) or \
+                            (f"{_short(mi.dotted)}.{fi.class_name}"
+                             f".{tgt.attr}")
+                        self._register(
+                            "class", (mi.dotted, fi.class_name,
+                                      tgt.attr),
+                            canonical, kind, rel, node.lineno)
+                else:
+                    # object.__setattr__(self, "attr", locks.RLock(...))
+                    d = _dotted(node.func, mi)
+                    if d != "object.__setattr__" or \
+                            len(node.args) != 3 or not fi.class_name:
+                        continue
+                    obj, key, val = node.args
+                    kind = _lock_ctor_kind(val)
+                    if kind and not self._cond_lock_arg(val) and \
+                            isinstance(obj, ast.Name) and \
+                            obj.id == "self" and \
+                            isinstance(key, ast.Constant):
+                        attr = str(key.value)
+                        canonical = _literal_lock_name(val) or \
+                            (f"{_short(mi.dotted)}.{fi.class_name}"
+                             f".{attr}")
+                        self._register(
+                            "class", (mi.dotted, fi.class_name, attr),
+                            canonical, kind, rel, node.lineno)
+
+    @staticmethod
+    def _cond_lock_arg(call) -> Optional[ast.expr]:
+        """The lock argument of a ``Condition(<lock>)`` construction
+        (named conditions — ``Condition(name=...)`` — return None)."""
+        if _lock_ctor_kind(call) != "Condition":
+            return None
+        if call.args and not isinstance(call.args[0], ast.Constant):
+            return call.args[0]
+        for kw in call.keywords:
+            if kw.arg == "lock":
+                return kw.value
+        return None
+
+    def _scan_aliases(self, mi):
+        rel = mi.src.rel
+        for fi in mi.functions.values():
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                arg = self._cond_lock_arg(node.value) \
+                    if isinstance(node.value, ast.Call) else None
+                if arg is None:
+                    continue
+                base = self.resolve(fi, mi, arg, {})
+                if base is None:
+                    base = _literal_lock_name(node.value)
+                if base is None:
+                    continue
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and fi.class_name:
+                    self._register(
+                        "class", (mi.dotted, fi.class_name, tgt.attr),
+                        base, "Condition", rel, node.lineno)
+                elif isinstance(tgt, ast.Name) and fi.class_name is None \
+                        and node in mi.src.tree.body:
+                    self._register("module", (mi.dotted, tgt.id),
+                                   base, "Condition", rel, node.lineno)
+
+    # -- resolution -----------------------------------------------------
+    def local_locks(self, fi: FuncInfo) -> dict:
+        """name -> canonical for function-local ``x = locks.Lock("...")``
+        bindings (only literal-named ones are identifiable)."""
+        out = {}
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) and \
+                    _lock_ctor_kind(node.value):
+                lit = _literal_lock_name(node.value)
+                if lit:
+                    out[node.targets[0].id] = lit
+        return out
+
+    def resolve(self, fi: FuncInfo, mi, expr,
+                local_locks: dict) -> Optional[str]:
+        """Canonical name of the lock an acquisition expression refers
+        to, or None when unidentifiable."""
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            if n in local_locks:
+                return local_locks[n]
+            hit = self.module_locks.get((mi.dotted, n))
+            if hit:
+                return hit
+            if n in mi.import_symbols:
+                dmod, attr = mi.import_symbols[n]
+                return self.module_locks.get((dmod, attr))
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            val = expr.value
+            if isinstance(val, ast.Name):
+                if val.id in ("self", "cls") and fi.class_name:
+                    hit = self.class_locks.get(
+                        (fi.module, fi.class_name, attr))
+                    if hit:
+                        return hit
+                dmod = mi.import_modules.get(val.id)
+                if dmod is None and val.id in mi.import_symbols:
+                    base, sub = mi.import_symbols[val.id]
+                    dmod = f"{base}.{sub}" if base else sub
+                if dmod is not None:
+                    hit = self.module_locks.get((dmod, attr))
+                    if hit:
+                        return hit
+            # unique attribute name across every registered lock
+            cands = self._attr_canon.get(attr, ())
+            if len(cands) == 1:
+                return next(iter(cands))
+        return None
+
+    def reentrant(self, canonical: str) -> bool:
+        info = self.canon.get(canonical)
+        return bool(info) and info["kind"] in ("RLock", "Condition")
+
+
+# ---------------------------------------------------------------------------
+# per-function lock-flow summaries
+# ---------------------------------------------------------------------------
+class FnSummary:
+    __slots__ = ("fi", "acquires", "calls", "blocked_calls")
+
+    def __init__(self, fi: FuncInfo):
+        self.fi = fi
+        #: canonical -> (rel, line, qualname) first acquisition site
+        self.acquires: dict = {}
+        #: [((module, qual), line, held_canonicals_tuple)]
+        self.calls: list = []
+        #: [(call_node, line, held_entries)] — every call made while at
+        #: least one lock (known or lockish-unknown) is held
+        self.blocked_calls: list = []
+
+
+class _HeldWalker:
+    """Walks one function body tracking the lexically held lock set:
+    ``with`` items (multi-item included), bare ``.acquire()`` /
+    ``.release()`` pairs, and ``# holds:`` contract seeds.  Held
+    entries are ``(canonical_or_None, spelled, line)``."""
+
+    def __init__(self, registry: LockRegistry, closure: TracedClosure,
+                 fi: FuncInfo, mi, summary: FnSummary,
+                 instances: Optional[dict] = None):
+        self.reg = registry
+        self.closure = closure
+        self.fi = fi
+        self.mi = mi
+        self.sum = summary
+        # closure capture: a nested def/class (server Handler etc.) can
+        # acquire a literal-named lock bound in an ENCLOSING function —
+        # merge ancestors' local locks, innermost binding winning
+        self.local_locks: dict = {}
+        parts = fi.qualname.split(".")
+        for i in range(1, len(parts)):
+            anc = mi.functions.get(".".join(parts[:i]))
+            if anc is not None:
+                self.local_locks.update(registry.local_locks(anc))
+        self.local_locks.update(registry.local_locks(fi))
+        #: (module, var) -> (class_module, class_name) for module-level
+        #: ``VAR = ClassName(...)`` singletons (REGISTRY et al.)
+        self.instances = instances or {}
+
+    @staticmethod
+    def _spelled(e) -> str:
+        if isinstance(e, ast.Name):
+            return e.id
+        if isinstance(e, ast.Attribute):
+            return e.attr
+        return "<expr>"
+
+    def _lock_entry(self, e, line: int) -> Optional[tuple]:
+        canonical = self.reg.resolve(self.fi, self.mi, e,
+                                     self.local_locks)
+        spelled = self._spelled(e)
+        if canonical is None and not _looks_lockish(spelled):
+            return None
+        return (canonical, spelled, line)
+
+    def walk(self):
+        held: list = []
+        for name in self.fi.holds:
+            canonical = self.reg.module_locks.get(
+                (self.fi.module, name)) or \
+                (self.reg.class_locks.get(
+                    (self.fi.module, self.fi.class_name, name))
+                 if self.fi.class_name else None) or \
+                (name if name in self.reg.canon else None)
+            held.append((canonical, name, self.fi.lineno))
+        self._stmts(self.fi.node.body, held)
+
+    def _on_acquire(self, entry: tuple, held: list):
+        canonical, _spelled, line = entry
+        if canonical is not None and canonical not in self.sum.acquires:
+            self.sum.acquires[canonical] = (
+                self.fi.src.rel, line, self.fi.qualname)
+        held_canons = tuple(c for c, _s, _l in held if c is not None)
+        if canonical is not None:
+            for a in held_canons:
+                if a != canonical:
+                    self._edge(a, canonical, line)
+
+    def _edge(self, a: str, b: str, line: int):
+        # recorded via the summary's acquires + the pass's edge table;
+        # the pass installs this hook
+        pass
+
+    def _on_call(self, call, held: list):
+        if held:
+            self.sum.blocked_calls.append((call, call.lineno,
+                                           list(held)))
+        held_canons = tuple(
+            dict.fromkeys(c for c, _s, _l in held if c is not None))
+        # record even lock-free calls: the transitive footprint must
+        # flow through lock-free intermediaries (edges themselves only
+        # form where held_canons is non-empty)
+        for tgt in self._resolve_for_graph(call):
+            self.sum.calls.append(((tgt.module, tgt.qualname),
+                                   call.lineno, held_canons))
+
+    def _instance_method(self, call) -> Optional[FuncInfo]:
+        """``SINGLETON.method(...)`` where SINGLETON is a module-level
+        ``VAR = ClassName(...)`` (local or from-imported): resolve to
+        the class's method exactly."""
+        func = call.func
+        if not (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)):
+            return None
+        v = func.value.id
+        inst = self.instances.get((self.fi.module, v))
+        if inst is None and v in self.mi.import_symbols:
+            inst = self.instances.get(self.mi.import_symbols[v])
+        if inst is None:
+            return None
+        cmod, cls = inst
+        return self.closure.project.function(cmod, f"{cls}.{func.attr}")
+
+    def _resolve_for_graph(self, call) -> list:
+        """Callgraph resolution, but reject the multi-candidate
+        distinctive-method fan-out: a speculative edge here would
+        manufacture deadlock cycles."""
+        exact_inst = self._instance_method(call)
+        if exact_inst is not None:
+            return [exact_inst]
+        cands = self.closure.resolve_call(self.fi, call)
+        if len(cands) > 1 and isinstance(call.func, ast.Attribute):
+            v = call.func.value
+            exact = isinstance(v, ast.Name) and (
+                v.id in ("self", "cls")
+                or v.id in self.mi.import_modules
+                or v.id in self.mi.import_symbols)
+            if not exact:
+                return []
+        return cands
+
+    def _may_acquire(self, st) -> list:
+        """Declared lock names from a ``# may-acquire:`` contract
+        trailing this statement or on the pure-comment line above it
+        (for calls into stored callbacks the callgraph cannot see)."""
+        lines = self.fi.src.lines
+        out = []
+
+        def scan(text):
+            m = _MAY_ACQUIRE_RE.search(text)
+            if m:
+                out.extend(n.strip() for n in m.group(1).split(",")
+                           if n.strip())
+
+        if 1 <= st.lineno <= len(lines):
+            scan(lines[st.lineno - 1])          # trailing
+        ln = st.lineno - 1
+        while 1 <= ln <= len(lines) and \
+                lines[ln - 1].lstrip().startswith("#"):
+            scan(lines[ln - 1])                 # comment block above
+            ln -= 1
+        return out
+
+    # -- statement walk -------------------------------------------------
+    def _stmts(self, stmts, held: list):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            for name in self._may_acquire(st):
+                self._on_acquire((name, name, st.lineno), held)
+            if isinstance(st, ast.With):
+                entries = []
+                for item in st.items:
+                    self._scan_calls(item.context_expr, held)
+                    ent = self._lock_entry(item.context_expr,
+                                           st.lineno)
+                    if ent is not None:
+                        self._on_acquire(ent, held + entries)
+                        entries.append(ent)
+                self._stmts(st.body, held + entries)
+                continue
+            bare = self._bare_lock_op(st)
+            if bare is not None:
+                op, ent = bare
+                if op == "acquire":
+                    self._on_acquire(ent, held)
+                    held.append(ent)
+                else:
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i][1] == ent[1] or \
+                                (ent[0] is not None
+                                 and held[i][0] == ent[0]):
+                            held.pop(i)
+                            break
+                continue
+            self._scan_calls(st, held)
+            for field in ("body", "orelse", "finalbody"):
+                for s in getattr(st, field, []) or []:
+                    self._stmts([s], held)
+            for h in getattr(st, "handlers", []) or []:
+                self._stmts(h.body, held)
+
+    def _bare_lock_op(self, st) -> Optional[tuple]:
+        """``lock.acquire()`` / ``lock.release()`` statements (Expr or
+        ``ok = lock.acquire(...)``) on a lock-looking receiver."""
+        call = None
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            call = st.value
+        elif isinstance(st, ast.Assign) and \
+                isinstance(st.value, ast.Call):
+            call = st.value
+        if call is None or not isinstance(call.func, ast.Attribute) or \
+                call.func.attr not in ("acquire", "release"):
+            return None
+        recv = call.func.value
+        ent = self._lock_entry(recv, st.lineno)
+        if ent is None:
+            return None
+        if call.func.attr == "acquire":
+            # blocking=False acquisitions may fail; their held region is
+            # conditional — still record the edge (the success path is
+            # what deadlocks) but treat assigns the same as Expr
+            self._scan_calls(st, [])
+            return ("acquire", ent)
+        return ("release", ent)
+
+    def _scan_calls(self, node, held: list):
+        """Call sites in this statement's own expressions (nested
+        statements recurse separately with their own held set)."""
+        stack = [v for f, v in ast.iter_fields(node)
+                 if f not in ("body", "orelse", "finalbody",
+                              "handlers")] if isinstance(node, ast.stmt) \
+            else [node]
+        while stack:
+            x = stack.pop()
+            if isinstance(x, list):
+                stack.extend(x)
+                continue
+            if not isinstance(x, ast.AST) or isinstance(x, ast.stmt):
+                continue
+            if isinstance(x, ast.Call):
+                self._on_call(x, held)
+            stack.extend(v for _, v in ast.iter_fields(x))
+
+
+class ConcurrencyContext:
+    """Registry + per-function summaries + the static edge table,
+    computed once and shared by the three passes."""
+
+    def __init__(self, project: Project, closure: TracedClosure):
+        self.project = project
+        self.closure = closure
+        self.registry = LockRegistry(project)
+        self.instances = self._instance_types()
+        self.summaries: dict = {}      # (module, qual) -> FnSummary
+        #: (a, b) -> (rel, line, qualname, note)
+        self.edges: dict = {}
+        self._build()
+
+    def _instance_types(self) -> dict:
+        """(module, var) -> (class_module, class_name) for module-level
+        ``VAR = ClassName(...)`` singleton assignments, so calls like
+        ``REGISTRY.counter(...)`` resolve to the class's method."""
+
+        def is_class(mod: str, name: str) -> bool:
+            mi = self.project.modules.get(mod)
+            return mi is not None and any(
+                q.startswith(name + ".") for q in mi.functions)
+
+        out: dict = {}
+        for mi in self.project.modules.values():
+            for st in mi.src.tree.body:
+                if not (isinstance(st, ast.Assign)
+                        and len(st.targets) == 1
+                        and isinstance(st.targets[0], ast.Name)
+                        and isinstance(st.value, ast.Call)):
+                    continue
+                func = st.value.func
+                tgt = None
+                if isinstance(func, ast.Name):
+                    if is_class(mi.dotted, func.id):
+                        tgt = (mi.dotted, func.id)
+                    elif func.id in mi.import_symbols:
+                        dmod, cls = mi.import_symbols[func.id]
+                        if is_class(dmod, cls):
+                            tgt = (dmod, cls)
+                elif isinstance(func, ast.Attribute) and \
+                        isinstance(func.value, ast.Name):
+                    dmod = mi.import_modules.get(func.value.id)
+                    if dmod and is_class(dmod, func.attr):
+                        tgt = (dmod, func.attr)
+                if tgt is not None:
+                    out[(mi.dotted, st.targets[0].id)] = tgt
+        return out
+
+    def _build(self):
+        for mi in self.project.modules.values():
+            for fi in mi.functions.values():
+                s = FnSummary(fi)
+                w = _HeldWalker(self.registry, self.closure, fi, mi, s,
+                                self.instances)
+                w._edge = self._make_edge_hook(fi)
+                w.walk()
+                self.summaries[(fi.module, fi.qualname)] = s
+        self._interprocedural()
+
+    def _make_edge_hook(self, fi: FuncInfo):
+        def hook(a, b, line):
+            self.edges.setdefault(
+                (a, b), (fi.src.rel, line, fi.qualname, ""))
+        return hook
+
+    def _interprocedural(self):
+        # transitive lock footprint per function (fixpoint)
+        foot = {k: dict(s.acquires) for k, s in self.summaries.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, s in self.summaries.items():
+                fk = foot[k]
+                for callee, _line, _held in s.calls:
+                    for c, site in foot.get(callee, {}).items():
+                        if c not in fk:
+                            fk[c] = site
+                            changed = True
+        for k, s in self.summaries.items():
+            for callee, line, held in s.calls:
+                for c, site in foot.get(callee, {}).items():
+                    for a in held:
+                        if a != c and (a, c) not in self.edges:
+                            self.edges[(a, c)] = (
+                                s.fi.src.rel, line, s.fi.qualname,
+                                f"via {callee[1]} "
+                                f"({site[0]}:{site[1]})")
+
+    def in_thread_tree(self, dotted: str) -> bool:
+        parts = dotted.split(".")
+        return len(parts) >= 2 and parts[1] in THREAD_TREES
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+class LockOrderPass:
+    rule = "lock-order"
+
+    def __init__(self, project: Project, ctx: ConcurrencyContext):
+        self.project = project
+        self.ctx = ctx
+
+    def run(self) -> list:
+        findings = []
+        self._cycles(findings)
+        self._cross_check(findings)
+        return findings
+
+    def _cycles(self, findings: list):
+        adj: dict = {}
+        for (a, b) in self.ctx.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        for comp in _sccs(adj):
+            if len(comp) < 2:
+                continue
+            cyc = _find_cycle(adj, sorted(comp))
+            parts = []
+            for a, b in zip(cyc, cyc[1:] + cyc[:1]):
+                rel, line, qual, note = self.ctx.edges.get(
+                    (a, b), ("?", 0, "", ""))
+                via = f" {note}" if note else ""
+                parts.append(f"{a} -> {b} ({rel}:{line}{via})")
+            rel0, line0, qual0, _ = self.ctx.edges[(cyc[0], cyc[1])] \
+                if len(cyc) > 1 else ("?", 0, "", "")
+            findings.append(Finding(
+                self.rule, rel0, line0, qual0,
+                "potential deadlock: lock-order cycle "
+                + "; ".join(parts)))
+
+    def _cross_check(self, findings: list):
+        path = os.path.join(self.project.root, self.project.package,
+                            "analysis", "lock_order.json")
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            findings.append(Finding(
+                self.rule, _rel_of(self.project, path), 1, "",
+                f"unreadable witnessed-edge file: {e}"))
+            return
+        rel = _rel_of(self.project, path)
+        known = self.ctx.registry.canon
+        for pair in data.get("edges", []):
+            if not (isinstance(pair, list) and len(pair) == 2):
+                continue
+            a, b = pair
+            unknown = [n for n in (a, b) if n not in known]
+            if unknown:
+                findings.append(Finding(
+                    self.rule, rel, 1, "",
+                    f"witnessed lock(s) {unknown} unknown to the "
+                    f"static registry — stale lock_order.json, "
+                    f"regenerate under OTB_LOCKCHECK=1"))
+                continue
+            if (a, b) not in self.ctx.edges:
+                findings.append(Finding(
+                    self.rule, rel, 1, "",
+                    f"edge {a} -> {b} witnessed at runtime but absent "
+                    f"from the static lock-order graph — the static "
+                    f"pass under-approximates reality"))
+
+
+def _rel_of(project: Project, path: str) -> str:
+    return os.path.relpath(path, project.root).replace(os.sep, "/")
+
+
+def _sccs(adj: dict) -> list:
+    """Iterative Tarjan strongly-connected components."""
+    index: dict = {}
+    low: dict = {}
+    on: set = set()
+    stack: list = []
+    out: list = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(adj[w]))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+    return out
+
+
+def _find_cycle(adj: dict, comp: list) -> list:
+    """A concrete cycle through an SCC (for the finding message)."""
+    comp_set = set(comp)
+    start = comp[0]
+    path, seen = [start], {start: 0}
+    cur = start
+    while True:
+        nxt = None
+        for w in sorted(adj.get(cur, ())):
+            if w in comp_set:
+                nxt = w
+                break
+        if nxt is None:
+            return path
+        if nxt in seen:
+            return path[seen[nxt]:]
+        seen[nxt] = len(path)
+        path.append(nxt)
+        cur = nxt
+
+
+# ---------------------------------------------------------------------------
+# lock-blocking
+# ---------------------------------------------------------------------------
+_SUBPROC = ("subprocess.",)
+_SOCKET_ATTRS = frozenset({"connect", "accept", "recv", "sendall",
+                           "recv_into", "sendmsg", "recvmsg"})
+_RPC_NAMES = frozenset({"send_msg", "recv_msg"})
+_DEVICE_SYNC_ATTRS = frozenset({"block_until_ready"})
+
+
+class LockBlockingPass:
+    rule = "lock-blocking"
+
+    def __init__(self, project: Project, ctx: ConcurrencyContext):
+        self.project = project
+        self.ctx = ctx
+
+    def run(self) -> list:
+        em = _Emitter(self.rule)
+        for key, s in sorted(self.ctx.summaries.items()):
+            if not self.ctx.in_thread_tree(s.fi.module):
+                continue
+            mi = self.project.modules[s.fi.module]
+            for call, line, held in s.blocked_calls:
+                self._check(s.fi, mi, call, line, held, em)
+        return em.findings
+
+    @staticmethod
+    def _unbounded(call) -> bool:
+        """No timeout: ``acquire()``, ``wait()``, ``join()`` with no
+        bounding argument (``blocking=False`` counts as bounded)."""
+        for kw in call.keywords:
+            if kw.arg in ("timeout", "blocking"):
+                return False
+        if call.func.attr == "acquire":
+            if call.args:
+                a0 = call.args[0]
+                if isinstance(a0, ast.Constant) and a0.value is False:
+                    return False
+                return len(call.args) < 2   # acquire(True) is unbounded
+            return True
+        return not call.args
+
+    def _held_names(self, held: list) -> str:
+        return ", ".join(dict.fromkeys(
+            (c or f"'{s}'") for c, s, _l in held))
+
+    def _check(self, fi, mi, call, line, held, em: _Emitter):
+        f = call.func
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+        name = f.id if isinstance(f, ast.Name) else None
+        d = _dotted(f, mi) or ""
+        held_str = self._held_names(held)
+
+        if attr == "join" and not self._is_thread_join(call, d):
+            attr = None
+        if attr in ("acquire", "wait", "join") and \
+                isinstance(f, ast.Attribute):
+            recv_canon = self.ctx.registry.resolve(
+                fi, mi, f.value, self.ctx.registry.local_locks(fi))
+            others = [h for h in held
+                      if recv_canon is None or h[0] != recv_canon]
+            if attr == "wait" and not others:
+                return   # cv.wait() releases the (only) held lock
+            if attr in ("acquire", "wait") and recv_canon is None and \
+                    not _looks_lockish(self._spelled(f.value)):
+                pass     # not a lock-looking receiver; fall through
+            elif others or attr == "join":
+                if self._unbounded(call):
+                    em.emit(fi, line,
+                            f"deadlock-capable: unbounded .{attr}() "
+                            f"while holding {held_str} — the awaited "
+                            f"thread may need the held lock")
+                else:
+                    em.emit(fi, line,
+                            f"latency: bounded .{attr}() wait while "
+                            f"holding {held_str}")
+                return
+
+        if d == "time.sleep":
+            em.emit(fi, line,
+                    f"latency: time.sleep() while holding {held_str}")
+        elif d.startswith(_SUBPROC):
+            em.emit(fi, line,
+                    f"latency: subprocess call while holding "
+                    f"{held_str}")
+        elif d == "socket.create_connection" or attr in _SOCKET_ATTRS:
+            em.emit(fi, line,
+                    f"latency: socket I/O (.{attr or 'connect'}) "
+                    f"while holding {held_str}")
+        elif (attr in _RPC_NAMES or name in _RPC_NAMES
+              or name == "guarded" or attr == "guarded"):
+            em.emit(fi, line,
+                    f"latency: RPC while holding {held_str}")
+        elif attr in _DEVICE_SYNC_ATTRS or \
+                d in ("jax.block_until_ready", "jax.device_get"):
+            em.emit(fi, line,
+                    f"latency: device sync while holding {held_str}")
+        elif d.startswith("numpy.") and \
+                d.split(".")[-1] in ("asarray", "array") and \
+                self._has_jax_arg(call, mi):
+            em.emit(fi, line,
+                    f"latency: host gather (np.{d.split('.')[-1]} of "
+                    f"a device value) while holding {held_str}")
+
+    @staticmethod
+    def _spelled(e) -> str:
+        if isinstance(e, ast.Name):
+            return e.id
+        if isinstance(e, ast.Attribute):
+            return e.attr
+        return "<expr>"
+
+    @classmethod
+    def _is_thread_join(cls, call, dotted: str) -> bool:
+        """Distinguish thread.join() from os.path.join / str.join:
+        those always take positional arguments, a thread join takes at
+        most a timeout."""
+        if dotted.startswith(("os.path.", "posixpath.", "ntpath.")):
+            return False
+        if not call.args and all(kw.arg == "timeout"
+                                 for kw in call.keywords):
+            return True
+        recv = cls._spelled(call.func.value).lower()
+        return "thread" in recv or "worker" in recv
+
+    @staticmethod
+    def _has_jax_arg(call, mi) -> bool:
+        """np.asarray(<jax call result>) — the only np.asarray shape we
+        can prove gathers device memory without a taint walk."""
+        for a in call.args:
+            if isinstance(a, ast.Call):
+                d = _dotted(a.func, mi) or ""
+                if d.startswith("jax."):
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# lock-atomicity
+# ---------------------------------------------------------------------------
+class LockAtomicityPass:
+    rule = "lock-atomicity"
+
+    def __init__(self, project: Project, ctx: ConcurrencyContext):
+        self.project = project
+        self.ctx = ctx
+        # (module, name) -> lock-name for every guarded container
+        self.guarded: dict = {}
+        for mi in project.modules.values():
+            if not ctx.in_thread_tree(mi.dotted):
+                continue
+            for name, info in mi.containers.items():
+                if info.get("lock"):
+                    self.guarded[(mi.dotted, name)] = info["lock"]
+
+    def run(self) -> list:
+        em = _Emitter(self.rule)
+        for mi in self.project.modules.values():
+            if not self.ctx.in_thread_tree(mi.dotted):
+                continue
+            for fi in mi.functions.values():
+                self._check_fn(mi, fi, em)
+        return em.findings
+
+    def _resolve(self, mi, name: str) -> Optional[tuple]:
+        if (mi.dotted, name) in self.guarded:
+            return (mi.dotted, name)
+        if name in mi.import_symbols:
+            dmod, attr = mi.import_symbols[name]
+            if (dmod, attr) in self.guarded:
+                return (dmod, attr)
+        return None
+
+    def _check_fn(self, mi, fi: FuncInfo, em: _Emitter):
+        locals_ = _func_locals(fi.node)
+        # container key -> {"reads": {region: [lines]},
+        #                   "writes": {region: [lines]}}
+        events: dict = {}
+
+        def note(key, kind, region, line):
+            ev = events.setdefault(key, {"reads": {}, "writes": {}})
+            ev[kind].setdefault(region, []).append(line)
+
+        def container_of(e) -> Optional[tuple]:
+            if isinstance(e, ast.Name) and e.id not in locals_:
+                return self._resolve(mi, e.id)
+            return None
+
+        def lock_name(e) -> Optional[str]:
+            if isinstance(e, ast.Name):
+                return e.id
+            if isinstance(e, ast.Attribute):
+                return e.attr
+            return None
+
+        def scan_exprs(node, region_for: dict):
+            """reads/writes in one statement's own expressions."""
+            for x in ast.walk(node):
+                if isinstance(x, ast.Subscript):
+                    key = container_of(x.value)
+                    if key is not None:
+                        kind = "reads" if isinstance(x.ctx, ast.Load) \
+                            else "writes"
+                        note(key, kind, region_for.get(
+                            self.guarded[key]), x.lineno)
+                elif isinstance(x, ast.Compare) and any(
+                        isinstance(op, (ast.In, ast.NotIn))
+                        for op in x.ops):
+                    for c in x.comparators:
+                        key = container_of(c)
+                        if key is not None:
+                            note(key, "reads", region_for.get(
+                                self.guarded[key]), x.lineno)
+                elif isinstance(x, ast.Call) and \
+                        isinstance(x.func, ast.Attribute):
+                    key = container_of(x.func.value)
+                    if key is not None:
+                        kind = "writes" if x.func.attr in _MUTATORS \
+                            else ("reads" if x.func.attr
+                                  in _READ_METHODS else None)
+                        if kind:
+                            note(key, kind, region_for.get(
+                                self.guarded[key]), x.lineno)
+
+        def walk(stmts, region_for: dict):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.With):
+                    inner = dict(region_for)
+                    for item in st.items:
+                        ln = lock_name(item.context_expr)
+                        if ln:
+                            inner[ln] = id(st)
+                        scan_exprs(item.context_expr, region_for)
+                    walk(st.body, inner)
+                    continue
+                if isinstance(st, (ast.Return, ast.Expr)):
+                    v = getattr(st, "value", None)
+                    if isinstance(st, ast.Return):
+                        self._check_escape(mi, fi, v, locals_, em,
+                                           "return")
+                    elif isinstance(v, (ast.Yield, ast.YieldFrom)):
+                        self._check_escape(mi, fi, v.value, locals_,
+                                           em, "yield")
+                stack = [val for f_, val in ast.iter_fields(st)
+                         if f_ not in ("body", "orelse", "finalbody",
+                                       "handlers")]
+                for x in stack:
+                    for n in (x if isinstance(x, list) else [x]):
+                        if isinstance(n, ast.AST) and \
+                                not isinstance(n, ast.stmt):
+                            scan_exprs(n, region_for)
+                for field in ("body", "orelse", "finalbody"):
+                    for s in getattr(st, field, []) or []:
+                        walk([s], region_for)
+                for h in getattr(st, "handlers", []) or []:
+                    walk(h.body, region_for)
+
+        # ``# holds:`` contract: the whole body is one held region
+        region0 = {}
+        for name in fi.holds:
+            region0[name] = ("holds", name)
+        walk(fi.node.body, region0)
+
+        for key, ev in sorted(events.items()):
+            self._judge(key, ev, fi, em)
+
+    def _judge(self, key, ev, fi, em: _Emitter):
+        name = key[1]
+        for wregion, wlines in ev["writes"].items():
+            if wregion is None:
+                continue   # unlocked writes are lock-discipline's beat
+            reads_in = ev["reads"].get(wregion, [])
+            reads_out = [ln for r, lns in ev["reads"].items()
+                         if r != wregion for ln in lns]
+            if reads_out and not reads_in:
+                em.emit(fi, min(reads_out),
+                        f"check-then-act on '{name}': read at line "
+                        f"{min(reads_out)} is outside the lock region "
+                        f"that writes it (line {min(wlines)}) — "
+                        f"re-validate under the lock")
+
+    def _check_escape(self, mi, fi, value, locals_, em: _Emitter,
+                      how: str):
+        if value is None:
+            return
+        def guarded_name(e) -> Optional[str]:
+            if isinstance(e, ast.Name) and e.id not in locals_ and \
+                    self._resolve(mi, e.id) is not None:
+                return e.id
+            return None
+
+        name = guarded_name(value)
+        if name:
+            em.emit(fi, value.lineno,
+                    f"guarded-container escape: {how} of '{name}' — "
+                    f"the caller iterates it outside its lock; "
+                    f"{how} a copy")
+            return
+        if isinstance(value, ast.Call):
+            f = value.func
+            cname = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if cname in _COPY_CALLS:
+                return
+            if isinstance(f, ast.Attribute) and f.attr in _LIVE_VIEWS:
+                name = guarded_name(f.value)
+                if name:
+                    em.emit(fi, value.lineno,
+                            f"guarded-container escape: {how} of live "
+                            f"view '{name}.{f.attr}()' — materialize "
+                            f"a copy under the lock")
+            elif cname == "iter" and value.args:
+                name = guarded_name(value.args[0])
+                if name:
+                    em.emit(fi, value.lineno,
+                            f"guarded-container escape: {how} of live "
+                            f"iterator over '{name}'")
+
+
+# ---------------------------------------------------------------------------
+# thread-daemon
+# ---------------------------------------------------------------------------
+class ThreadDaemonPass:
+    rule = "thread-daemon"
+
+    def __init__(self, project: Project):
+        self.project = project
+
+    def run(self) -> list:
+        em = _Emitter(self.rule)
+        for mi in self.project.modules.values():
+            self._check_module(mi, em)
+        return em.findings
+
+    @staticmethod
+    def _thread_ctor(call, mi) -> Optional[str]:
+        d = _dotted(call.func, mi) or ""
+        if d in ("threading.Thread", "threading.Timer"):
+            return d.split(".")[-1]
+        return None
+
+    @staticmethod
+    def _daemon_kwarg(call) -> Optional[bool]:
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return None
+
+    def _check_module(self, mi, em: _Emitter):
+        # names/attrs that get .join() or .daemon = True anywhere in
+        # the module: an "owned" lifecycle
+        joined: set = set()
+        daemonized: set = set()
+        for node in ast.walk(mi.src.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join":
+                base = node.func.value
+                nm = base.attr if isinstance(base, ast.Attribute) else (
+                    base.id if isinstance(base, ast.Name) else None)
+                if nm:
+                    joined.add(nm)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "daemon" and \
+                            isinstance(node.value, ast.Constant) and \
+                            node.value.value is True:
+                        base = t.value
+                        nm = base.attr if isinstance(base,
+                                                     ast.Attribute) \
+                            else (base.id if isinstance(base, ast.Name)
+                                  else None)
+                        if nm:
+                            daemonized.add(nm)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "setDaemon":
+                base = node.func.value
+                nm = base.attr if isinstance(base, ast.Attribute) else (
+                    base.id if isinstance(base, ast.Name) else None)
+                if nm:
+                    daemonized.add(nm)
+
+        for fi in mi.functions.values():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._thread_ctor(node, mi)
+                if kind is None:
+                    continue
+                dk = self._daemon_kwarg(node)
+                if dk is True:
+                    continue
+                bound = self._bound_name(mi, node)
+                if bound and (bound in joined or bound in daemonized):
+                    continue
+                if dk is False:
+                    em.emit(fi, node.lineno,
+                            f"{kind} created with daemon=False and no "
+                            f"owned join() — hangs interpreter exit")
+                    continue
+                em.emit(fi, node.lineno,
+                        f"{kind} created without daemon=True or an "
+                        f"owned join() path — a leaked non-daemon "
+                        f"thread hangs interpreter exit")
+
+        # Thread subclasses must daemonize in __init__ (or every
+        # instantiation site is on its own, which we can't see)
+        for st in ast.walk(mi.src.tree):
+            if not isinstance(st, ast.ClassDef):
+                continue
+            if not any(self._is_thread_base(b, mi) for b in st.bases):
+                continue
+            if self._class_daemonizes(st):
+                continue
+            if mi.src.disabled(st.lineno, self.rule):
+                continue
+            em.findings.append(Finding(
+                self.rule, mi.src.rel, st.lineno, st.name,
+                f"threading.Thread subclass '{st.name}' never sets "
+                f"daemon=True — instances leak non-daemon threads"))
+
+    @staticmethod
+    def _bound_name(mi, call) -> Optional[str]:
+        for node in ast.walk(mi.src.tree):
+            if isinstance(node, ast.Assign) and node.value is call:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    return t.id
+                if isinstance(t, ast.Attribute):
+                    return t.attr
+        return None
+
+    @staticmethod
+    def _is_thread_base(base, mi) -> bool:
+        d = _dotted(base, mi) or ""
+        return d in ("threading.Thread", "Thread")
+
+    @staticmethod
+    def _class_daemonizes(cls_node) -> bool:
+        for node in ast.walk(cls_node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "daemon" and \
+                            isinstance(node.value, ast.Constant) and \
+                            node.value.value is True:
+                        return True
+                    if isinstance(t, ast.Name) and t.id == "daemon" \
+                            and isinstance(node.value, ast.Constant) \
+                            and node.value.value is True:
+                        return True
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "daemon" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            kw.value.value is True:
+                        return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# public helpers (tests + tooling)
+# ---------------------------------------------------------------------------
+def build_context(root: str, package: str = "opentenbase_tpu",
+                  ) -> ConcurrencyContext:
+    project = Project(root, package)
+    return ConcurrencyContext(project, TracedClosure(project))
+
+
+def lock_order_edges(root: str, package: str = "opentenbase_tpu",
+                     ) -> dict:
+    """(a, b) -> site tuple — the repo's static lock-order graph."""
+    return build_context(root, package).edges

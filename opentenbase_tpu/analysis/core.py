@@ -43,6 +43,15 @@ RULES = {
                   "traced region",
     "net-deadline": "network conversation without a deadline, or raw "
                     "socket I/O outside the frame codec",
+    "lock-order": "lock-acquisition-order cycle (potential deadlock) "
+                  "or a runtime-witnessed edge the static graph lacks",
+    "lock-blocking": "blocking operation (RPC, sleep, subprocess, "
+                     "device sync, unbounded wait) inside a held-lock "
+                     "region",
+    "lock-atomicity": "check-then-act across a lock release, or a "
+                      "guarded container escaping its lock",
+    "thread-daemon": "non-daemon Thread/Timer without an owned join() "
+                     "path (hangs interpreter exit)",
     "hlo-f64": "f64 tensor type in exported StableHLO",
     "hlo-host-transfer": "host transfer / callback op in exported "
                          "StableHLO",

@@ -19,10 +19,15 @@ import os
 import sys
 
 from .callgraph import TracedClosure
+from .concurrency import (ConcurrencyContext, LockAtomicityPass,
+                          LockBlockingPass, LockOrderPass,
+                          ThreadDaemonPass)
 from .core import (Baseline, Project, RULES, default_baseline_path,
                    make_report)
 from .passes import (HostSyncPass, LockDisciplinePass, NetDeadlinePass,
                      ObsPurityPass, ProgramKeyPass, TracePurityPass)
+
+_CONCURRENCY_RULES = {"lock-order", "lock-blocking", "lock-atomicity"}
 
 
 def repo_root() -> str:
@@ -40,7 +45,15 @@ def run_passes(project: Project, rules=None) -> list:
         ProgramKeyPass(project),
         LockDisciplinePass(project),
         NetDeadlinePass(project),
+        ThreadDaemonPass(project),
     ]
+    if rules is None or rules & _CONCURRENCY_RULES:
+        ctx = ConcurrencyContext(project, closure)
+        passes += [
+            LockOrderPass(project, ctx),
+            LockBlockingPass(project, ctx),
+            LockAtomicityPass(project, ctx),
+        ]
     findings = []
     for p in passes:
         if rules is None or p.rule in rules:
@@ -79,6 +92,15 @@ def main(argv=None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule subset "
                          f"(known: {', '.join(sorted(RULES))})")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only in files changed vs "
+                         "the merge-base (OTB_LINT_BASE, origin/main, "
+                         "main); the scan itself stays whole-repo so "
+                         "cross-file passes see everything")
+    ap.add_argument("--github", action="store_true",
+                    help="emit GitHub workflow annotations "
+                         "(::error file=...,line=...::) for "
+                         "unsuppressed findings")
     args = ap.parse_args(argv)
 
     root = args.root or repo_root()
@@ -94,6 +116,15 @@ def main(argv=None) -> int:
     bl_path = args.baseline or default_baseline_path()
     project = Project(root, "opentenbase_tpu")
     findings = run_passes(project, rules=rules)
+
+    if args.changed_only:
+        changed = _changed_files(root)
+        if changed is None:
+            print("otblint: --changed-only: no git merge-base found, "
+                  "reporting the full scan", file=sys.stderr)
+        else:
+            findings = [f for f in findings
+                        if f.file.replace(os.sep, "/") in changed]
 
     if args.write_baseline:
         data = Baseline.write(bl_path, findings)
@@ -117,7 +148,41 @@ def main(argv=None) -> int:
               f"{report['total']} findings "
               f"({report['suppressed']} baseline, "
               f"{report['unsuppressed']} unsuppressed)")
+    if args.github:
+        for f in sorted(findings, key=lambda x: (x.file, x.line)):
+            if not f.suppressed:
+                print(f"::error file={f.file},line={f.line}::"
+                      f"{f.rule} {f.message}")
     return 0 if report["ok"] else 1
+
+
+def _changed_files(root: str):
+    """Repo-relative paths changed vs the merge-base (committed,
+    staged, unstaged, and untracked), or None when no base resolves."""
+    import subprocess
+
+    def git(*a):
+        r = subprocess.run(["git", *a], cwd=root, capture_output=True,
+                           text=True, timeout=30)
+        return r.stdout.strip() if r.returncode == 0 else None
+
+    bases = [b for b in (os.environ.get("OTB_LINT_BASE", ""),
+                         "origin/main", "main") if b]
+    mb = None
+    for b in bases:
+        mb = git("merge-base", "HEAD", b)
+        if mb:
+            break
+    if not mb:
+        return None
+    out: set = set()
+    diff = git("diff", "--name-only", mb)
+    if diff:
+        out.update(diff.splitlines())
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    if untracked:
+        out.update(untracked.splitlines())
+    return {p.replace(os.sep, "/") for p in out if p}
 
 
 if __name__ == "__main__":

@@ -912,33 +912,59 @@ class LockDisciplinePass:
                         f"write to '{name}' without holding its "
                         f"guarded_by lock '{lock}'")
 
+        def bare_lock_op(st):
+            """('acquire'|'release', name) for a statement-level
+            ``lock.acquire()`` / ``lock.release()`` call."""
+            call = st.value if isinstance(st, ast.Expr) and \
+                isinstance(st.value, ast.Call) else None
+            if call is None and isinstance(st, ast.Assign) and \
+                    isinstance(st.value, ast.Call):
+                call = st.value
+            if call is None or not isinstance(call.func, ast.Attribute) \
+                    or call.func.attr not in ("acquire", "release"):
+                return None
+            name = lock_name(call.func.value)
+            return (call.func.attr, name) if name else None
+
         def walk(stmts, held: tuple):
+            #: locks taken by bare .acquire() earlier in this body —
+            #: they stay held across the following sibling statements
+            #: (the classic acquire();try:...finally:release() shape)
+            bare: list = []
             for st in stmts:
                 if isinstance(st, (ast.FunctionDef,
                                    ast.AsyncFunctionDef, ast.ClassDef)):
                     continue
+                eff = held + tuple(bare)
                 if isinstance(st, ast.With):
                     add = [lock_name(item.context_expr)
                            for item in st.items]
-                    walk(st.body, held + tuple(a for a in add if a))
+                    walk(st.body, eff + tuple(a for a in add if a))
+                    continue
+                op = bare_lock_op(st)
+                if op is not None:
+                    if op[0] == "acquire":
+                        bare.append(op[1])
+                    elif op[1] in bare:
+                        bare.remove(op[1])
                     continue
                 if isinstance(st, ast.Assign):
                     for t in st.targets:
                         if not isinstance(t, ast.Name):
                             r = mutation_root(t)
                             if r is not None:
-                                report(r.id, st.lineno, held)
+                                report(r.id, st.lineno, eff)
                 elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
                     t = st.target
                     if not isinstance(t, ast.Name):
                         r = mutation_root(t)
                         if r is not None:
-                            report(r.id, st.lineno, held)
+                            report(r.id, st.lineno, eff)
                 elif isinstance(st, ast.Delete):
                     for t in st.targets:
                         r = mutation_root(t)
                         if r is not None and not isinstance(t, ast.Name):
-                            report(r.id, st.lineno, held)
+                            report(r.id, st.lineno, eff)
                 # mutating method calls in THIS statement's own
                 # expressions — nested statements (e.g. a `with lock:`
                 # block under an `if`) are walked by the recursion
@@ -959,13 +985,14 @@ class LockDisciplinePass:
                             x.func.attr in _MUTATORS:
                         r = mutation_root(x.func.value)
                         if r is not None:
-                            report(r.id, x.lineno, held)
+                            report(r.id, x.lineno, eff)
                     stack.extend(v for _, v in ast.iter_fields(x))
+                # nested bodies walked WHOLE so a bare acquire() inside
+                # (say) a try body stays held for its later siblings
                 for field in ("body", "orelse", "finalbody"):
-                    for s in getattr(st, field, []) or []:
-                        walk([s], held)
+                    walk(getattr(st, field, []) or [], eff)
                 for h in getattr(st, "handlers", []) or []:
-                    walk(h.body, held)
+                    walk(h.body, eff)
 
         walk(fi.node.body, held0)
 

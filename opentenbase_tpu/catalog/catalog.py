@@ -18,6 +18,7 @@ import numpy as np
 
 from .schema import (ColumnDef, Distribution, DistType, NodeDef, NUM_SHARDS,
                      SequenceDef, TableDef)
+from ..utils import locks
 
 
 class CatalogError(Exception):
@@ -26,7 +27,7 @@ class CatalogError(Exception):
 
 class Catalog:
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = locks.RLock("catalog.catalog.Catalog._lock")
         self.tables: dict[str, TableDef] = {}
         self.nodes: dict[str, NodeDef] = {}
         self.sequences: dict[str, SequenceDef] = {}

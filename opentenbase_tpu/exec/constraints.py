@@ -22,13 +22,14 @@ import threading
 from ..sql import ast as A
 from ..sql.parser import Parser
 from .executor import ExecError
+from ..utils import locks
 
 
 class ConstraintViolation(ExecError):
     pass
 
 
-_check_lock = threading.Lock()
+_check_lock = locks.Lock("exec.constraints._check_lock")
 _check_cache: dict[tuple, A.Node] = {}   # guarded_by: _check_lock
 
 
@@ -39,6 +40,9 @@ def _parse_check(table: str, src: str) -> A.Node:
     if expr is None:
         expr = Parser(src).expr()
         with _check_lock:
+            won = _check_cache.get(key)  # re-validate: parse race
+            if won is not None:
+                return won
             _check_cache[key] = expr
             if len(_check_cache) > 512:
                 _check_cache.pop(next(iter(_check_cache)))

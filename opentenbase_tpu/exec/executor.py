@@ -39,6 +39,7 @@ from ..storage.store import ABORTED_TS, TableStore
 from ..utils.dtypes import (bits_to_float, dev_dtype, device_float,
                             float_to_bits)
 from ..utils.hashing import hash_columns_jax
+from ..utils import locks
 
 
 class ExecError(Exception):
@@ -58,7 +59,7 @@ class ExecError(Exception):
 STAT_FIELDS = ("joins", "index_compositions", "deferred_cols",
                "eager_cols", "cols_materialized", "bytes_materialized",
                "host_syncs", "fused_join_hits")
-STATS_LOCK = threading.Lock()
+STATS_LOCK = locks.Lock("exec.executor.STATS_LOCK")
 EXEC_STATS: dict = {t: {f: 0 for f in STAT_FIELDS}   # guarded_by: STATS_LOCK
                     for t in ("single", "fused", "mesh")}
 _TIER = threading.local()   # per-thread counter attribution

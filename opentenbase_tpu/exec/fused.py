@@ -58,10 +58,11 @@ from ..plan.planner import rewrite as rewrite_expr
 from ..obs import trace as obs_trace
 from ..sql.fingerprint import struct_key
 from . import plancache
+from ..utils import locks
 
 # one lock for this module's learned-state dicts: CN-server threads
 # share them, and the add-then-evict sequences below must be atomic
-_STATE_LOCK = threading.Lock()
+_STATE_LOCK = locks.Lock("exec.fused._STATE_LOCK")
 
 # plan shapes whose literal-masked trace host-synced (a masked value
 # fed a host branch): retried and cached baked instead.  Bounded FIFO

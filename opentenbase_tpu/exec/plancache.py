@@ -49,8 +49,9 @@ from typing import Optional
 
 from ..obs import trace as obs_trace
 from ..sql.fingerprint import fingerprint
+from ..utils import locks
 
-_LOCK = threading.RLock()
+_LOCK = locks.RLock("exec.plancache._LOCK")
 _SEQ = itertools.count()
 _REGISTRY: list = []   # guarded_by: _LOCK  (jit caches under the budget)
 

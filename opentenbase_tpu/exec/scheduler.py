@@ -57,13 +57,14 @@ from ..sql.parser import parse_sql
 from .executor import ExecContext, ExecError, materialize
 from .fused import batch_signature, run_fused_batch
 from .session import Result
+from ..utils import locks
 
 # ---------------------------------------------------------------------------
 # serving-tier telemetry (surfaced by the otb_scheduler view).  Counters
 # are process-global across Scheduler instances so the view aggregates
 # every serving front-end in the process.
 # ---------------------------------------------------------------------------
-_STATS_LOCK = threading.Lock()
+_STATS_LOCK = locks.Lock("exec.scheduler._STATS_LOCK")
 _STATS: dict = {          # guarded_by: _STATS_LOCK
     "admitted": 0,        # queries that passed admission and executed
     "batched": 0,         # queries served by a multi-query dispatch
@@ -221,8 +222,8 @@ class Scheduler:
         self._q: queue.Queue = queue.Queue()
         self._deferred: collections.deque = collections.deque()
         self._depth: dict = {}          # group -> queued count
-        self._lock = threading.Lock()
-        self._write_lock = threading.Lock()   # one write lane
+        self._lock = locks.Lock("exec.scheduler.Scheduler._lock")
+        self._write_lock = locks.Lock("exec.scheduler.Scheduler._write_lock")   # one write lane
         self._pool: Optional[ThreadPoolExecutor] = None
         self._thread: Optional[threading.Thread] = None
         self._stopped = False

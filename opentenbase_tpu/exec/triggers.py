@@ -26,10 +26,11 @@ import threading
 from ..sql import ast as A
 from ..sql.parser import parse_sql
 from .executor import ExecError
+from ..utils import locks
 
 _MAX_DEPTH = 8
 
-_body_lock = threading.Lock()
+_body_lock = locks.Lock("exec.triggers._body_lock")
 _body_cache: dict[str, list] = {}   # guarded_by: _body_lock
 
 
@@ -43,6 +44,9 @@ def _parse_body(name: str, body: str) -> list:
             raise ExecError(f"function {name!r} body does not parse: "
                             f"{e}") from None
         with _body_lock:
+            won = _body_cache.get(body)  # re-validate: parse race
+            if won is not None:
+                return won
             _body_cache[body] = hit
             if len(_body_cache) > 256:
                 _body_cache.pop(next(iter(_body_cache)))

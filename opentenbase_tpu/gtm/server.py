@@ -27,6 +27,7 @@ import time
 from typing import Optional
 
 from ..net.wire import recv_msg, send_msg
+from ..utils import locks
 
 RESERVE = 1_000_000  # timestamps reserved ahead per persistence write
 
@@ -43,7 +44,7 @@ class GtmCore:
         standby), a failed ship blocks allocation past the last shipped
         window, so a promoted standby can never re-issue; async mode
         keeps serving and flags ``standby_ok`` False instead."""
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("gtm.server.GtmCore._lock")
         self._ts = 100
         self._txid = 1
         self._sequences: dict[str, dict] = {}
@@ -87,6 +88,7 @@ class GtmCore:
             # Deep-copied: an in-process standby must not alias the live
             # sequence/prepared dicts of a primary that later mutates them
             try:
+                # may-acquire: gtm.standby.GtmStandby._lock
                 self._ship(json.loads(json.dumps(st)))
                 self.standby_ok = True
             except Exception:
@@ -406,14 +408,17 @@ class GtmClient:
     def __init__(self, host: str, port: int):
         self.addr = (host, port)
         self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("gtm.server.GtmClient._lock")
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
             self._sock = socket.create_connection(self.addr, timeout=10)
         return self._sock
 
-    def call(self, **msg) -> dict:
+    # the per-client lock IS the wire serializer — one request/response
+    # conversation per socket at a time; the hold is bounded by the
+    # socket timeout, so the RPC-under-lock here is the design
+    def call(self, **msg) -> dict:  # otblint: disable=lock-blocking
         with self._lock:
             for attempt in (0, 1):
                 try:

@@ -27,6 +27,7 @@ from typing import Callable, Optional
 
 from ..net.wire import recv_msg, send_msg
 from .server import GtmCore
+from ..utils import locks
 
 
 class GtmStandby:
@@ -40,7 +41,7 @@ class GtmStandby:
     """
 
     def __init__(self, store_path: Optional[str] = None):
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("gtm.standby.GtmStandby._lock")
         self.store_path = store_path
         self._state: Optional[dict] = None
         self.applied = 0
@@ -148,10 +149,13 @@ def ship_to(host: str, port: int, timeout: float = 5.0) -> Callable:
     """Build a ship hook for GtmCore: sends each persisted state to a
     GtmStandbyServer and waits for the ack (synchronous replication —
     the primary's _persist_locked fails if the standby didn't take it)."""
-    state_lock = threading.Lock()
+    state_lock = locks.Lock("gtm.standby.ship_to.state_lock")
     conn: list[Optional[socket.socket]] = [None]
 
-    def ship(state: dict) -> None:
+    # state_lock IS the replication serializer: ships must reach the
+    # standby in persist order, so the socket conversation happens
+    # under it by design; the hold is bounded by the socket timeout
+    def ship(state: dict) -> None:  # otblint: disable=lock-blocking
         with state_lock:
             if conn[0] is None:
                 conn[0] = socket.create_connection((host, port),

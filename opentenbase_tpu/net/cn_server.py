@@ -33,6 +33,7 @@ import threading
 from typing import Optional
 
 from .wire import recv_msg, send_msg
+from ..utils import locks
 
 _BANNER = "opentenbase_tpu"
 
@@ -90,7 +91,7 @@ class CnServer:
         self.users_path = users_path
         self._sessions: dict = {}     # pid -> (secret, session)
         self._next_pid = [1000]
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("net.cn_server.CnServer._lock")
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):

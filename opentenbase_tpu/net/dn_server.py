@@ -24,6 +24,7 @@ from ..gtm.server import GtmClient
 from ..parallel.cluster import DataNode
 from . import guard
 from .wire import recv_msg, send_msg
+from ..utils import locks
 
 
 class DnServer:
@@ -39,7 +40,7 @@ class DnServer:
         self.node.recover(catalog, gtm)
         self.node.open_wal()
         node = self.node
-        lock = threading.Lock()   # one DEVICE executor at a time per DN
+        lock = locks.Lock("net.dn_server.DnServer.device_lock")   # one DEVICE executor at a time per DN
 
         # host-side ops run without the executor lock: DML marking, txn
         # resolution, and lock-manager traffic must interleave freely —
@@ -66,6 +67,14 @@ class DnServer:
                             resp = {"ok": _dispatch(node, msg)}
                         else:
                             with lock:
+                                # device execution compiles through
+                                # the plan cache under this lock; in a
+                                # fresh process the first dispatch also
+                                # IMPORTS executor/plancache here, whose
+                                # module bodies register metrics
+                                # collectors:
+                                # may-acquire: exec.plancache._LOCK
+                                # may-acquire: obs.metrics.Registry._lock
                                 resp = {"ok": _dispatch(node, msg)}
                     except Exception as e:
                         resp = {"error": f"{type(e).__name__}: {e}",
@@ -217,8 +226,8 @@ class DnConnectionPool:
         self.addr = addr
         self.max_conns = max_conns
         self.connect_timeout = connect_timeout
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = locks.Lock("net.dn_server.DnConnectionPool._lock")
+        self._cv = locks.Condition(self._lock)
         self._free: list = []    # guarded_by: _lock -- [(gen, sock)]
         self._leased: dict = {}  # guarded_by: _lock -- sock -> gen
         self._count = 0          # guarded_by: _lock -- open sockets

@@ -43,6 +43,7 @@ import time
 from typing import Optional
 
 from ..obs.metrics import REGISTRY
+from ..utils import locks
 
 
 class GuardError(ConnectionError):
@@ -116,7 +117,7 @@ class CircuitBreaker:
             else _breaker_threshold()
         self.cooldown_s = cooldown_s if cooldown_s is not None \
             else _breaker_cooldown()
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("net.guard.CircuitBreaker._lock")
         self._state = "closed"   # guarded_by: _lock
         self._fails = 0          # guarded_by: _lock
         self._opened_at = 0.0    # guarded_by: _lock
@@ -186,7 +187,7 @@ class NodeGuard:
     def __init__(self, key: str):
         self.key = key
         self.breaker = CircuitBreaker(key)
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("net.guard.NodeGuard._lock")
         self.retries = 0         # guarded_by: _lock
         self.last_ok = 0.0       # guarded_by: _lock
         self.last_fail = 0.0     # guarded_by: _lock
@@ -230,7 +231,7 @@ class NodeGuard:
 
 
 _GUARDS: dict[str, NodeGuard] = {}   # guarded_by: _GUARDS_LOCK
-_GUARDS_LOCK = threading.Lock()
+_GUARDS_LOCK = locks.Lock("net.guard._GUARDS_LOCK")
 
 
 def guard_for(key: str) -> NodeGuard:
@@ -322,7 +323,8 @@ class GtmGuard:
         object.__setattr__(self, "_target", target)
         object.__setattr__(self, "_standby", standby)
         object.__setattr__(self, "_key", key)
-        object.__setattr__(self, "_plock", threading.Lock())
+        object.__setattr__(self, "_plock",
+                           locks.Lock("net.guard.GtmGuard._plock"))
 
     # -- delegation -----------------------------------------------------
     def __getattr__(self, name):

@@ -35,6 +35,7 @@ import socketserver
 import struct
 import threading
 from typing import Optional
+from ..utils import locks
 
 PROTO_V3 = 196608
 CANCEL_CODE = 80877102
@@ -218,7 +219,7 @@ class PgWireServer:
         self.auth_mode = auth if users_path else "trust"
         self._sessions: dict = {}
         self._next_pid = [2000]
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("net.pgwire.PgWireServer._lock")
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):

@@ -28,6 +28,7 @@ from __future__ import annotations
 import math
 import threading
 from typing import Callable, Iterable, Optional
+from ..utils import locks
 
 # fixed log-scale bucket bounds (ms): 2^-10 .. 2^18, quarter-power steps
 _BUCKET_LO_EXP = -10.0
@@ -45,7 +46,7 @@ class Counter:
         self.name = name
         self.labels = labels
         self._v = 0.0
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("obs.metrics.metric._lock")
 
     def inc(self, n: float = 1) -> None:
         with self._lock:
@@ -67,7 +68,7 @@ class Gauge:
         self.name = name
         self.labels = labels
         self._v = 0.0
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("obs.metrics.metric._lock")
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -94,7 +95,7 @@ class Histogram:
         self.counts = [0] * (_NBUCKETS + 1)    # +1: overflow bucket
         self.count = 0
         self.sum = 0.0
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("obs.metrics.metric._lock")
 
     @staticmethod
     def _bucket(v: float) -> int:
@@ -143,7 +144,7 @@ _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
 class Registry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("obs.metrics.Registry._lock")
         self._metrics: dict = {}        # (name, labels) -> metric
         self._collectors: dict = {}     # name -> sample generator fn
 

@@ -32,6 +32,7 @@ import threading
 import time
 from collections import deque
 from typing import Optional
+from ..utils import locks
 
 ENABLED = os.environ.get("OTB_TRACE", "1").strip().lower() \
     not in ("0", "off", "false")
@@ -40,7 +41,7 @@ SLOW_STREAM = sys.stderr        # swappable in tests / by embedders
 RING_CAP = int(os.environ.get("OTB_TRACE_RING", "64") or "64")
 
 _TLS = threading.local()        # .stack: list[Span], .trace: QueryTrace
-_LOCK = threading.Lock()
+_LOCK = locks.Lock("obs.trace._LOCK")
 _RING: deque = deque(maxlen=RING_CAP)   # guarded_by: _LOCK
 _LAST: list = [None]                    # guarded_by: _LOCK
 _IDS = itertools.count(1)

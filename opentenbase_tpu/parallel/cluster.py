@@ -26,6 +26,7 @@ from ..storage.store import (SerializationConflict, TableStore,
                              WriteConflict)
 from ..storage.wal import Wal, checkpoint_store, restore_store
 from ..utils.faultinject import fault_point
+from ..utils import locks
 
 
 class DataNode:
@@ -711,7 +712,7 @@ class Cluster:
         # (TRUNCATE): held across its precheck + fan-out so no txn can
         # begin mid-clear and refuse a later DN after earlier DNs were
         # irreversibly emptied
-        self.ddl_mutex = threading.RLock()
+        self.ddl_mutex = locks.RLock("parallel.cluster.Cluster.ddl_mutex")
         from .maintenance import AuditLogger, ResourceQueue
         self._resqueue: Optional[ResourceQueue] = None
         self._resqueue_slots = 0
@@ -723,7 +724,7 @@ class Cluster:
         self._resolver = None
         # read-failover serialization: concurrent fragment threads that
         # all hit the same dead DN coalesce into ONE promotion
-        self._failover_lock = threading.Lock()
+        self._failover_lock = locks.Lock("parallel.cluster.Cluster._failover_lock")
         self._promoted_at: dict[int, float] = {}
         # restart survival: persisted catalog.jobs resume scheduling as
         # soon as the cluster initializes, not only on CREATE JOB
@@ -915,6 +916,11 @@ class Cluster:
             srv = DnServer(dn_index, sb["datadir"], catalog_path,
                            gtm_addr=getattr(self.gtm, "addr", None))
             srv.start()
+            # old-proxy teardown + fresh-server handshake do RPC while
+            # the failover lock serializes promotion:
+            # may-acquire: gtm.server.GtmClient._lock
+            # may-acquire: net.dn_server.DnConnectionPool._lock
+            # may-acquire: utils.faultinject._lock
             try:
                 cur.close()
             except Exception:

@@ -53,8 +53,9 @@ import weakref
 import numpy as np
 
 from ..obs import trace as obs_trace
+from ..utils import locks
 
-_LOCK = threading.RLock()
+_LOCK = locks.RLock("storage.bufferpool._LOCK")
 _SEQ = itertools.count()
 
 _SYS_COLS = ("__xmin_ts", "__xmax_ts", "__xmin_txid", "__xmax_txid")

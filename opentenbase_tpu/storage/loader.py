@@ -17,8 +17,9 @@ import numpy as np
 
 from ..catalog.schema import TableDef
 from ..catalog.types import TypeKind
+from ..utils import locks
 
-_lock = threading.Lock()
+_lock = locks.Lock("storage.loader._lock")
 _lib = None
 _tried = False
 
@@ -31,7 +32,10 @@ _KIND = {TypeKind.INT32: 0, TypeKind.INT64: 0, TypeKind.FLOAT64: 1,
          TypeKind.BOOL: 5}
 
 
-def _get_lib():
+
+# holding the lock across the (timeout-bounded, once-ever) g++ build is
+# the point: concurrent first-callers must not race duplicate compiles
+def _get_lib():  # otblint: disable=lock-blocking
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:

@@ -23,6 +23,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
+from ..utils import locks
 
 
 class LockTimeout(Exception):
@@ -43,7 +44,7 @@ class LockManager:
     _RESOLVED_KEEP = 8192
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = locks.Condition(name="storage.lockmgr.LockManager._cond")
         self._resolved: OrderedDict[int, str] = OrderedDict()
         self._waits: dict[int, int] = {}      # waiter -> holder
         self._killed: set[int] = set()        # GDD victims

@@ -51,12 +51,13 @@ from typing import Optional
 import numpy as np
 
 from ..catalog.types import TypeKind
+from ..utils import locks
 
 
 # in-process connection registry: CREATE SUBSCRIPTION ... CONNECTION
 # 'local:<key>' resolves here (tests and single-host deployments);
 # 'tcp:host:port' goes over the wire
-_publishers_lock = threading.Lock()
+_publishers_lock = locks.Lock("storage.logical._publishers_lock")
 _LOCAL_PUBLISHERS: dict[str, "LogicalPublisher"] = {}  # guarded_by: _publishers_lock
 
 
@@ -109,7 +110,7 @@ class LogicalDecoder:
         # pay per-value decode cost)
         self.should_capture = should_capture or (lambda table: True)
         self.pending: dict[int, list] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("storage.logical.LogicalDecoder._lock")
 
     def on_insert(self, table: str, store, enc: dict, masks: dict,
                   n: int, txid: int):
@@ -165,7 +166,7 @@ class ReplicationSlot:
         self.slot_id = slot_id
         self.tables = tables
         self._q: list = []
-        self._cv = threading.Condition()
+        self._cv = locks.Condition(name="storage.logical.ReplicationSlot._cv")
         self.closed = False
 
     def push(self, txn: dict):
@@ -192,7 +193,7 @@ class LogicalPublisher:
         self.pubs: dict[str, list[str]] = {}
         self.slots: dict[int, ReplicationSlot] = {}
         self._next_slot = 1
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("storage.logical.LogicalPublisher._lock")
         for dn in cluster.datanodes:
             if getattr(dn, "decoder", None) is None and \
                     hasattr(dn, "stores"):
@@ -548,7 +549,7 @@ class LogicalPubClient:
         from ..net.wire import recv_msg, send_msg
         self._send, self._recv = send_msg, recv_msg
         self._sock = socket.create_connection((host, port), timeout=30)
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("storage.logical.LogicalPubClient._lock")
 
     def _call(self, msg: dict) -> dict:
         with self._lock:

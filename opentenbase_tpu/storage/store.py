@@ -29,6 +29,7 @@ import numpy as np
 
 from ..catalog.schema import TableDef
 from ..catalog.types import TypeKind
+from ..utils import locks
 
 INF_TS = np.int64(1 << 62)        # "not yet deleted" / "not yet committed"
 ABORTED_TS = np.int64((1 << 62) + 1)  # creator aborted: never visible
@@ -176,7 +177,7 @@ class TableStore:
         # serializes check-then-set row marking and chunk appends: DN
         # host ops run concurrently across sessions (the reference gets
         # per-tuple atomicity from buffer-page locks, bufmgr.c)
-        self._mu = threading.RLock()
+        self._mu = locks.RLock("storage.store.TableStore._mu")
         self.version = next(_VERSION_COUNTER)  # bumped on any mutation
         # prefix-mutation log: (version, lowest scan-order row touched)
         # for every mutation that rewrote EXISTING rows.  The device

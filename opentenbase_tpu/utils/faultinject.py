@@ -20,10 +20,11 @@ from __future__ import annotations
 
 import os
 import threading
+from . import locks
 
 _armed: dict[str, int] = {}   # guarded_by: _lock
 _wire_armed: dict[str, dict] = {}   # guarded_by: _lock
-_lock = threading.Lock()
+_lock = locks.Lock("utils.faultinject._lock")
 
 # the 2PC windows (named after the reference's stub points)
 POINTS = (

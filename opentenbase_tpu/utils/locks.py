@@ -1,0 +1,336 @@
+"""Runtime lock sanitizer — named lock factories + order witnessing.
+
+Reference analog: PostgreSQL's ``LOCK_DEBUG`` / LWLock rank discipline
+(lwlock.c): every LWLock carries a rank and acquisition order is
+asserted at runtime in debug builds.  Here every engine lock is
+created through the factories below with a CANONICAL NAME (the same
+name the static lock-order pass in ``analysis/concurrency.py``
+derives for the acquisition site), and under ``OTB_LOCKCHECK=1`` each
+acquisition is recorded per thread:
+
+- **order witnessing** — holding A while acquiring B witnesses the
+  edge A->B.  If the reverse edge B->A was witnessed earlier (by any
+  thread), the acquisition is an ORDER INVERSION: two threads running
+  those paths concurrently can deadlock.  Recorded as a violation.
+- **holds contracts** — ``assert_holds("exec.plancache._LOCK")`` at
+  the top of a function that documents ``# holds: _LOCK`` turns the
+  static contract into a runtime check.
+- **held-time** — per-lock-name count / total / max held duration, for
+  finding lock-hold latency hazards empirically.
+- **witness persistence** — at interpreter exit (or via
+  ``save_report()``) the witnessed edge set is merged into
+  ``analysis/lock_order.json``; the static pass cross-checks that its
+  derived edge set is a SUPERSET of every witnessed edge, so the
+  static graph can never silently under-approximate reality.
+
+Fast path: with the sanitizer off (the default), the factories return
+the raw ``threading`` primitives — zero wrapper, zero overhead
+(tests/test_locks.py measures it at <3%, and it is 0 by construction).
+The OTB_LOCKCHECK flag is read at factory-call time, not at import, so
+a subprocess test run can flip it without re-importing this module —
+but locks created before the flip stay unchecked.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ["Lock", "RLock", "Condition", "enabled", "assert_holds",
+           "witnessed_edges", "violations", "held_stats", "reset",
+           "save_report", "default_report_path"]
+
+
+def enabled() -> bool:
+    return os.environ.get("OTB_LOCKCHECK", "").strip().lower() \
+        in ("1", "on", "true", "yes")
+
+
+# ---------------------------------------------------------------------------
+# sanitizer state (process-global, guarded by a RAW lock — the
+# sanitizer's own bookkeeping must not recurse into itself)
+# ---------------------------------------------------------------------------
+
+_STATE = threading.Lock()
+_EDGES: dict = {}        # guarded_by: _STATE — (a, b) -> {count, thread}
+_VIOLATIONS: list = []   # guarded_by: _STATE — kind/lock/message/thread
+_HELD: dict = {}         # guarded_by: _STATE — name -> [cnt, tot, max]
+_TLS = threading.local()  # .held: list of [name, lock_obj, t0, depth]
+_ATEXIT = [False]        # guarded_by: _STATE
+
+
+def _held_stack() -> list:
+    st = getattr(_TLS, "held", None)
+    if st is None:
+        st = _TLS.held = []
+    return st
+
+
+def _record_violation(kind: str, lock: str, message: str) -> None:
+    with _STATE:
+        _VIOLATIONS.append({
+            "kind": kind, "lock": lock, "message": message,
+            "thread": threading.current_thread().name,
+        })
+
+
+def _note_acquire(lk: "CheckedLock") -> None:
+    st = _held_stack()
+    for ent in st:
+        if ent[1] is lk:         # reentrant re-acquisition: no new edge
+            ent[3] += 1
+            return
+    name = lk.name
+    tname = threading.current_thread().name
+    for ent in st:
+        a = ent[0]
+        if a == name:
+            continue             # same rank (two instances): not ordered
+        with _STATE:
+            rev = _EDGES.get((name, a))
+            e = _EDGES.get((a, name))
+            if e is None:
+                _EDGES[(a, name)] = {"count": 1, "thread": tname}
+            else:
+                e["count"] += 1
+        if rev is not None:
+            _record_violation(
+                "order-inversion", name,
+                f"acquired '{name}' while holding '{a}', but the "
+                f"reverse order {name}->{a} was witnessed earlier "
+                f"(thread {rev['thread']}) — concurrent threads on "
+                f"these paths can deadlock")
+    st.append([name, lk, time.monotonic(), 1])
+
+
+def _note_release(lk: "CheckedLock") -> None:
+    st = _held_stack()
+    for i in range(len(st) - 1, -1, -1):
+        if st[i][1] is lk:
+            st[i][3] -= 1
+            if st[i][3] <= 0:
+                name, _obj, t0, _d = st.pop(i)
+                dt = time.monotonic() - t0
+                with _STATE:
+                    rec = _HELD.get(name)
+                    if rec is None:
+                        _HELD[name] = [1, dt, dt]
+                    else:
+                        rec[0] += 1
+                        rec[1] += dt
+                        rec[2] = max(rec[2], dt)
+            return
+    _record_violation("unpaired-release", lk.name,
+                      f"release of '{lk.name}' that this thread does "
+                      f"not hold")
+
+
+class CheckedLock:
+    """Instrumented lock.  Presents the ``threading.Lock``/``RLock``
+    surface; every successful acquire/release updates the per-thread
+    held stack and the witnessed-edge graph."""
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self._lk = threading.RLock() if reentrant else threading.Lock()
+        self.name = name or f"anon@{id(self):x}"
+        self.reentrant = reentrant
+        _register_atexit()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._lk.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        _note_release(self)
+        self._lk.release()
+
+    def locked(self) -> bool:
+        lk = self._lk
+        return lk.locked() if hasattr(lk, "locked") else False
+
+    # -- threading.Condition integration ---------------------------------
+    # Condition prefers these three methods when the backing lock offers
+    # them; without them it falls back to probing acquire(0), which is
+    # wrong for a reentrant lock (the owner's probe succeeds).
+
+    def _is_owned(self) -> bool:
+        lk = self._lk
+        if hasattr(lk, "_is_owned"):
+            return lk._is_owned()
+        return any(ent[1] is self for ent in _held_stack())
+
+    def _pop_held(self) -> int:
+        """Drop this lock's held-stack entry (all recursion levels),
+        accounting held time; returns the saved depth."""
+        st = _held_stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][1] is self:
+                name, _obj, t0, depth = st.pop(i)
+                dt = time.monotonic() - t0
+                with _STATE:
+                    rec = _HELD.get(name)
+                    if rec is None:
+                        _HELD[name] = [1, dt, dt]
+                    else:
+                        rec[0] += 1
+                        rec[1] += dt
+                        rec[2] = max(rec[2], dt)
+                return depth
+        return 1
+
+    def _release_save(self):
+        depth = self._pop_held()
+        lk = self._lk
+        if hasattr(lk, "_release_save"):
+            return (lk._release_save(), depth)
+        lk.release()
+        return (None, depth)
+
+    def _acquire_restore(self, state) -> None:
+        inner, depth = state
+        lk = self._lk
+        if hasattr(lk, "_acquire_restore"):
+            lk._acquire_restore(inner)
+        else:
+            lk.acquire()
+        _note_acquire(self)
+        st = _held_stack()
+        if st and st[-1][1] is self:
+            st[-1][3] = depth
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<CheckedLock {self.name}>"
+
+
+# ---------------------------------------------------------------------------
+# factories — the only spellings engine code uses
+# ---------------------------------------------------------------------------
+
+def Lock(name: str = ""):
+    """A mutex; ``name`` is the canonical rank name (short module path
+    + owner + attr, e.g. ``"exec.plancache._LOCK"``)."""
+    if not enabled():
+        return threading.Lock()
+    return CheckedLock(name, reentrant=False)
+
+
+def RLock(name: str = ""):
+    if not enabled():
+        return threading.RLock()
+    return CheckedLock(name, reentrant=True)
+
+
+def Condition(lock=None, name: str = ""):
+    """A condition variable.  Pass an engine lock created by the
+    factories above to share its rank; with ``lock=None`` the condition
+    owns a fresh (reentrant) lock under ``name``."""
+    if not enabled():
+        if isinstance(lock, CheckedLock):   # created before a flip-off
+            lock = lock._lk
+        return threading.Condition(lock)
+    if lock is None:
+        lock = CheckedLock(name, reentrant=True)
+    # threading.Condition speaks to any acquire/release object: wait()
+    # releases through the wrapper, so held-tracking stays correct
+    # across the wait window.
+    return threading.Condition(lock)
+
+
+def assert_holds(*names: str) -> None:
+    """Runtime form of the ``# holds: <lock>`` contract: record a
+    violation if the calling thread does not hold every named lock.
+    No-op (one truthy check) when the sanitizer is off."""
+    if not enabled():
+        return
+    held = {ent[0] for ent in _held_stack()}
+    for n in names:
+        if n not in held:
+            _record_violation(
+                "holds-violation", n,
+                f"caller contract requires '{n}' but the thread holds "
+                f"{sorted(held) or 'nothing'}")
+
+
+# ---------------------------------------------------------------------------
+# introspection + persistence
+# ---------------------------------------------------------------------------
+
+def witnessed_edges() -> list:
+    with _STATE:
+        return sorted(_EDGES)
+
+
+def violations() -> list:
+    with _STATE:
+        return list(_VIOLATIONS)
+
+
+def held_stats() -> dict:
+    """name -> {count, total_ms, max_ms}."""
+    with _STATE:
+        return {n: {"count": c, "total_ms": t * 1e3, "max_ms": m * 1e3}
+                for n, (c, t, m) in sorted(_HELD.items())}
+
+
+def reset() -> None:
+    with _STATE:
+        _EDGES.clear()
+        _VIOLATIONS.clear()
+        _HELD.clear()
+
+
+def default_report_path() -> str:
+    env = os.environ.get("OTB_LOCKCHECK_REPORT", "").strip()
+    if env:
+        return env
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(pkg, "analysis", "lock_order.json")
+
+
+def save_report(path: Optional[str] = None) -> dict:
+    """Merge this process's witnessed edges into the report file (the
+    union survives across shards/processes) and write violations +
+    held-time stats from THIS process."""
+    path = path or default_report_path()
+    edges = {tuple(e) for e in witnessed_edges()}
+    try:
+        with open(path, encoding="utf-8") as f:
+            prior = json.load(f)
+        edges |= {tuple(e) for e in prior.get("edges", [])}
+    except (OSError, ValueError):
+        pass
+    data = {
+        "comment": "witnessed lock-order edges (OTB_LOCKCHECK=1 runs); "
+                   "the static lock-order graph must be a superset — "
+                   "see analysis/concurrency.py",
+        "edges": sorted(list(e) for e in edges),
+        "violations": violations(),
+        "held_ms": held_stats(),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def _register_atexit() -> None:
+    with _STATE:
+        if _ATEXIT[0]:
+            return
+        _ATEXIT[0] = True
+    if os.environ.get("OTB_LOCKCHECK_REPORT", "").strip() or \
+            os.environ.get("OTB_LOCKCHECK_PERSIST", "").strip():
+        atexit.register(save_report)

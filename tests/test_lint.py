@@ -436,3 +436,385 @@ class TestRepoGate:
                   if s["file"].startswith(("opentenbase_tpu/exec/",
                                            "opentenbase_tpu/storage/"))]
         assert burned == [], burned
+
+
+# ---------------------------------------------------------------------------
+# concurrency suite (analysis/concurrency.py)
+# ---------------------------------------------------------------------------
+
+def _msgs(root, rule):
+    report = lint(root=str(root), package="fixpkg", rules={rule})
+    return [(f["file"], f["message"]) for f in report["findings"]]
+
+
+class TestLockOrderPass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/order.py": """\
+            from ..utils import locks
+
+            A = locks.Lock("exec.order.A")
+            B = locks.Lock("exec.order.B")
+
+            def fwd():
+                with A:
+                    with B:
+                        pass
+
+            def rev():
+                with B:
+                    with A:
+                        pass
+        """,
+    }
+
+    def test_cycle_found(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        got = _msgs(tmp_path, "lock-order")
+        assert len(got) == 1 and "potential deadlock" in got[0][1], got
+        assert "exec.order.A -> exec.order.B" in got[0][1]
+
+    CLEAN = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/order.py": """\
+            from ..utils import locks
+
+            A = locks.Lock("exec.order.A")
+            B = locks.Lock("exec.order.B")
+
+            def fwd():
+                with A:
+                    with B:
+                        pass
+
+            def also_fwd():
+                with A:
+                    with B:
+                        pass
+        """,
+    }
+
+    def test_consistent_order_clean(self, tmp_path):
+        _write_pkg(tmp_path, self.CLEAN)
+        assert _scan(tmp_path, "lock-order") == []
+
+    def test_may_acquire_contract_feeds_graph(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/exec/__init__.py": "",
+            "fixpkg/exec/contract.py": """\
+                from ..utils import locks
+
+                A = locks.Lock("exec.contract.A")
+                B = locks.Lock("exec.contract.B")
+
+                def fwd(cb):
+                    with A:
+                        cb()  # may-acquire: exec.contract.B
+
+                def rev():
+                    with B:
+                        with A:
+                            pass
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        got = _msgs(tmp_path, "lock-order")
+        assert len(got) == 1 and "potential deadlock" in got[0][1], got
+
+    def test_witness_cross_check(self, tmp_path):
+        # runtime witnessed an order the static graph doesn't know:
+        # that is a gate failure, not a shrug
+        files = dict(self.CLEAN)
+        files["fixpkg/analysis/lock_order.json"] = """\
+            {"edges": [["exec.order.B", "exec.order.A"],
+                       ["exec.order.A", "nosuch.lock"]]}
+        """
+        _write_pkg(tmp_path, files)
+        got = _msgs(tmp_path, "lock-order")
+        assert len(got) == 2, got
+        assert any("under-approximates" in m for _f, m in got), got
+        assert any("unknown to the static registry" in m
+                   for _f, m in got), got
+
+
+class TestLockBlockingPass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/blk.py": """\
+            import time
+            from ..utils import locks
+
+            L = locks.Lock("exec.blk.L")
+
+            def hot():
+                with L:
+                    time.sleep(0.01)
+        """,
+        "fixpkg/exec/blk_clean.py": """\
+            import os
+            from ..utils import locks
+
+            M = locks.Lock("exec.blk_clean.M")
+
+            def cold():
+                with M:
+                    p = os.path.join("a", "b")   # not a thread join
+                return p
+        """,
+    }
+
+    def test_sleep_under_lock_vs_clean(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        got = _msgs(tmp_path, "lock-blocking")
+        assert len(got) == 1, got
+        assert got[0][0] == "fixpkg/exec/blk.py"
+        assert "latency" in got[0][1] and "time.sleep" in got[0][1]
+
+    def test_deadlock_capable_waits(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/exec/__init__.py": "",
+            "fixpkg/exec/blk2.py": """\
+                from ..utils import locks
+
+                L = locks.Lock("exec.blk2.L")
+                CV = locks.Condition(name="exec.blk2.CV")
+
+                def bad_wait():
+                    with L:
+                        with CV:
+                            CV.wait()
+
+                def bad_join(worker):
+                    with L:
+                        worker.join()
+
+                def ok_wait():
+                    with CV:
+                        CV.wait()   # releases the only held lock
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        got = _msgs(tmp_path, "lock-blocking")
+        assert len(got) == 2, got
+        assert all("deadlock-capable" in m for _f, m in got), got
+
+
+class TestLockAtomicityPass:
+    def test_check_then_act_vs_recheck(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/exec/__init__.py": "",
+            "fixpkg/exec/atom.py": """\
+                from ..utils import locks
+
+                _LOCK = locks.Lock("exec.atom._LOCK")
+                _CACHE = {}   # guarded_by: _LOCK
+
+                def bad(key):
+                    v = _CACHE.get(key)
+                    if v is None:
+                        v = object()
+                        with _LOCK:
+                            _CACHE[key] = v
+                    return v
+            """,
+            "fixpkg/exec/atom_clean.py": """\
+                from ..utils import locks
+
+                _LOCK2 = locks.Lock("exec.atom_clean._LOCK2")
+                _CACHE2 = {}   # guarded_by: _LOCK2
+
+                def good(key):
+                    v = _CACHE2.get(key)
+                    if v is None:
+                        with _LOCK2:
+                            v = _CACHE2.get(key)   # re-validate
+                            if v is None:
+                                v = object()
+                                _CACHE2[key] = v
+                    return v
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        got = _msgs(tmp_path, "lock-atomicity")
+        assert len(got) == 1, got
+        assert got[0][0] == "fixpkg/exec/atom.py"
+
+    def test_live_view_escape_vs_copy(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/exec/__init__.py": "",
+            "fixpkg/exec/esc.py": """\
+                from ..utils import locks
+
+                _LOCKE = locks.Lock("exec.esc._LOCKE")
+                _ITEMS = {}   # guarded_by: _LOCKE
+
+                def leak():
+                    with _LOCKE:
+                        return _ITEMS.values()
+
+                def safe():
+                    with _LOCKE:
+                        return list(_ITEMS.values())
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        got = _msgs(tmp_path, "lock-atomicity")
+        assert len(got) == 1 and "escape" in got[0][1], got
+
+
+class TestThreadDaemonPass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/threads.py": """\
+            import threading
+
+            def bad():
+                t = threading.Thread(target=print)
+                t.start()
+                return t
+        """,
+        "fixpkg/exec/threads_clean.py": """\
+            import threading
+
+            def ok_daemon():
+                t = threading.Thread(target=print, daemon=True)
+                t.start()
+
+            def ok_joined():
+                w = threading.Thread(target=print)
+                w.start()
+                w.join()
+        """,
+    }
+
+    def test_leaked_thread_vs_clean_twins(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        got = _scan(tmp_path, "thread-daemon")
+        assert got == [("thread-daemon", "fixpkg/exec/threads.py")], got
+
+    def test_thread_subclass_must_daemonize(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/exec/__init__.py": "",
+            "fixpkg/exec/sub.py": """\
+                import threading
+
+                class Loose(threading.Thread):
+                    def run(self):
+                        pass
+
+                class Tight(threading.Thread):
+                    def __init__(self):
+                        super().__init__(daemon=True)
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        got = _msgs(tmp_path, "thread-daemon")
+        assert len(got) == 1 and "Loose" in got[0][1], got
+
+
+class TestLockDisciplineBareAndMulti:
+    def test_bare_pair_and_multi_with_are_held(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/exec/__init__.py": "",
+            "fixpkg/exec/disc.py": """\
+                from ..utils import locks
+
+                _LOCK = locks.Lock("exec.disc._LOCK")
+                _OTHER = locks.Lock("exec.disc._OTHER")
+                _ITEMS = []   # guarded_by: _LOCK
+
+                def bare_ok():
+                    _LOCK.acquire()
+                    try:
+                        _ITEMS.append(1)
+                    finally:
+                        _LOCK.release()
+
+                def multi_ok():
+                    with _OTHER, _LOCK:
+                        _ITEMS.append(2)
+
+                def bad():
+                    _ITEMS.append(3)
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"lock-discipline"})
+        got = [(f["line"], f["message"])
+               for f in report["findings"]]
+        assert len(got) == 1, got
+        assert "without holding" in got[0][1], got
+
+
+# ---------------------------------------------------------------------------
+# CI ergonomics: --github annotations + --changed-only
+# ---------------------------------------------------------------------------
+
+_VIOLATION = """\
+import threading
+
+def bad():
+    t = threading.Thread(target=print)
+    t.start()
+    return t
+"""
+
+
+def _mini_repo(tmp_path, name="threads.py"):
+    pkg = tmp_path / "opentenbase_tpu" / "exec"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (tmp_path / "opentenbase_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / name).write_text(_VIOLATION)
+    return tmp_path
+
+
+class TestCliErgonomics:
+    def test_github_annotations(self, tmp_path):
+        _mini_repo(tmp_path)
+        out = subprocess.run(
+            [sys.executable, "-m", "opentenbase_tpu.analysis.lint",
+             "--root", str(tmp_path), "--no-baseline", "--github"],
+            capture_output=True, text=True, env=_ENV, cwd=_REPO,
+            timeout=120)
+        assert out.returncode == 1
+        assert "::error file=opentenbase_tpu/exec/threads.py,line=4::" \
+            in out.stdout, out.stdout
+
+    def test_changed_only_filters_to_merge_base(self, tmp_path):
+        _mini_repo(tmp_path)
+
+        def git(*a):
+            subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                           capture_output=True, timeout=30)
+
+        git("init", "-q", "-b", "main")
+        git("add", "-A")
+        git("-c", "user.email=t@t", "-c", "user.name=t",
+            "commit", "-qm", "seed")
+        # a NEW violating file on top of the committed one
+        (tmp_path / "opentenbase_tpu" / "exec" /
+         "threads2.py").write_text(_VIOLATION)
+        env = {**_ENV}
+        env.pop("OTB_LINT_BASE", None)
+        out = subprocess.run(
+            [sys.executable, "-m", "opentenbase_tpu.analysis.lint",
+             "--root", str(tmp_path), "--no-baseline",
+             "--changed-only", "--json"],
+            capture_output=True, text=True, env=env, cwd=_REPO,
+            timeout=120)
+        assert out.returncode == 1, out.stdout + out.stderr
+        report = json.loads(out.stdout)
+        files = {f["file"] for f in report["findings"]}
+        assert files == {"opentenbase_tpu/exec/threads2.py"}, files

@@ -1,0 +1,222 @@
+"""Runtime lock sanitizer (utils/locks.py) + lock-order soundness.
+
+Four layers:
+- sanitizer unit tests: edge witnessing, order-inversion detection,
+  reentrancy, Condition wait/notify through the wrapper, holds
+  contracts, unpaired release, report persistence;
+- the zero-overhead fast path: with OTB_LOCKCHECK off the factories
+  return RAW threading primitives (identity-checked) and a timed
+  acquire/release loop measures within noise of bare threading.Lock;
+- the repo's own lock-order graph must be acyclic (tier-1 — this is
+  the "no potential deadlocks" invariant the static pass gates on);
+- chaos-under-sanitizer: a real test_guard shard re-runs in a
+  subprocess with OTB_LOCKCHECK=1 and must produce zero violations,
+  with every witnessed edge present in the static graph (the
+  cross-check invariant, exercised end to end).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from opentenbase_tpu.utils import locks
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+@pytest.fixture
+def lockcheck(monkeypatch):
+    """Sanitizer on, clean slate, no report-file side effects."""
+    monkeypatch.setenv("OTB_LOCKCHECK", "1")
+    monkeypatch.delenv("OTB_LOCKCHECK_REPORT", raising=False)
+    monkeypatch.delenv("OTB_LOCKCHECK_PERSIST", raising=False)
+    locks.reset()
+    yield
+    locks.reset()
+
+
+class TestSanitizerUnits:
+    def test_edge_witnessing_and_inversion(self, lockcheck):
+        a = locks.Lock("t.A")
+        b = locks.Lock("t.B")
+        with a:
+            with b:
+                pass
+        assert ("t.A", "t.B") in locks.witnessed_edges()
+        assert locks.violations() == []
+        with b:
+            with a:          # reverse of the witnessed order
+                pass
+        kinds = [v["kind"] for v in locks.violations()]
+        assert kinds == ["order-inversion"]
+
+    def test_reentrant_reacquire_is_not_an_edge(self, lockcheck):
+        r = locks.RLock("t.R")
+        with r:
+            with r:
+                pass
+        assert locks.witnessed_edges() == []
+        assert locks.violations() == []
+
+    def test_same_name_two_instances_not_ordered(self, lockcheck):
+        # two locks of the same rank (e.g. per-metric instances) held
+        # together must not witness a self-edge
+        m1 = locks.Lock("t.metric._lock")
+        m2 = locks.Lock("t.metric._lock")
+        with m1:
+            with m2:
+                pass
+        assert locks.witnessed_edges() == []
+
+    def test_condition_wait_notify_through_wrapper(self, lockcheck):
+        for cv in (locks.Condition(name="t.CV"),           # RLock-backed
+                   locks.Condition(locks.Lock("t.CV2"))):  # Lock-backed
+            hits = []
+
+            def waiter():
+                with cv:
+                    hits.append(cv.wait(timeout=5.0))
+
+            t = threading.Thread(target=waiter, daemon=True)
+            t.start()
+            time.sleep(0.05)
+            with cv:
+                cv.notify_all()
+            t.join(5.0)
+            assert hits == [True]
+        assert locks.violations() == []
+
+    def test_assert_holds_contract(self, lockcheck):
+        lk = locks.Lock("t.H")
+        with lk:
+            locks.assert_holds("t.H")
+        assert locks.violations() == []
+        locks.assert_holds("t.H")          # not held now
+        kinds = [v["kind"] for v in locks.violations()]
+        assert kinds == ["holds-violation"]
+
+    def test_unpaired_release(self, lockcheck):
+        lk = locks.Lock("t.U")
+        lk._lk.acquire()                   # bypass bookkeeping
+        lk.release()
+        kinds = [v["kind"] for v in locks.violations()]
+        assert kinds == ["unpaired-release"]
+
+    def test_held_stats_accumulate(self, lockcheck):
+        lk = locks.Lock("t.S")
+        for _ in range(3):
+            with lk:
+                pass
+        st = locks.held_stats()["t.S"]
+        assert st["count"] == 3
+        assert st["max_ms"] >= 0
+
+    def test_save_report_merges_union(self, lockcheck, tmp_path):
+        path = str(tmp_path / "lock_order.json")
+        a, b, c = (locks.Lock("t.a"), locks.Lock("t.b"),
+                   locks.Lock("t.c"))
+        with a, b:
+            pass
+        locks.save_report(path)
+        locks.reset()
+        with b, c:
+            pass
+        data = locks.save_report(path)
+        assert [tuple(e) for e in data["edges"]] == \
+            [("t.a", "t.b"), ("t.b", "t.c")]
+        on_disk = json.load(open(path))
+        assert on_disk["edges"] == data["edges"]
+
+
+class TestFastPath:
+    def test_off_returns_raw_primitives(self, monkeypatch):
+        monkeypatch.delenv("OTB_LOCKCHECK", raising=False)
+        assert type(locks.Lock("x")) is type(threading.Lock())
+        assert type(locks.RLock("x")) is type(threading.RLock())
+        assert isinstance(locks.Condition(), threading.Condition)
+
+    def test_overhead_within_noise(self, monkeypatch):
+        # the factory RETURNS threading.Lock when off, so overhead is 0
+        # by construction; the timing loop guards against a regression
+        # that reintroduces a wrapper on the fast path
+        monkeypatch.delenv("OTB_LOCKCHECK", raising=False)
+
+        def bench(lk, n=20000):
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    lk.acquire()
+                    lk.release()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        raw = bench(threading.Lock())
+        ours = bench(locks.Lock("bench"))
+        assert ours <= raw * 1.03 or ours - raw < 2e-3, (ours, raw)
+
+
+class TestRepoLockOrder:
+    def test_repo_graph_is_acyclic(self):
+        from opentenbase_tpu.analysis.concurrency import lock_order_edges
+        edges = lock_order_edges(_REPO)
+        adj: dict = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        state: dict = {}                 # 1 = on stack, 2 = done
+
+        def dfs(n, path):
+            state[n] = 1
+            for m in sorted(adj[n]):
+                if state.get(m) == 1:
+                    pytest.fail(f"lock-order cycle: "
+                                f"{' -> '.join(path + [m])}")
+                if state.get(m) is None:
+                    dfs(m, path + [m])
+            state[n] = 2
+
+        for n in sorted(adj):
+            if state.get(n) is None:
+                dfs(n, [n])
+        assert edges, "repo lock-order graph should not be empty"
+
+    def test_committed_witness_file_is_subset(self):
+        from opentenbase_tpu.analysis.concurrency import lock_order_edges
+        path = os.path.join(_REPO, "opentenbase_tpu", "analysis",
+                            "lock_order.json")
+        data = json.load(open(path))
+        assert data["violations"] == []
+        static = set(lock_order_edges(_REPO))
+        witnessed = {tuple(e) for e in data["edges"]}
+        assert witnessed <= static, witnessed - static
+
+
+class TestChaosUnderSanitizer:
+    def test_guard_shard_zero_violations(self, tmp_path):
+        """Re-run the fault-tolerance shard with the sanitizer on: no
+        inversions/holds violations, and witnessed edges must already
+        be in the static graph."""
+        report = str(tmp_path / "witnessed.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             "tests/test_guard.py::TestGtmGuard",
+             "tests/test_guard.py::TestCircuitBreaker",
+             "tests/test_guard.py::TestChaosFailover",
+             "-q", "-p", "no:cacheprovider"],
+            cwd=_REPO, capture_output=True, text=True, timeout=420,
+            env={**_ENV, "OTB_LOCKCHECK": "1",
+                 "OTB_LOCKCHECK_REPORT": report})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        data = json.load(open(report))
+        assert data["violations"] == [], data["violations"]
+        from opentenbase_tpu.analysis.concurrency import lock_order_edges
+        static = set(lock_order_edges(_REPO))
+        witnessed = {tuple(e) for e in data["edges"]}
+        assert witnessed <= static, witnessed - static
