@@ -25,6 +25,21 @@ Modes via env:
 - BENCH_SF (default 1.0), BENCH_REPEAT (default 5)
 - BENCH_MODE=ladder (default) | single | mesh — single/mesh run only that
   one arm (the r1/r2 behavior) for quick checks
+- BENCH_MODE=qps: the serving-tier arm (exec/scheduler.py) — sustained
+  throughput with 8/64/256 concurrent clients over (a) a same-signature
+  point-SELECT workload (varying key literal: every query is the SAME
+  literal-masked compiled program, so the scheduler coalesces them into
+  multi-query dispatches and amortizes per-query host overhead), (b) a
+  same-signature analytics workload (Q1 with a varying shipdate
+  literal), and (c) a mixed Q1/Q3/Q5 + point-SELECT workload.
+  Reports per-arm qps, p50/p99 latency, batch_rate
+  (fraction of admitted queries served by a multi-query dispatch), shed
+  count, and the dispatch-size histogram, plus a single-session
+  serial-loop baseline per workload.  Knobs: BENCH_QPS_SECONDS (timed
+  window per arm, default 4), BENCH_QPS_WARM_SECONDS (untimed
+  compile-warm phase per arm, default 2), BENCH_QPS_CLIENTS (default
+  "8,64,256"), BENCH_QPS_BASELINE_N (serial baseline queries, default
+  60); BENCH_SF defaults to 0.05 in this mode
 - BENCH_OLTP=1: additionally measure the point-op latency path (FQS
   INSERT/SELECT p50) — the reference's execLight.c OLTP story
 - --trace: after each timed arm, dump the full last-query span tree
@@ -46,6 +61,16 @@ Modes via env:
   p50/p99 latency, error rate, wrong-result count, and the otbguard
   counters (net/guard.py).  Knobs: BENCH_CHAOS_OPS (400),
   BENCH_CHAOS_FLAP_EVERY (50), plus the OTB_RPC_*/OTB_BREAKER_* envs.
+- --chaos-concurrent: the otbshield acceptance arm — 64 client threads
+  (coalescing scheduler + a flapping TCP cluster) under simultaneous
+  poisoned-literal, cancel-storm, dispatch-OOM, wire-flap, and shed
+  pressure.  ONE JSON line with qps, p50/p99, the offender-vs-
+  collateral error split (collateral must be 0), wrong_results (must
+  be 0), degraded count, and the admission-slot + GTM-lease ledgers
+  (must balance); exits nonzero when any acceptance number fails.
+  Knobs: BENCH_CHAOSC_SECONDS (8), BENCH_CHAOSC_WARM_SECONDS (2),
+  BENCH_CHAOSC_CLIENTS (64), BENCH_CHAOSC_SF (0.02),
+  BENCH_CHAOSC_ANALYTICS=0 for a quick smoke run.
 """
 
 import json
@@ -182,6 +207,7 @@ def _oltp_latencies(s, n=200):
 
 TRACE_DUMP = "--trace" in sys.argv[1:]
 CHAOS = "--chaos" in sys.argv[1:]
+CHAOS_CONCURRENT = "--chaos-concurrent" in sys.argv[1:]
 
 
 def _chaos_arm():
@@ -277,6 +303,323 @@ def _chaos_arm():
                 pass
         gtm.stop()
         shutil.rmtree(d, ignore_errors=True)
+
+
+def _rows_close(got, want):
+    """Wrong-result check: exact for ints/strings, tight relative
+    tolerance for floats (a degraded/spill re-execution may legally
+    re-associate float reductions; it may never change an answer)."""
+    if got == want:
+        return True
+    if got is None or want is None or len(got) != len(want):
+        return False
+    for rg, rw in zip(got, want):
+        if len(rg) != len(rw):
+            return False
+        for a, b in zip(rg, rw):
+            if isinstance(a, float) or isinstance(b, float):
+                if abs(float(a) - float(b)) > 1e-6 * max(
+                        1.0, abs(float(b))):
+                    return False
+            elif a != b:
+                return False
+    return True
+
+
+def _chaosc_streams(analytics):
+    """The mixed chaos workload: point SELECTs (one tiny coalescable
+    signature), a small-agg signature, and — unless disabled for smoke
+    runs — the Q1-varying-literal / Q3 / Q5 analytics shapes from the
+    qps arm.  Key 251 is reserved for the poison offender's stream and
+    never appears in a clean literal."""
+    points = [f"select v from qps_kv where k = {(i * 37) % 250}"
+              for i in range(64)]
+    aggs = [f"select sum(v), count(*) from qps_kv where k < {60 + 7 * i}"
+            for i in range(8)]
+    mixed = []
+    if analytics:
+        _, same, _ = _qps_queries()
+        from opentenbase_tpu.tpch.queries import Q
+        for i in range(16):
+            mixed.append(points[i % len(points)])
+            mixed.append(same[i % len(same)])
+            mixed.append(aggs[i % len(aggs)])
+            if i % 5 == 0:
+                mixed.append(Q[3])
+            if i % 8 == 0:
+                mixed.append(Q[5])
+            mixed.append(points[(i * 3 + 1) % len(points)])
+    else:
+        for i in range(16):
+            mixed.append(points[i % len(points)])
+            mixed.append(aggs[i % len(aggs)])
+            mixed.append(points[(i * 3 + 1) % len(points)])
+    return mixed
+
+
+def _chaosc_flap_cluster(tmp):
+    """Plane B of --chaos-concurrent: a live 2-DN TCP cluster whose
+    dn0 wire will flap mid-run.  Gentle knobs — the retry budget must
+    absorb every tear (times=2 faults < 3 retries, breaker threshold
+    high enough to never fast-fail): errors here are COLLATERAL."""
+    from opentenbase_tpu.exec.dist_session import ClusterSession
+    from opentenbase_tpu.gtm.server import GtmCore, GtmServer
+    from opentenbase_tpu.net.dn_server import DnServer
+    from opentenbase_tpu.parallel.cluster import Cluster
+
+    os.environ.setdefault("OTB_RPC_RETRIES", "3")
+    os.environ.setdefault("OTB_BREAKER_THRESHOLD", "16")
+    Cluster(n_datanodes=2, datadir=tmp).checkpoint()
+    gtm = GtmServer(GtmCore(os.path.join(tmp, "gtm.json"))).start()
+    catalog_path = os.path.join(tmp, "catalog.json")
+    servers = [DnServer(i, os.path.join(tmp, f"dn{i}"), catalog_path,
+                        gtm_addr=(gtm.host, gtm.port)).start()
+               for i in range(2)]
+    cluster = Cluster.connect(catalog_path,
+                              [(s.host, s.port) for s in servers],
+                              (gtm.host, gtm.port))
+    s = ClusterSession(cluster)
+    s.execute("create table chaos_kv (k bigint primary key, v bigint) "
+              "distribute by shard(k)")
+    s.execute("insert into chaos_kv values " + ", ".join(
+        f"({i}, {i * 3})" for i in range(64)))
+    return cluster, gtm, servers
+
+
+def _chaos_concurrent_arm():
+    """--chaos-concurrent: the full otbshield acceptance run.  64
+    client threads (56 through the coalescing scheduler on mixed
+    Q1/Q3/agg/point ops, 8 point-reading a live TCP cluster) while a
+    chaos driver injects, concurrently:
+
+    - a poisoned literal (key 251) that kills any batched dispatch it
+      rides in — bisection must fail ONLY the offender's queries and
+      repeat offenses must trip the signature quarantine;
+    - cancel storms (random sessions' cancel_event set mid-flight);
+    - device OOM at dispatch (alternating recover-after-eviction and
+      degrade-to-spill severities);
+    - DN wire flaps on the TCP plane (otbguard retries absorb them);
+    - shed pressure (queue_depth below the client count).
+
+    Prints ONE JSON line: qps + p50/p99 over clean queries, the error
+    split (offender_poison / offender_cancel / offender_timeout / shed
+    vs collateral — collateral MUST be 0), wrong_results (MUST be 0),
+    degraded count (injected OOM answers, not errors), and the slot /
+    lease ledgers (MUST balance: zero leaks after drain).  Knobs:
+    BENCH_CHAOSC_SECONDS (8), BENCH_CHAOSC_WARM_SECONDS (2),
+    BENCH_CHAOSC_CLIENTS (64), BENCH_CHAOSC_SF (0.02),
+    BENCH_CHAOSC_ANALYTICS=0 to drop Q1/Q3/Q5 for quick smoke runs."""
+    import shutil
+    import threading
+    from opentenbase_tpu.exec import scheduler as sched_mod
+    from opentenbase_tpu.exec import shield
+    from opentenbase_tpu.exec.session import Session
+    from opentenbase_tpu.exec.dist_session import ClusterSession
+    from opentenbase_tpu.utils import faultinject as FI
+
+    seconds = float(os.environ.get("BENCH_CHAOSC_SECONDS", "8"))
+    warm_s = float(os.environ.get("BENCH_CHAOSC_WARM_SECONDS", "2"))
+    n_clients = int(os.environ.get("BENCH_CHAOSC_CLIENTS", "64"))
+    sf = float(os.environ.get("BENCH_CHAOSC_SF", "0.02"))
+    analytics = os.environ.get("BENCH_CHAOSC_ANALYTICS", "1") != "0"
+    # short cooldown so the quarantine trips AND lifts inside the run
+    # (brownout-and-recover, not a permanent serial lane)
+    os.environ.setdefault("OTB_SHIELD_COOLDOWN_S", "2")
+
+    n_flap = max(1, min(8, n_clients // 8))
+    n_sched = n_clients - n_flap
+
+    node, setup_s, _ = _qps_setup(sf)
+    mixed = _chaosc_streams(analytics)
+    poison_sql = "select v from qps_kv where k = 251"
+    refs = {}
+    for q in sorted(set(mixed + [poison_sql])):
+        refs[q] = setup_s.execute(q)[-1].rows   # serial truth + compile
+
+    tmp = tempfile.mkdtemp(prefix="otb-chaosc-")
+    cluster, fgtm, servers = _chaosc_flap_cluster(tmp)
+
+    sched_mod.reset_stats()
+    shield.reset_stats()
+    FI.arm_poison(251, times=-1)
+
+    stats = {"ok": 0, "wrong": 0, "offender_poison": 0,
+             "offender_cancel": 0, "offender_timeout": 0, "shed": 0,
+             "collateral": 0}
+    flap = {"ops": 0, "errors": 0, "wrong": 0}
+    coll_samples = []
+    lats = []
+    sessions = []
+    lock = threading.Lock()
+    stop_at = [0.0]
+    timed_from = [float("inf")]
+
+    def classify(msg):
+        if "poison-literal" in msg:
+            return "offender_poison"
+        if "user request" in msg:
+            return "offender_cancel"
+        if "statement timeout" in msg:
+            return "offender_timeout"
+        if "shed" in msg:
+            return "shed"
+        return "collateral"
+
+    def sched_client(ci):
+        sess = Session(node)
+        with lock:
+            sessions.append(sess)
+        offender = ci % 7 == 0
+        i = ci
+        while time.perf_counter() < stop_at[0]:
+            sql = (poison_sql if offender and i % 4 == 0
+                   else mixed[i % len(mixed)])
+            t0 = time.perf_counter()
+            try:
+                rows = sched.run(sess, sql)[-1].rows
+                dt = time.perf_counter() - t0
+                with lock:
+                    if _rows_close(rows, refs[sql]):
+                        stats["ok"] += 1
+                    else:
+                        stats["wrong"] += 1
+                    if t0 >= timed_from[0]:
+                        lats.append(dt)
+            except Exception as e:  # noqa: BLE001 — the split IS the metric
+                kind = classify(str(e))
+                with lock:
+                    stats[kind] += 1
+                    if kind == "collateral" and len(coll_samples) < 3:
+                        coll_samples.append(str(e)[:160])
+            i += 1
+
+    def flap_client(fi):
+        fsess = ClusterSession(cluster)
+        i = fi
+        while time.perf_counter() < stop_at[0]:
+            k = i % 64
+            try:
+                rows = fsess.query(f"select v from chaos_kv "
+                                   f"where k = {k}")
+                with lock:
+                    flap["ops"] += 1
+                    if rows != [(k * 3,)]:
+                        flap["wrong"] += 1
+            except Exception:  # noqa: BLE001 — collateral by definition
+                with lock:
+                    flap["ops"] += 1
+                    flap["errors"] += 1
+            i += 1
+
+    def chaos_driver():
+        n = 0
+        while time.perf_counter() < stop_at[0]:
+            time.sleep(0.4)
+            n += 1
+            with lock:
+                live = list(sessions)
+            if live:   # cancel storm: two victims per tick
+                live[(n * 13) % len(live)].cancel_event.set()
+                live[(n * 29) % len(live)].cancel_event.set()
+            if n % 2 == 0:
+                # OOM at dispatch: odd doses recover after eviction,
+                # every 4th dose defeats the retry → spill degradation
+                FI.arm_oom("dispatch", times=2 if n % 4 == 0 else 1)
+            else:
+                FI.arm_wire("dn0.recv", "close", times=2)
+
+    # queue_depth below the client count: admission overflow IS the
+    # shed-pressure injection (classified separately, never collateral)
+    sched = sched_mod.Scheduler(node=node,
+                                queue_depth=max(8, 3 * n_sched // 4),
+                                max_batch=16)
+    try:
+        stop_at[0] = time.perf_counter() + warm_s + seconds
+        timed_from[0] = time.perf_counter() + warm_s
+        threads = ([threading.Thread(target=sched_client, args=(ci,),
+                                     daemon=True)
+                    for ci in range(n_sched)]
+                   + [threading.Thread(target=flap_client, args=(fi,),
+                                      daemon=True)
+                      for fi in range(n_flap)]
+                   + [threading.Thread(target=chaos_driver,
+                                       daemon=True)])
+        t_begin = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_begin
+        timed_wall = min(wall, seconds)
+    finally:
+        FI.disarm_poison()
+        FI.disarm_oom()
+        FI.disarm_wire()
+        sched.stop()
+        res = getattr(cluster, "_resolver", None)
+        if res is not None:
+            res.stop()
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        fgtm.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    acq, rel = sched_mod.slot_balance()
+    lst = sched.gtm.resq_stats()
+    live_slots = sum(sched.gtm.resq_counts().values())
+    sst = shield.stats_snapshot()
+    dst = sched_mod.stats_snapshot()
+    lats.sort()
+    n_queries = sum(stats.values()) + flap["ops"]
+    collateral = stats["collateral"] + flap["errors"]
+    out = {
+        "metric": f"chaos-concurrent p99 ({n_clients} clients, DN flap"
+                  f" + cancel storm + OOM + poison, {platform})",
+        "value": round(_qps_pct(lats, 0.99) * 1e3, 3),
+        "unit": "ms",
+        "clients": {"scheduler": n_sched, "flap": n_flap},
+        "queries": n_queries,
+        "qps": round(len(lats) / timed_wall, 1) if timed_wall else 0.0,
+        "p50_ms": round(_qps_pct(lats, 0.50) * 1e3, 3),
+        "p99_ms": round(_qps_pct(lats, 0.99) * 1e3, 3),
+        "wrong_results": stats["wrong"] + flap["wrong"],
+        "errors": {
+            "offender_poison": stats["offender_poison"],
+            "offender_cancel": stats["offender_cancel"],
+            "offender_timeout": stats["offender_timeout"],
+            "shed": stats["shed"],
+            "collateral": collateral,
+        },
+        "collateral_rate": round(collateral / max(1, n_queries), 6),
+        "degraded": sst["degraded"],
+        "oom_dispatches": sst["oom_dispatches"],
+        "oom_retries": sst["oom_retries"],
+        "batch_failures": sst["batch_failures"],
+        "isolated": sst["isolated"],
+        "quarantined": sst["quarantined"],
+        "batch_rate": round(dst["batched"] / dst["admitted"], 3)
+        if dst["admitted"] else 0.0,
+        "slot_ledger": {"acquired": acq, "released": rel,
+                        "leaked": acq - rel},
+        "gtm_leases": {**lst, "live_slots": live_slots},
+        "flap": dict(flap),
+    }
+    if coll_samples:
+        out["collateral_samples"] = coll_samples
+    if tpu_unavailable:
+        out["tpu_unavailable"] = True
+    print(json.dumps(out))
+    ok = (collateral == 0 and out["wrong_results"] == 0
+          and acq == rel and live_slots == 0
+          and lst["acquired"] == lst["released"] + lst["expired"])
+    print(f"# chaos-concurrent: {'PASS' if ok else 'FAIL'} "
+          f"(collateral={collateral} wrong={out['wrong_results']} "
+          f"slots {acq}/{rel} leases {lst})", file=sys.stderr)
+    if not ok:
+        sys.exit(1)
 
 
 def _phases(qs):
@@ -430,15 +773,213 @@ def _run_warm2(data, sf):
             pass
 
 
+# ---------------------------------------------------------------------------
+# BENCH_MODE=qps — the serving-tier sustained-throughput arm
+# ---------------------------------------------------------------------------
+
+def _qps_pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              int(round(q * (len(sorted_vals) - 1))))
+    return float(sorted_vals[idx])
+
+
+def _qps_queries():
+    """Three SQL streams, all same-signature-friendly to different
+    degrees.  point_sig: point SELECTs with a varying key literal —
+    every query masks to ONE tiny fused program, so per-query host
+    overhead dominates and coalescing amortizes it (the decisive
+    batching demonstration; on a 1-core CPU host the analytics shapes
+    are compute-bound and batching can only tie serial).  q1_sig: Q1
+    with a varying shipdate literal — one analytics signature.  mixed:
+    Q1 variants + Q3 + Q5 + point SELECTs — several signatures plus
+    join shapes."""
+    from opentenbase_tpu.tpch.queries import Q
+    base = Q[1].replace("date '1998-12-01' - interval '90' day",
+                        "date '{}'")
+    same = [base.format(f"1998-{m:02d}-{d:02d}")
+            for m in (7, 8, 9) for d in (2, 9, 16, 23)]
+    points = [f"select v from qps_kv where k = {(i * 37) % 400}"
+              for i in range(64)]
+    mixed = []
+    for i in range(16):
+        mixed.append(same[i % len(same)])
+        if i % 4 == 0:
+            mixed.append(Q[3])
+        if i % 8 == 0:
+            mixed.append(Q[5])
+        mixed.append(points[i % len(points)])
+    return points, same, mixed
+
+
+def _qps_setup(sf):
+    from opentenbase_tpu.exec.session import LocalNode, Session
+    from opentenbase_tpu.tpch import datagen
+    from opentenbase_tpu.tpch.schema import SCHEMA
+    data = datagen.generate(sf=sf)
+    node = LocalNode()
+    s = Session(node)
+    s.execute(SCHEMA)
+    for tname in ("region", "nation", "supplier", "customer",
+                  "orders", "lineitem"):
+        td = node.catalog.table(tname)
+        nn = len(next(iter(data[tname].values())))
+        s._insert_rows(td, node.stores[tname], data[tname], nn)
+    s.execute("create table qps_kv (k bigint, v bigint)")
+    rows = ", ".join(f"({i}, {i * 7})" for i in range(400))
+    s.execute(f"insert into qps_kv values {rows}")
+    return node, s, len(data["lineitem"]["l_orderkey"])
+
+
+def _qps_serial(node, stream, n):
+    """Serial-loop baseline: one session, one query at a time — the
+    number the scheduler arms must beat on sustained throughput."""
+    from opentenbase_tpu.exec.session import Session
+    s = Session(node)
+    lats = []
+    t_begin = time.perf_counter()
+    for i in range(n):
+        t0 = time.perf_counter()
+        s.execute(stream[i % len(stream)])
+        lats.append(time.perf_counter() - t0)
+    wall = time.perf_counter() - t_begin
+    lats.sort()
+    return {"clients": 1, "queries": n, "qps": n / wall,
+            "p50_ms": _qps_pct(lats, 0.50) * 1e3,
+            "p99_ms": _qps_pct(lats, 0.99) * 1e3}
+
+
+def _qps_drive(sched, node, stream, clients, seconds):
+    """Closed-loop load: `clients` threads, each its own Session over
+    the shared node, issuing through the scheduler back-to-back.
+    Returns (merged latencies s, shed count, wall s)."""
+    import threading
+    from opentenbase_tpu.exec.session import Session
+    lats = [[] for _ in range(clients)]
+    sheds = [0] * clients
+    stop_at = [0.0]
+    gate = threading.Barrier(clients + 1)
+
+    def client(ci):
+        s = Session(node)
+        i = ci
+        gate.wait()
+        while time.perf_counter() < stop_at[0]:
+            t0 = time.perf_counter()
+            try:
+                sched.run(s, stream[i % len(stream)])
+                lats[ci].append(time.perf_counter() - t0)
+            except Exception:
+                sheds[ci] += 1
+            i += 1
+
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(clients)]
+    for t in threads:
+        t.start()
+    stop_at[0] = time.perf_counter() + seconds
+    t_begin = time.perf_counter()
+    gate.wait()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_begin
+    merged = sorted(x for per in lats for x in per)
+    return merged, sum(sheds), wall
+
+
+def _qps_arm(name, node, stream, clients, seconds, warm_s):
+    from opentenbase_tpu.exec import scheduler as sched_mod
+    sched = sched_mod.Scheduler(node=node,
+                                queue_depth=max(128, 4 * clients))
+    try:
+        if warm_s > 0:   # untimed phase: batch-class compiles land here
+            _qps_drive(sched, node, stream, clients, warm_s)
+        s0 = sched_mod.stats_snapshot()
+        lats, shed, wall = _qps_drive(sched, node, stream, clients,
+                                      seconds)
+        s1 = sched_mod.stats_snapshot()
+    finally:
+        sched.stop()
+    admitted = s1["admitted"] - s0["admitted"]
+    batched = s1["batched"] - s0["batched"]
+    hist = {k: s1["hist"].get(k, 0) - s0["hist"].get(k, 0)
+            for k in s1["hist"]
+            if s1["hist"].get(k, 0) > s0["hist"].get(k, 0)}
+    return {"arm": name, "clients": clients, "queries": len(lats),
+            "qps": len(lats) / wall if wall > 0 else 0.0,
+            "p50_ms": _qps_pct(lats, 0.50) * 1e3,
+            "p99_ms": _qps_pct(lats, 0.99) * 1e3,
+            "shed": shed,
+            "batch_rate": batched / admitted if admitted else 0.0,
+            "batch_dispatches": s1["batch_dispatches"]
+            - s0["batch_dispatches"],
+            "batch_hist": " ".join(f"{k}:{v}"
+                                   for k, v in sorted(hist.items()))}
+
+
+def _qps_mode():
+    sf = float(os.environ.get("BENCH_SF", "0.02"))
+    seconds = float(os.environ.get("BENCH_QPS_SECONDS", "4"))
+    warm_s = float(os.environ.get("BENCH_QPS_WARM_SECONDS", "2"))
+    clients_list = [int(c) for c in os.environ.get(
+        "BENCH_QPS_CLIENTS", "8,64,256").split(",") if c.strip()]
+    baseline_n = int(os.environ.get("BENCH_QPS_BASELINE_N", "60"))
+    node, s, n_rows = _qps_setup(sf)
+    points, same, mixed = _qps_queries()
+    serial = {}
+    arms = []
+    for name, stream in (("point_sig", points), ("q1_sig", same),
+                         ("mixed", mixed)):
+        for q in sorted(set(stream)):   # compile every serial shape once
+            s.execute(q)
+        serial[name] = _qps_serial(node, stream, baseline_n)
+        for clients in clients_list:
+            arms.append(_qps_arm(name, node, stream, clients, seconds,
+                                 warm_s))
+    pick = [a for a in arms if a["arm"] == "point_sig"]
+    head = next((a for a in pick if a["clients"] == 64),
+                (pick or arms)[-1])
+    out = {
+        "metric": f"sustained QPS SF{sf:g} (point_sig, "
+                  f"{head['clients']} clients, {platform})",
+        "value": round(head["qps"], 1),
+        "unit": "qps",
+        "vs_baseline": round(head["qps"] / serial["point_sig"]["qps"], 3)
+        if serial["point_sig"]["qps"] else 0.0,
+        "schema": "serial: per-workload single-session loop "
+                  "{clients, queries, qps, p50_ms, p99_ms}; arms: "
+                  "per (workload, client-count) scheduler run "
+                  "{arm, clients, queries, qps, p50_ms, p99_ms, "
+                  "batch_rate = batched/admitted, batch_dispatches, "
+                  "batch_hist 'size:count ...', shed}; vs_baseline = "
+                  "headline qps / serial point_sig qps",
+        "serial": {k: {f: (round(v, 3) if isinstance(v, float) else v)
+                       for f, v in e.items()} for k, e in serial.items()},
+        "arms": [{k: (round(v, 3) if isinstance(v, float) else v)
+                  for k, v in e.items()} for e in arms],
+        "lineitem_rows": n_rows,
+    }
+    if tpu_unavailable:
+        out["tpu_unavailable"] = True
+    print(json.dumps(out))
+    print(f"# qps mode: sf={sf} seconds={seconds} warm={warm_s} "
+          f"clients={clients_list} platform={platform}",
+          file=sys.stderr)
+
+
 def main():
+    if CHAOS_CONCURRENT:
+        _chaos_concurrent_arm()
+        return
     if CHAOS:
         _chaos_arm()
         return
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     repeat = int(os.environ.get("BENCH_REPEAT", "5"))
     mode = os.environ.get("BENCH_MODE", "ladder")
-    if mode not in ("ladder", "single", "mesh"):
-        print(f"unknown BENCH_MODE={mode!r} (ladder|single|mesh)",
+    if mode not in ("ladder", "single", "mesh", "qps"):
+        print(f"unknown BENCH_MODE={mode!r} (ladder|single|mesh|qps)",
               file=sys.stderr)
         sys.exit(2)
 
@@ -452,6 +993,10 @@ def main():
 
     if os.environ.get("BENCH_WARM2_CHILD") == "1":
         _warm2_child()
+        return
+
+    if mode == "qps":
+        _qps_mode()
         return
 
     from opentenbase_tpu.tpch import datagen

@@ -52,6 +52,8 @@ RULES = {
                       "guarded container escaping its lock",
     "thread-daemon": "non-daemon Thread/Timer without an owned join() "
                      "path (hangs interpreter exit)",
+    "slot-discipline": "admission-slot acquire (resq_acquire/_admit) "
+                       "without a release reachable via finally",
     "hlo-f64": "f64 tensor type in exported StableHLO",
     "hlo-host-transfer": "host transfer / callback op in exported "
                          "StableHLO",

@@ -25,7 +25,8 @@ from .concurrency import (ConcurrencyContext, LockAtomicityPass,
 from .core import (Baseline, Project, RULES, default_baseline_path,
                    make_report)
 from .passes import (HostSyncPass, LockDisciplinePass, NetDeadlinePass,
-                     ObsPurityPass, ProgramKeyPass, TracePurityPass)
+                     ObsPurityPass, ProgramKeyPass, SlotDisciplinePass,
+                     TracePurityPass)
 
 _CONCURRENCY_RULES = {"lock-order", "lock-blocking", "lock-atomicity"}
 
@@ -46,6 +47,7 @@ def run_passes(project: Project, rules=None) -> list:
         LockDisciplinePass(project),
         NetDeadlinePass(project),
         ThreadDaemonPass(project),
+        SlotDisciplinePass(project),
     ]
     if rules is None or rules & _CONCURRENCY_RULES:
         ctx = ConcurrencyContext(project, closure)

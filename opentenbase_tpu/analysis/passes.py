@@ -1087,3 +1087,101 @@ class NetDeadlinePass:
                 self._emit(findings, mi, node.lineno,
                            "settimeout(None) disables the RPC "
                            "deadline on this socket")
+
+
+# ===========================================================================
+# slot-discipline
+# ===========================================================================
+class SlotDisciplinePass:
+    """Every admission-slot acquire must have a release reachable via
+    ``finally``.  A GTM resource-queue slot (``resq_acquire``) or a
+    scheduler admission (``_admit``) that a statement dies holding
+    shrinks cluster-wide concurrency until the lease reaper notices —
+    and with long leases that is minutes of a slot doing nothing.
+
+    Accepted shapes, within the enclosing function:
+
+    - ``acquire(); try: ... finally: release()`` — the ``try`` starts
+      at/after the acquire, so every post-acquire exception path runs
+      the release; or
+    - ``try: acquire(); ... finally: release()`` — the acquire sits
+      inside the protected body (release must tolerate not-held, which
+      resq_release's owner identity check provides).
+
+    Wrappers that intentionally delegate the release to their caller
+    (the scheduler's ``_admit`` itself, the GTM wire passthrough) mark
+    the site ``# otblint: disable=slot-discipline``."""
+
+    rule = "slot-discipline"
+
+    _ACQUIRES = ("resq_acquire", "_admit")
+    _RELEASES = ("resq_release", "_release", "release",
+                 "resq_disconnect")
+
+    def __init__(self, project: Project):
+        self.project = project
+
+    def run(self) -> list:
+        findings = []
+        for mi in self.project.by_rel.values():
+            for node in ast.walk(mi.src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func, mi)
+                if d is None or d.split(".")[-1] not in self._ACQUIRES:
+                    continue
+                self._check_site(mi, node, findings)
+        return findings
+
+    # -- helpers --------------------------------------------------------
+    def _enclosing(self, mi, line: int):
+        best, best_start = None, -1
+        for fi in mi.functions.values():
+            node = fi.node
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end and node.lineno > best_start:
+                best, best_start = fi, node.lineno
+        return best
+
+    def _releases(self, stmts) -> bool:
+        for st in stmts:
+            for node in ast.walk(st):
+                if isinstance(node, ast.Call):
+                    d = _dotted(node.func, self._mi)
+                    if d is not None and \
+                            d.split(".")[-1] in self._RELEASES:
+                        return True
+        return False
+
+    def _check_site(self, mi, call: ast.Call, findings):
+        src = mi.src
+        if src.disabled(call.lineno, self.rule):
+            return
+        fi = self._enclosing(mi, call.lineno)
+        if fi is None:
+            findings.append(Finding(
+                self.rule, src.rel, call.lineno, "",
+                "module-level slot acquire cannot pair with a "
+                "finally-reachable release"))
+            return
+        if _fn_disabled(fi, self.rule):
+            return
+        self._mi = mi
+        ok = False
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Try) or not node.finalbody:
+                continue
+            if not self._releases(node.finalbody):
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            encloses = node.lineno <= call.lineno <= end
+            follows = node.lineno >= call.lineno
+            if encloses or follows:
+                ok = True
+                break
+        if not ok:
+            findings.append(Finding(
+                self.rule, src.rel, call.lineno, fi.qualname,
+                "slot acquire without a release reachable via "
+                "finally — an exception here leaks cluster-wide "
+                "admission concurrency until lease expiry"))

@@ -111,12 +111,32 @@ class ClusterSession:
         # out-of-band statement cancel (set by the CN server's cancel
         # protocol; reference: CHECK_FOR_INTERRUPTS / StatementCancel)
         self.cancel_event = None
+        # absolute monotonic deadline of the CURRENT statement, set at
+        # execute() entry from the statement_timeout GUC (PG semantics:
+        # milliseconds, 0/unset disabled) and enforced at every cancel
+        # poll point — queue waits, fragment boundaries, retries
+        self._stmt_deadline = None
 
     def _check_cancel(self):
         ev = self.cancel_event
         if ev is not None and ev.is_set():
             ev.clear()
             raise ExecError("canceling statement due to user request")
+        dl = self._stmt_deadline
+        if dl is not None and time.monotonic() >= dl:
+            raise ExecError(
+                "canceling statement due to statement timeout")
+
+    def _arm_deadline(self):
+        raw = str(self.cluster.gucs.get("statement_timeout", "")
+                  or "").strip()
+        ms = None
+        try:
+            ms = float(raw) if raw else None
+        except ValueError:
+            ms = None
+        self._stmt_deadline = (time.monotonic() + ms / 1e3
+                               if ms and ms > 0 else None)
 
     def _resq_owner(self) -> str:
         """Stable per-session acquirer identity for GTM resource-group
@@ -131,6 +151,7 @@ class ClusterSession:
     def execute(self, sql: str) -> list[Result]:
         out = []
         self._cur_sql = sql.strip()
+        self._arm_deadline()
         audit = getattr(self.cluster, "audit", None) \
             if self.cluster.gucs.get("audit_enabled", "off") == "on" \
             else None

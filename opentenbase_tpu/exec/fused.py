@@ -279,19 +279,18 @@ def _mask_node(node, lits: list):
     return node
 
 
-def try_fused(executor, node) -> Optional[object]:
-    """Execute `node` as one jitted program, or None if unsupported."""
-    return _try_fused(executor, node, allow_mask=True)
-
-
-def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
+def _screen_fragment(ctx, node):
+    """Shared fusability screen: `(scans, stores)` when `node` is a
+    traceable fragment over live SeqScan leaves, else None.  Used by
+    the serial path (`_try_fused`) and the serving tier's batch
+    classification (`batch_signature`) so both agree on what can run
+    as one program."""
     if not isinstance(node, (P.Agg, P.Project, P.Filter, P.Sort,
                              P.Limit, P.HashJoin)):
         return None   # bare SeqScan gains nothing
     scans = _find_scans(node)
     if not scans:
         return None
-    ctx = executor.ctx
     stores: dict = {}
     for scan in scans:
         store = ctx.stores.get(scan.table.name)
@@ -304,6 +303,29 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
     for store in stores.values():
         if _has_transformed_dup_dict(node, store):
             return None
+    return scans, stores
+
+
+def _table_sig(stores: dict) -> tuple:
+    """Per-table signature components: store identity + TEXT dictionary
+    lengths (dictionaries are baked trace constants)."""
+    return tuple(
+        (t, id(st), tuple(sorted((c, len(d.values))
+                                 for c, d in st.dicts.items())))
+        for t, st in sorted(stores.items()))
+
+
+def try_fused(executor, node) -> Optional[object]:
+    """Execute `node` as one jitted program, or None if unsupported."""
+    return _try_fused(executor, node, allow_mask=True)
+
+
+def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
+    ctx = executor.ctx
+    screened = _screen_fragment(ctx, node)
+    if screened is None:
+        return None
+    scans, stores = screened
 
     # canonical fragment signature: literal-masked plan + per-table
     # components (store identity + dictionary lengths — dictionaries
@@ -318,10 +340,7 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
     if key is None:
         return None
 
-    table_sig = tuple(
-        (t, id(st), tuple(sorted((c, len(d.values))
-                                 for c, d in st.dicts.items())))
-        for t, st in sorted(stores.items()))
+    table_sig = _table_sig(stores)
     traced_names = tuple(sorted(
         k for k, (v, _t) in ctx.params.items()
         if isinstance(v, (int, float)) and not isinstance(v, bool)))
@@ -463,12 +482,20 @@ def _ladder_remember(lkey, factors: dict):
             _JOIN_LADDER.pop(next(iter(_JOIN_LADDER)))
 
 
-def _build_program(ctx, frag_plan, baked, traced_names, lits, factors):
+def _build_program(ctx, frag_plan, baked, traced_names, lits, factors,
+                   batch=False):
     """jit the fragment runner.  The program's leaf tables arrive as a
     dict-of-dicts of traced arrays; per-table live row counts are
     traced scalars (a write changes the count every time — a static
     count would recompile the fragment per insert-then-read cycle);
-    only the padded shapes (size classes) retrace."""
+    only the padded shapes (size classes) retrace.
+
+    With `batch=True` the returned program maps the SAME traced
+    fragment over a leading batch axis of (snapshot, txid, literal)
+    tuples via `jax.lax.map` — K same-signature queries become ONE
+    compiled dispatch over shared staged tables, each batch element
+    carrying its own MVCC snapshot and literal bindings (the serving
+    tier's coalesced-dispatch path, exec/scheduler.py)."""
     from .executor import ExecContext, Executor
 
     meta: dict = {}
@@ -504,4 +531,215 @@ def _build_program(ctx, frag_plan, baked, traced_names, lits, factors):
             if sub.join_required else jnp.zeros(0, jnp.int64)
         return b.cols, b.valid, b.nulls, join_req
 
-    return jax.jit(run), meta
+    if not batch:
+        return jax.jit(run), meta
+
+    def run_batch(arrs_in, snaps, txids, pvals, ns_in):
+        # lax.map traces the fragment body ONCE and scans it over the
+        # batch axis — one executable, one dispatch, K queries; staged
+        # tables are closed over (shared), snapshot/txid/literals are
+        # the mapped leaves so every query keeps its own visibility
+        return jax.lax.map(
+            lambda q: run(arrs_in, q[0], q[1], q[2], ns_in),
+            (snaps, txids, tuple(pvals)))
+
+    return jax.jit(run_batch), meta
+
+
+# ---------------------------------------------------------------------------
+# Serving-tier batch entry points (exec/scheduler.py)
+
+@dataclasses.dataclass
+class FragSig:
+    """One query's literal-masked fused-fragment signature plus the
+    pieces a coalesced batch dispatch needs.  Two queries with equal
+    `sig` run the same compiled program and differ only in their
+    (snapshot, txid, literal-value) bindings — exactly the batching
+    the serving tier exploits."""
+    sig: object            # hashable canonical signature (struct_key)
+    plan: object           # literal-masked physical plan
+    lits: list             # this query's [(name, value, type)] bindings
+    stores: dict           # table name -> TableStore
+    cache: object          # DeviceTableCache handle for staging
+    need_by_table: dict    # table name -> needed column set
+    has_join: bool
+    plan_key: tuple        # _key_of(masked plan)
+    lit_types: tuple
+
+
+def batch_signature(ctx, node) -> Optional[FragSig]:
+    """Classify a plan subtree for same-program batching: the fragment
+    signature the serial path would cache under, or None when the
+    fragment can't ride the batched dispatch (not fusable, prepared
+    params in play, mask previously refused, or a join below the fuse
+    row floor).  Mirrors `_try_fused`'s screens so classification and
+    execution agree."""
+    if ctx.params:
+        # init-plan / prepared params would need per-query host work
+        # before the dispatch; keep those on the serial path
+        return None
+    screened = _screen_fragment(ctx, node)
+    if screened is None:
+        return None
+    scans, stores = screened
+
+    lits: list = []
+    masked = _mask_node(node, lits)
+    plan_key = _key_of(masked)
+    if plan_key is None:
+        return None
+    lit_types = tuple(t for _n, _v, t in lits)
+    base_key = (plan_key, _table_sig(stores), (), (), lit_types)
+    try:
+        hash(base_key)
+    except TypeError:
+        return None
+    with _STATE_LOCK:
+        refused = struct_key(base_key) in _MASK_REFUSED
+    if refused:
+        return None  # masked trace host-synced before: literals bake
+
+    has_join = _plan_has_join(masked)
+    if has_join and sum(st.row_count() for st in stores.values()) \
+            < _fuse_join_min_rows():
+        return None
+
+    need_by_table: dict = {}
+    for scan in scans:
+        need_by_table.setdefault(scan.table.name, set()).update(
+            _needed_columns(node, scan.alias))
+    return FragSig(sig=struct_key(base_key), plan=masked, lits=lits,
+                   stores=stores, cache=ctx.cache,
+                   need_by_table=need_by_table, has_join=has_join,
+                   plan_key=plan_key, lit_types=lit_types)
+
+
+def _batch_class(k: int) -> int:
+    """Pad batch size to a power of two so K concurrent arrivals hit a
+    bounded set of compiled batch classes."""
+    c = 1
+    while c < k:
+        c *= 2
+    return c
+
+
+def run_fused_batch(info: FragSig, queries: list) -> Optional[list]:
+    """Run K same-signature queries as ONE compiled dispatch.
+
+    `queries` is [(snapshot_ts, txid, [literal values])] — one entry
+    per query, literal order matching `info.lits`.  Returns a list of
+    per-query DBatch results (device views into the stacked program
+    output — materialization happens on the caller's thread, which is
+    what lets the scheduler overlap the next batch's staging with this
+    batch's device compute), or None when the batched path can't serve
+    this group (caller falls back to serial execution)."""
+    from .executor import DBatch, ExecContext, stats_tier
+
+    if not queries:
+        return None
+    # recompute the table signature at dispatch time: DML between
+    # classification and dispatch can grow a TEXT dictionary, and the
+    # dictionaries are baked trace constants — the key must match what
+    # the program will actually bake (same property as the serial path)
+    base_key = (info.plan_key, _table_sig(info.stores), (), (),
+                info.lit_types)
+    with _STATE_LOCK:
+        refused = struct_key(base_key) in _MASK_REFUSED
+    if refused:
+        return None
+
+    k = len(queries)
+    kclass = _batch_class(k)
+    padded = list(queries) + [queries[-1]] * (kclass - k)
+    snaps = jnp.asarray([q[0] for q in padded], jnp.int64)
+    txids = jnp.asarray([q[1] for q in padded], jnp.int64)
+    pvals = tuple(
+        jnp.stack([jnp.asarray(q[2][i]) for q in padded])
+        for i in range(len(info.lits)))
+
+    # stage ONCE for the whole batch (device cache, version-keyed)
+    staged_arrs: dict = {}
+    staged_ns: dict = {}
+    for t, need in sorted(info.need_by_table.items()):
+        arrs, n = info.cache.get(info.stores[t], sorted(need))
+        staged_arrs[t] = arrs
+        staged_ns[t] = jnp.int64(n)
+
+    lkey = struct_key(base_key)
+    with _STATE_LOCK:
+        factors = dict(_JOIN_LADDER.get(lkey, {})) if info.has_join \
+            else {}
+    bctx = ExecContext(info.stores, 0, 0, info.cache)
+
+    for _attempt in range(24):
+        full_key = base_key + (("__batch", kclass),
+                               tuple(sorted(factors.items())))
+        hit = plancache.FUSED.get(full_key)
+        if hit is None:
+            hit = plancache.FUSED.put(
+                full_key, _build_program(bctx, info.plan, {}, (),
+                                         info.lits, factors,
+                                         batch=True))
+        fn, meta = hit
+        if fn is None:
+            return None
+        t0 = time.perf_counter()
+        try:
+            with stats_tier("fused"):
+                cols, valid, nulls, join_req = fn(
+                    staged_arrs, snaps, txids, pvals, staged_ns)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError):
+            # a masked literal fed value-dependent program structure:
+            # this shape bakes its literals — never batchable
+            _mask_refused_add(struct_key(base_key))
+            plancache.FUSED.pop(full_key)
+            return None
+        except Exception as e:
+            from . import shield
+            if shield.is_oom(e):
+                # device allocation failure must REACH the scheduler:
+                # its pressure ladder (evict-coldest + retry, then
+                # degrade to spill) is the correct response — a serial
+                # fallback would just re-discover the same OOM K times
+                plancache.FUSED.pop(full_key)
+                raise
+            # fall back to serial execution, which reproduces (and
+            # attributes) the error per query
+            plancache.FUSED.pop(full_key)
+            return None
+        plancache.FUSED.record_call(fn, t0)
+
+        caps = meta.get("join_caps") or ()
+        if caps:
+            # per-join required totals arrive stacked (K, njoins):
+            # grow to the max any batch element needs
+            req = np.asarray(jax.device_get(join_req)).max(axis=0)
+            grew = False
+            for (jid, cap), r in zip(caps, req):
+                if r <= cap:
+                    continue
+                mult = 1
+                while cap * mult < r:
+                    mult *= 2
+                factors[jid] = factors.get(jid, 1) * mult
+                if factors[jid] > 4096:
+                    return None
+                grew = True
+            if grew:
+                _ladder_remember(lkey, factors)
+                continue
+        if info.has_join:
+            _ladder_remember(lkey, factors)
+
+        # demux: per-query device views into the stacked output (the
+        # padded tail, if any, is discarded)
+        out = []
+        for i in range(k):
+            out.append(DBatch(
+                {n: a[i] for n, a in cols.items()}, valid[i],
+                dict(meta["types"]), dict(meta["dicts"]),
+                {n: a[i] for n, a in nulls.items()}))
+        return out
+    return None  # overflow never converged

@@ -54,6 +54,7 @@ from typing import Optional
 
 from ..sql import ast as A
 from ..sql.parser import parse_sql
+from . import shield
 from .executor import ExecContext, ExecError, materialize
 from .fused import batch_signature, run_fused_batch
 from .session import Result
@@ -71,6 +72,14 @@ _STATS: dict = {          # guarded_by: _STATS_LOCK
     "shed": 0,            # rejected: queue full or shed-deadline passed
     "dispatches": 0,      # device dispatches (a batch counts once)
     "batch_dispatches": 0,
+    # slot-discipline ledger: every successful GTM slot acquire must be
+    # matched by exactly one release, no matter which exception path a
+    # statement dies on — asserted equal after drain (otbshield)
+    "slots_acquired": 0,
+    "slots_released": 0,
+    # statement-deadline / cancel outcomes (otbshield)
+    "expired": 0,         # statement_timeout fired (queued or in-flight)
+    "canceled": 0,        # cancel event consumed (queued or in-flight)
 }
 _HIST: dict = {}          # guarded_by: _STATS_LOCK — batch size -> count
 _WAITS: collections.deque = collections.deque(  # guarded_by: _STATS_LOCK
@@ -149,8 +158,42 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def slot_balance() -> tuple:
+    """(acquired, released) across every scheduler in the process —
+    equal once all submitted work has drained (the no-leak invariant
+    the chaos harness asserts)."""
+    with _STATS_LOCK:
+        return _STATS["slots_acquired"], _STATS["slots_released"]
+
+
+def assert_slot_balance():
+    acq, rel = slot_balance()
+    assert acq == rel, f"admission slot leak: acquired={acq} released={rel}"
+
+
+def _stmt_timeout_s(session) -> Optional[float]:
+    """The session's statement_timeout GUC in seconds (PG semantics:
+    milliseconds, 0/unset = disabled)."""
+    owner = getattr(session, "node", None) or \
+        getattr(session, "cluster", None)
+    gucs = getattr(owner, "gucs", None) or {}
+    raw = str(gucs.get("statement_timeout", "") or "").strip()
+    if not raw:
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        return None
+    return ms / 1e3 if ms > 0 else None
+
+
 class _Shed(Exception):
     pass
+
+
+class _Gone(Exception):
+    """Admission abandoned: the item expired/canceled while waiting for
+    a slot — it is already finished, and NO slot is held."""
 
 
 _STOP = object()
@@ -160,7 +203,8 @@ class _Item:
     """One submitted statement moving through the scheduler."""
     __slots__ = ("session", "sql", "planned", "info", "group",
                  "t_submit", "ev", "error", "results", "batch",
-                 "out_names", "is_write")
+                 "out_names", "is_write", "deadline", "cancel_event",
+                 "lk", "detached", "degraded", "lits")
 
     def __init__(self, session, sql):
         self.session = session
@@ -175,6 +219,19 @@ class _Item:
         self.batch = None         # batched path: demuxed DBatch view
         self.out_names = None
         self.is_write = False
+        # statement deadline (absolute monotonic) from the session's
+        # statement_timeout GUC at submit time; None = unbounded
+        to = _stmt_timeout_s(session)
+        self.deadline = None if to is None else self.t_submit + to
+        # out-of-band cancel propagates into QUEUED and BATCHED items
+        # (previously only the serial lane's execute() polled it)
+        self.cancel_event = getattr(session, "cancel_event", None)
+        # completion/detach handshake: the waiter may abandon the item
+        # (deadline, cancel) while a dispatcher/worker is completing it
+        self.lk = threading.Lock()
+        self.detached = False     # guarded_by: lk
+        self.degraded = False     # served by the spill path (shield)
+        self.lits = None          # literal bindings (poison fault surface)
 
     @property
     def sig(self):
@@ -295,8 +352,37 @@ class Scheduler:
         return item
 
     def wait(self, item: _Item, timeout: float = 600.0) -> list:
-        if not item.ev.wait(timeout):
-            raise ExecError("scheduler: query timed out awaiting dispatch")
+        """Wait for completion, honoring the statement deadline and the
+        session's cancel event.  On expiry/cancel the item DETACHES: it
+        finishes here, batch-mates are untouched, and whichever
+        dispatcher later tries to complete it becomes a no-op."""
+        end = time.monotonic() + timeout
+        if item.deadline is not None:
+            end = min(end, item.deadline)
+        cancel = item.cancel_event
+        while True:
+            now = time.monotonic()
+            rem = end - now
+            if rem <= 0:
+                if self._detach(item):
+                    if item.deadline is not None and now >= item.deadline:
+                        _bump("expired")
+                        raise ExecError(
+                            "canceling statement due to statement timeout")
+                    raise ExecError(
+                        "scheduler: query timed out awaiting dispatch")
+                break    # completed under the wire: consume the result
+            # poll in short slices only when there is a cancel event to
+            # watch; otherwise one blocking wait to the deadline
+            if item.ev.wait(min(0.05, rem) if cancel is not None else rem):
+                break
+            if cancel is not None and cancel.is_set():
+                cancel.clear()
+                if self._detach(item):
+                    _bump("canceled")
+                    raise ExecError(
+                        "canceling statement due to user request")
+                break
         if item.error is not None:
             raise item.error
         if item.results is not None:
@@ -304,9 +390,79 @@ class Scheduler:
         # batched path: materialize HERE, on the client thread — the
         # device→host sync for query i happens while the dispatcher is
         # already staging/launching query i+1
-        names, rows = materialize(item.batch, item.out_names)
+        try:
+            names, rows = materialize(item.batch, item.out_names)
+        except BaseException as e:
+            # per-member materialization fault: isolate and re-run this
+            # ONE member serially; batch-mates already hold their views
+            return self._recover_member(item, e)
         return [Result("SELECT", names=names, rows=rows,
                        rowcount=len(rows))]
+
+    # -- completion handshake ---------------------------------------------
+    def _complete(self, item: _Item, error=None, results=None,
+                  batch=None, out_names=None) -> bool:
+        """Deliver a result/error unless the waiter already left.
+        Returns False (and delivers nothing) for detached items."""
+        with item.lk:
+            if item.detached or item.ev.is_set():
+                return False
+            item.error = error
+            if results is not None:
+                item.results = results
+            if batch is not None:
+                item.batch = batch
+                item.out_names = out_names
+            item.ev.set()
+            return True
+
+    def _detach(self, item: _Item) -> bool:
+        """Waiter abandons the item (deadline/cancel).  False when a
+        completion already landed — the waiter must consume it."""
+        with item.lk:
+            if item.ev.is_set():
+                return False
+            item.detached = True
+            return True
+
+    def _expire_if_dead(self, item: _Item) -> bool:
+        """Dispatcher-side reap: True when the item is already detached
+        or just expired/canceled here.  Queued items die in place — no
+        slot was ever acquired for them."""
+        with item.lk:
+            if item.detached:
+                return True
+        now = time.monotonic()
+        if item.deadline is not None and now >= item.deadline:
+            if self._complete(item, error=ExecError(
+                    "canceling statement due to statement timeout")):
+                _bump("expired")
+            return True
+        cancel = item.cancel_event
+        if cancel is not None and cancel.is_set():
+            cancel.clear()
+            if self._complete(item, error=ExecError(
+                    "canceling statement due to user request")):
+                _bump("canceled")
+            return True
+        return False
+
+    def _recover_member(self, item: _Item, exc: BaseException) -> list:
+        """A batched member failed at materialization (client thread):
+        record the batch failure for quarantine accounting and re-run
+        this one member serially, inline.  Batch-mates are unaffected —
+        they hold independent views into the stacked output."""
+        shield.note_batch_failure(item.sig)
+        shield.bump("isolated")
+        try:
+            self._admit(item.group, time.monotonic() + self.shed_s,
+                        item=item)
+        except (_Shed, _Gone):
+            raise exc
+        try:
+            return item.session.execute(item.sql)
+        finally:
+            self._release(item.group)
 
     # -- classification ---------------------------------------------------
     def _classify(self, item: _Item):
@@ -339,7 +495,10 @@ class Scheduler:
         if info is None:
             return
         item.planned = planned
-        item.info = info
+        item.lits = info.lits     # serial lane shares the poison surface
+        if shield.quarantined(info.sig):
+            return    # repeat offender: barred from coalescing, runs
+        item.info = info          # alone on the serial lane (cooldown)
 
     # -- admission --------------------------------------------------------
     def _cap(self, group: str) -> int:
@@ -354,35 +513,52 @@ class Scheduler:
                 pass
         return self.slots
 
-    def _admit(self, group: str, deadline: float):
+    def _admit(self, group: str, deadline: float,
+               item: Optional[_Item] = None):
         """Acquire one GTM slot or shed at the deadline.  Exponential
-        backoff mirrors the cluster session's resource-queue wait."""
+        backoff mirrors the cluster session's resource-queue wait.
+
+        Slot-discipline contract: `slots_acquired` bumps ONLY on a
+        successful acquire, so every exit from this function — _Shed,
+        _Gone, or a GTM failure raising mid-acquire — leaves the ledger
+        consistent with zero slots held.  Callers must reach _release
+        via finally once this returns."""
         delay = 0.0005
-        while not self.gtm.resq_acquire(group, self._cap(group),
-                                        owner=self._owner,
-                                        lease_s=self.lease_s):
+        # the sanctioned wrapper: callers pair THIS acquire with
+        # _release in their own finally
+        while not self.gtm.resq_acquire(  # otblint: disable=slot-discipline
+                group, self._cap(group), owner=self._owner,
+                lease_s=self.lease_s):
+            if item is not None and self._expire_if_dead(item):
+                raise _Gone()
             if time.monotonic() >= deadline:
                 raise _Shed(
                     f"resource group '{group}' queue wait timeout: "
                     "query shed")
             time.sleep(delay)
             delay = min(delay * 2, 0.02)
+        _bump("slots_acquired")
 
     def _release(self, group: str):
+        # ledger counts the scheduler's release INTENT: resq_release is
+        # a no-op when GTM already reaped an expired lease (that side is
+        # accounted by gtm resq_stats), and a GTM error must not unwind
+        # the caller's completion path
+        _bump("slots_released")
         try:
             self.gtm.resq_release(group, owner=self._owner)
         except Exception:
             pass
 
     def _shed_item(self, item: _Item, exc: _Shed):
+        if not self._complete(item, error=ExecError(str(exc))):
+            return    # waiter already gone: don't count a shed
         _bump("shed")
         # the overload arm of the guard's degradation ladder: a shed is
         # "this CN is degraded by load", same surface as "that DN is
         # degraded by failures" (otb_node_health + otb_guard_shed_total)
         from ..net.guard import note_shed
         note_shed(getattr(item, "group", "default") or "default")
-        item.error = ExecError(str(exc))
-        item.ev.set()
 
     # -- dispatcher -------------------------------------------------------
     def _next(self, timeout: Optional[float]):
@@ -443,11 +619,28 @@ class Scheduler:
                 return
             if it is _STOP:
                 continue
-            it.error = ExecError("scheduler stopped")
-            it.ev.set()
+            self._complete(it, error=ExecError("scheduler stopped"))
 
     # -- execution paths --------------------------------------------------
     def _dispatch_batch(self, items: list):
+        """Coalesced dispatch entry: reap dead members, pre-shrink the
+        batch to the admission byte estimate, launch each chunk."""
+        live = [it for it in items if not self._expire_if_dead(it)]
+        if not live:
+            return
+        if len(live) == 1:
+            self._pool.submit(self._run_serial, live[0])
+            return
+        cap = shield.batch_cap(live[0].session.node, live[0].info,
+                               self.max_batch)
+        for i in range(0, len(live), cap):
+            chunk = live[i:i + cap]
+            if len(chunk) == 1:
+                self._pool.submit(self._run_serial, chunk[0])
+            else:
+                self._dispatch_one(chunk)
+
+    def _dispatch_one(self, items: list, isolating: bool = False):
         group = items[0].group
         deadline = min(it.t_submit for it in items) + self.shed_s
         try:
@@ -456,9 +649,15 @@ class Scheduler:
             for it in items:
                 self._shed_item(it, e)
             return
-        out = None
+        except BaseException as e:
+            # admission infrastructure failure (GTM died mid-acquire):
+            # nothing is held, fail the members with the ledger intact
+            for it in items:
+                self._complete(it, error=e)
+            return
+        out = err = None
+        t_start = time.monotonic()
         try:
-            t_start = time.monotonic()
             node = items[0].session.node
             queries = []
             for it in items:
@@ -469,14 +668,34 @@ class Scheduler:
                 snap = node.gts.next_gts()
                 queries.append(
                     (snap, txid, [v for _n, v, _t in it.info.lits]))
-            out = run_fused_batch(items[0].info, queries)
-        except BaseException as e:
+            for attempt in (0, 1):
+                try:
+                    shield.pre_dispatch(items[0].info, queries)
+                    out = run_fused_batch(items[0].info, queries)
+                    err = None
+                    break
+                except BaseException as e:
+                    err = e
+                    if shield.is_oom(e) and attempt == 0:
+                        # memory-pressure ladder, rung 1: evict the
+                        # coldest bufferpool entries and retry ONCE
+                        shield.bump("oom_dispatches")
+                        shield.relieve()
+                        continue
+                    break
+        finally:
             self._release(group)
-            for it in items:
-                it.error = e
-                it.ev.set()
+        if err is not None:
+            if shield.is_oom(err):
+                # rung 2: relief did not help — degrade the members to
+                # the spill tier (an answer instead of an error)
+                for it in items:
+                    self._pool.submit(self._serve_degraded, it)
+                return
+            if not isolating:
+                shield.note_batch_failure(items[0].sig)
+            self._isolate(items)
             return
-        self._release(group)
         if out is None:
             # batched path declined (mask refused / ladder exhausted /
             # program error): serial fallback reproduces per-query
@@ -486,28 +705,84 @@ class Scheduler:
             return
         _note_dispatch(items, t_start)
         for it, b in zip(items, out):
-            it.batch = b
-            it.out_names = it.planned.output_names
-            it.ev.set()
+            self._complete(it, batch=b, out_names=it.planned.output_names)
 
-    def _run_serial(self, item: _Item):
+    def _isolate(self, items: list):
+        """Quarantine by bisection: re-dispatch the failed batch in
+        halves, so innocents complete batched while the offender bottoms
+        out on the serial lane and fails ALONE — per-backend crash
+        isolation re-created for a shared device dispatch."""
+        live = [it for it in items if not self._expire_if_dead(it)]
+        if not live:
+            return
+        if len(live) == 1:
+            shield.bump("isolated")
+            self._pool.submit(self._run_serial, live[0])
+            return
+        mid = len(live) // 2
+        for half in (live[:mid], live[mid:]):
+            if len(half) == 1:
+                shield.bump("isolated")
+                self._pool.submit(self._run_serial, half[0])
+            else:
+                self._dispatch_one(half, isolating=True)
+
+    def _serve_degraded(self, item: _Item):
+        """Brownout lane: serve one member through the spill tier after
+        dispatch-level memory pressure."""
+        if self._expire_if_dead(item):
+            return
         try:
-            self._admit(item.group, item.t_submit + self.shed_s)
+            self._admit(item.group, time.monotonic() + self.shed_s,
+                        item=item)
+        except _Gone:
+            return
         except _Shed as e:
             self._shed_item(item, e)
             return
+        except BaseException as e:
+            self._complete(item, error=e)
+            return
         try:
             _note_dispatch([item], time.monotonic())
-            if item.is_write:
-                with self._write_lock:
-                    item.results = item.session.execute(item.sql)
-            else:
-                item.results = item.session.execute(item.sql)
-        except BaseException as e:
-            item.error = e
+            try:
+                res = shield.run_degraded(item)
+                item.degraded = True
+                self._complete(item, results=res)
+            except BaseException as e:
+                self._complete(item, error=e)
         finally:
             self._release(item.group)
-            item.ev.set()
+
+    def _run_serial(self, item: _Item):
+        if self._expire_if_dead(item):
+            return    # died queued: no slot was ever acquired
+        try:
+            self._admit(item.group, item.t_submit + self.shed_s,
+                        item=item)
+        except _Gone:
+            return
+        except _Shed as e:
+            self._shed_item(item, e)
+            return
+        except BaseException as e:
+            # admission infrastructure failure: no slot held
+            self._complete(item, error=e)
+            return
+        try:
+            _note_dispatch([item], time.monotonic())
+            try:
+                shield.serial_guard(item.lits)
+                if item.is_write:
+                    with self._write_lock:
+                        res = item.session.execute(item.sql)
+                else:
+                    res = item.session.execute(item.sql)
+                self._complete(item, results=res)
+            except BaseException as e:
+                self._complete(item, error=e)
+        finally:
+            self._release(item.group)
 
 
 def serve(node, host: str = "127.0.0.1", port: int = 0,

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 from typing import Optional
 
@@ -159,16 +160,21 @@ class LocalGts:
     Cluster mode swaps in gtm/client.py with the same interface."""
 
     def __init__(self, start: int = 100):
+        # the serving tier (exec/scheduler.py) draws snapshots from
+        # concurrent dispatch threads; unlocked += would drop grants
+        self._lock = threading.Lock()
         self._ts = start
         self._txid = 1
 
     def next_gts(self) -> int:
-        self._ts += 1
-        return self._ts
+        with self._lock:
+            self._ts += 1
+            return self._ts
 
     def next_txid(self) -> int:
-        self._txid += 1
-        return self._txid
+        with self._lock:
+            self._txid += 1
+            return self._txid
 
 
 class LocalNode:
@@ -352,6 +358,16 @@ class LocalNode:
         if self.wal:
             self.wal.append(rec, sync=sync)
 
+    def serve(self, host: str = "127.0.0.1", port: int = 0,
+              users_path: Optional[str] = None, **knobs):
+        """Thin serving-tier facade: start a CN wire server whose
+        connections each get a Session over this node, with every
+        statement routed through the admission/batching scheduler
+        (exec/scheduler.py).  Returns (server, scheduler)."""
+        from .scheduler import serve
+        return serve(self, host=host, port=port,
+                     users_path=users_path, **knobs)
+
 
 def _trace_explain_lines() -> str:
     """EXPLAIN ANALYZE footer from the open query trace: staging,
@@ -383,12 +399,40 @@ class Session:
         self.node = node
         self.txn: Optional[TxnState] = None
         self.txn_aborted = False
+        # out-of-band cancel (CnServer wires the cancel-protocol peer to
+        # this; the scheduler propagates it into queued/batched items)
+        self.cancel_event = threading.Event()
 
     # ------------------------------------------------------------------
+    def _check_interrupts(self, deadline: Optional[float]):
+        """Statement-boundary interrupt poll (CHECK_FOR_INTERRUPTS):
+        consume a pending cancel, enforce the statement deadline."""
+        if self.cancel_event.is_set():
+            self.cancel_event.clear()
+            raise ExecError("canceling statement due to user request")
+        if deadline is not None and time.monotonic() >= deadline:
+            raise ExecError(
+                "canceling statement due to statement timeout")
+
+    def _stmt_deadline(self) -> Optional[float]:
+        """Absolute deadline from the statement_timeout GUC (PG
+        semantics: milliseconds, 0/unset disabled)."""
+        raw = str(self.node.gucs.get("statement_timeout", "")
+                  or "").strip()
+        if not raw:
+            return None
+        try:
+            ms = float(raw)
+        except ValueError:
+            return None
+        return time.monotonic() + ms / 1e3 if ms > 0 else None
+
     def execute(self, sql: str) -> list[Result]:
         out = []
         self._cur_sql = sql.strip()
+        deadline = self._stmt_deadline()
         for s in parse_sql(sql):
+            self._check_interrupts(deadline)
             if self.txn is not None and self.txn_aborted \
                     and not isinstance(s, A.TxnStmt) \
                     and not (isinstance(s, A.SavepointStmt)

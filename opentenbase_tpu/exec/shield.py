@@ -1,0 +1,278 @@
+"""Fault isolation for the concurrent serving tier (otbshield).
+
+Reference analog: three protections every PostgreSQL-lineage server
+takes for granted, re-created for a tier where N clients share ONE
+compiled device dispatch (exec/scheduler.py):
+
+- per-backend crash isolation (postmaster restarts the one backend a
+  poisoned statement killed): a coalesced batch is one executable, so
+  one bad literal / device error would fail every member.  The shield
+  quarantines by bisection — the failing batch re-dispatches in
+  halves, innocents complete batched, the offender bottoms out on the
+  serial lane and fails ALONE.  A signature that keeps killing batches
+  is temporarily barred from coalescing (cooldown keyed by the
+  literal-masked program signature, the same key plancache uses).
+- statement_timeout / StatementCancel (CHECK_FOR_INTERRUPTS bounds
+  every query): deadline helpers here; the scheduler threads them
+  through queue wait, admission, dispatch, and materialization.
+- resource-group memory brownout (resgroup memory limits shed work
+  before the OOM killer arrives): a dispatch that hits RESOURCE_
+  EXHAUSTED evicts the coldest bufferpool entries and retries once,
+  then DEGRADES the members to the spill tier (work_mem_rows-style
+  bounded passes) instead of erroring; an admission-level byte
+  estimate from catalog stats pre-shrinks batch size under pressure so
+  OOM is mostly never discovered on-device.
+
+Knobs: OTB_SHIELD_QUARANTINE_FAILS (batch failures within the window
+before a signature is barred, default 2), OTB_SHIELD_WINDOW_S (failure
+accounting window, default 30), OTB_SHIELD_COOLDOWN_S (coalescing bar,
+default 30), OTB_SHIELD_DEGRADE_ROWS (spill budget for degraded
+members, default 65536), OTB_SHIELD_MEMBER_COST (per-batch-member cost
+as a fraction of the staged input estimate, default 0.25).
+
+Counters surface as otb_shield_* in the metrics registry and as the
+otb_shield stat view (parallel/statviews.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..obs import trace as obs_trace
+from ..utils import faultinject as FI
+from ..utils import locks
+
+_LOCK = locks.Lock("exec.shield._LOCK")
+_STATS: dict = {              # guarded_by: _LOCK
+    "batch_failures": 0,      # coalesced dispatches that raised
+    "isolated": 0,            # members re-routed by bisection/recovery
+    "quarantined": 0,         # signatures barred from coalescing
+    "quarantine_hits": 0,     # classifications bypassed by an active bar
+    "oom_dispatches": 0,      # dispatches that hit RESOURCE_EXHAUSTED
+    "oom_retries": 0,         # evict-coldest-and-retry passes
+    "oom_evicted_bytes": 0,   # HBM freed by pressure relief
+    "degraded": 0,            # members served by the spill path
+    "shrunk_batches": 0,      # admission byte estimate cut a batch
+}
+_QUAR: dict = {}              # guarded_by: _LOCK — sig -> [fails, t0, until]
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def bump(field: str, n: int = 1):
+    with _LOCK:
+        _STATS[field] += n
+
+
+def stats_snapshot() -> dict:
+    with _LOCK:
+        d = dict(_STATS)
+        d["quarantine_active"] = sum(
+            1 for e in _QUAR.values() if e[2] > time.monotonic())
+    return d
+
+
+def stats_rows() -> list:
+    """One row for the otb_shield view."""
+    d = stats_snapshot()
+    return [(d["batch_failures"], d["isolated"], d["quarantined"],
+             d["quarantine_active"], d["quarantine_hits"],
+             d["oom_dispatches"], d["oom_retries"],
+             d["oom_evicted_bytes"], d["degraded"],
+             d["shrunk_batches"])]
+
+
+def reset_stats():
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+        _QUAR.clear()
+
+
+def _metrics_samples():
+    for k, v in stats_snapshot().items():
+        yield (f"otb_shield_{k}", {}, v)
+
+
+# ---------------------------------------------------------------------------
+# repeat-offender quarantine (cooldown keyed by the program signature)
+# ---------------------------------------------------------------------------
+
+def note_batch_failure(sig) -> bool:
+    """Record one coalesced-dispatch failure for `sig`.  Returns True
+    when the signature just crossed the repeat-offender threshold and
+    is now barred from coalescing for the cooldown."""
+    if sig is None:
+        return False
+    thresh = int(_env_f("OTB_SHIELD_QUARANTINE_FAILS", 2))
+    window = _env_f("OTB_SHIELD_WINDOW_S", 30.0)
+    cooldown = _env_f("OTB_SHIELD_COOLDOWN_S", 30.0)
+    now = time.monotonic()
+    with _LOCK:
+        _STATS["batch_failures"] += 1
+        ent = _QUAR.get(sig)
+        if ent is None or now - ent[1] > window:
+            ent = _QUAR[sig] = [0, now, 0.0]
+        ent[0] += 1
+        if ent[0] >= thresh and ent[2] <= now:
+            ent[2] = now + cooldown
+            _STATS["quarantined"] += 1
+            barred = True
+        else:
+            barred = False
+        if len(_QUAR) > 512:        # bounded: drop the stalest entry
+            _QUAR.pop(next(iter(_QUAR)))
+    if barred:
+        obs_trace.event("quarantine", sig=str(sig)[:80])
+    return barred
+
+
+def quarantined(sig) -> bool:
+    """Is this signature currently barred from coalescing?"""
+    if sig is None:
+        return False
+    now = time.monotonic()
+    with _LOCK:
+        ent = _QUAR.get(sig)
+        if ent is None or ent[2] <= now:
+            return False
+        _STATS["quarantine_hits"] += 1
+        return True
+
+
+# ---------------------------------------------------------------------------
+# fault classification + injection surfaces
+# ---------------------------------------------------------------------------
+
+def is_oom(exc: BaseException) -> bool:
+    """Device allocation failure?  Matches XLA's RESOURCE_EXHAUSTED
+    family (and the injected stand-in) without importing jaxlib error
+    types — the string marker is the stable contract across versions."""
+    if isinstance(exc, FI.InjectedOom):
+        return True
+    s = f"{type(exc).__name__}: {exc}"
+    return ("RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower()
+            or "OOM" in s)
+
+
+def pre_dispatch(info, queries):
+    """Fault surface crossed by every coalesced dispatch, BEFORE the
+    compiled program launches: the injected-OOM window and the
+    poisoned-literal check.  A poisoned literal anywhere in the batch
+    aborts the WHOLE dispatch — that is precisely the blast radius the
+    quarantine path then narrows by bisection."""
+    FI.oom_point("dispatch")
+    for q in queries:
+        v = FI.poison_hit(q[2])
+        if v is not None:
+            raise FI.InjectedFault(f"poison-literal {v!r} (batched)")
+
+
+def serial_guard(lits):
+    """The serial lane's slice of the same fault surface: a poisoned
+    statement must keep failing when re-run alone, so bisection
+    attributes the error to the offender instead of absolving it."""
+    if not lits:
+        return
+    v = FI.poison_hit([val for _n, val, _t in lits])
+    if v is not None:
+        raise FI.InjectedFault(f"poison-literal {v!r}")
+
+
+# ---------------------------------------------------------------------------
+# memory pressure: relief + admission byte estimate + spill degrade
+# ---------------------------------------------------------------------------
+
+def relieve() -> int:
+    """Evict the coldest device bufferpool entries (about half the
+    resident bytes) so ONE retry can succeed.  Returns bytes freed."""
+    from ..storage.bufferpool import POOL
+    freed = POOL.shed_coldest(0.5)
+    with _LOCK:
+        _STATS["oom_retries"] += 1
+        _STATS["oom_evicted_bytes"] += freed
+    obs_trace.event("oom_relief", bytes=int(freed))
+    return freed
+
+
+def _table_rows(node, info, table: str) -> int:
+    """Catalog ANALYZE stats when present, live count otherwise."""
+    st = getattr(node.catalog, "stats", None) or {}
+    ent = st.get(table)
+    if ent and int(ent.get("rows", 0)) > 0:
+        return int(ent["rows"])
+    return info.stores[table].row_count()
+
+
+def estimate_bytes(node, info) -> int:
+    """Staged-input byte estimate for one member of this signature:
+    needed columns x padded rows x 8 (MVCC sys columns included).  The
+    batch shares the staged tables, but lax.map materializes per-member
+    intermediates/outputs on top — see batch_cap."""
+    from ..storage.batch import size_class
+    total = 0
+    for table, need in info.need_by_table.items():
+        rows = size_class(max(_table_rows(node, info, table), 1))
+        total += rows * (len(need) + 4) * 8
+    return total
+
+
+def batch_cap(node, info, max_batch: int) -> int:
+    """Admission-level pre-shrink: how many members of this signature
+    one dispatch can hold given current device headroom.  Full batches
+    under no pressure; shrinks toward 1 (serial) as resident bytes
+    crowd the budget — discovering OOM here costs a smaller batch,
+    discovering it on-device costs a failed dispatch + retry."""
+    from ..storage import bufferpool
+    try:
+        est = estimate_bytes(node, info)
+    except Exception:
+        return max_batch
+    if est <= 0:
+        return max_batch
+    headroom = bufferpool._budget() - bufferpool.POOL.totals()["bytes_live"]
+    per_member = max(int(est * _env_f("OTB_SHIELD_MEMBER_COST", 0.25)), 1)
+    cap = int((headroom - est) // per_member)
+    cap = max(1, min(max_batch, cap))
+    if cap < max_batch:
+        bump("shrunk_batches")
+        obs_trace.event("batch_shrunk", cap=cap, est=est)
+    return cap
+
+
+def run_degraded(item) -> list:
+    """Serve one batch member through the spill tier (bounded passes,
+    work_mem_rows-style) after dispatch-level memory pressure — the
+    brownout path: slower, but an answer instead of an error."""
+    from .executor import materialize
+    from .session import Result
+    from .spill import SpillDriver
+
+    session = item.session
+    node = session.node
+    budget = int(_env_f("OTB_SHIELD_DEGRADE_ROWS", 65536))
+    txid = node.gts.next_txid()
+    snap = node.gts.next_gts()
+    bump("degraded")
+    from ..net.guard import note_degraded
+    note_degraded("memory_pressure")
+    obs_trace.event("degraded", budget_rows=budget)
+    if item.planned is not None:
+        drv = SpillDriver(node.stores, node.cache, snap, txid, budget)
+        batch = drv.try_run(item.planned)
+        if batch is not None:
+            names, rows = materialize(batch, item.planned.output_names)
+            return [Result("SELECT", names=names, rows=rows,
+                           rowcount=len(rows))]
+    # shapes the spill driver declines still get a serial answer
+    return session.execute(item.sql)
+
+
+from ..obs.metrics import REGISTRY as _METRICS  # noqa: E402
+_METRICS.register_collector("shield", _metrics_samples)

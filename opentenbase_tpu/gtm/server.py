@@ -129,8 +129,23 @@ class GtmCore:
             rq = self._resq = {}
         slots = rq.setdefault(group, [])
         now = time.monotonic()
-        slots[:] = [s for s in slots if s[1] > now]
+        kept = [s for s in slots if s[1] > now]
+        # a reaped lease was an acquire that will never see its release
+        # land (the owner crashed or lost its GTM connection): account
+        # it, or the acquired/released ledger silently diverges
+        if len(kept) != len(slots):
+            st = self._resq_stats_dict()
+            st["expired"] += len(slots) - len(kept)
+        slots[:] = kept
         return slots
+
+    def _resq_stats_dict(self) -> dict:
+        # caller holds self._lock
+        st = getattr(self, "_resq_stat", None)
+        if st is None:
+            st = self._resq_stat = {"acquired": 0, "released": 0,
+                                    "expired": 0}
+        return st
 
     def resq_acquire(self, group: str, cap: int, owner: str = "",
                      lease_s: float = 30.0) -> bool:
@@ -140,6 +155,7 @@ class GtmCore:
                 return False
             slots.append([owner,
                           time.monotonic() + max(float(lease_s), 0.001)])
+            self._resq_stats_dict()["acquired"] += 1
             return True
 
     def resq_release(self, group: str, owner: str = "") -> None:
@@ -148,12 +164,15 @@ class GtmCore:
             for i, s in enumerate(slots):
                 if s[0] == owner:
                     del slots[i]
+                    self._resq_stats_dict()["released"] += 1
                     return
             # identity-less legacy caller: positional release.  An
             # IDENTIFIED owner whose slot was already lease-reaped must
-            # NOT pop someone else's slot — no-op instead.
+            # NOT pop someone else's slot — no-op instead (the reap was
+            # already counted as `expired`, never double as `released`).
             if slots and not owner:
                 del slots[0]
+                self._resq_stats_dict()["released"] += 1
 
     def resq_disconnect(self, owner: str) -> int:
         """Reap every slot held by `owner` (connection closed / session
@@ -167,12 +186,27 @@ class GtmCore:
                 kept = [s for s in slots if s[0] != owner]
                 freed += len(slots) - len(kept)
                 slots[:] = kept
+            if freed:
+                # the owner's goodbye IS its release (ledger stays
+                # balanced for sessions that die holding slots)
+                self._resq_stats_dict()["released"] += freed
         return freed
 
     def resq_counts(self) -> dict:
         with self._lock:
             return {g: len(self._resq_slots(g))
                     for g in list(getattr(self, "_resq", None) or {})}
+
+    def resq_stats(self) -> dict:
+        """Slot-lifecycle ledger: acquired == released + expired +
+        (slots currently live) at any quiescent point — the GTM side of
+        the scheduler's slot-leak invariant."""
+        with self._lock:
+            for g in list(getattr(self, "_resq", None) or {}):
+                self._resq_slots(g)     # fold pending expiries in
+            st = dict(self._resq_stats_dict())
+        st["live"] = sum(self.resq_counts().values())
+        return st
 
     # ---- API ----
     def next_gts(self) -> int:
@@ -351,7 +385,10 @@ class GtmServer:
                             owner = msg.get("owner", "")
                             if owner:
                                 self.resq_owners.add(owner)
-                            resp = {"ok2": core_ref.resq_acquire(
+                            # wire passthrough: the release arrives as
+                            # its own message; disconnect/lease reap
+                            # covers a peer that never sends it
+                            resp = {"ok2": core_ref.resq_acquire(  # otblint: disable=slot-discipline
                                 msg["group"], msg["cap"], owner,
                                 msg.get("lease_s", 30.0))}
                         elif op == "resq_release":

@@ -83,12 +83,20 @@ class CnServer:
     make_session: () -> ClusterSession — each connection gets a fresh
     session over the SHARED cluster object (shared mesh runner, shared
     plan caches, per-session txn/GUC/prepared state).
+
+    scheduler: optional serving-tier Scheduler (exec/scheduler.py) —
+    when set, every statement routes through its admission/coalescing
+    queue instead of executing directly on the handler thread, so
+    same-signature queries from different connections batch into one
+    device dispatch.
     """
 
     def __init__(self, make_session, users_path: Optional[str] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 scheduler=None):
         self.make_session = make_session
         self.users_path = users_path
+        self.scheduler = scheduler
         self._sessions: dict = {}     # pid -> (secret, session)
         self._next_pid = [1000]
         self._lock = locks.Lock("net.cn_server.CnServer._lock")
@@ -161,6 +169,16 @@ class CnServer:
                                "secret": secret}})
         try:
             while True:
+                # a cancel that landed while the session was idle
+                # targets nothing — drop it HERE, at the idle point,
+                # before blocking for the next message (reference: a
+                # backend ignores SIGINT outside statement execution).
+                # Clearing any later — say, just before execute() —
+                # races the cancel connection: a cancel arriving after
+                # the query message was read but before the clear would
+                # be silently dropped instead of canceling the
+                # statement it targeted.
+                sess.cancel_event.clear()
                 msg = recv_msg(sock)
                 if msg is None or msg.get("op") == "terminate":
                     return
@@ -179,11 +197,10 @@ class CnServer:
                                     f"unknown op {msg.get('op')!r}"})
                     continue
                 try:
-                    # a cancel that landed while the session was idle
-                    # targets nothing — drop it (reference: a backend
-                    # ignores SIGINT outside statement execution)
-                    sess.cancel_event.clear()
-                    results = sess.execute(msg["sql"])
+                    if self.scheduler is not None:
+                        results = self.scheduler.run(sess, msg["sql"])
+                    else:
+                        results = sess.execute(msg["sql"])
                     send_msg(sock, {"ok": [
                         {"command": r.command, "names": r.names,
                          "rows": r.rows, "rowcount": r.rowcount,

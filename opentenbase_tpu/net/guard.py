@@ -270,6 +270,15 @@ def note_shed(group: str) -> None:
     guard_for("scheduler").note_shed()
 
 
+def note_degraded(reason: str) -> None:
+    """Brownout arm of the ladder: a query was served through a slower
+    tier instead of failing (memory pressure -> spill).  Counts toward
+    otb_guard_degraded_total and marks the scheduler node degraded in
+    otb_node_health — same surface as load shedding, one rung gentler."""
+    REGISTRY.counter("otb_guard_degraded_total", reason=reason).inc()
+    guard_for("scheduler").note_shed()
+
+
 def note_failover(kind: str) -> None:
     REGISTRY.counter("otb_guard_failovers_total", kind=kind).inc()
 

@@ -20,6 +20,14 @@ Views:
   eager_cols, cols_materialized, bytes_materialized, host_syncs,
   fused_join_hits) — the executor's late-materialization join counters
   (exec/executor.py EXEC_STATS)
+- otb_scheduler(admitted, queued, batched, shed, dispatches,
+  batch_dispatches, queue_wait_p50_ms, queue_wait_p99_ms, batch_hist)
+  — the serving tier's admission/coalescing counters
+  (exec/scheduler.py)
+- otb_shield(batch_failures, isolated, quarantined, quarantine_active,
+  quarantine_hits, oom_dispatches, oom_retries, oom_evicted_bytes,
+  degraded, shrunk_batches) — the serving tier's fault-isolation
+  counters (exec/shield.py)
 """
 
 from __future__ import annotations
@@ -100,6 +108,37 @@ STAT_TABLES = {
         ColumnDef("bytes_materialized", T.INT64),
         ColumnDef("host_syncs", T.INT64),
         ColumnDef("fused_join_hits", T.INT64)],
+    # serving-tier telemetry (exec/scheduler.py): admission/coalescing
+    # counters aggregated across every Scheduler in the process.
+    # admitted = queries that passed admission and executed; queued =
+    # current queue depth (gauge); batched = queries served by a
+    # multi-query dispatch; shed = rejected (queue full or shed
+    # deadline); batch_hist = "size:count ..." dispatch histogram;
+    # queue waits are submit -> execution-start, recent window.
+    "otb_scheduler": [
+        ColumnDef("admitted", T.INT64), ColumnDef("queued", T.INT64),
+        ColumnDef("batched", T.INT64), ColumnDef("shed", T.INT64),
+        ColumnDef("dispatches", T.INT64),
+        ColumnDef("batch_dispatches", T.INT64),
+        ColumnDef("queue_wait_p50_ms", T.FLOAT64),
+        ColumnDef("queue_wait_p99_ms", T.FLOAT64),
+        ColumnDef("batch_hist", T.TEXT)],
+    # serving-tier fault isolation (exec/shield.py): batch quarantine,
+    # memory-pressure degradation, and admission pre-shrink counters —
+    # the observable record of faults the tier absorbed instead of
+    # spreading (reference: per-backend crash accounting + resgroup
+    # memory-limit kills, except here absorption is the success path)
+    "otb_shield": [
+        ColumnDef("batch_failures", T.INT64),
+        ColumnDef("isolated", T.INT64),
+        ColumnDef("quarantined", T.INT64),
+        ColumnDef("quarantine_active", T.INT64),
+        ColumnDef("quarantine_hits", T.INT64),
+        ColumnDef("oom_dispatches", T.INT64),
+        ColumnDef("oom_retries", T.INT64),
+        ColumnDef("oom_evicted_bytes", T.INT64),
+        ColumnDef("degraded", T.INT64),
+        ColumnDef("shrunk_batches", T.INT64)],
     # recent-query trace ring (obs/trace.py): one row per finished
     # top-level statement, newest last — per-phase wall-time breakdown
     # plus staging/materialization byte counts and buffer-pool hit
@@ -211,6 +250,12 @@ def refresh(cluster, names: list[str]):
         elif name == "otb_execstats":
             from ..exec.executor import exec_stats_rows
             rows = list(exec_stats_rows())
+        elif name == "otb_scheduler":
+            from ..exec.scheduler import stats_rows
+            rows = list(stats_rows())
+        elif name == "otb_shield":
+            from ..exec.shield import stats_rows as shield_rows
+            rows = list(shield_rows())
         elif name == "otb_stat_query":
             from ..obs import trace as obs_trace
             for qt in obs_trace.recent():

@@ -234,6 +234,31 @@ class DeviceBufferPool:
                                                                  None)
                 self._tstats(e.table)[2] += 1
 
+    def shed_coldest(self, frac: float = 0.5) -> int:
+        """Memory-pressure relief (exec/shield.py): evict the coldest
+        device entries until `frac` of the resident bytes are freed,
+        regardless of budget.  Returns bytes freed.  Unlike trim() this
+        may evict down to nothing — after a RESOURCE_EXHAUSTED the
+        retry restages only what the failed dispatch actually needs."""
+        freed = 0
+        with _LOCK:
+            resident = (sum(e.nbytes for _s, e in self._dev.values())
+                        + sum(e.nbytes for _s, e in self._mesh.values()))
+            target = int(resident * max(0.0, min(1.0, frac)))
+            while freed < target:
+                items = ([("dev", k, s, e)
+                          for k, (s, e) in self._dev.items()]
+                         + [("mesh", k, s, e)
+                            for k, (s, e) in self._mesh.items()])
+                if not items:
+                    break
+                kind, key, _s, e = min(items, key=lambda it: it[2])
+                (self._dev if kind == "dev" else self._mesh).pop(key,
+                                                                 None)
+                self._tstats(e.table)[2] += 1
+                freed += e.nbytes
+        return freed
+
     def _trim_host(self):
         budget = _host_budget()
         with _LOCK:

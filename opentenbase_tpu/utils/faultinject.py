@@ -43,6 +43,17 @@ class InjectedFault(Exception):
         self.point = point
 
 
+class InjectedOom(Exception):
+    """Simulated device allocation failure.  The message carries the
+    XLA RESOURCE_EXHAUSTED marker so exec/shield.py's OOM classifier
+    treats it exactly like the real allocator error it stands in for."""
+
+    def __init__(self, point: str):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device OOM at {point}")
+        self.point = point
+
+
 def arm(point: str, times: int = 1):
     with _lock:
         _armed[point] = times
@@ -104,6 +115,79 @@ def wire_action(point: str):
         if ent["times"] <= 0:
             del _wire_armed[point]
         return {"mode": ent["mode"], "delay_s": ent["delay_s"]}
+
+
+# ---------------------------------------------------------------------------
+# serving-tier chaos (armed per test; consulted by exec/shield.py)
+# ---------------------------------------------------------------------------
+
+_poison: dict = {}        # guarded_by: _lock — literal value -> times left
+_oom_armed: dict[str, int] = {}   # guarded_by: _lock
+
+
+def arm_poison(value, times: int = -1):
+    """Mark a literal VALUE as poisoned: any dispatch whose literal
+    bindings contain it aborts (the 'one bad constant crashes the
+    shared device program' failure mode).  times < 0 = until
+    disarm_poison() — the poisoned statement must keep failing when the
+    quarantine path re-runs it serially, otherwise bisection would
+    wrongly absolve the offender."""
+    with _lock:
+        _poison[value] = int(times)
+
+
+def disarm_poison(value=None):
+    with _lock:
+        if value is None:
+            _poison.clear()
+        else:
+            _poison.pop(value, None)
+
+
+def poison_hit(values):
+    """First poisoned literal among `values`, or None.  Finite arms
+    decrement per hit (self-disarm at 0); negative arms persist."""
+    with _lock:
+        for v in values:
+            try:
+                n = _poison.get(v, 0)
+            except TypeError:
+                continue          # unhashable literal cannot be armed
+            if n == 0:
+                continue
+            if n > 0:
+                _poison[v] = n - 1
+                if _poison[v] == 0:
+                    del _poison[v]
+            return v
+    return None
+
+
+def arm_oom(point: str = "dispatch", times: int = 1):
+    """Arm a simulated RESOURCE_EXHAUSTED at a named shield point.
+    `times=2` defeats the evict-coldest-and-retry-once pass, forcing
+    the degrade-to-spill path."""
+    with _lock:
+        _oom_armed[point] = int(times)
+
+
+def disarm_oom(point: str = None):
+    with _lock:
+        if point is None:
+            _oom_armed.clear()
+        else:
+            _oom_armed.pop(point, None)
+
+
+def oom_point(point: str):
+    """Raise InjectedOom when armed at `point` (consumes one firing)."""
+    with _lock:
+        n = _oom_armed.get(point, 0)
+        if n > 0:
+            _oom_armed[point] = n - 1
+            if _oom_armed[point] == 0:
+                del _oom_armed[point]
+            raise InjectedOom(point)
 
 
 def _arm_from_env():

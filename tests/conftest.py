@@ -32,3 +32,9 @@ _xb._backend_factories.pop("axon", None)
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running bench / end-to-end arms "
+        "(deselected by the tier-1 run)")

@@ -357,6 +357,67 @@ class TestNetDeadlinePass:
         assert _scan(tmp_path, "net-deadline") == []
 
 
+class TestSlotDisciplinePass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/leaky.py": """\
+            def run_leaky(gtm, group, sql, execute):
+                if not gtm.resq_acquire(group, 8, owner="w"):
+                    raise RuntimeError("shed")
+                res = execute(sql)      # an exception leaks the slot
+                gtm.resq_release(group, owner="w")
+                return res
+        """,
+        "fixpkg/exec/clean.py": """\
+            def run_clean(gtm, group, sql, execute):
+                if not gtm.resq_acquire(group, 8, owner="w"):
+                    raise RuntimeError("shed")
+                try:
+                    return execute(sql)
+                finally:
+                    gtm.resq_release(group, owner="w")
+
+            def run_clean_inside(gtm, group, sql, execute):
+                try:
+                    gtm.resq_acquire(group, 8, owner="w")
+                    return execute(sql)
+                finally:
+                    gtm.resq_release(group, owner="w")
+        """,
+    }
+
+    def test_violation_and_clean_twin(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        got = _scan(tmp_path, "slot-discipline")
+        assert got == [("slot-discipline", "fixpkg/exec/leaky.py")], got
+
+    def test_pragma_suppresses(self, tmp_path):
+        files = dict(self.FILES)
+        files["fixpkg/exec/leaky.py"] = files[
+            "fixpkg/exec/leaky.py"].replace(
+            'owner="w"):\n',
+            'owner="w"):  # otblint: disable=slot-discipline\n', 1)
+        _write_pkg(tmp_path, files)
+        assert _scan(tmp_path, "slot-discipline") == []
+
+    def test_admit_wrapper_needs_finally_too(self, tmp_path):
+        # the scheduler-side spelling: _admit() is an acquire
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/exec/__init__.py": "",
+            "fixpkg/exec/sched.py": """\
+                def serve(self, item):
+                    self._admit(item.group, 1.0)
+                    item.results = item.session.execute(item.sql)
+                    self._release(item.group)
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        got = _scan(tmp_path, "slot-discipline")
+        assert got == [("slot-discipline", "fixpkg/exec/sched.py")], got
+
+
 # ---------------------------------------------------------------------------
 # HLO text scan (no jax export involved)
 # ---------------------------------------------------------------------------

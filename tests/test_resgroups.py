@@ -252,3 +252,63 @@ class TestSlotLeases:
         # slots drained back to zero after the query
         assert cl.gtm.resq_counts().get("idg", 0) == 0
         s.execute("set resource_group = none")
+
+
+class TestServingAdmissionRaces:
+    """Serving-tier admission over GTM slots (exec/scheduler.py): the
+    last slot is never double-granted under a thread race, and a
+    shed/timed-out query leaves the group's slot accounting intact."""
+
+    def test_last_slot_race_single_winner(self):
+        """N threads hit resq_acquire for a 1-slot group behind a
+        barrier, repeatedly: every round grants EXACTLY one slot."""
+        core = GtmCore()
+        nthreads, rounds = 8, 20
+        for r in range(rounds):
+            barrier = threading.Barrier(nthreads)
+            wins = [0] * nthreads
+
+            def racer(i, r=r, barrier=barrier, wins=wins):
+                barrier.wait()
+                if core.resq_acquire("last", 1, owner=f"cn{r}-{i}",
+                                     lease_s=30):
+                    wins[i] = 1
+
+            ts = [threading.Thread(target=racer, args=(i,))
+                  for i in range(nthreads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert sum(wins) == 1, f"round {r}: {sum(wins)} admitted"
+            winner = wins.index(1)
+            core.resq_release("last", owner=f"cn{r}-{winner}")
+        assert core.resq_counts().get("last", 0) == 0
+
+    def test_scheduler_shed_timeout_frees_group(self):
+        """A query shed at its admission deadline holds no lease: once
+        the blocking owner releases, the full cap is available again
+        and a later query drains the group back to zero."""
+        from opentenbase_tpu.exec import scheduler as sm
+        from opentenbase_tpu.exec.session import LocalNode, Session
+        node = LocalNode()
+        s = Session(node)
+        s.execute("create table sg (k bigint, v bigint)")
+        s.execute("insert into sg values (1, 10), (2, 20)")
+        gtm = GtmCore()
+        assert gtm.resq_acquire("default", 1, owner="blocker",
+                                lease_s=60)
+        sched = sm.Scheduler(node=node, gtm=gtm, slots=1,
+                             shed_timeout_ms=120.0)
+        try:
+            with pytest.raises(ExecError, match="query shed"):
+                sched.run(Session(node), "select v from sg where k = 1")
+            # the shed query released nothing it did not hold
+            assert gtm.resq_counts()["default"] == 1
+            gtm.resq_release("default", owner="blocker")
+            assert sched.run(Session(node),
+                             "select v from sg where k = 2")[-1].rows \
+                == [(20,)]
+            assert gtm.resq_counts()["default"] == 0
+        finally:
+            sched.stop()
