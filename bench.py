@@ -677,6 +677,23 @@ def _mat_counters(x0, x1):
     }
 
 
+def _compile_snapshot():
+    """Total (programs_compiled, compile_ms) across every plancache
+    tier — the otb_plancache counters the arms report as deltas so a
+    compile storm is visible per-arm in the perf trajectory."""
+    from opentenbase_tpu.exec import plancache
+    c, ms = 0, 0.0
+    for _t, _h, _m, comp, cms, _e, _l in plancache.stats():
+        c += comp
+        ms += cms
+    return c, ms
+
+
+def _compile_counters(c0, c1):
+    return {"programs_compiled": c1[0] - c0[0],
+            "compile_ms": round(c1[1] - c0[1], 3)}
+
+
 def _save_data(data, path):
     np.savez(path, **{f"{t}::{c}": v for t, cols in data.items()
                       for c, v in cols.items()})
@@ -736,11 +753,13 @@ def _warm2_child():
     warmup_ms = (time.perf_counter() - t0) * 1e3
     out = {"warmup_ms": warmup_ms}
     for qn in (1, 3, 5):
+        c0 = _compile_snapshot()
         eng, cold = _time(lambda: s.query(Q[qn]), 1)
         out[f"Q{qn}"] = {"cold_ms": cold * 1e3,
                          "engine_ms": eng * 1e3,
                          "stage_ms": s.last_stage_ms,
-                         "tier": s.last_tier}
+                         "tier": s.last_tier,
+                         **_compile_counters(c0, _compile_snapshot())}
     print(json.dumps({"warm2": out}))
 
 
@@ -896,8 +915,10 @@ def _qps_arm(name, node, stream, clients, seconds, warm_s):
         if warm_s > 0:   # untimed phase: batch-class compiles land here
             _qps_drive(sched, node, stream, clients, warm_s)
         s0 = sched_mod.stats_snapshot()
+        c0 = _compile_snapshot()
         lats, shed, wall = _qps_drive(sched, node, stream, clients,
                                       seconds)
+        c1 = _compile_snapshot()
         s1 = sched_mod.stats_snapshot()
     finally:
         sched.stop()
@@ -915,7 +936,8 @@ def _qps_arm(name, node, stream, clients, seconds, warm_s):
             "batch_dispatches": s1["batch_dispatches"]
             - s0["batch_dispatches"],
             "batch_hist": " ".join(f"{k}:{v}"
-                                   for k, v in sorted(hist.items()))}
+                                   for k, v in sorted(hist.items())),
+            **_compile_counters(c0, c1)}
 
 
 def _qps_mode():
@@ -1030,7 +1052,9 @@ def main():
             s1._insert_rows(td, node.stores[tname], data[tname], nn)
         for qn in (1, 3, 5):
             x0 = exec_stats_snapshot()
+            c0 = _compile_snapshot()
             eng, cold = _time(lambda: s1.query(Q[qn]), repeat)
+            c1 = _compile_snapshot()
             x1 = exec_stats_snapshot()
             phases = _phases(s1.last_query_stats())
             _dump_trace(f"Q{qn} single")
@@ -1044,6 +1068,7 @@ def main():
                      "gb_touched": gb, "gb_per_s": gb / eng,
                      "phases": phases}
             entry.update(_mat_counters(x0, x1))
+            entry.update(_compile_counters(c0, c1))
             ladder.append(entry)
         del s1, node
 
@@ -1055,7 +1080,9 @@ def main():
         s2 = _mesh_session(data)
         for qn in (1, 3, 5):
             x0 = exec_stats_snapshot()
+            c0 = _compile_snapshot()
             eng, cold = _time(lambda: s2.query(Q[qn]), repeat)
+            c1 = _compile_snapshot()
             x1 = exec_stats_snapshot()
             ctl, _ = _time(lambda: controls[qn](dfs), max(2, repeat // 2))
             gb = _gb_touched(qn, data)
@@ -1087,17 +1114,21 @@ def main():
                      "tier": s2.last_tier,
                      "phases": phases}
             entry.update(_mat_counters(x0, x1))
+            entry.update(_compile_counters(c0, c1))
             if s2.last_tier != "mesh":
                 entry["fallback"] = s2.last_fallback
             ladder.append(entry)
             if qn == 1:
                 mesh_q1 = entry
         if os.environ.get("BENCH_OLTP", "1") != "0":
+            c0 = _compile_snapshot()
             ins_p50, raw_p50, prep_p50 = _oltp_latencies(s2)
-            ladder.append({"config": "point ops",
-                           "insert_p50_ms": ins_p50,
-                           "select_raw_p50_ms": raw_p50,
-                           "select_prepared_p50_ms": prep_p50})
+            entry = {"config": "point ops",
+                     "insert_p50_ms": ins_p50,
+                     "select_raw_p50_ms": raw_p50,
+                     "select_prepared_p50_ms": prep_p50}
+            entry.update(_compile_counters(c0, _compile_snapshot()))
+            ladder.append(entry)
 
         # ---- warm-restart arm: a FRESH process against the populated
         # persistent compile cache; its first-query cold_ms lands in
@@ -1137,12 +1168,15 @@ def main():
                 nn = len(next(iter(data10[tname].values())))
                 s3._insert_rows(td, data10[tname], nn)
             for qn in (1, 3, 5):
+                c0 = _compile_snapshot()
                 eng, cold = _time(lambda: s3.query(Q[qn]), 2)
-                ladder.append({"config": f"SF10 Q{qn}",
-                               "engine_ms": eng * 1e3,
-                               "cold_ms": cold * 1e3,
-                               "mrows_s_chip": n10 / eng / 1e6,
-                               "tier": s3.last_tier})
+                entry = {"config": f"SF10 Q{qn}",
+                         "engine_ms": eng * 1e3,
+                         "cold_ms": cold * 1e3,
+                         "mrows_s_chip": n10 / eng / 1e6,
+                         "tier": s3.last_tier}
+                entry.update(_compile_counters(c0, _compile_snapshot()))
+                ladder.append(entry)
         except Exception as e:   # noqa: BLE001 — SF10 must not kill
             ladder.append({"config": "SF10", "error": str(e)[:200]})
 

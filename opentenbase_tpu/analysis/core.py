@@ -12,6 +12,12 @@ conventions the passes understand:
     the call-graph closure stops here.  Used for executor operators the
     fusability screens reject (cross joins, index/ANN scans) and for
     host-side facades (device-cache staging).
+``# otblint: sync-boundary``
+    on a ``def`` line: this function is a DECLARED device->host
+    materialization boundary (the fused tier's join-overflow read, the
+    mesh tier's per-call gather) — transfer-discipline treats its
+    pulls as sanctioned.  The annotation is the audit artifact: every
+    legal sync in the engine is enumerable by grepping for it.
 ``# guarded_by: <lock>``
     on a module-level container assignment: writes from function scope
     must hold the named module lock.
@@ -54,6 +60,22 @@ RULES = {
                      "path (hangs interpreter exit)",
     "slot-discipline": "admission-slot acquire (resq_acquire/_admit) "
                        "without a release reachable via finally",
+    "program-cardinality": "value with an unbounded domain (raw row "
+                           "count, wall clock, RNG, dict iteration "
+                           "order) reaches a program-cache key",
+    "retrace-risk": "program identity minted per value: unhashable "
+                    "key component, ephemeral object id, per-value "
+                    "int() of device data, or branching on an "
+                    "unquantized shape in traced code",
+    "device-residency": "device upload or device-array global storage "
+                        "outside the bufferpool staging layer "
+                        "(unaccounted under OTB_DEVICE_CACHE_BYTES)",
+    "transfer-discipline": "device->host pull (device_get/np.asarray/"
+                           ".tolist()) in eager engine code outside a "
+                           "declared sync boundary",
+    "retrace-witness": "runtime program census diverges from the "
+                       "static ladder prediction (non-ladder class, "
+                       "unexplained recompile, or compile storm)",
     "hlo-f64": "f64 tensor type in exported StableHLO",
     "hlo-host-transfer": "host transfer / callback op in exported "
                          "StableHLO",
@@ -118,6 +140,9 @@ class SourceFile:
                     self.disables.setdefault(i, set()).update(rules)
                 elif kind in ("eager-only", "host-only"):
                     self.markers.setdefault(i, set()).add("eager-only")
+                elif kind == "sync-boundary":
+                    self.markers.setdefault(i, set()).add(
+                        "sync-boundary")
             m = _GUARDED.search(ln)
             if m:
                 self.guarded_by[i] = m.group(1)
@@ -133,11 +158,15 @@ class SourceFile:
 
 
 def _stmt_pragma_lines(node: ast.AST):
-    """Candidate comment lines for a statement: its first line and,
-    for a def, the decorator lines above (pragmas ride either)."""
+    """Candidate comment lines for a statement: its signature lines
+    (first line through the line before the body for a multi-line
+    def) and the decorator lines above (pragmas ride any of them)."""
     lines = {node.lineno}
     for d in getattr(node, "decorator_list", []) or []:
         lines.add(d.lineno)
+    body = getattr(node, "body", None)
+    if isinstance(body, list) and body:
+        lines.update(range(node.lineno, body[0].lineno))
     return lines
 
 
@@ -149,6 +178,7 @@ class FuncInfo:
     class_name: Optional[str]
     src: SourceFile
     eager_only: bool = False
+    sync_boundary: bool = False
     holds: tuple = ()
 
     @property
@@ -186,6 +216,8 @@ class ModuleIndex:
             for ln in _stmt_pragma_lines(node):
                 if "eager-only" in src.markers.get(ln, ()):
                     fi.eager_only = True
+                if "sync-boundary" in src.markers.get(ln, ()):
+                    fi.sync_boundary = True
                 if ln in src.holds:
                     fi.holds = fi.holds + src.holds[ln]
             self.functions[qual] = fi

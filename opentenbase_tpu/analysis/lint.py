@@ -19,6 +19,9 @@ import os
 import sys
 
 from .callgraph import TracedClosure
+from .cardinality import (DeviceResidencyPass, ProgramCardinalityPass,
+                          RetraceRiskPass, RetraceWitnessPass,
+                          TransferDisciplinePass)
 from .concurrency import (ConcurrencyContext, LockAtomicityPass,
                           LockBlockingPass, LockOrderPass,
                           ThreadDaemonPass)
@@ -48,6 +51,11 @@ def run_passes(project: Project, rules=None) -> list:
         NetDeadlinePass(project),
         ThreadDaemonPass(project),
         SlotDisciplinePass(project),
+        ProgramCardinalityPass(project, closure),
+        RetraceRiskPass(project, closure),
+        DeviceResidencyPass(project),
+        TransferDisciplinePass(project, closure),
+        RetraceWitnessPass(project),
     ]
     if rules is None or rules & _CONCURRENCY_RULES:
         ctx = ConcurrencyContext(project, closure)

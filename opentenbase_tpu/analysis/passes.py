@@ -328,22 +328,25 @@ class HostSyncPass:
                         em.emit(fi, e.lineno,
                                 f"{f.id}() forces a traced value to "
                                 f"the host")
-                if isinstance(f, ast.Attribute):
-                    d = _dotted(f, mi) or ""
-                    if d in ("jax.device_get", "jax.block_until_ready"):
-                        em.emit(fi, e.lineno,
-                                f"{d}() inside a traced region")
-                    elif d.startswith("numpy.") and \
-                            d.split(".")[-1] in ("asarray", "array",
-                                                 "copy") and \
-                            e.args and taint(e.args[0]):
-                        em.emit(fi, e.lineno,
-                                "np.%s() copies a traced value to the "
-                                "host" % d.split(".")[-1])
-                    elif f.attr in _SYNC_METHODS and taint(f.value):
-                        em.emit(fi, e.lineno,
-                                f".{f.attr}() forces a traced value "
-                                f"to the host")
+                # dotted resolution covers BOTH spellings of a sink:
+                # ``jax.device_get(x)`` and ``from jax import
+                # device_get; device_get(x)`` map to the same name
+                d = _dotted(f, mi) or ""
+                if d in ("jax.device_get", "jax.block_until_ready"):
+                    em.emit(fi, e.lineno,
+                            f"{d}() inside a traced region")
+                elif d.startswith("numpy.") and \
+                        d.split(".")[-1] in ("asarray", "array",
+                                             "copy") and \
+                        e.args and taint(e.args[0]):
+                    em.emit(fi, e.lineno,
+                            "np.%s() copies a traced value to the "
+                            "host" % d.split(".")[-1])
+                elif isinstance(f, ast.Attribute) and \
+                        f.attr in _SYNC_METHODS and taint(f.value):
+                    em.emit(fi, e.lineno,
+                            f".{f.attr}() forces a traced value "
+                            f"to the host")
             for c in ast.iter_child_nodes(e):
                 if isinstance(c, ast.expr):
                     check_expr(c, eager)

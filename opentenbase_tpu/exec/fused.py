@@ -320,7 +320,7 @@ def try_fused(executor, node) -> Optional[object]:
     return _try_fused(executor, node, allow_mask=True)
 
 
-def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:
+def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:  # otblint: sync-boundary
     ctx = executor.ctx
     screened = _screen_fragment(ctx, node)
     if screened is None:
@@ -623,7 +623,7 @@ def _batch_class(k: int) -> int:
     return c
 
 
-def run_fused_batch(info: FragSig, queries: list) -> Optional[list]:
+def run_fused_batch(info: FragSig, queries: list) -> Optional[list]:  # otblint: sync-boundary
     """Run K same-signature queries as ONE compiled dispatch.
 
     `queries` is [(snapshot_ts, txid, [literal values])] — one entry

@@ -1034,7 +1034,7 @@ class MeshRunner:
                                   params)
 
     def _call_program(self, fn, meta, gather_idx, staged, table_names,
-                      snapshot_ts, txid, params):
+                      snapshot_ts, txid, params):  # otblint: sync-boundary
         from .executor import stats_tier
         flat_args = [jnp.int64(snapshot_ts), jnp.int64(txid)]
         for k in meta.get("traced", ()):

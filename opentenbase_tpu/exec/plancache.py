@@ -32,6 +32,13 @@ segfaulted XLA:CPU at a few hundred programs.  Four pieces:
 4. Telemetry — per-tier hit/miss/compile/compile_ms/eviction counters
    surfaced by the otb_plancache stat view (parallel/statviews.py).
 
+5. Retrace sanitizer — OTB_TRACECHECK=1 records every jit-tier put's
+   quantized class components (join factors, size classes, batch
+   classes) into a program census; save_census() merges it into
+   analysis/program_census.json, where the retrace-witness lint pass
+   cross-checks witnessed compiles against the static ladder
+   predictions (analysis/cardinality.py).
+
 The exact-statement plan cache (get_or_build, used by both sessions)
 keeps its holder-attached storage but now feeds the same counters.
 Mutation stays defensive: sessions on a CN server share these caches
@@ -40,7 +47,9 @@ across handler threads, so races must never fail a query.
 
 from __future__ import annotations
 
+import atexit
 import itertools
+import json
 import os
 import queue
 import threading
@@ -48,7 +57,7 @@ import time
 from typing import Optional
 
 from ..obs import trace as obs_trace
-from ..sql.fingerprint import fingerprint
+from ..sql.fingerprint import fingerprint, struct_key
 from ..utils import locks
 
 _LOCK = locks.RLock("exec.plancache._LOCK")
@@ -140,6 +149,8 @@ class ProgramCache:
                 self._d[key] = [next(_SEQ), value]
             except TypeError:
                 return value          # unhashable key: just don't cache
+            if self.jit and tracecheck_enabled():
+                _census_note(self, key)
             while len(self._d) > self.max_entries:
                 self._evict_lru()
         if self.jit:
@@ -158,10 +169,14 @@ class ProgramCache:
                     except Exception:
                         pass
                 ent[1] = value
+                if self.jit and tracecheck_enabled():
+                    _census_forget(self, key)
 
     def pop(self, key):
         with _LOCK:
             ent = self._d.pop(key, None)
+            if ent is not None and self.jit and tracecheck_enabled():
+                _census_forget(self, key)
         if ent is not None:
             for fn in _entry_fns(ent[1]):
                 try:
@@ -213,6 +228,8 @@ class ProgramCache:
         key = min(self._d, key=lambda k: self._d[k][0])
         _s, value = self._d.pop(key)
         self.evictions += 1
+        if self.jit and tracecheck_enabled():
+            _census_forget(self, key)
         for fn in _entry_fns(value):
             try:
                 fn.clear_cache()
@@ -243,11 +260,171 @@ def trim_live():
             _seq, c, k = best
             _s, value = c._d.pop(k)
             c.evictions += 1
+            if tracecheck_enabled():
+                _census_forget(c, k)   # _REGISTRY holds jit caches only
             for fn in _entry_fns(value):
                 try:
                     fn.clear_cache()
                 except Exception:
                     pass
+
+
+# ---------------------------------------------------------------------------
+# retrace sanitizer (OTB_TRACECHECK=1): per-program compile census
+# ---------------------------------------------------------------------------
+_CENSUS: dict = {}        # guarded_by: _LOCK  (tier, frag, key) -> entry
+_CENSUS_ATEXIT = [False]  # guarded_by: _LOCK
+
+
+def tracecheck_enabled() -> bool:
+    """OTB_TRACECHECK=1 arms the retrace sanitizer: every jit-tier
+    ``put`` records its signature's quantized class components so the
+    lint gate can cross-check witnessed compiles against the static
+    ladder predictions (analysis/cardinality.py, retrace-witness) —
+    the lock-witness pattern of utils/locks.py applied to program
+    cardinality.  Read at use time, not import, so subprocess tests
+    can flip it."""
+    return os.environ.get("OTB_TRACECHECK", "").strip().lower() \
+        in ("1", "on", "true", "yes")
+
+
+def _census_classes(tier: str, key):
+    """Split a program key into (classes, frag_key): the quantized
+    size/factor components — each must be ladder-shaped — and the key
+    with those positions masked out (the fragment signature whose
+    class combinations share one compile budget).  Returns None for
+    key shapes this extractor does not recognize."""
+    if tier == "fused" and isinstance(key, tuple) and len(key) >= 6:
+        # base_key(5) [+ ("__batch", class)] + sorted factor items
+        classes, tail = [], []
+        for part in key[5:]:
+            if (isinstance(part, tuple) and len(part) == 2
+                    and part[0] == "__batch"):
+                classes.append(("batch", part[1]))
+                tail.append(("__batch", "*"))
+            elif isinstance(part, tuple):
+                for it in part:
+                    if isinstance(it, tuple) and len(it) == 2:
+                        classes.append((f"factor:{it[0]}", it[1]))
+                tail.append("*")
+            else:
+                tail.append(part)
+        # table_sig (key[1]) carries store id()s and per-snapshot dict
+        # sizes — execution environment, not fragment identity
+        frag = (key[0], "*", key[2], key[3], key[4]) + tuple(tail)
+        return classes, frag
+    if tier == "mesh" and isinstance(key, tuple) and len(key) == 9:
+        # (runner_id, frags, exchanges, tables, factors, mults,
+        #  gathers, baked, traced-types) — see mesh_exec.prog_key
+        classes, tabs = [], []
+        for el in key[3]:     # (table, padded, dicts, arrs)
+            classes.append((f"pad:{el[0]}", el[1]))
+            tabs.append((el[0], "*", el[2], el[3]))
+        for label, part in (("factor", key[4]), ("mult", key[5]),
+                            ("gather", key[6])):
+            for k, v in part:
+                classes.append((f"{label}:{k}", v))
+        frag = ("*", key[1], key[2], tuple(tabs), "*", "*", "*",
+                key[7], key[8])
+        return classes, frag
+    return None
+
+
+def _census_note(cache: "ProgramCache", key) -> None:  # holds: _LOCK
+    # the sanitizer must never fail a query
+    try:
+        split = _census_classes(cache.name, key)
+        if split is None:
+            classes, frag_fp = [], "?"
+        else:
+            classes, frag_fp = split[0], struct_key(split[1])
+        kfp = struct_key(key)
+        ent = _CENSUS.get((cache.name, frag_fp, kfp))
+        if ent is None:
+            _CENSUS[(cache.name, frag_fp, kfp)] = {
+                "tier": cache.name, "frag": frag_fp, "key": kfp,
+                "classes": [[d, v] for d, v in classes], "puts": 1}
+        else:
+            ent["puts"] += 1
+        _census_arm_atexit()
+    except Exception:
+        pass
+
+
+def _census_forget(cache: "ProgramCache", key) -> None:  # holds: _LOCK
+    # an evicted program's later re-put is a legitimate recompile, not
+    # a retrace — drop its census entry
+    try:
+        split = _census_classes(cache.name, key)
+        frag_fp = "?" if split is None else struct_key(split[1])
+        _CENSUS.pop((cache.name, frag_fp, struct_key(key)), None)
+    except Exception:
+        pass
+
+
+def _census_arm_atexit() -> None:  # holds: _LOCK
+    if _CENSUS_ATEXIT[0]:
+        return
+    _CENSUS_ATEXIT[0] = True
+    if os.environ.get("OTB_TRACECHECK_REPORT", "").strip() or \
+            os.environ.get("OTB_TRACECHECK_PERSIST", "").strip():
+        atexit.register(save_census)
+
+
+def census() -> list:
+    """This process's witnessed program census entries (copies)."""
+    with _LOCK:
+        return [dict(e) for e in _CENSUS.values()]
+
+
+def reset_census() -> None:
+    with _LOCK:
+        _CENSUS.clear()
+
+
+def default_census_path() -> str:
+    env = os.environ.get("OTB_TRACECHECK_REPORT", "").strip()
+    if env:
+        return env
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(pkg, "analysis", "program_census.json")
+
+
+def save_census(path: Optional[str] = None) -> dict:
+    """Merge this process's program census into the report file (max
+    puts per signature survives across shards/processes); the static
+    pass cross-checks every witnessed class against the ladder
+    predictions (analysis/cardinality.py, retrace-witness)."""
+    path = path or default_census_path()
+    merged = {(e["tier"], e["frag"], e["key"]): dict(e)
+              for e in census()}
+    try:
+        with open(path, encoding="utf-8") as f:
+            prior = json.load(f)
+        for e in prior.get("entries", []):
+            k = (e.get("tier"), e.get("frag"), e.get("key"))
+            cur = merged.get(k)
+            if cur is None:
+                merged[k] = e
+            else:
+                cur["puts"] = max(cur.get("puts", 1),
+                                  e.get("puts", 1))
+    except (OSError, ValueError):
+        pass
+    data = {
+        "comment": "program compile census (OTB_TRACECHECK=1 runs); "
+                   "every witnessed class must be ladder-shaped and "
+                   "every live signature must compile exactly once — "
+                   "see analysis/cardinality.py (retrace-witness)",
+        "entries": sorted(merged.values(),
+                          key=lambda e: (str(e.get("tier")),
+                                         str(e.get("frag")),
+                                         str(e.get("key")))),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
 
 
 # ---------------------------------------------------------------------------

@@ -76,7 +76,7 @@ def _lloyd_step(vecs, valid, centroids, nlist: int):
 
 
 def kmeans(vecs: np.ndarray, nlist: int, iters: int = 8,
-           seed: int = 17) -> np.ndarray:
+           seed: int = 17) -> np.ndarray:  # otblint: sync-boundary
     """Lloyd k-means for the IVF coarse quantizer (host-driven loop,
     device steps)."""
     n = len(vecs)

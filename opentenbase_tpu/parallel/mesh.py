@@ -95,7 +95,7 @@ def _pack_for_a2a(key_hash, arrs, valid, n_dev: int, bucket: int):
 
 
 def redistribute(mesh: Mesh, cols: dict, valid, key_col: str,
-                 bucket: int):
+                 bucket: int):  # otblint: sync-boundary
     """Hash-redistribute sharded columns by cols[key_col] so each row
     lands on its owner device: ONE all_to_all per column over ICI.
 

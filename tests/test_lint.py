@@ -819,6 +819,310 @@ class TestLockDisciplineBareAndMulti:
 
 
 # ---------------------------------------------------------------------------
+# otbcard suite (analysis/cardinality.py)
+# ---------------------------------------------------------------------------
+
+class TestHostSyncSinkSpellings:
+    """Every spelling of a host sync on a traced value is a finding:
+    ``.tolist()``, dotted ``jax.device_get(...)``, and the bare-name
+    ``from jax import device_get`` form."""
+
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/hot.py": """\
+            import jax
+            from jax import device_get
+
+            def run(x):
+                y = jax.numpy.cumsum(x)
+                a = y.tolist()          # host sync: method
+                b = jax.device_get(y)   # host sync: dotted
+                c = device_get(y)       # host sync: bare from-import
+                return a, b, c
+
+            def build():
+                return jax.jit(run)
+        """,
+        "fixpkg/exec/cold.py": """\
+            import jax
+
+            def run(x):
+                y = jax.numpy.cumsum(x)
+                n = y.shape[0]          # static metadata, no sync
+                return y + n
+
+            def build():
+                return jax.jit(run)
+        """,
+    }
+
+    def test_all_three_spellings_flagged(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"host-sync"})
+        got = sorted((f["file"], f["line"]) for f in report["findings"])
+        assert got == [("fixpkg/exec/hot.py", 6),
+                       ("fixpkg/exec/hot.py", 7),
+                       ("fixpkg/exec/hot.py", 8)], got
+
+
+class TestProgramCardinalityPass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/hotkeys.py": """\
+            import time
+            from opentenbase_tpu.exec.plancache import ProgramCache
+
+            CACHE = ProgramCache("fix", 8)
+
+            def next_pow2(n):
+                c = 1
+                while c < n:
+                    c *= 2
+                return c
+
+            def put_clock(prog):
+                key = (time.time(),)       # wall clock in the key
+                CACHE.put(key, prog)
+
+            def put_rowcount(store, prog):
+                n = store.row_count()      # raw row count, no ladder
+                CACHE.put((n,), prog)
+
+            def put_dictorder(opts, prog):
+                key = tuple(opts.items())  # iteration order in the key
+                CACHE.put(key, prog)
+
+            def put_clean(store, opts, prog):
+                key = (next_pow2(store.row_count()),
+                       tuple(sorted(opts.items())))
+                CACHE.put(key, prog)
+        """,
+    }
+
+    def test_unbounded_sources_flagged_clean_twin_silent(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"program-cardinality"})
+        got = sorted(f["symbol"] for f in report["findings"])
+        assert got == ["put_clock", "put_dictorder", "put_rowcount"], \
+            [(f["symbol"], f["message"]) for f in report["findings"]]
+
+
+class TestRetraceRiskPass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/keys.py": """\
+            import jax
+            from opentenbase_tpu.exec.plancache import ProgramCache
+
+            CACHE = ProgramCache("fix", 8)
+
+            def put_list(parts, prog):
+                CACHE.put([p for p in parts], prog)   # unhashable
+
+            def put_sorted(parts, prog):
+                CACHE.put((sorted(parts),), prog)     # list component
+
+            def put_ephemeral(prog):
+                scratch = {}
+                CACHE.put((id(scratch),), prog)       # fresh identity
+
+            def put_pervalue(x, prog):
+                k = int(jax.numpy.sum(x))             # per-value read
+                CACHE.put((k,), prog)
+
+            def put_clean(parts, prog):
+                CACHE.put(tuple(sorted(parts)), prog)
+        """,
+        "fixpkg/exec/traced.py": """\
+            import jax
+
+            def run(x, lim):
+                if x.shape[0] > lim:   # raw shape vs runtime value
+                    return x
+                return x + 1
+
+            def build():
+                return jax.jit(run)
+        """,
+        "fixpkg/exec/traced_clean.py": """\
+            import jax
+
+            def run2(x):
+                if x.shape[0] > 128:   # constant comparison: fine
+                    return x
+                return x + 1
+
+            def build2():
+                return jax.jit(run2)
+        """,
+    }
+
+    def test_per_value_identity_flagged(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"retrace-risk"})
+        got = sorted(f["symbol"] for f in report["findings"])
+        assert got == ["put_ephemeral", "put_list", "put_pervalue",
+                       "put_sorted", "run"], \
+            [(f["symbol"], f["message"]) for f in report["findings"]]
+
+
+class TestDeviceResidencyPass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/storage/__init__.py": "",
+        "fixpkg/storage/stray.py": """\
+            import jax
+
+            _PARKED: dict = {}
+
+            def park(k, x):
+                _PARKED[k] = jax.device_put(x)   # untracked residency
+        """,
+        "fixpkg/storage/pool.py": """\
+            import jax
+
+            class Pool:
+                def note_upload(self, n):
+                    pass
+
+            POOL = Pool()
+
+            def stage(x):
+                a = jax.device_put(x)
+                POOL.note_upload(8)   # accounted: the pool can evict it
+                return a
+        """,
+    }
+
+    def test_stray_device_put_and_global_store(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        got = _scan(tmp_path, "device-residency")
+        # park trips twice: the raw device_put AND the module-global
+        # store of device-produced bytes; the accounting twin is silent
+        assert got == [("device-residency", "fixpkg/storage/stray.py"),
+                       ("device-residency",
+                        "fixpkg/storage/stray.py")], got
+
+    def test_sanctioned_staging_file_exempt(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/storage/__init__.py": "",
+            "fixpkg/storage/bufferpool.py":
+                self.FILES["fixpkg/storage/stray.py"],
+        }
+        _write_pkg(tmp_path, files)
+        assert _scan(tmp_path, "device-residency") == []
+
+
+class TestTransferDisciplinePass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/pulls.py": """\
+            import jax
+            import numpy as np
+
+            def leak(x):
+                y = jax.numpy.cumsum(x)
+                return np.asarray(y)      # undeclared host pull
+
+            def grab(x):
+                y = jax.numpy.cumsum(x)
+                return jax.device_get(y)  # undeclared host pull
+
+            def listify(x):
+                y = jax.numpy.cumsum(x)
+                return y.tolist()         # undeclared host pull
+
+            def declared(x):  # otblint: sync-boundary
+                y = jax.numpy.cumsum(x)
+                return np.asarray(y)
+
+            def declared_multiline(x,
+                                   n):  # otblint: sync-boundary
+                y = jax.numpy.cumsum(x)
+                return np.asarray(y)[:n]
+
+            def handles(n):
+                # device HANDLES, not device data — no pull
+                return np.asarray(jax.devices()[:n])
+        """,
+    }
+
+    def test_undeclared_pulls_flagged_boundaries_exempt(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"transfer-discipline"})
+        got = sorted(f["symbol"] for f in report["findings"])
+        assert got == ["grab", "leak", "listify"], \
+            [(f["symbol"], f["message"]) for f in report["findings"]]
+
+    def test_out_of_scope_module_silent(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/utils/__init__.py": "",
+            "fixpkg/utils/dump.py": """\
+                import jax
+                import numpy as np
+
+                def snapshot(x):
+                    return np.asarray(jax.numpy.cumsum(x))
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        assert _scan(tmp_path, "transfer-discipline") == []
+
+
+class TestRetraceWitnessPass:
+    def test_bad_census_fails_gate(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/analysis/program_census.json": """\
+                {"entries": [
+                  {"tier": "fused", "frag": "f1", "key": "k1",
+                   "classes": [["factor:j0", 1000]], "puts": 1},
+                  {"tier": "mesh", "frag": "f2", "key": "k2",
+                   "classes": [["pad:t", 256]], "puts": 3}
+                ]}
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        got = _msgs(tmp_path, "retrace-witness")
+        assert len(got) == 2, got
+        assert any("not ladder-shaped" in m for _f, m in got), got
+        assert any("unexplained retrace" in m for _f, m in got), got
+
+    def test_clean_census_silent(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/analysis/program_census.json": """\
+                {"entries": [
+                  {"tier": "mesh", "frag": "f", "key": "k",
+                   "classes": [["pad:t", 256], ["factor:j", 4],
+                               ["gather:0", 96]], "puts": 1}
+                ]}
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        assert _msgs(tmp_path, "retrace-witness") == []
+
+    def test_unreadable_census_is_a_finding(self, tmp_path):
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/analysis/program_census.json": "{not json",
+        }
+        _write_pkg(tmp_path, files)
+        got = _msgs(tmp_path, "retrace-witness")
+        assert len(got) == 1 and "unreadable" in got[0][1], got
+
+
+# ---------------------------------------------------------------------------
 # CI ergonomics: --github annotations + --changed-only
 # ---------------------------------------------------------------------------
 
