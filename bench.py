@@ -71,6 +71,14 @@ Modes via env:
   Knobs: BENCH_CHAOSC_SECONDS (8), BENCH_CHAOSC_WARM_SECONDS (2),
   BENCH_CHAOSC_CLIENTS (64), BENCH_CHAOSC_SF (0.02),
   BENCH_CHAOSC_ANALYTICS=0 for a quick smoke run.
+- --oob: the out-of-core arm (exec/morsel.py) — SKIP the ladder; cap
+  OTB_DEVICE_CACHE_BYTES at what the BENCH_OOB_CAP_SF (default 1)
+  dataset would occupy staged, then run Q1/Q3/Q5 at BENCH_OOB_SF
+  (default 10) through the morsel streaming tier.  ONE JSON line with
+  per-query GB/s of bytes touched (vs the uncapped in-memory run),
+  chunk count, chunk_downshifts, bytes_streamed, bit_identical, and
+  warm_programs_compiled (must be 0 — chunk count never reaches a
+  program key), plus the bufferpool pin ledger (must balance).
 """
 
 import json
@@ -208,6 +216,126 @@ def _oltp_latencies(s, n=200):
 TRACE_DUMP = "--trace" in sys.argv[1:]
 CHAOS = "--chaos" in sys.argv[1:]
 CHAOS_CONCURRENT = "--chaos-concurrent" in sys.argv[1:]
+OOB = "--oob" in sys.argv[1:]
+
+
+def _oob_arm():
+    """--oob: the out-of-core acceptance arm (exec/morsel.py) — SF10 on
+    an SF1 device budget.  OTB_DEVICE_CACHE_BYTES is capped at what the
+    cap-SF dataset would occupy staged (the "SF1 device"), then
+    Q1/Q3/Q5 run at BENCH_OOB_SF through the morsel tier: the dominant
+    scan streams in fixed-shape pinned chunks, blocking operators
+    decompose per chunk, and the answer must be bit-identical to the
+    uncapped in-memory run.  Prints ONE JSON line; per query it
+    reports gb_touched / gb_per_s (bytes-touched throughput, the
+    out-of-core figure of merit vs gb_per_s_in_memory), chunk count,
+    chunk_downshifts, bytes_streamed, bit_identical, and
+    warm_programs_compiled (MUST be 0: chunk count/offsets never reach
+    a program key, so a warm stream recompiles nothing).  Knobs:
+    BENCH_OOB_SF (default 10), BENCH_OOB_CAP_SF (default 1),
+    BENCH_REPEAT (default 3) — smoke runs use e.g. BENCH_OOB_SF=0.2
+    BENCH_OOB_CAP_SF=0.02."""
+    from opentenbase_tpu.exec import morsel as morsel_mod
+    from opentenbase_tpu.exec.session import LocalNode, Session
+    from opentenbase_tpu.storage.batch import size_class
+    from opentenbase_tpu.storage.bufferpool import POOL
+    from opentenbase_tpu.tpch import datagen
+    from opentenbase_tpu.tpch.queries import Q
+    from opentenbase_tpu.tpch.schema import SCHEMA
+
+    sf = float(os.environ.get("BENCH_OOB_SF", "10"))
+    cap_sf = float(os.environ.get("BENCH_OOB_CAP_SF", "1"))
+    repeat = max(1, int(os.environ.get("BENCH_REPEAT", "3")))
+
+    t0 = time.time()
+    data = datagen.generate(sf=sf)
+    gen_s = time.time() - t0
+    n_rows = len(data["lineitem"]["l_orderkey"])
+
+    # the SF-cap device budget: what the FULL cap-SF dataset would
+    # occupy staged (value + MVCC sys columns, size_class padding) —
+    # a device sized to hold SF1 resident, which SF10 streams through
+    cap = 0
+    for cols in data.values():
+        rows = len(next(iter(cols.values())))
+        cap += size_class(max(int(rows * cap_sf / sf), 1)) \
+            * (len(cols) + 4) * 8
+    os.environ["OTB_DEVICE_CACHE_BYTES"] = str(cap)
+
+    node = LocalNode()
+    s = Session(node)
+    s.execute(SCHEMA)
+    for tname in ("region", "nation", "supplier", "customer",
+                  "orders", "lineitem"):
+        td = node.catalog.table(tname)
+        nn = len(next(iter(data[tname].values())))
+        s._insert_rows(td, node.stores[tname], data[tname], nn)
+
+    ladder = []
+    for qn in (1, 3, 5):
+        # uncapped in-memory truth + timing (the comparison arm)
+        s.execute("set morsel = off")
+        ref = s.query(Q[qn])
+        eng_mem, _ = _time(lambda: s.query(Q[qn]),
+                           max(1, repeat // 2))
+        # the streamed arm: auto-activation under the capped budget
+        s.execute("set morsel = auto")
+        POOL.clear()
+        m0 = morsel_mod.stats_snapshot()
+        c0 = _compile_snapshot()
+        t1 = time.perf_counter()
+        got = s.query(Q[qn])
+        cold = time.perf_counter() - t1
+        c1 = _compile_snapshot()
+        times = []
+        for _ in range(repeat):
+            t1 = time.perf_counter()
+            s.query(Q[qn])
+            times.append(time.perf_counter() - t1)
+        c2 = _compile_snapshot()
+        m1 = morsel_mod.stats_snapshot()
+        eng = min(times)
+        gb = _gb_touched(qn, data)
+        entry = {"config": f"Q{qn} oob SF{sf:g}",
+                 "engine_ms": eng * 1e3, "cold_ms": cold * 1e3,
+                 "in_memory_ms": eng_mem * 1e3,
+                 "x_in_memory": eng / eng_mem,
+                 "gb_touched": gb, "gb_per_s": gb / eng,
+                 "gb_per_s_in_memory": gb / eng_mem,
+                 "streamed": m1["streams"] - m0["streams"] > 0,
+                 "chunks": m1["chunks"] - m0["chunks"],
+                 "chunk_downshifts": m1["chunk_downshifts"]
+                 - m0["chunk_downshifts"],
+                 "bytes_streamed": m1["bytes_streamed"]
+                 - m0["bytes_streamed"],
+                 "bit_identical": _rows_close(got, ref),
+                 "warm_programs_compiled": c2[0] - c1[0]}
+        entry.update(_compile_counters(c0, c1))
+        ladder.append(entry)
+        s.execute("set morsel = off")
+
+    head = ladder[0]
+    pool = POOL.totals()
+    out = {
+        "metric": f"out-of-core Q1 SF{sf:g} bytes-touched throughput "
+                  f"(SF{cap_sf:g}-sized device cache, {platform})",
+        "value": round(head["gb_per_s"], 3),
+        "unit": "GB/s",
+        "vs_baseline": round(head["gb_per_s"]
+                             / head["gb_per_s_in_memory"], 3)
+        if head["gb_per_s_in_memory"] else 0.0,
+        "device_cache_bytes": cap,
+        "ladder": [{k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in e.items()} for e in ladder],
+        "pin_ledger": POOL.check_pin_ledger(),
+        "pool": {k: pool[k] for k in ("bytes_live", "chunks_live",
+                                      "evictions", "uploaded_bytes")},
+    }
+    if tpu_unavailable:
+        out["tpu_unavailable"] = True
+    print(json.dumps(out))
+    print(f"# oob: sf={sf} cap_sf={cap_sf} cap={cap} rows={n_rows} "
+          f"datagen={gen_s:.1f}s platform={platform}", file=sys.stderr)
 
 
 def _chaos_arm():
@@ -997,6 +1125,9 @@ def main():
     if CHAOS:
         _chaos_arm()
         return
+    if OOB:
+        _oob_arm()
+        return
     sf = float(os.environ.get("BENCH_SF", "1.0"))
     repeat = int(os.environ.get("BENCH_REPEAT", "5"))
     mode = os.environ.get("BENCH_MODE", "ladder")
@@ -1199,7 +1330,8 @@ def main():
     from opentenbase_tpu.storage.bufferpool import POOL
     out["buffercache"] = [
         dict(zip(("table", "hits", "misses", "bytes_live", "evictions",
-                  "invalidations"), r)) for r in POOL.stats_rows()]
+                  "invalidations", "pinned", "pins", "unpins"), r))
+        for r in POOL.stats_rows()]
     if tpu_unavailable:
         out["tpu_unavailable"] = True
     print(json.dumps(out))

@@ -70,7 +70,8 @@ from .core import Finding, FuncInfo, Project
 from .passes import ProgramKeyPass, _dotted, _Emitter, _fn_disabled
 
 #: functions that collapse an unbounded int into a bounded class
-_QUANT_FUNCS = frozenset({"size_class", "next_pow2", "_batch_class"})
+_QUANT_FUNCS = frozenset({"size_class", "next_pow2", "_batch_class",
+                          "chunk_class"})
 #: call prefixes whose results have an unbounded / per-process domain
 _UNBOUNDED_PREFIXES = ("time.", "datetime.", "random.", "secrets.",
                        "uuid.", "numpy.random.")
@@ -83,7 +84,7 @@ _HASHABLE_CALLS = frozenset({"tuple", "frozenset", "struct_key",
                              "fingerprint", "hash", "id", "int", "str",
                              "float", "bool", "len", "min", "max",
                              "sum", "repr", "next_pow2", "size_class",
-                             "_batch_class", "getattr"})
+                             "_batch_class", "chunk_class", "getattr"})
 #: constructors of fresh per-call objects — id() of one is ephemeral
 _FRESH_CALLS = frozenset({"dict", "list", "set", "object", "bytearray"})
 
@@ -288,7 +289,20 @@ class ProgramCardinalityPass:
                             f"sorted(...) or two processes with "
                             f"different insertion orders compile "
                             f"distinct programs for one fragment")
+            elif isinstance(e, ast.Name) and \
+                    isinstance(e.ctx, ast.Load) and not in_quant and \
+                    "chunk" in e.id.lower():
+                em.emit(fi, e.lineno,
+                        f"raw chunk count/size '{e.id}' in program-key "
+                        f"material — a morsel stream re-sizes its "
+                        f"window under pressure, so quantize through "
+                        f"chunk_class() or one stream mints one "
+                        f"compiled program per chunk geometry")
+                return
             for c in ast.iter_child_nodes(e):
+                if isinstance(e, ast.Call) and c is e.func and \
+                        isinstance(c, ast.Name):
+                    continue   # callee name, not key material
                 if isinstance(c, ast.expr):
                     walk(c, in_sorted, in_quant)
                 elif isinstance(c, ast.comprehension):

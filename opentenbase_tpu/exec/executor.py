@@ -61,7 +61,7 @@ STAT_FIELDS = ("joins", "index_compositions", "deferred_cols",
                "host_syncs", "fused_join_hits")
 STATS_LOCK = locks.Lock("exec.executor.STATS_LOCK")
 EXEC_STATS: dict = {t: {f: 0 for f in STAT_FIELDS}   # guarded_by: STATS_LOCK
-                    for t in ("single", "fused", "mesh")}
+                    for t in ("single", "fused", "mesh", "morsel")}
 _TIER = threading.local()   # per-thread counter attribution
 
 #: late-materialization master switch — off reverts joins to the eager
@@ -102,7 +102,7 @@ def exec_stats_rows() -> list:
     """(tier, *STAT_FIELDS) rows for the otb_execstats view."""
     with STATS_LOCK:
         return [(t, *(EXEC_STATS[t][f] for f in STAT_FIELDS))
-                for t in ("single", "fused", "mesh")]
+                for t in ("single", "fused", "mesh", "morsel")]
 
 
 def exec_stats_snapshot() -> dict:

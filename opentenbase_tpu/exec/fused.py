@@ -614,6 +614,162 @@ def batch_signature(ctx, node) -> Optional[FragSig]:
                    plan_key=plan_key, lit_types=lit_types)
 
 
+# ---------------------------------------------------------------------------
+# Morsel-tier fragment programs (exec/morsel.py)
+
+class FragmentProgram:
+    """One literal-masked compiled fragment, re-dispatched per streamed
+    chunk (the morsel tier's unit of execution).
+
+    The serial path's `_try_fused` screens, stages and runs in one
+    shot; a morsel stream instead compiles ONCE and calls the program
+    per chunk with the streamed table's staged window swapped in — the
+    chunk's padded shape (`chunk_rows`, chunk_class-quantized) is part
+    of the cache key (`("__morsel", class)`), the chunk COUNT and row
+    offsets are not, so a thousand-chunk stream is one compile.  Mask
+    fallback and the learned join-size ladder work exactly as on the
+    serial path: a masked literal that host-syncs rebuilds baked, a
+    join overflow re-runs the SAME chunk one factor class up."""
+
+    def __init__(self, ctx, plan, chunk_rows: int):
+        from ..storage.batch import chunk_class
+        self.ctx = ctx
+        self.plan = plan
+        self.chunk_rows = int(chunk_rows)
+        self._chunk_key = ("__morsel", chunk_class(int(chunk_rows)))
+        self._ok = self._prepare(allow_mask=True)
+
+    def _prepare(self, allow_mask: bool) -> bool:
+        ctx = self.ctx
+        lits: list = []
+        exec_plan = _mask_node(self.plan, lits) if allow_mask \
+            else self.plan
+        key = _key_of(exec_plan)
+        if key is None:
+            return False
+        stores = {nd.table.name: ctx.stores[nd.table.name]
+                  for nd in _morsel_walk(self.plan)
+                  if isinstance(nd, P.SeqScan)}
+        for store in stores.values():
+            if _has_transformed_dup_dict(self.plan, store):
+                return False
+        self.traced_names = tuple(sorted(
+            k for k, (v, _t) in ctx.params.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)))
+        baked = {k: ctx.params[k] for k in ctx.params
+                 if k not in self.traced_names}
+        baked_key = tuple(sorted(
+            (k, v) for k, (v, _t) in baked.items()
+            if isinstance(v, (str, bool, type(None)))))
+        if len(baked_key) != len(baked):
+            return False  # non-scalar param: don't risk a stale closure
+        types_key = tuple((k, ctx.params[k][1])
+                          for k in self.traced_names)
+        lit_types = tuple(t for _n, _v, t in lits)
+        base_key = (key, _table_sig(stores), baked_key, types_key,
+                    lit_types)
+        try:
+            hash(base_key)
+        except TypeError:
+            return False
+        if lits and struct_key(base_key) in _MASK_REFUSED:
+            return self._prepare(allow_mask=False)
+        self.exec_plan = exec_plan
+        self.lits = lits
+        self.baked = baked
+        self.base_key = base_key
+        self.lkey = struct_key(base_key)
+        self.has_join = _plan_has_join(exec_plan)
+        with _STATE_LOCK:
+            self.factors = dict(_JOIN_LADDER.get(self.lkey, {})) \
+                if self.has_join else {}
+        return True
+
+    def ok(self) -> bool:
+        return self._ok
+
+    def run(self, staged_arrs: dict, staged_ns: dict, snapshot_ts,
+            txid):  # otblint: sync-boundary
+        """One chunk through the compiled fragment.  `staged_arrs` maps
+        every leaf table to its traced arrays — the streamed table's
+        window plus the resident (pinned) sides — and `staged_ns` to
+        its live row count.  Returns a device DBatch, or None when the
+        shape permanently refuses fusion (caller declines the stream)."""
+        from .executor import DBatch, stats_tier
+        ctx = self.ctx
+        pvals = tuple(
+            [jnp.asarray(ctx.params[k][0]) for k in self.traced_names]
+            + [jnp.asarray(v) for _n, v, _t in self.lits])
+        for _attempt in range(24):
+            full_key = self.base_key + (
+                self._chunk_key, tuple(sorted(self.factors.items())))
+            hit = plancache.FUSED.get(full_key)
+            if hit is None:
+                hit = plancache.FUSED.put(
+                    full_key, _build_program(
+                        ctx, self.exec_plan, self.baked,
+                        self.traced_names, self.lits, self.factors))
+            fn, meta = hit
+            if fn is None:
+                return None  # permanently fell back for this shape
+            t0 = time.perf_counter()
+            try:
+                with stats_tier("morsel"):
+                    cols, valid, nulls, join_req = fn(
+                        staged_arrs, jnp.int64(snapshot_ts),
+                        jnp.int64(txid), pvals, staged_ns)
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError):
+                plancache.FUSED.pop(full_key)
+                if self.lits:
+                    # a masked literal fed value-dependent structure:
+                    # remember, rebuild baked, re-run this chunk
+                    _mask_refused_add(struct_key(self.base_key))
+                    if self._prepare(allow_mask=False):
+                        continue
+                    return None
+                plancache.FUSED.replace(full_key, (None, None))
+                return None
+            except Exception:
+                plancache.FUSED.pop(full_key)
+                raise  # OOM must reach the driver's downshift ladder
+            plancache.FUSED.record_call(fn, t0)
+
+            caps = meta.get("join_caps") or ()
+            if caps:
+                req = np.asarray(jax.device_get(join_req))
+                grew = False
+                for (jid, cap), r in zip(caps, req):
+                    if r <= cap:
+                        continue
+                    mult = 1
+                    while cap * mult < r:
+                        mult *= 2
+                    self.factors[jid] = self.factors.get(jid, 1) * mult
+                    if self.factors[jid] > 4096:
+                        return None  # ladder exhausted
+                    grew = True
+                if grew:
+                    _ladder_remember(self.lkey, self.factors)
+                    obs_trace.event("retrace", tier="morsel",
+                                    factors=dict(self.factors))
+                    continue  # SAME chunk, one factor class up
+            if self.has_join:
+                _ladder_remember(self.lkey, self.factors)
+            return DBatch(dict(cols), valid, dict(meta["types"]),
+                          dict(meta["dicts"]), dict(nulls))
+        return None  # overflow never converged
+
+
+def _morsel_walk(node):
+    yield node
+    for attr in ("child", "left", "right"):
+        c = getattr(node, attr, None)
+        if isinstance(c, P.PhysNode):
+            yield from _morsel_walk(c)
+
+
 def _batch_class(k: int) -> int:
     """Pad batch size to a power of two so K concurrent arrivals hit a
     bounded set of compiled batch classes."""

@@ -295,13 +295,21 @@ def _census_classes(tier: str, key):
     class combinations share one compile budget).  Returns None for
     key shapes this extractor does not recognize."""
     if tier == "fused" and isinstance(key, tuple) and len(key) >= 6:
-        # base_key(5) [+ ("__batch", class)] + sorted factor items
+        # base_key(5) [+ ("__batch", class) | ("__morsel", class)]
+        # + sorted factor items
         classes, tail = [], []
         for part in key[5:]:
             if (isinstance(part, tuple) and len(part) == 2
                     and part[0] == "__batch"):
                 classes.append(("batch", part[1]))
                 tail.append(("__batch", "*"))
+            elif (isinstance(part, tuple) and len(part) == 2
+                    and part[0] == "__morsel"):
+                # the chunk-size class of a morsel stream — quantized
+                # by storage/batch.py chunk_class, so the witness gate
+                # can hold it to the ladder like any batch class
+                classes.append(("chunk", part[1]))
+                tail.append(("__morsel", "*"))
             elif isinstance(part, tuple):
                 for it in part:
                     if isinstance(it, tuple) and len(it) == 2:

@@ -687,8 +687,11 @@ class Scheduler:
             self._release(group)
         if err is not None:
             if shield.is_oom(err):
-                # rung 2: relief did not help — degrade the members to
-                # the spill tier (an answer instead of an error)
+                # rung 2: relief did not help — hand the members to
+                # shield.run_degraded, which tries the morsel chunk
+                # stream first (bounded device windows, the ladder's
+                # middle rung) and only then leaves the device for the
+                # spill tier (an answer instead of an error)
                 for it in items:
                     self._pool.submit(self._serve_degraded, it)
                 return
@@ -728,8 +731,9 @@ class Scheduler:
                 self._dispatch_one(half, isolating=True)
 
     def _serve_degraded(self, item: _Item):
-        """Brownout lane: serve one member through the spill tier after
-        dispatch-level memory pressure."""
+        """Brownout lane: serve one member through the morsel stream
+        (or, failing that, the spill tier) after dispatch-level memory
+        pressure."""
         if self._expire_if_dead(item):
             return
         try:

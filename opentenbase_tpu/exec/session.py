@@ -1087,29 +1087,48 @@ class Session:
         t, implicit = self._begin_implicit()
         batch = None
         exe = None
+
+        def prerun_init_plans():
+            # init plans must run first so their scalars reach the
+            # chunk/slab/partition passes (the in-memory path does
+            # this in Executor.run); returns (params, stripped plan)
+            if not planned.init_plans:
+                return {}, planned
+            ctx0 = ExecContext(self.node.stores, t.snapshot_ts,
+                               t.txid, self.node.cache)
+            ex0 = Executor(ctx0)
+            for ip in planned.init_plans:
+                b0 = ex0.exec_node(ip.plan)
+                from .executor import scalar_from_batch
+                ctx0.params[ip.name] = (scalar_from_batch(b0),
+                                        ip.type)
+            return dict(ctx0.params), PlannedStmt(
+                planned.plan, [], planned.output_names)
+
+        raw_morsel = self.node.gucs.get("morsel", "auto")
+        if raw_morsel != "off" and not instrument:
+            # out-of-core streaming tier: the dominant scan streams
+            # through fixed-shape device chunk windows (exec/morsel.py)
+            from .morsel import MorselDriver, default_chunk_rows
+            raw_cr = self.node.gucs.get("morsel_chunk_rows", "")
+            cr = int(raw_cr) if raw_cr.isdigit() and int(raw_cr) > 0 \
+                else default_chunk_rows()
+            drv_m = MorselDriver(self.node.stores, self.node.cache,
+                                 t.snapshot_ts, t.txid, chunk_rows=cr,
+                                 forced=(raw_morsel == "on"))
+            params_m, planned_m = prerun_init_plans()
+            drv_m.params = dict(params_m)
+            batch = drv_m.try_run(planned_m)
         raw_budget = self.node.gucs.get("work_mem_rows", "")
-        if raw_budget.isdigit() and int(raw_budget) > 0:
+        if batch is None and raw_budget.isdigit() \
+                and int(raw_budget) > 0:
             # beyond-HBM tier: multi-pass partitioned execution when a
             # scanned table exceeds the staging budget (exec/spill.py)
             from .spill import SpillDriver
             drv = SpillDriver(self.node.stores, self.node.cache,
                               t.snapshot_ts, t.txid, int(raw_budget))
-            # init plans must run first so their scalars reach the
-            # slab/partition passes (the in-memory path does this in
-            # Executor.run)
-            planned_spill = planned
-            if planned.init_plans:
-                ctx0 = ExecContext(self.node.stores, t.snapshot_ts,
-                                   t.txid, self.node.cache)
-                ex0 = Executor(ctx0)
-                for ip in planned.init_plans:
-                    b0 = ex0.exec_node(ip.plan)
-                    from .executor import scalar_from_batch
-                    ctx0.params[ip.name] = (scalar_from_batch(b0),
-                                            ip.type)
-                drv.params = dict(ctx0.params)
-                planned_spill = PlannedStmt(planned.plan, [],
-                                            planned.output_names)
+            params_s, planned_spill = prerun_init_plans()
+            drv.params = dict(params_s)
             batch = drv.try_run(planned_spill)
         if batch is None:
             ctx = ExecContext(self.node.stores, t.snapshot_ts, t.txid,

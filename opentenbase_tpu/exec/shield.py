@@ -54,6 +54,9 @@ _STATS: dict = {              # guarded_by: _LOCK
     "oom_evicted_bytes": 0,   # HBM freed by pressure relief
     "degraded": 0,            # members served by the spill path
     "shrunk_batches": 0,      # admission byte estimate cut a batch
+    "streamed": 0,            # members served by the morsel chunk
+    # stream under pressure (the ladder's middle rung: shrink the
+    # device window before leaving the device)
 }
 _QUAR: dict = {}              # guarded_by: _LOCK — sig -> [fails, t0, until]
 
@@ -85,7 +88,7 @@ def stats_rows() -> list:
              d["quarantine_active"], d["quarantine_hits"],
              d["oom_dispatches"], d["oom_retries"],
              d["oom_evicted_bytes"], d["degraded"],
-             d["shrunk_batches"])]
+             d["shrunk_batches"], d["streamed"])]
 
 
 def reset_stats():
@@ -247,8 +250,12 @@ def batch_cap(node, info, max_batch: int) -> int:
 
 
 def run_degraded(item) -> list:
-    """Serve one batch member through the spill tier (bounded passes,
-    work_mem_rows-style) after dispatch-level memory pressure — the
+    """Serve one batch member after dispatch-level memory pressure.
+    The ladder's middle rung runs FIRST: a morsel chunk stream keeps
+    the query on-device with a bounded window (exec/morsel.py) and can
+    itself downshift the window on further pressure; only when the
+    shape is not streamable does the member leave the device for the
+    spill tier (bounded eager passes, work_mem_rows-style) — the
     brownout path: slower, but an answer instead of an error."""
     from .executor import materialize
     from .session import Result
@@ -259,8 +266,24 @@ def run_degraded(item) -> list:
     budget = int(_env_f("OTB_SHIELD_DEGRADE_ROWS", 65536))
     txid = node.gts.next_txid()
     snap = node.gts.next_gts()
-    bump("degraded")
     from ..net.guard import note_degraded
+    if item.planned is not None:
+        from ..storage.batch import chunk_class
+        from .morsel import MorselDriver
+        drv_m = MorselDriver(node.stores, node.cache, snap, txid,
+                             chunk_rows=chunk_class(budget),
+                             forced=True)
+        batch = drv_m.try_run(item.planned)
+        if batch is not None:
+            bump("streamed")
+            note_degraded("memory_pressure")
+            obs_trace.event("degraded_streamed",
+                            chunks=drv_m.chunks,
+                            chunk_rows=drv_m.chunk_rows)
+            names, rows = materialize(batch, item.planned.output_names)
+            return [Result("SELECT", names=names, rows=rows,
+                           rowcount=len(rows))]
+    bump("degraded")
     note_degraded("memory_pressure")
     obs_trace.event("degraded", budget_rows=budget)
     if item.planned is not None:

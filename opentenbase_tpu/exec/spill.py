@@ -96,6 +96,55 @@ class _ScanInfo:
     # eq=False: identity hashing so infos key _stage_for's dicts
 
 
+# -- shared slice-decomposition predicates (spill + morsel tiers) -------
+def node_contains(node, target) -> bool:
+    return any(nd is target for nd in _walk_nodes(node))
+
+
+def sliced_side_ok(plan, big_nodes, exclude=None) -> bool:
+    """A sliced table must sit on the preserved/probe side of every
+    outer/semi/anti join above it: slicing the null-extended or lookup
+    side would emit unmatched rows once per slice.  An excluded join
+    (the grace-partitioned one) is exempt — partitioning by its OWN key
+    hash keeps matches partition-aligned, so its join semantics survive
+    on both sides (reference: the hybrid hash join's nbatch
+    partitioning, nodeHash.c)."""
+    for nd in _walk_nodes(plan):
+        if not isinstance(nd, P.HashJoin) or nd is exclude:
+            continue
+        if nd.kind == "full" and any(
+                node_contains(nd, b) for b in big_nodes):
+            return False
+        if nd.kind in ("left", "semi", "anti") and any(
+                node_contains(nd.right, b) for b in big_nodes):
+            return False
+    return True
+
+
+def has_order_sensitive(subtree) -> bool:
+    """A Limit or Sort INSIDE the per-pass subtree would re-apply per
+    slice/chunk — those plans are not slice-decomposable."""
+    return any(isinstance(nd, (P.Limit, P.Sort))
+               for nd in _walk_nodes(subtree))
+
+
+def staged_host_columns(store, needed) -> dict:
+    """One store's host columns in the staged namespace (values + MVCC
+    sys columns + null masks), reusing the pool's host snapshot when a
+    current one is resident — the shared host source for spill slabs
+    and morsel chunk windows."""
+    from ..storage.bufferpool import POOL
+    snap = POOL.peek_host_snapshot(store)
+    if snap is not None:
+        keys = set(needed) | {
+            "__xmin_ts", "__xmax_ts", "__xmin_txid",
+            "__xmax_txid"} | {
+            f"__null.{c}" for c in needed
+            if c in store.null_columns}
+        return {k: snap["cols"][k] for k in keys}
+    return store.host_live_columns(needed)
+
+
 class SpillDriver:
     """Plan-shape matcher + multi-pass executor for one session node."""
 
@@ -181,10 +230,7 @@ class SpillDriver:
 
     @staticmethod
     def _has_order_sensitive(subtree) -> bool:
-        """A Limit or Sort INSIDE the per-pass subtree would re-apply
-        per slab/partition — those plans are not slice-decomposable."""
-        return any(isinstance(nd, (P.Limit, P.Sort))
-                   for nd in _walk_nodes(subtree))
+        return has_order_sensitive(subtree)
 
     # -- execution helpers --------------------------------------------
     def _exec_with_staged(self, plan, staged):
@@ -212,17 +258,7 @@ class SpillDriver:
             hkey = (id(info.store), info.store.version, tuple(needed))
             host = self._host_cache.get(hkey)
             if host is None:
-                from ..storage.bufferpool import POOL
-                snap = POOL.peek_host_snapshot(info.store)
-                if snap is not None:
-                    keys = set(needed) | {
-                        "__xmin_ts", "__xmax_ts", "__xmin_txid",
-                        "__xmax_txid"} | {
-                        f"__null.{c}" for c in needed
-                        if c in info.store.null_columns}
-                    host = {k: snap["cols"][k] for k in keys}
-                else:
-                    host = info.store.host_live_columns(needed)
+                host = staged_host_columns(info.store, needed)
                 self._host_cache = {hkey: host, **{
                     k: v for k, v in list(self._host_cache.items())[-3:]}}
             arrs, n = stage_padded(host, sel)
@@ -320,26 +356,10 @@ class SpillDriver:
 
     @staticmethod
     def _contains(node, target) -> bool:
-        return any(nd is target for nd in _walk_nodes(node))
+        return node_contains(node, target)
 
     def _sliced_side_ok(self, plan, big_nodes, exclude=None) -> bool:
-        """A sliced table must sit on the preserved/probe side of every
-        outer/semi/anti join above it: slicing the null-extended or
-        lookup side would emit unmatched rows once per slice.  The
-        grace-partitioned join itself is excluded — partitioning by its
-        OWN key hash keeps matches partition-aligned, so its join
-        semantics survive on both sides (reference: the hybrid hash
-        join's nbatch partitioning, nodeHash.c)."""
-        for nd in _walk_nodes(plan):
-            if not isinstance(nd, P.HashJoin) or nd is exclude:
-                continue
-            if nd.kind == "full" and any(
-                    self._contains(nd, b) for b in big_nodes):
-                return False
-            if nd.kind in ("left", "semi", "anti") and any(
-                    self._contains(nd.right, b) for b in big_nodes):
-                return False
-        return True
+        return sliced_side_ok(plan, big_nodes, exclude)
 
     def _top_join(self, plan, joins):
         for nd in _walk_nodes(plan):

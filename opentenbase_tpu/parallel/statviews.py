@@ -14,8 +14,12 @@ Views:
 - otb_plancache(tier, hits, misses, compiles, compile_ms, evictions,
   live) — the compiled-program subsystem's counters (exec/plancache.py)
 - otb_buffercache(table_name, hits, misses, bytes_live, evictions,
-  invalidations) — the device buffer pool's per-table counters
+  invalidations, pinned, pins, unpins) — the device buffer pool's
+  per-table counters, pin-refcount ledger included
   (storage/bufferpool.py)
+- otb_morsel(streams, chunks, bytes_streamed, chunk_downshifts,
+  declined) — the out-of-core streaming tier's counters
+  (exec/morsel.py)
 - otb_execstats(tier, joins, index_compositions, deferred_cols,
   eager_cols, cols_materialized, bytes_materialized, host_syncs,
   fused_join_hits) — the executor's late-materialization join counters
@@ -26,8 +30,8 @@ Views:
   (exec/scheduler.py)
 - otb_shield(batch_failures, isolated, quarantined, quarantine_active,
   quarantine_hits, oom_dispatches, oom_retries, oom_evicted_bytes,
-  degraded, shrunk_batches) — the serving tier's fault-isolation
-  counters (exec/shield.py)
+  degraded, shrunk_batches, streamed) — the serving tier's
+  fault-isolation counters (exec/shield.py)
 """
 
 from __future__ import annotations
@@ -85,7 +89,18 @@ STAT_TABLES = {
         ColumnDef("table_name", T.TEXT), ColumnDef("hits", T.INT64),
         ColumnDef("misses", T.INT64), ColumnDef("bytes_live", T.INT64),
         ColumnDef("evictions", T.INT64),
-        ColumnDef("invalidations", T.INT64)],
+        ColumnDef("invalidations", T.INT64),
+        ColumnDef("pinned", T.INT64), ColumnDef("pins", T.INT64),
+        ColumnDef("unpins", T.INT64)],
+    # out-of-core streaming telemetry (exec/morsel.py): chunk windows
+    # executed, bytes streamed through the pinned chunk cache, and
+    # OOM-driven chunk-size downshifts — the observable record of
+    # queries that exceeded device residency yet stayed on-device
+    "otb_morsel": [
+        ColumnDef("streams", T.INT64), ColumnDef("chunks", T.INT64),
+        ColumnDef("bytes_streamed", T.INT64),
+        ColumnDef("chunk_downshifts", T.INT64),
+        ColumnDef("declined", T.INT64)],
     # executor late-materialization telemetry (exec/executor.py
     # EXEC_STATS): one row per execution tier.  "single" counts every
     # eager operator dispatch; "fused"/"mesh" count TRACE-time events
@@ -138,7 +153,8 @@ STAT_TABLES = {
         ColumnDef("oom_retries", T.INT64),
         ColumnDef("oom_evicted_bytes", T.INT64),
         ColumnDef("degraded", T.INT64),
-        ColumnDef("shrunk_batches", T.INT64)],
+        ColumnDef("shrunk_batches", T.INT64),
+        ColumnDef("streamed", T.INT64)],
     # recent-query trace ring (obs/trace.py): one row per finished
     # top-level statement, newest last — per-phase wall-time breakdown
     # plus staging/materialization byte counts and buffer-pool hit
@@ -256,6 +272,9 @@ def refresh(cluster, names: list[str]):
         elif name == "otb_shield":
             from ..exec.shield import stats_rows as shield_rows
             rows = list(shield_rows())
+        elif name == "otb_morsel":
+            from ..exec.morsel import stats_rows as morsel_rows
+            rows = list(morsel_rows())
         elif name == "otb_stat_query":
             from ..obs import trace as obs_trace
             for qt in obs_trace.recent():

@@ -132,6 +132,19 @@ def size_class(n: int, floor: int = 256) -> int:
     return p
 
 
+def chunk_class(n: int, floor: int = 4096) -> int:
+    """Morsel chunk-size quantizer: pow2 with a floor, so every chunk
+    of a stream shares ONE static shape (exec/morsel.py) and the OOM
+    downshift ladder (halving) stays inside the same quantized family.
+    Coarser than size_class on purpose — a chunk is an ephemeral
+    streaming window, not a resident table, so compile-class economy
+    beats padding economy."""
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
+
+
 def stage_padded(host_cols, sel):
     """Host column slices -> pow2-padded device arrays for one pass.
     `sel` is a slice (row-range slab), an int index array (hash

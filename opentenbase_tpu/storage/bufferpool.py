@@ -89,6 +89,26 @@ class DevEntry:
     n: int                # live (staged) row count
     null_at_cache: set    # store.null_columns when staged
     nbytes: int
+    pins: int = 0         # refcount: >0 bars eviction (resident build
+    # side of a streaming join, exec/morsel.py); guarded_by: _LOCK
+
+
+@dataclasses.dataclass
+class ChunkEntry:
+    """Morsel tier: one fixed-shape row-range window of a store's host
+    columns, staged to device.  All chunks of a stream share one padded
+    shape (`chunk_rows`, storage/batch.py chunk_class) so the compiled
+    per-chunk program never retraces; `live` is the real row count of
+    this window (the tail chunk zero-pads).  Pinned while a stream
+    holds it — eviction skips pinned entries."""
+    table: str
+    version: int
+    start: int            # first source row of the window
+    chunk_rows: int       # padded window shape (chunk_class-quantized)
+    live: int             # real rows in [start, start+live)
+    arrs: dict            # staged name -> device array [chunk_rows,...]
+    nbytes: int
+    pins: int = 0         # guarded_by: _LOCK
 
 
 @dataclasses.dataclass
@@ -110,15 +130,28 @@ class DeviceBufferPool:
         self._dev: dict = {}    # id(store) -> [seq, DevEntry]
         self._mesh: dict = {}   # (runner_id, table) -> [seq, MeshEntry]
         self._host: dict = {}   # id(store) -> [seq, snapshot, nbytes]
+        # morsel chunk windows: (id(store), start, chunk_rows,
+        # names_key) -> [seq, ChunkEntry]
+        self._chunks: dict = {}
         # entries must not outlive their owners: a weakref per store /
         # mesh runner drops the owner's entries at GC, so the pool never
         # pins device arrays for dead nodes (the per-node caches this
         # replaces died with their nodes; the shared pool must match)
         self._refs: dict = {}   # id(owner) -> weakref
-        # table -> [hits, misses, evictions, invalidations]
+        # table -> [hits, misses, evictions, invalidations, pins,
+        # unpins]
         self._stats: dict[str, list] = {}
         self.uploaded_bytes = 0   # cumulative host->device bytes staged
         self.tail_rows = 0        # rows staged via the incremental path
+        # pin ledger (the PR-10 slot-ledger pattern): every pin must be
+        # balanced by an unpin, and eviction must never destroy a
+        # pinned entry silently.  pins_total == unpins_total +
+        # live-pinned (in-dict entries + orphans invalidation popped
+        # while still pinned — their holders unpin through the entry
+        # object they kept).
+        self._pins_total = 0      # guarded_by: _LOCK
+        self._unpins_total = 0    # guarded_by: _LOCK
+        self._orphans: list = []  # guarded_by: _LOCK — popped-but-pinned
 
     def _watch_store(self, store):
         # caller holds _LOCK
@@ -133,6 +166,8 @@ class DeviceBufferPool:
             with _LOCK:
                 p._dev.pop(key, None)
                 p._host.pop(key, None)
+                for ck in [k for k in p._chunks if k[0] == key]:
+                    p._chunks.pop(ck, None)
                 p._refs.pop(key, None)
         try:
             self._refs[key] = weakref.ref(store, drop)
@@ -162,7 +197,9 @@ class DeviceBufferPool:
     def _tstats(self, table: str) -> list:
         s = self._stats.get(table)
         if s is None:
-            s = self._stats[table] = [0, 0, 0, 0]
+            s = self._stats[table] = [0, 0, 0, 0, 0, 0]
+        elif len(s) < 6:
+            s.extend([0] * (6 - len(s)))
         return s
 
     def note_upload(self, nbytes: int, tail_rows: int = 0):
@@ -174,20 +211,32 @@ class DeviceBufferPool:
                             tail_rows=int(tail_rows))
 
     def stats_rows(self) -> list[tuple]:
-        """(table, hits, misses, bytes_live, evictions, invalidations)
-        rows for the otb_buffercache view (system otb_ tables omitted)."""
+        """(table, hits, misses, bytes_live, evictions, invalidations,
+        pinned, pins, unpins) rows for the otb_buffercache view (system
+        otb_ tables omitted).  `pinned` is the live pinned-entry count;
+        pins/unpins are the cumulative refcount ledger — columns append
+        so positional consumers of the original six stay valid."""
         with _LOCK:
             live: dict[str, int] = {}
+            pinned: dict[str, int] = {}
             for _s, e in self._dev.values():
                 live[e.table] = live.get(e.table, 0) + e.nbytes
+                if e.pins > 0:
+                    pinned[e.table] = pinned.get(e.table, 0) + 1
             for _s, e in self._mesh.values():
                 live[e.table] = live.get(e.table, 0) + e.nbytes
+            for _s, e in self._chunks.values():
+                live[e.table] = live.get(e.table, 0) + e.nbytes
+                if e.pins > 0:
+                    pinned[e.table] = pinned.get(e.table, 0) + 1
             rows = []
             for t in sorted(set(self._stats) | set(live)):
                 if t.startswith("otb_"):
                     continue
-                h, m, ev, inv = self._stats.get(t, (0, 0, 0, 0))
-                rows.append((t, h, m, live.get(t, 0), ev, inv))
+                h, m, ev, inv, pi, up = self._tstats(t) \
+                    if t in self._stats else (0, 0, 0, 0, 0, 0)
+                rows.append((t, h, m, live.get(t, 0), ev, inv,
+                             pinned.get(t, 0), pi, up))
             return rows
 
     def totals(self) -> dict:
@@ -199,9 +248,14 @@ class DeviceBufferPool:
                 "invalidations": sum(s[3] for s in self._stats.values()),
                 "bytes_live": sum(e.nbytes for _s, e in
                                   self._dev.values())
-                + sum(e.nbytes for _s, e in self._mesh.values()),
+                + sum(e.nbytes for _s, e in self._mesh.values())
+                + sum(e.nbytes for _s, e in self._chunks.values()),
                 "uploaded_bytes": self.uploaded_bytes,
                 "tail_rows": self.tail_rows,
+                "pins": self._pins_total,
+                "unpins": self._unpins_total,
+                "pinned_live": self._live_pinned_locked(),
+                "chunks_live": len(self._chunks),
             }
 
     def clear(self):
@@ -210,51 +264,108 @@ class DeviceBufferPool:
             self._dev.clear()
             self._mesh.clear()
             self._host.clear()
+            self._chunks.clear()
             self._refs.clear()
+            self._orphans.clear()
+            self._pins_total = 0
+            self._unpins_total = 0
+
+    # -- pin ledger -----------------------------------------------------
+    def _live_pinned_locked(self) -> int:
+        # caller holds _LOCK
+        return (sum(e.pins for _s, e in self._dev.values())
+                + sum(e.pins for _s, e in self._chunks.values())
+                + sum(e.pins for e in self._orphans))
+
+    def _note_pin_locked(self, entry, table: str):
+        # caller holds _LOCK
+        entry.pins += 1
+        self._pins_total += 1
+        self._tstats(table)[4] += 1
+
+    def _note_unpin_locked(self, entry, table: str):
+        # caller holds _LOCK
+        entry.pins -= 1
+        assert entry.pins >= 0, \
+            f"bufferpool: unbalanced unpin for {table}"
+        self._unpins_total += 1
+        self._tstats(table)[5] += 1
+        if entry.pins == 0:
+            # identity filter: dataclass __eq__ would compare arrays
+            self._orphans = [o for o in self._orphans if o is not entry]
+
+    def check_pin_ledger(self):
+        """Ledger invariant (mirrors the PR-10 slot ledgers): every pin
+        is either balanced by an unpin or visible as a live pinned
+        entry — eviction/invalidation can never make a pin disappear."""
+        with _LOCK:
+            live = self._live_pinned_locked()
+            assert self._pins_total == self._unpins_total + live, (
+                f"bufferpool pin ledger broken: pins={self._pins_total} "
+                f"unpins={self._unpins_total} live={live}")
+            return {"pins": self._pins_total,
+                    "unpins": self._unpins_total, "live": live}
 
     # -- eviction -------------------------------------------------------
+    def _evictable_locked(self) -> list:
+        """(kind, key, seq, entry) over every UNPINNED device entry —
+        pinned entries (streaming joins' resident build sides, in-flight
+        morsel chunks) are wired down and never eviction candidates."""
+        return ([("dev", k, s, e)
+                 for k, (s, e) in self._dev.items() if e.pins == 0]
+                + [("mesh", k, s, e)
+                   for k, (s, e) in self._mesh.items()]
+                + [("chunk", k, s, e)
+                   for k, (s, e) in self._chunks.items()
+                   if e.pins == 0])
+
+    def _pop_entry_locked(self, kind: str, key):
+        d = {"dev": self._dev, "mesh": self._mesh,
+             "chunk": self._chunks}[kind]
+        d.pop(key, None)
+
     def trim(self):
-        """Enforce the device byte budget: evict globally-LRU entries
-        (across the single-device AND mesh tiers) until the resident
-        population fits.  A lone over-budget entry stays — the active
-        query holds references anyway, so evicting it frees nothing."""
+        """Enforce the device byte budget: evict globally-LRU UNPINNED
+        entries (single-device, mesh and chunk tiers) until the
+        resident population fits.  A lone over-budget entry stays — the
+        active query holds references anyway, so evicting it frees
+        nothing."""
         budget = _budget()
         with _LOCK:
             while True:
-                items = ([("dev", k, s, e)
-                          for k, (s, e) in self._dev.items()]
-                         + [("mesh", k, s, e)
-                            for k, (s, e) in self._mesh.items()])
-                if len(items) <= 1:
-                    return
-                if sum(e.nbytes for _k1, _k2, _s, e in items) <= budget:
+                items = self._evictable_locked()
+                resident = (
+                    sum(e.nbytes for _s, e in self._dev.values())
+                    + sum(e.nbytes for _s, e in self._mesh.values())
+                    + sum(e.nbytes for _s, e in self._chunks.values()))
+                if len(items) <= 1 or resident <= budget:
                     return
                 kind, key, _s, e = min(items, key=lambda it: it[2])
-                (self._dev if kind == "dev" else self._mesh).pop(key,
-                                                                 None)
+                self._pop_entry_locked(kind, key)
                 self._tstats(e.table)[2] += 1
 
     def shed_coldest(self, frac: float = 0.5) -> int:
         """Memory-pressure relief (exec/shield.py): evict the coldest
-        device entries until `frac` of the resident bytes are freed,
-        regardless of budget.  Returns bytes freed.  Unlike trim() this
-        may evict down to nothing — after a RESOURCE_EXHAUSTED the
-        retry restages only what the failed dispatch actually needs."""
+        UNPINNED device entries until `frac` of the resident bytes are
+        freed, regardless of budget.  Returns bytes freed.  Unlike
+        trim() this may evict down to nothing — after a
+        RESOURCE_EXHAUSTED the retry restages only what the failed
+        dispatch actually needs.  Pinned entries survive: evicting a
+        wired chunk/build side would crash the very stream the relief
+        is trying to save."""
         freed = 0
         with _LOCK:
-            resident = (sum(e.nbytes for _s, e in self._dev.values())
-                        + sum(e.nbytes for _s, e in self._mesh.values()))
+            resident = (
+                sum(e.nbytes for _s, e in self._dev.values())
+                + sum(e.nbytes for _s, e in self._mesh.values())
+                + sum(e.nbytes for _s, e in self._chunks.values()))
             target = int(resident * max(0.0, min(1.0, frac)))
             while freed < target:
-                items = ([("dev", k, s, e)
-                          for k, (s, e) in self._dev.items()]
-                         + [("mesh", k, s, e)
-                            for k, (s, e) in self._mesh.items()])
+                items = self._evictable_locked()
                 if not items:
                     break
                 kind, key, _s, e = min(items, key=lambda it: it[2])
-                (self._dev if kind == "dev" else self._mesh).pop(key,
-                                                                 None)
+                self._pop_entry_locked(kind, key)
                 self._tstats(e.table)[2] += 1
                 freed += e.nbytes
         return freed
@@ -275,11 +386,23 @@ class DeviceBufferPool:
         go too — their per-DN version tuple is stale by construction."""
         table = store.td.name
         with _LOCK:
-            hit = self._dev.pop(id(store), None) is not None
+            dropped = self._dev.pop(id(store), None)
+            hit = dropped is not None
+            if dropped is not None and dropped[1].pins > 0:
+                self._orphans.append(dropped[1])
             self._host.pop(id(store), None)
             for key in [k for k, (_s, e) in self._mesh.items()
                         if e.table == table]:
                 self._mesh.pop(key)
+                hit = True
+            for key in [k for k in self._chunks if k[0] == id(store)]:
+                _s, e = self._chunks.pop(key)
+                # a stream may hold this entry mid-flight: the arrays
+                # stay alive through its reference and it unpins through
+                # the entry object — track it so the ledger still sees
+                # the live pin (check_pin_ledger)
+                if e.pins > 0:
+                    self._orphans.append(e)
                 hit = True
             if hit:
                 self._tstats(table)[3] += 1
@@ -412,6 +535,84 @@ class DeviceBufferPool:
             arrs.update(add)
             up += up2
         return arrs, n, up, n - e.n
+
+    # ------------------------------------------------------------------
+    # morsel chunk tier (exec/morsel.py streaming windows)
+    # ------------------------------------------------------------------
+    def pin_table(self, store):
+        """Pin the store's resident device entry (a streaming join's
+        build side must survive per-chunk pressure relief).  Returns
+        the DevEntry handle for unpin_table, or None when nothing
+        current is resident — the caller stages via get_device first."""
+        with _LOCK:
+            ent = self._dev.get(id(store))
+            if ent is None or ent[1].version != store.version:
+                return None
+            self._note_pin_locked(ent[1], ent[1].table)
+            return ent[1]
+
+    def unpin_table(self, entry: DevEntry):
+        with _LOCK:
+            self._note_unpin_locked(entry, entry.table)
+
+    def get_chunk(self, store, host_cols: dict, start: int,
+                  chunk_rows: int) -> ChunkEntry:
+        """One fixed-shape streaming window of `host_cols` (the staged
+        namespace: value columns + MVCC sys columns + null masks),
+        staged to device and returned PINNED — the caller unpins via
+        unpin_chunk when the window's program call has consumed it.
+        device_put is async, so fetching chunk i+1 before blocking on
+        chunk i's output double-buffers the host→device copy.  Windows
+        are version-keyed like every pool entry; a re-requested warm
+        window is a hit (repeat streams over an unchanged table)."""
+        import jax
+
+        from ..utils.dtypes import stage_cast
+        table = store.td.name
+        ver = store.version
+        key = (id(store), int(start), int(chunk_rows),
+               tuple(sorted(host_cols)))
+        with _LOCK:
+            ent = self._chunks.get(key)
+            if ent is not None and ent[1].version == ver:
+                ent[0] = next(_SEQ)
+                self._tstats(table)[0] += 1
+                self._note_pin_locked(ent[1], table)
+                return ent[1]
+            if ent is not None:
+                self._chunks.pop(key, None)
+                if ent[1].pins > 0:
+                    self._orphans.append(ent[1])
+                self._tstats(table)[3] += 1
+        # stage outside the lock (same policy as get_device)
+        total = len(next(iter(host_cols.values()))) if host_cols else 0
+        live = max(0, min(total, start + chunk_rows) - start)
+        arrs = {}
+        up = 0
+        for name, arr in host_cols.items():
+            h = stage_cast(arr)
+            buf = np.zeros((chunk_rows, *h.shape[1:]), dtype=h.dtype)
+            if live:
+                buf[:live] = h[start:start + live]
+            arrs[name] = jax.device_put(buf)
+            up += buf.nbytes
+        e = ChunkEntry(table, ver, int(start), int(chunk_rows),
+                       int(live), arrs, up)
+        with _LOCK:
+            self._tstats(table)[1] += 1
+            self.uploaded_bytes += up
+            self._chunks[key] = [next(_SEQ), e]
+            self._note_pin_locked(e, table)
+            self._watch_store(store)
+        if obs_trace.ENABLED:
+            obs_trace.event("chunk_stage", table=table, start=int(start),
+                            rows=int(live), bytes=int(up))
+        self.trim()
+        return e
+
+    def unpin_chunk(self, entry: ChunkEntry):
+        with _LOCK:
+            self._note_unpin_locked(entry, entry.table)
 
     # ------------------------------------------------------------------
     # mesh tier (exec/mesh_exec.py staging)

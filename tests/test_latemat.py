@@ -148,6 +148,6 @@ class TestStatView:
                        "cols_materialized, host_syncs, fused_join_hits "
                        "from otb_execstats order by tier")
         tiers = [r[0] for r in rows]
-        assert tiers == ["fused", "mesh", "single"]
+        assert tiers == ["fused", "mesh", "morsel", "single"]
         for r in rows:
             assert all(isinstance(v, int) and v >= 0 for v in r[1:])

@@ -911,6 +911,51 @@ class TestProgramCardinalityPass:
             [(f["symbol"], f["message"]) for f in report["findings"]]
 
 
+class TestChunkKeyQuantization:
+    """Morsel-tier key discipline: a chunk count/size reaching a
+    program key raw is a finding; the chunk_class()-wrapped twin is
+    silent (exec/morsel.py re-sizes its window under memory pressure,
+    so an unquantized chunk geometry mints one program per downshift)."""
+
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/morselkeys.py": """\
+            from opentenbase_tpu.exec.plancache import ProgramCache
+
+            CACHE = ProgramCache("fix", 8)
+
+            def chunk_class(n):
+                c = 4096
+                while c < n:
+                    c *= 2
+                return c
+
+            def put_chunk_size(plan_key, chunk_rows, prog):
+                key = (plan_key, ("__morsel", chunk_rows))  # raw size
+                CACHE.put(key, prog)
+
+            def put_chunk_count(plan_key, n_chunks, prog):
+                CACHE.put((plan_key, n_chunks), prog)       # raw count
+
+            def put_clean(plan_key, chunk_rows, prog):
+                key = (plan_key, ("__morsel", chunk_class(chunk_rows)))
+                CACHE.put(key, prog)
+        """,
+    }
+
+    def test_raw_chunk_geometry_flagged_quantized_twin_silent(
+            self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"program-cardinality"})
+        got = sorted(f["symbol"] for f in report["findings"])
+        assert got == ["put_chunk_count", "put_chunk_size"], \
+            [(f["symbol"], f["message"]) for f in report["findings"]]
+        assert all("chunk_class" in f["message"]
+                   for f in report["findings"]), report["findings"]
+
+
 class TestRetraceRiskPass:
     FILES = {
         "fixpkg/__init__.py": "",

@@ -1,0 +1,423 @@
+"""Morsel tier: out-of-core partitioned streaming (exec/morsel.py).
+
+Reference analog: the Postgres buffer manager streams any-size tables
+through a bounded shared_buffers (bulk reads via freelist.c ring
+buffers); here the bounded resource is the device cache and the unit
+is a fixed-shape pinned chunk.  The contract under test: streamed
+answers are bit-identical to in-memory answers at every chunk
+geometry, chunk COUNT never reaches a program key (zero recompiles
+after warmup), and pins are ledgered — eviction can never unwire a
+window a live stream still holds."""
+
+import math
+import types
+
+import numpy as np
+import pytest
+
+import opentenbase_tpu.exec.fused as FU
+import opentenbase_tpu.exec.morsel as M
+import opentenbase_tpu.exec.plancache as plancache
+import opentenbase_tpu.exec.shield as SH
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.exec.spill import staged_host_columns
+from opentenbase_tpu.storage.batch import chunk_class
+from opentenbase_tpu.storage.bufferpool import POOL
+
+N_FACT = 30000
+N_DIM = 12000
+CHUNK = 4096
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session(LocalNode())
+    rng = np.random.default_rng(7)
+    s.execute("create table f (k bigint, g varchar(2), v decimal(8,2))")
+    ks = rng.integers(0, 5000, N_FACT)
+    s._insert_rows(
+        s.node.catalog.table("f"), s.node.stores["f"],
+        {"k": ks, "g": [f"g{i % 4}" for i in ks],
+         "v": (ks % 100).astype(float)}, N_FACT)
+    s.execute("create table d (dk bigint, w decimal(8,2))")
+    dks = rng.integers(0, 5000, N_DIM)
+    s._insert_rows(
+        s.node.catalog.table("d"), s.node.stores["d"],
+        {"dk": dks, "w": (dks % 7).astype(float)}, N_DIM)
+    yield s
+    s.execute("set morsel = auto")
+
+
+def _rows_close(base, got):
+    assert len(got) == len(base), (len(base), len(got))
+    for rb, rs in zip(base, got):
+        for x, y in zip(rb, rs):
+            if isinstance(x, float) and isinstance(y, float):
+                assert math.isclose(x, y, rel_tol=1e-9), (rb, rs)
+            else:
+                assert x == y, (rb, rs)
+
+
+def run_both(sess, sql, chunk_rows=CHUNK, expect_stream=True):
+    """Baseline with the tier off, then again with `morsel = on` at the
+    given window — asserting the stream actually served (or declined)
+    and the rows are bit-identical."""
+    sess.execute("set morsel = off")
+    base = sess.query(sql)
+    sess.execute("set morsel = on")
+    sess.execute(f"set morsel_chunk_rows = {chunk_rows}")
+    served = []
+    drivers = []
+    orig = M.MorselDriver.try_run
+
+    def spy(self, planned):
+        r = orig(self, planned)
+        served.append(r is not None)
+        drivers.append(self)
+        return r
+
+    M.MorselDriver.try_run = spy
+    try:
+        got = sess.query(sql)
+    finally:
+        M.MorselDriver.try_run = orig
+        sess.execute("set morsel = off")
+    if expect_stream:
+        assert served and served[-1], f"plan did not stream: {sql}"
+        drv = drivers[-1]
+        assert drv.chunks == -(-N_FACT // chunk_rows), \
+            (drv.chunks, chunk_rows)
+    else:
+        assert not (served and served[-1]), f"unexpected stream: {sql}"
+    _rows_close(base, got)
+    return got
+
+
+# ---------------------------------------------------------------------------
+# chunk-boundary correctness: bit-identical across geometries
+# ---------------------------------------------------------------------------
+
+class TestChunkedAgg:
+    # 30000 is divisible by neither window: both runs exercise a
+    # short (zero-padded) tail chunk
+    @pytest.mark.parametrize("chunk", [4096, 8192])
+    def test_group_agg(self, sess, chunk):
+        run_both(sess, "select g, sum(v), count(*), avg(v), min(v), "
+                       "max(v) from f group by g order by g",
+                 chunk_rows=chunk)
+
+    def test_global_agg(self, sess):
+        run_both(sess, "select sum(v), count(v), avg(v) from f")
+
+    def test_filtered_agg(self, sess):
+        run_both(sess, "select g, count(*) from f where v > 50 "
+                       "group by g order by g")
+
+    def test_empty_chunks(self, sess):
+        # matches nothing in ANY window: every per-chunk partial is
+        # empty and the final merge still shapes the answer
+        run_both(sess, "select count(*), sum(v) from f where k < 0")
+
+    def test_sparse_chunks(self, sess):
+        # a handful of survivors scattered across windows
+        run_both(sess, "select count(*) from f where k = 17")
+
+    def test_nulls_through_chunks(self, sess):
+        sess.execute("insert into f values (9999999, null, null)")
+        try:
+            run_both(sess, "select g, count(v), count(*) from f "
+                           "group by g order by g")
+        finally:
+            sess.execute("delete from f where k = 9999999")
+            sess.execute("set morsel = off")
+
+
+class TestStreamedJoin:
+    @pytest.mark.parametrize("chunk", [4096, 8192])
+    def test_join_group_agg(self, sess, chunk):
+        run_both(sess, "select g, count(*), sum(w) from f, d "
+                       "where k = dk group by g order by g",
+                 chunk_rows=chunk)
+
+    def test_left_join_counts(self, sess):
+        run_both(sess, "select count(*), count(w) from f "
+                       "left join d on k = dk")
+
+    def test_build_side_pinned_and_ledger_balanced(self, sess):
+        POOL.clear()
+        run_both(sess, "select count(*) from f, d where k = dk")
+        led = POOL.check_pin_ledger()
+        assert led["live"] == 0, led
+        assert led["pins"] > 0 and led["pins"] == led["unpins"], led
+
+
+class TestChunkedSort:
+    def test_topk_pushdown(self, sess):
+        # planner-bounded Sort: per-chunk top-k truncation is exact
+        run_both(sess, "select k, g, v from f "
+                       "order by v desc, k, g limit 25")
+
+    def test_full_sort_after_merge(self, sess):
+        # unbounded Sort: the core streams, the ORIGINAL sort re-runs
+        # over the merged batch
+        run_both(sess, "select k, g, v from f where v > 97 "
+                       "order by k, g, v")
+
+    def test_limit_offset(self, sess):
+        run_both(sess, "select k, v from f "
+                       "order by k, v, g limit 10 offset 5")
+
+
+class TestFallback:
+    def test_small_table_declines(self, sess):
+        sess.execute("create table tiny (x bigint)")
+        sess.execute("insert into tiny values (1), (2)")
+        run_both(sess, "select count(*) from tiny", expect_stream=False)
+
+    def test_distinct_agg_declines(self, sess):
+        run_both(sess, "select count(distinct g) from f",
+                 expect_stream=False)
+
+    def test_self_join_declines(self, sess):
+        run_both(sess, "select count(*) from f a, f b "
+                       "where a.k = b.k and a.k < 3",
+                 expect_stream=False)
+
+
+# ---------------------------------------------------------------------------
+# compile discipline: chunk COUNT/offsets never reach a program key
+# ---------------------------------------------------------------------------
+
+class TestCompileDiscipline:
+    def test_zero_recompiles_after_warmup(self, sess):
+        sql = ("select g, sum(v), count(*) from f "
+               "group by g order by g")
+        sess.execute("set morsel = on")
+        sess.execute(f"set morsel_chunk_rows = {CHUNK}")
+        puts = []
+        orig = plancache.FUSED.put
+
+        def spy(key, *a, **kw):
+            puts.append(key)
+            return orig(key, *a, **kw)
+
+        plancache.FUSED.put = spy
+        try:
+            sess.query(sql)          # warmup
+            warm = len(puts)
+            sess.query(sql)          # second stream: all windows warm
+            assert len(puts) == warm, \
+                f"recompiled after warmup: {puts[warm:]}"
+        finally:
+            plancache.FUSED.put = orig
+            sess.execute("set morsel = off")
+        n_chunks = -(-N_FACT // CHUNK)
+        assert warm < n_chunks, \
+            f"{warm} compiles for {n_chunks} chunks — per-chunk retrace"
+
+    def test_chunk_size_class_is_ladder_quantized(self, sess):
+        sess.execute("set morsel = on")
+        sess.execute("set morsel_chunk_rows = 5000")  # not a pow2
+        keys = []
+        orig = plancache.FUSED.put
+
+        def spy(key, *a, **kw):
+            keys.append(key)
+            return orig(key, *a, **kw)
+
+        plancache.FUSED.put = spy
+        try:
+            sess.query("select count(*) from f where v > 990")
+        finally:
+            plancache.FUSED.put = orig
+            sess.execute("set morsel = off")
+        comps = [part for key in keys for part in key
+                 if isinstance(part, tuple) and len(part) == 2
+                 and part[0] == "__morsel"]
+        assert comps, f"no morsel-keyed program compiled: {keys}"
+        from opentenbase_tpu.analysis.cardinality import is_ladder_int
+        assert all(is_ladder_int(c[1]) for c in comps), comps
+        assert all(c[1] == chunk_class(5000) for c in comps), comps
+
+
+# ---------------------------------------------------------------------------
+# pinned chunk cache: eviction respects pins, ledger stays balanced
+# ---------------------------------------------------------------------------
+
+class TestPinnedCache:
+    def test_shed_coldest_skips_pinned_chunks(self, sess):
+        POOL.clear()
+        store = sess.node.stores["f"]
+        host = staged_host_columns(store, ["k", "v"])
+        entry = POOL.get_chunk(store, host, 0, CHUNK)
+        assert entry.pins == 1
+        POOL.shed_coldest(1.0)
+        t = POOL.totals()
+        assert t["pinned_live"] == 1, t
+        assert t["chunks_live"] >= 1, t
+        POOL.unpin_chunk(entry)
+        POOL.shed_coldest(1.0)
+        t = POOL.totals()
+        assert t["pinned_live"] == 0, t
+        POOL.check_pin_ledger()
+
+    def test_invalidation_orphans_live_pins(self, sess):
+        POOL.clear()
+        store = sess.node.stores["d"]
+        host = staged_host_columns(store, ["dk"])
+        entry = POOL.get_chunk(store, host, 0, CHUNK)
+        POOL.invalidate(store)
+        # the pin survives invalidation as an orphan; the ledger still
+        # balances and the holder's unpin retires it
+        led = POOL.check_pin_ledger()
+        assert led["live"] == 1, led
+        POOL.unpin_chunk(entry)
+        led = POOL.check_pin_ledger()
+        assert led["live"] == 0, led
+
+    def test_warm_stream_hits_chunk_cache(self, sess):
+        POOL.clear()
+        sess.execute("set morsel = on")
+        sess.execute(f"set morsel_chunk_rows = {CHUNK}")
+        try:
+            sess.query("select count(*) from f where v > 990")
+            up_first = POOL.totals()["uploaded_bytes"]
+            sess.query("select count(*) from f where v > 990")
+            up_second = POOL.totals()["uploaded_bytes"]
+        finally:
+            sess.execute("set morsel = off")
+        # second pass re-reads the same windows from the device cache
+        assert up_second - up_first < up_first - 0, \
+            (up_first, up_second)
+
+
+# ---------------------------------------------------------------------------
+# pressure ladder: mid-stream OOM downshifts the window
+# ---------------------------------------------------------------------------
+
+class TestDownshift:
+    def test_oom_halves_chunk_and_resumes(self, sess, monkeypatch):
+        state = {"raised": False}
+        orig = FU.FragmentProgram.run
+
+        def flaky(self, staged_arrs, staged_ns, snapshot_ts, txid):
+            if not state["raised"]:
+                state["raised"] = True
+                raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+            return orig(self, staged_arrs, staged_ns, snapshot_ts,
+                        txid)
+
+        monkeypatch.setattr(FU.FragmentProgram, "run", flaky)
+        sess.execute("set morsel = off")
+        base = sess.query("select g, count(*) from f "
+                          "group by g order by g")
+        sess.execute("set morsel = on")
+        sess.execute("set morsel_chunk_rows = 8192")
+        drivers = []
+        orig_try = M.MorselDriver.try_run
+
+        def spy(self, planned):
+            drivers.append(self)
+            return orig_try(self, planned)
+
+        monkeypatch.setattr(M.MorselDriver, "try_run", spy)
+        try:
+            got = sess.query("select g, count(*) from f "
+                             "group by g order by g")
+        finally:
+            sess.execute("set morsel = off")
+        _rows_close(base, got)
+        drv = drivers[-1]
+        assert drv.downshifts == 1, drv.downshifts
+        assert drv.chunk_rows == 4096, drv.chunk_rows
+        POOL.check_pin_ledger()
+
+
+# ---------------------------------------------------------------------------
+# snapshot consistency: DML landing mid-stream stays invisible
+# ---------------------------------------------------------------------------
+
+class TestMidStreamDML:
+    def test_insert_during_stream_is_snapshot_consistent(self, sess,
+                                                         monkeypatch):
+        sess.execute("create table mid (x bigint)")
+        n = 2 * CHUNK + 100
+        sess._insert_rows(sess.node.catalog.table("mid"),
+                          sess.node.stores["mid"],
+                          {"x": np.arange(n)}, n)
+        writer = Session(sess.node)
+        state = {"fired": False}
+        orig = POOL.get_chunk
+
+        def chunk_with_dml(store, host, start, chunk_rows):
+            if not state["fired"]:
+                state["fired"] = True
+                writer.execute("insert into mid values (777777)")
+            return orig(store, host, start, chunk_rows)
+
+        monkeypatch.setattr(POOL, "get_chunk", chunk_with_dml)
+        sess.execute("set morsel = on")
+        sess.execute(f"set morsel_chunk_rows = {CHUNK}")
+        try:
+            got = sess.query("select count(*), sum(x) from mid")
+        finally:
+            sess.execute("set morsel = off")
+        assert state["fired"]
+        # the stream's snapshot predates the insert
+        assert got == [(n, sum(range(n)))], got
+        # a NEW snapshot sees it
+        assert sess.query("select count(*) from mid") == [(n + 1,)]
+        POOL.check_pin_ledger()
+
+
+# ---------------------------------------------------------------------------
+# shield integration: the degrade ladder's middle rung streams
+# ---------------------------------------------------------------------------
+
+class TestShieldStreams:
+    def test_run_degraded_prefers_morsel(self, sess, monkeypatch):
+        monkeypatch.setenv("OTB_SHIELD_DEGRADE_ROWS", str(CHUNK))
+        sess.execute("set morsel = off")
+        sql = "select g, count(*) from f group by g order by g"
+        base = sess.query(sql)
+        from opentenbase_tpu.sql.parser import parse_sql
+        planned = sess._plan_select(parse_sql(sql)[0])
+        item = types.SimpleNamespace(session=sess, planned=planned,
+                                     sql=sql)
+        before = SH.stats_snapshot()["streamed"]
+        res = SH.run_degraded(item)
+        assert SH.stats_snapshot()["streamed"] == before + 1
+        _rows_close(base, res[-1].rows)
+
+
+# ---------------------------------------------------------------------------
+# observability: stat views expose the tier
+# ---------------------------------------------------------------------------
+
+class TestStatViews:
+    @pytest.fixture(scope="class")
+    def cs(self):
+        from opentenbase_tpu.exec.dist_session import ClusterSession
+        from opentenbase_tpu.parallel.cluster import Cluster
+        return ClusterSession(Cluster(n_datanodes=2))
+
+    def test_otb_morsel_view(self, sess, cs):
+        M.reset_stats()
+        sess.execute("set morsel = on")
+        sess.execute(f"set morsel_chunk_rows = {CHUNK}")
+        try:
+            sess.query("select count(*) from f")
+        finally:
+            sess.execute("set morsel = off")
+        rows = cs.query("select streams, chunks, declined "
+                        "from otb_morsel")
+        assert rows[0][0] >= 1, rows
+        assert rows[0][1] >= -(-N_FACT // CHUNK), rows
+
+    def test_otb_buffercache_pin_columns(self, sess, cs):
+        rows = cs.query("select pinned, pins, unpins "
+                        "from otb_buffercache")
+        assert rows, rows
+        for pinned, pins, unpins in rows:
+            assert pins >= unpins >= 0
+            assert pinned >= 0
