@@ -1055,7 +1055,16 @@ def _qps_arm(name, node, stream, clients, seconds, warm_s):
     hist = {k: s1["hist"].get(k, 0) - s0["hist"].get(k, 0)
             for k in s1["hist"]
             if s1["hist"].get(k, 0) > s0["hist"].get(k, 0)}
-    return {"arm": name, "clients": clients, "queries": len(lats),
+    # otbpipe: what fraction of THIS arm's staging work the two-stage
+    # pipeline hid behind device compute (delta, not lifetime ratio)
+    stage_work = s1["stage_work_ms"] - s0["stage_work_ms"]
+    stage_overlap = s1["stage_overlap_ms"] - s0["stage_overlap_ms"]
+    return {"arm": name, "clients": clients, "replicas": 0,
+            "queries": len(lats),
+            "overlap_ratio": stage_overlap / stage_work
+            if stage_work > 0 else 0.0,
+            "pipelined": s1["pipelined_dispatches"]
+            - s0["pipelined_dispatches"],
             "qps": len(lats) / wall if wall > 0 else 0.0,
             "p50_ms": _qps_pct(lats, 0.50) * 1e3,
             "p99_ms": _qps_pct(lats, 0.99) * 1e3,
@@ -1066,6 +1075,99 @@ def _qps_arm(name, node, stream, clients, seconds, warm_s):
             "batch_hist": " ".join(f"{k}:{v}"
                                    for k, v in sorted(hist.items())),
             **_compile_counters(c0, c1)}
+
+
+def _replica_counter(prefix):
+    from opentenbase_tpu.obs.metrics import REGISTRY
+    total = 0.0
+    for line in REGISTRY.text().splitlines():
+        if line.startswith(prefix) and not line.startswith("#"):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _qps_replica_setup(n_replicas, tmpdir):
+    """A 2-DN cluster with `n_replicas` hot standbys per DN registered
+    as read replicas (0 = primary-only baseline)."""
+    from opentenbase_tpu.exec.dist_session import ClusterSession
+    from opentenbase_tpu.parallel.cluster import Cluster
+    from opentenbase_tpu.storage.replication import (DnStandbyServer,
+                                                     HotStandby)
+    cl = Cluster(n_datanodes=2,
+                 datadir=os.path.join(tmpdir, f"cl_r{n_replicas}"))
+    s = ClusterSession(cl)
+    s.execute("create table rkv (k bigint primary key, v bigint)"
+              " distribute by shard(k)")
+    rows = ", ".join(f"({i}, {i * 7})" for i in range(400))
+    s.execute(f"insert into rkv values {rows}")
+    servers = []
+    for rep in range(n_replicas):
+        for i, dn in enumerate(cl.datanodes):
+            sb = HotStandby(
+                os.path.join(tmpdir, f"sb_r{n_replicas}_{rep}_dn{i}"),
+                index=i)
+            srv = DnStandbyServer(sb).start()
+            dn.attach_standby(srv.host, srv.port)
+            cl.register_read_replica(i, srv.host, srv.port, sb.datadir)
+            servers.append(srv)
+    if n_replicas:
+        s.execute("set replica_reads = on")
+    return cl, servers
+
+
+def _qps_replica_arm(n_replicas, clients, seconds, tmpdir):
+    """Closed-loop snapshot point reads over the cluster; every result
+    is checked against the known v = 7k ground truth — routing to a
+    standby must NEVER change an answer (wrong is asserted 0)."""
+    import threading
+    from opentenbase_tpu.exec.dist_session import ClusterSession
+    cl, servers = _qps_replica_setup(n_replicas, tmpdir)
+    routed0 = _replica_counter("otb_replica_reads_total")
+    fall0 = _replica_counter("otb_replica_fallthrough_total")
+    lats = [[] for _ in range(clients)]
+    wrong = [0] * clients
+    stop_at = [0.0]
+    gate = threading.Barrier(clients + 1)
+
+    def client(ci):
+        s = ClusterSession(cl)
+        i = ci
+        gate.wait()
+        while time.perf_counter() < stop_at[0]:
+            k = (i * 37) % 400
+            t0 = time.perf_counter()
+            r = s.query(f"select v from rkv where k = {k}")
+            lats[ci].append(time.perf_counter() - t0)
+            if r != [(k * 7,)]:
+                wrong[ci] += 1
+            i += 1
+
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(clients)]
+    for t in threads:
+        t.start()
+    stop_at[0] = time.perf_counter() + seconds
+    t_begin = time.perf_counter()
+    gate.wait()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_begin
+    for srv in servers:
+        srv.stop()
+    merged = sorted(x for per in lats for x in per)
+    n_wrong = sum(wrong)
+    assert n_wrong == 0, f"replica routing changed {n_wrong} answers"
+    return {"arm": "replica_point", "clients": clients,
+            "replicas": n_replicas, "queries": len(merged),
+            "qps": len(merged) / wall if wall > 0 else 0.0,
+            "p50_ms": _qps_pct(merged, 0.50) * 1e3,
+            "p99_ms": _qps_pct(merged, 0.99) * 1e3,
+            "wrong": n_wrong,
+            "routed_reads":
+                _replica_counter("otb_replica_reads_total") - routed0,
+            "fallthrough":
+                _replica_counter("otb_replica_fallthrough_total")
+                - fall0}
 
 
 def _qps_mode():
@@ -1087,6 +1189,17 @@ def _qps_mode():
         for clients in clients_list:
             arms.append(_qps_arm(name, node, stream, clients, seconds,
                                  warm_s))
+    # standby read scale-out axis: same point-read stream over a
+    # cluster, replicas=0 (primary only) vs replicas=N hot standbys
+    replicas_list = [int(r) for r in os.environ.get(
+        "BENCH_QPS_REPLICAS", "0,2").split(",") if r.strip() != ""]
+    if replicas_list:
+        import tempfile
+        rep_clients = clients_list[-1] if clients_list else 64
+        with tempfile.TemporaryDirectory() as tmpdir:
+            for n_rep in replicas_list:
+                arms.append(_qps_replica_arm(n_rep, rep_clients,
+                                             seconds, tmpdir))
     pick = [a for a in arms if a["arm"] == "point_sig"]
     head = next((a for a in pick if a["clients"] == 64),
                 (pick or arms)[-1])
@@ -1100,10 +1213,14 @@ def _qps_mode():
         "schema": "serial: per-workload single-session loop "
                   "{clients, queries, qps, p50_ms, p99_ms}; arms: "
                   "per (workload, client-count) scheduler run "
-                  "{arm, clients, queries, qps, p50_ms, p99_ms, "
-                  "batch_rate = batched/admitted, batch_dispatches, "
-                  "batch_hist 'size:count ...', shed}; vs_baseline = "
-                  "headline qps / serial point_sig qps",
+                  "{arm, clients, replicas, queries, qps, p50_ms, "
+                  "p99_ms, batch_rate = batched/admitted, "
+                  "batch_dispatches, batch_hist 'size:count ...', "
+                  "shed, overlap_ratio = staged-behind-compute ms / "
+                  "staging ms, pipelined}; replica_point arms: cluster "
+                  "point reads {replicas = hot standbys per DN, wrong "
+                  "(asserted 0), routed_reads, fallthrough}; "
+                  "vs_baseline = headline qps / serial point_sig qps",
         "serial": {k: {f: (round(v, 3) if isinstance(v, float) else v)
                        for f, v in e.items()} for k, e in serial.items()},
         "arms": [{k: (round(v, 3) if isinstance(v, float) else v)

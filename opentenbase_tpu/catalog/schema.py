@@ -126,6 +126,10 @@ class NodeDef:
     # bumped at every failover of this slot: a coordinator holding a
     # connection to an older epoch's address must re-resolve (fencing)
     epoch: int = 0
+    # hot-standby READ replicas (list of {"host","port","datadir"}):
+    # the ReplicaRouter's rotation — distinct from `standby`, which is
+    # the failover target (net/guard.py ReplicaRouter)
+    standbys: list = None
 
     def to_json(self):
         return dataclasses.asdict(self)

@@ -137,8 +137,15 @@ def _to_device(hb: HostBatch) -> DBatch:
 class DistExecutor:
     def __init__(self, cluster: Cluster, snapshot_ts: int, txid: int,
                  instrument: bool = False, use_mesh: bool = False,
-                 cancel_check=None, group_budget_rows: int = 0):
+                 cancel_check=None, group_budget_rows: int = 0,
+                 replica_reads: bool = False):
         self.group_budget_rows = group_budget_rows
+        # standby read scale-out (GUC replica_reads, net/guard.py
+        # ReplicaRouter): read fragments may run on a hot standby whose
+        # GTS hwm covers the snapshot.  The session only enables this
+        # for snapshot-read statements of txns that have not written —
+        # own uncommitted writes exist nowhere but the primary.
+        self.replica_reads = replica_reads
         self.cluster = cluster
         # statement-cancel probe (reference: CHECK_FOR_INTERRUPTS at the
         # executor's safe points) — raises when the client canceled
@@ -295,6 +302,9 @@ class DistExecutor:
             self.tier = "gidx" if getattr(dp, "via_gidx", "") else "fqs"
             dn = self.cluster.datanodes[dp.fqs_node]
             frag = dp.fragments[dp.top_fragment]
+            out = self._try_replica(dp.fqs_node, frag, {})
+            if out is not None:
+                return _to_device(out)
             if hasattr(dn, "exec_plan_device"):
                 return dn.exec_plan_device(frag.plan, self.snapshot_ts,
                                            self.txid, self.params, {})
@@ -500,6 +510,22 @@ class DistExecutor:
                         f"(got {type(k).__name__})")
 
     # ------------------------------------------------------------------
+    def _try_replica(self, dn_index: int, frag: Fragment,
+                     sources: dict):
+        """Route one read fragment to a hot standby of dn_index, or
+        None -> run on the primary as always (router trouble never
+        fails a statement)."""
+        if not self.replica_reads:
+            return None
+        router = getattr(self.cluster, "read_router", None)
+        if router is None:
+            return None
+        with obs_trace.span("execute", fragment=frag.index,
+                            where=f"dn{dn_index}-standby"):
+            return router.try_exec(dn_index, frag.plan,
+                                   self.snapshot_ts, self.txid,
+                                   self.params, sources)
+
     def _failover_target(self, dn_index: int):
         """Resolve the replacement datanode for a read re-dispatch, or
         None when the cluster has no standby to promote (the original
@@ -541,6 +567,13 @@ class DistExecutor:
         # on a remote cluster this runs from dispatch worker threads,
         # where span() is a no-op (the trace stack is thread-local) —
         # per-fragment timing still lands in self.stats under instrument
+        out = self._try_replica(where, frag, sources)
+        if out is not None:
+            if self.instrument:
+                self.stats[(frag.index, where)] = {
+                    "ms": (_time.perf_counter() - t0) * 1e3,
+                    "rows": out.nrows}
+            return out
         with obs_trace.span("execute", fragment=frag.index,
                             where=f"dn{where}"):
             try:

@@ -1042,7 +1042,13 @@ class ClusterSession:
                                   "enable_mesh_exchange", "on") != "off",
                               group_budget_rows=int(ginfo.get(
                                   "staging_budget_rows", 0))
-                              if ginfo else 0)
+                              if ginfo else 0,
+                              # standby routing only for reads of txns
+                              # with no writes: own uncommitted rows
+                              # exist nowhere but the primary
+                              replica_reads=self.cluster.gucs.get(
+                                  "replica_reads", "off") == "on"
+                              and not txn.written_dns)
             if params:
                 ex.params.update(params)
             batch = ex.run(dp)

@@ -779,17 +779,35 @@ def _batch_class(k: int) -> int:
     return c
 
 
-def run_fused_batch(info: FragSig, queries: list) -> Optional[list]:  # otblint: sync-boundary
-    """Run K same-signature queries as ONE compiled dispatch.
+class StagedBatch:
+    """A coalesced batch after the STAGE phase: keys computed, literal
+    and MVCC columns stacked, leaf tables resident on device — host work
+    only, no program launched yet.  The pipelined scheduler stages batch
+    i+1 while batch i computes; `launch_fused_batch` turns one of these
+    into an in-flight dispatch."""
 
-    `queries` is [(snapshot_ts, txid, [literal values])] — one entry
-    per query, literal order matching `info.lits`.  Returns a list of
-    per-query DBatch results (device views into the stacked program
-    output — materialization happens on the caller's thread, which is
-    what lets the scheduler overlap the next batch's staging with this
-    batch's device compute), or None when the batched path can't serve
-    this group (caller falls back to serial execution)."""
-    from .executor import DBatch, ExecContext, stats_tier
+    __slots__ = ("info", "k", "kclass", "base_key", "lkey", "snaps",
+                 "txids", "pvals", "staged_arrs", "staged_ns", "bctx",
+                 "factors")
+
+
+class FusedFlight:
+    """One launched (asynchronously dispatched) coalesced batch.  The
+    device arrays here are futures — JAX async dispatch returned before
+    compute finished; `finish_fused_batch` performs the only host sync
+    (the join-ladder check) and demuxes per-query views."""
+
+    __slots__ = ("sb", "fn", "meta", "cols", "valid", "nulls",
+                 "join_req", "attempt")
+
+
+def stage_fused_batch(info: FragSig, queries: list) \
+        -> Optional[StagedBatch]:
+    """STAGE phase of a coalesced dispatch: recompute the dispatch-time
+    key, stack per-query MVCC/literal columns, and upload every needed
+    table through the device cache.  Returns None when the batched path
+    refuses this group (mask-refused shape, empty batch)."""
+    from .executor import ExecContext
 
     if not queries:
         return None
@@ -804,74 +822,108 @@ def run_fused_batch(info: FragSig, queries: list) -> Optional[list]:  # otblint:
     if refused:
         return None
 
-    k = len(queries)
-    kclass = _batch_class(k)
-    padded = list(queries) + [queries[-1]] * (kclass - k)
-    snaps = jnp.asarray([q[0] for q in padded], jnp.int64)
-    txids = jnp.asarray([q[1] for q in padded], jnp.int64)
-    pvals = tuple(
+    sb = StagedBatch()
+    sb.info = info
+    sb.base_key = base_key
+    sb.lkey = struct_key(base_key)
+    sb.k = len(queries)
+    sb.kclass = _batch_class(sb.k)
+    padded = list(queries) + [queries[-1]] * (sb.kclass - sb.k)
+    sb.snaps = jnp.asarray([q[0] for q in padded], jnp.int64)
+    sb.txids = jnp.asarray([q[1] for q in padded], jnp.int64)
+    sb.pvals = tuple(
         jnp.stack([jnp.asarray(q[2][i]) for q in padded])
         for i in range(len(info.lits)))
 
     # stage ONCE for the whole batch (device cache, version-keyed)
-    staged_arrs: dict = {}
-    staged_ns: dict = {}
+    sb.staged_arrs = {}
+    sb.staged_ns = {}
     for t, need in sorted(info.need_by_table.items()):
         arrs, n = info.cache.get(info.stores[t], sorted(need))
-        staged_arrs[t] = arrs
-        staged_ns[t] = jnp.int64(n)
+        sb.staged_arrs[t] = arrs
+        sb.staged_ns[t] = jnp.int64(n)
 
-    lkey = struct_key(base_key)
     with _STATE_LOCK:
-        factors = dict(_JOIN_LADDER.get(lkey, {})) if info.has_join \
-            else {}
-    bctx = ExecContext(info.stores, 0, 0, info.cache)
+        sb.factors = dict(_JOIN_LADDER.get(sb.lkey, {})) \
+            if info.has_join else {}
+    sb.bctx = ExecContext(info.stores, 0, 0, info.cache)
+    return sb
 
-    for _attempt in range(24):
-        full_key = base_key + (("__batch", kclass),
-                               tuple(sorted(factors.items())))
-        hit = plancache.FUSED.get(full_key)
-        if hit is None:
-            hit = plancache.FUSED.put(
-                full_key, _build_program(bctx, info.plan, {}, (),
-                                         info.lits, factors,
-                                         batch=True))
-        fn, meta = hit
-        if fn is None:
-            return None
-        t0 = time.perf_counter()
-        try:
-            with stats_tier("fused"):
-                cols, valid, nulls, join_req = fn(
-                    staged_arrs, snaps, txids, pvals, staged_ns)
-        except (jax.errors.TracerBoolConversionError,
-                jax.errors.ConcretizationTypeError,
-                jax.errors.TracerArrayConversionError):
-            # a masked literal fed value-dependent program structure:
-            # this shape bakes its literals — never batchable
-            _mask_refused_add(struct_key(base_key))
-            plancache.FUSED.pop(full_key)
-            return None
-        except Exception as e:
-            from . import shield
-            if shield.is_oom(e):
-                # device allocation failure must REACH the scheduler:
-                # its pressure ladder (evict-coldest + retry, then
-                # degrade to spill) is the correct response — a serial
-                # fallback would just re-discover the same OOM K times
-                plancache.FUSED.pop(full_key)
-                raise
-            # fall back to serial execution, which reproduces (and
-            # attributes) the error per query
-            plancache.FUSED.pop(full_key)
-            return None
-        plancache.FUSED.record_call(fn, t0)
 
-        caps = meta.get("join_caps") or ()
+def launch_fused_batch(sb: StagedBatch, attempt: int = 0) \
+        -> Optional[FusedFlight]:
+    """LAUNCH phase: program lookup/compile + ONE asynchronous dispatch.
+    No host sync happens here — the returned flight's arrays are device
+    futures.  Returns None when the program permanently declined this
+    shape (caller falls back to serial); re-raises device OOM so the
+    scheduler's pressure ladder can respond."""
+    from .executor import stats_tier
+
+    full_key = sb.base_key + (("__batch", sb.kclass),
+                              tuple(sorted(sb.factors.items())))
+    hit = plancache.FUSED.get(full_key)
+    if hit is None:
+        hit = plancache.FUSED.put(
+            full_key, _build_program(sb.bctx, sb.info.plan, {}, (),
+                                     sb.info.lits, sb.factors,
+                                     batch=True))
+    fn, meta = hit
+    if fn is None:
+        return None
+    t0 = time.perf_counter()
+    try:
+        with stats_tier("fused"):
+            cols, valid, nulls, join_req = fn(
+                sb.staged_arrs, sb.snaps, sb.txids, sb.pvals,
+                sb.staged_ns)
+    except (jax.errors.TracerBoolConversionError,
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError):
+        # a masked literal fed value-dependent program structure:
+        # this shape bakes its literals — never batchable
+        _mask_refused_add(struct_key(sb.base_key))
+        plancache.FUSED.pop(full_key)
+        return None
+    except Exception as e:
+        from . import shield
+        if shield.is_oom(e):
+            # device allocation failure must REACH the scheduler:
+            # its pressure ladder (evict-coldest + retry, then
+            # degrade to spill) is the correct response — a serial
+            # fallback would just re-discover the same OOM K times
+            plancache.FUSED.pop(full_key)
+            raise
+        # fall back to serial execution, which reproduces (and
+        # attributes) the error per query
+        plancache.FUSED.pop(full_key)
+        return None
+    plancache.FUSED.record_call(fn, t0)
+
+    fl = FusedFlight()
+    fl.sb = sb
+    fl.fn, fl.meta = fn, meta
+    fl.cols, fl.valid, fl.nulls = cols, valid, nulls
+    fl.join_req = join_req
+    fl.attempt = attempt
+    return fl
+
+
+def finish_fused_batch(flight: FusedFlight) -> Optional[list]:  # otblint: sync-boundary
+    """FINISH phase: the ONLY host sync of a coalesced dispatch — the
+    join-ladder overflow check reads `join_req` back (which also
+    surfaces any deferred device error from the async launch), growing
+    factors and relaunching until the batch converges.  Returns the
+    per-query DBatch device views, or None when the batched path gave
+    up (caller falls back to serial)."""
+    from .executor import DBatch
+
+    while True:
+        sb = flight.sb
+        caps = flight.meta.get("join_caps") or ()
         if caps:
             # per-join required totals arrive stacked (K, njoins):
             # grow to the max any batch element needs
-            req = np.asarray(jax.device_get(join_req)).max(axis=0)
+            req = np.asarray(jax.device_get(flight.join_req)).max(axis=0)
             grew = False
             for (jid, cap), r in zip(caps, req):
                 if r <= cap:
@@ -879,23 +931,52 @@ def run_fused_batch(info: FragSig, queries: list) -> Optional[list]:  # otblint:
                 mult = 1
                 while cap * mult < r:
                     mult *= 2
-                factors[jid] = factors.get(jid, 1) * mult
-                if factors[jid] > 4096:
+                sb.factors[jid] = sb.factors.get(jid, 1) * mult
+                if sb.factors[jid] > 4096:
                     return None
                 grew = True
             if grew:
-                _ladder_remember(lkey, factors)
+                _ladder_remember(sb.lkey, sb.factors)
+                if flight.attempt + 1 >= 24:
+                    return None  # overflow never converged
+                flight = launch_fused_batch(sb, attempt=flight.attempt + 1)
+                if flight is None:
+                    return None
                 continue
-        if info.has_join:
-            _ladder_remember(lkey, factors)
+        if sb.info.has_join:
+            _ladder_remember(sb.lkey, sb.factors)
 
         # demux: per-query device views into the stacked output (the
         # padded tail, if any, is discarded)
         out = []
-        for i in range(k):
+        for i in range(sb.k):
             out.append(DBatch(
-                {n: a[i] for n, a in cols.items()}, valid[i],
-                dict(meta["types"]), dict(meta["dicts"]),
-                {n: a[i] for n, a in nulls.items()}))
+                {n: a[i] for n, a in flight.cols.items()},
+                flight.valid[i],
+                dict(flight.meta["types"]), dict(flight.meta["dicts"]),
+                {n: a[i] for n, a in flight.nulls.items()}))
         return out
-    return None  # overflow never converged
+
+
+def run_fused_batch(info: FragSig, queries: list) -> Optional[list]:  # otblint: sync-boundary
+    """Run K same-signature queries as ONE compiled dispatch.
+
+    `queries` is [(snapshot_ts, txid, [literal values])] — one entry
+    per query, literal order matching `info.lits`.  Returns a list of
+    per-query DBatch results (device views into the stacked program
+    output — materialization happens on the caller's thread, which is
+    what lets the scheduler overlap the next batch's staging with this
+    batch's device compute), or None when the batched path can't serve
+    this group (caller falls back to serial execution).
+
+    This is the synchronous composition of the three pipeline phases
+    (stage → launch → finish); the pipelined scheduler calls them
+    separately so the finish-phase host sync lands on its drainer
+    thread instead of the dispatch loop."""
+    sb = stage_fused_batch(info, queries)
+    if sb is None:
+        return None
+    flight = launch_fused_batch(sb)
+    if flight is None:
+        return None
+    return finish_fused_batch(flight)

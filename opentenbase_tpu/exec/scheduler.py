@@ -14,12 +14,19 @@ axis and `jax.lax.map` runs the shared fragment once per batch element
 inside one executable (fused.run_fused_batch), then per-query results
 demux as device views into the stacked output.
 
-Pipelining: the dispatcher thread only classifies, coalesces, and
-launches — JAX async dispatch returns before device compute finishes,
-and materialization (the device→host sync) happens on each CLIENT
-thread.  While clients block on query i's results, the dispatcher is
-already staging and launching query i+1's batch: host staging overlaps
-device compute with no extra machinery.
+Pipelining (otbpipe): the dispatcher thread only classifies, coalesces,
+stages, and launches — JAX async dispatch returns before device compute
+finishes, so while the device computes batch i the dispatcher is
+already staging batch i+1 (bufferpool uploads + program lookup).  The
+one host sync a coalesced dispatch needs (the join-ladder overflow
+check, fused.finish_fused_batch) runs on a dedicated DRAINER thread fed
+by a bounded completion queue, so the dispatch loop never blocks on the
+device; per-query materialization stays on each CLIENT thread.  GTM
+slot ownership transfers to the drainer when a flight enqueues, and the
+drainer releases it — the slot ledger stays exact across the thread
+boundary.  `enable_pipeline` GUC (env OTB_SCHED_PIPELINE, default on)
+switches the overlap off, falling back to the synchronous dispatch
+path with bit-identical results.
 
 Admission: GTM resource-group slots (owner + lease, gtm/server.py)
 throttle concurrent dispatches per group — a coalesced batch holds one
@@ -56,7 +63,9 @@ from ..sql import ast as A
 from ..sql.parser import parse_sql
 from . import shield
 from .executor import ExecContext, ExecError, materialize
-from .fused import batch_signature, run_fused_batch
+from .fused import (batch_signature, finish_fused_batch,
+                    launch_fused_batch, run_fused_batch,
+                    stage_fused_batch)
 from .session import Result
 from ..utils import locks
 
@@ -80,6 +89,14 @@ _STATS: dict = {          # guarded_by: _STATS_LOCK
     # statement-deadline / cancel outcomes (otbshield)
     "expired": 0,         # statement_timeout fired (queued or in-flight)
     "canceled": 0,        # cancel event consumed (queued or in-flight)
+    # two-stage pipeline (otbpipe): dispatches whose finish-phase host
+    # sync ran on the drainer thread, and how much staging wall time
+    # overlapped an in-flight device dispatch (the overlap ratio the
+    # bench reports — staging wait ≪ staging work once warm)
+    "pipelined_dispatches": 0,
+    "drained": 0,         # flights the drainer completed
+    "stage_work_ms": 0.0,     # total staging wall time
+    "stage_overlap_ms": 0.0,  # staging wall time hidden behind compute
 }
 _HIST: dict = {}          # guarded_by: _STATS_LOCK — batch size -> count
 _WAITS: collections.deque = collections.deque(  # guarded_by: _STATS_LOCK
@@ -106,6 +123,13 @@ def stats_snapshot() -> dict:
     d["queue_wait_p99_ms"] = _pct(waits, 0.99)
     d["batch_hist"] = " ".join(f"{k}:{v}" for k, v in hist.items())
     d["hist"] = hist
+    # otbpipe surfaces: how deep the completion queue sits right now,
+    # and what fraction of staging work the pipeline hid behind compute
+    d["drain_queue_depth"] = sum(s.drain_depth() for s in scheds)
+    work = float(d.get("stage_work_ms", 0.0))
+    d["pipeline_overlap_ratio"] = \
+        (float(d.get("stage_overlap_ms", 0.0)) / work) if work > 0 \
+        else 0.0
     return d
 
 
@@ -142,6 +166,31 @@ def _note_dispatch(items, t_start: float):
         _HIST[k] = _HIST.get(k, 0) + 1
         for it in items:
             _WAITS.append((t_start - it.t_submit) * 1e3)
+
+
+def _note_stage(ms: float, overlapped: bool):
+    """Account one staging pass; `overlapped` when at least one flight
+    was computing on-device while this staging ran (the wall time the
+    dispatch loop did NOT spend idle waiting on the device)."""
+    with _STATS_LOCK:
+        _STATS["stage_work_ms"] += ms
+        if overlapped:
+            _STATS["stage_overlap_ms"] += ms
+
+
+def _metrics_samples():
+    """otb_sched_* samples for the unified registry (obs/metrics.py) —
+    the otbtrace pane the ISSUE's pipeline counters surface through."""
+    d = stats_snapshot()
+    for k in ("admitted", "queued", "batched", "shed", "dispatches",
+              "batch_dispatches", "slots_acquired", "slots_released",
+              "expired", "canceled", "pipelined_dispatches", "drained"):
+        yield (f"otb_sched_{k}", {}, d[k])
+    yield ("otb_sched_stage_work_ms", {}, d["stage_work_ms"])
+    yield ("otb_sched_stage_overlap_ms", {}, d["stage_overlap_ms"])
+    yield ("otb_sched_pipeline_overlap_ratio", {},
+           d["pipeline_overlap_ratio"])
+    yield ("otb_sched_drain_queue_depth", {}, d["drain_queue_depth"])
 
 
 def _env_float(name: str, default: float) -> float:
@@ -196,6 +245,54 @@ class _Gone(Exception):
     a slot — it is already finished, and NO slot is held."""
 
 
+class CancelEvent(threading.Event):
+    """A cancel signal that can WAKE parked waiters.  A plain Event
+    forces `Scheduler.wait` to poll (the idle-spin the --qps bench saw
+    as wasted CPU at low load); this variant notifies every registered
+    per-item condition when it fires, so waiters park on their
+    completion CV and still observe an out-of-band cancel promptly.
+    The CN server hands one of these to every connection session."""
+
+    def __init__(self):
+        super().__init__()
+        self._waiters: list = []
+        self._wlk = threading.Lock()
+
+    def register(self, cv) -> None:
+        with self._wlk:
+            self._waiters.append(cv)
+
+    def unregister(self, cv) -> None:
+        with self._wlk:
+            try:
+                self._waiters.remove(cv)
+            except ValueError:
+                pass
+
+    def set(self):
+        super().set()
+        with self._wlk:
+            cvs = list(self._waiters)
+        for cv in cvs:
+            with cv:
+                cv.notify_all()
+
+
+class _Flight:
+    """One launched coalesced dispatch crossing the dispatcher→drainer
+    boundary.  The GTM slot acquired for the dispatch is OWNED by this
+    record once enqueued — the drainer releases it."""
+
+    __slots__ = ("items", "flight", "sb", "group", "t_start")
+
+    def __init__(self, items, flight, sb, group, t_start):
+        self.items = items
+        self.flight = flight
+        self.sb = sb
+        self.group = group
+        self.t_start = t_start
+
+
 _STOP = object()
 
 
@@ -204,7 +301,7 @@ class _Item:
     __slots__ = ("session", "sql", "planned", "info", "group",
                  "t_submit", "ev", "error", "results", "batch",
                  "out_names", "is_write", "deadline", "cancel_event",
-                 "lk", "detached", "degraded", "lits")
+                 "lk", "cv", "detached", "degraded", "lits")
 
     def __init__(self, session, sql):
         self.session = session
@@ -229,6 +326,9 @@ class _Item:
         # completion/detach handshake: the waiter may abandon the item
         # (deadline, cancel) while a dispatcher/worker is completing it
         self.lk = threading.Lock()
+        # the waiter parks on this (instead of polling ev) — _complete
+        # notifies it, and a CancelEvent wakes it out-of-band
+        self.cv = threading.Condition(self.lk)
         self.detached = False     # guarded_by: lk
         self.degraded = False     # served by the spill path (shield)
         self.lits = None          # literal bindings (poison fault surface)
@@ -284,6 +384,23 @@ class Scheduler:
         self._pool: Optional[ThreadPoolExecutor] = None
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
+        # two-stage pipeline: launched flights await their finish-phase
+        # host sync here.  Bounded — a full queue back-pressures the
+        # dispatcher (it blocks on put), capping device work in flight.
+        self._drainq: queue.Queue = queue.Queue(
+            maxsize=max(1, _env_int("OTB_SCHED_DRAIN_DEPTH", 4)))
+        self._drain_thread: Optional[threading.Thread] = None
+        # flights launched but not yet finished, and staging passes
+        # currently running: staging that starts while inflight > 0 is
+        # overlapped with device compute (the pipeline_overlap_ratio)
+        self._pipe_lock = locks.Lock(
+            "exec.scheduler.Scheduler._pipe_lock")
+        self._inflight = 0              # guarded_by: _pipe_lock
+        # admission parking: _release notifies; waiters still wake on a
+        # bounded timeout because GTM-side releases (other processes,
+        # lease reaping) can't notify this condition
+        self._slot_cv = locks.Condition(
+            name="exec.scheduler.Scheduler._slot_cv")
         with _STATS_LOCK:
             _SCHEDULERS.append(self)
 
@@ -298,13 +415,27 @@ class Scheduler:
                     target=self._loop, daemon=True, name="otb-sched-disp")
                 self._thread.start()
 
+    def _ensure_drainer(self):
+        with self._lock:
+            if self._drain_thread is None:
+                self._drain_thread = threading.Thread(
+                    target=self._drain_loop, daemon=True,
+                    name="otb-sched-drain")
+                self._drain_thread.start()
+
     def stop(self):
         with self._lock:
             self._stopped = True
             started = self._thread is not None
+            drainer = self._drain_thread
         if started:
             self._q.put(_STOP)
             self._thread.join(timeout=30)
+            if drainer is not None:
+                # FIFO: every flight the dispatcher enqueued drains
+                # before the sentinel — no result is abandoned
+                self._drainq.put(_STOP)
+                drainer.join(timeout=30)
             self._pool.shutdown(wait=True)
         try:
             self.gtm.resq_disconnect(self._owner)
@@ -323,6 +454,9 @@ class Scheduler:
     def queue_depth(self) -> int:
         with self._lock:
             return sum(self._depth.values())
+
+    def drain_depth(self) -> int:
+        return self._drainq.qsize()
 
     # -- client API -------------------------------------------------------
     def run(self, session, sql: str) -> list:
@@ -355,34 +489,54 @@ class Scheduler:
         """Wait for completion, honoring the statement deadline and the
         session's cancel event.  On expiry/cancel the item DETACHES: it
         finishes here, batch-mates are untouched, and whichever
-        dispatcher later tries to complete it becomes a no-op."""
+        dispatcher later tries to complete it becomes a no-op.
+
+        The waiter PARKS on the item's condition — _complete notifies
+        it, and a CancelEvent wakes it out-of-band.  Only a legacy
+        plain-Event cancel still forces the short poll slice (it has no
+        way to wake a parked waiter)."""
         end = time.monotonic() + timeout
         if item.deadline is not None:
             end = min(end, item.deadline)
         cancel = item.cancel_event
-        while True:
-            now = time.monotonic()
-            rem = end - now
-            if rem <= 0:
-                if self._detach(item):
-                    if item.deadline is not None and now >= item.deadline:
-                        _bump("expired")
-                        raise ExecError(
-                            "canceling statement due to statement timeout")
-                    raise ExecError(
-                        "scheduler: query timed out awaiting dispatch")
-                break    # completed under the wire: consume the result
-            # poll in short slices only when there is a cancel event to
-            # watch; otherwise one blocking wait to the deadline
-            if item.ev.wait(min(0.05, rem) if cancel is not None else rem):
-                break
-            if cancel is not None and cancel.is_set():
-                cancel.clear()
-                if self._detach(item):
-                    _bump("canceled")
-                    raise ExecError(
-                        "canceling statement due to user request")
-                break
+        wakeable = isinstance(cancel, CancelEvent)
+        if wakeable:
+            cancel.register(item.cv)
+        try:
+            with item.cv:
+                while not item.ev.is_set():
+                    now = time.monotonic()
+                    rem = end - now
+                    if rem <= 0:
+                        # the detach check, inlined under item.lk (cv
+                        # wraps the same lock _complete takes)
+                        if not item.detached:
+                            item.detached = True
+                            if item.deadline is not None \
+                                    and now >= item.deadline:
+                                _bump("expired")
+                                raise ExecError(
+                                    "canceling statement due to "
+                                    "statement timeout")
+                            raise ExecError(
+                                "scheduler: query timed out awaiting "
+                                "dispatch")
+                        break    # completed under the wire
+                    if cancel is not None and cancel.is_set():
+                        cancel.clear()
+                        if not item.detached:
+                            item.detached = True
+                            _bump("canceled")
+                            raise ExecError(
+                                "canceling statement due to user "
+                                "request")
+                        break
+                    item.cv.wait(
+                        rem if (wakeable or cancel is None)
+                        else min(0.05, rem))
+        finally:
+            if wakeable:
+                cancel.unregister(item.cv)
         if item.error is not None:
             raise item.error
         if item.results is not None:
@@ -414,6 +568,7 @@ class Scheduler:
                 item.batch = batch
                 item.out_names = out_names
             item.ev.set()
+            item.cv.notify_all()    # wake the parked waiter
             return True
 
     def _detach(self, item: _Item) -> bool:
@@ -535,8 +690,13 @@ class Scheduler:
                 raise _Shed(
                     f"resource group '{group}' queue wait timeout: "
                     "query shed")
-            time.sleep(delay)
-            delay = min(delay * 2, 0.02)
+            # park instead of sleep-polling: a local _release notifies
+            # immediately; the bounded timeout still catches GTM-side
+            # frees this condition can't observe (other owners, lease
+            # reaping)
+            with self._slot_cv:
+                self._slot_cv.wait(timeout=delay)
+            delay = min(delay * 2, 0.05)
         _bump("slots_acquired")
 
     def _release(self, group: str):
@@ -549,6 +709,8 @@ class Scheduler:
             self.gtm.resq_release(group, owner=self._owner)
         except Exception:
             pass
+        with self._slot_cv:
+            self._slot_cv.notify_all()
 
     def _shed_item(self, item: _Item, exc: _Shed):
         if not self._complete(item, error=ExecError(str(exc))):
@@ -633,10 +795,13 @@ class Scheduler:
             return
         cap = shield.batch_cap(live[0].session.node, live[0].info,
                                self.max_batch)
+        pipelined = self._pipeline_on(live[0].session)
         for i in range(0, len(live), cap):
             chunk = live[i:i + cap]
             if len(chunk) == 1:
                 self._pool.submit(self._run_serial, chunk[0])
+            elif pipelined:
+                self._dispatch_pipelined(chunk)
             else:
                 self._dispatch_one(chunk)
 
@@ -710,6 +875,166 @@ class Scheduler:
         for it, b in zip(items, out):
             self._complete(it, batch=b, out_names=it.planned.output_names)
 
+    def _pipeline_on(self, session) -> bool:
+        """`enable_pipeline` GUC (env default OTB_SCHED_PIPELINE, on).
+        Off falls back to the synchronous dispatch path — bit-identical
+        results, no drainer thread."""
+        node = getattr(session, "node", None) or self.node
+        gucs = getattr(node, "gucs", None) or {}
+        v = str(gucs.get("enable_pipeline", "") or "").strip().lower()
+        if not v:
+            v = os.environ.get("OTB_SCHED_PIPELINE", "on").strip().lower()
+        return v not in ("off", "0", "false")
+
+    def _dispatch_pipelined(self, items: list):
+        """Two-stage pipeline entry (dispatcher thread only): admit →
+        stage → async launch → enqueue the flight for the drainer.  The
+        dispatch loop returns without ever touching the device result —
+        the finish-phase host sync runs on the drainer, so the loop is
+        already staging the NEXT batch while this one computes.
+
+        Slot discipline across the thread boundary: the GTM slot this
+        dispatch holds transfers to the _Flight at enqueue; every error
+        path BEFORE the enqueue releases it here."""
+        group = items[0].group
+        deadline = min(it.t_submit for it in items) + self.shed_s
+        try:
+            # ownership transfer, not a leak: the slot rides the _Flight
+            # to the drainer, whose finish path releases in finally;
+            # every path between here and the enqueue releases explicitly
+            self._admit(group, deadline)  # otblint: disable=slot-discipline
+        except _Shed as e:
+            for it in items:
+                self._shed_item(it, e)
+            return
+        except BaseException as e:
+            for it in items:
+                self._complete(it, error=e)
+            return
+        t_start = time.monotonic()
+        flight = sb = None
+        try:
+            node = items[0].session.node
+            queries = []
+            for it in items:
+                txid = node.gts.next_txid()
+                snap = node.gts.next_gts()
+                queries.append(
+                    (snap, txid, [v for _n, v, _t in it.info.lits]))
+            with self._pipe_lock:
+                overlapped = self._inflight > 0
+            # same pressure ladder as the synchronous path: one
+            # evict-coldest + retry pass covers the fault surface,
+            # staging uploads, AND the async launch
+            for attempt in (0, 1):
+                try:
+                    shield.pre_dispatch(items[0].info, queries)
+                    if sb is None:
+                        t0 = time.perf_counter()
+                        sb = stage_fused_batch(items[0].info, queries)
+                        _note_stage((time.perf_counter() - t0) * 1e3,
+                                    overlapped)
+                    if sb is not None:
+                        flight = launch_fused_batch(sb)
+                    break
+                except BaseException as e:
+                    if shield.is_oom(e) and attempt == 0:
+                        shield.bump("oom_dispatches")
+                        shield.relieve()
+                        continue
+                    raise
+        except BaseException as e:
+            self._release(group)
+            self._flight_error(items, e)
+            return
+        if flight is None:
+            # staging/launch declined (mask refused, program fell back):
+            # serial fallback reproduces per-query results
+            self._release(group)
+            for it in items:
+                self._pool.submit(self._run_serial, it)
+            return
+        self._ensure_drainer()
+        with self._pipe_lock:
+            self._inflight += 1
+        _bump("pipelined_dispatches")
+        # bounded queue: a slow drainer back-pressures the dispatcher
+        # here, capping how much device work can pile up in flight
+        self._drainq.put(_Flight(items, flight, sb, group, t_start))
+
+    def _drain_loop(self):
+        """Drainer thread: the finish-phase host sync (join-ladder
+        read-back — where deferred device errors also surface) for every
+        launched flight, then per-item completion.  Deadlines/cancels,
+        quarantine bisection, and the slot ledger keep their exact
+        semantics: _complete/_isolate re-check liveness per item, and
+        the flight's slot releases HERE, in the finally.
+        # may-acquire: exec.scheduler._STATS_LOCK
+        # may-acquire: exec.shield._LOCK
+        # may-acquire: exec.scheduler.Scheduler._pipe_lock
+        # may-acquire: exec.scheduler.Scheduler._slot_cv
+        """
+        while True:
+            fl = self._drainq.get()
+            if fl is _STOP:
+                return
+            self._drain_one(fl)
+
+    def _drain_one(self, fl: _Flight):
+        out = err = None
+        try:
+            try:
+                out = finish_fused_batch(fl.flight)
+            except BaseException as e:
+                if shield.is_oom(e):
+                    # deferred device OOM surfaced at the sync point:
+                    # same rung-1 response as the synchronous path —
+                    # evict-coldest, relaunch from the staged batch once
+                    shield.bump("oom_dispatches")
+                    shield.relieve()
+                    try:
+                        f2 = launch_fused_batch(fl.sb)
+                        out = finish_fused_batch(f2) \
+                            if f2 is not None else None
+                    except BaseException as e2:
+                        err = e2
+                else:
+                    err = e
+        finally:
+            with self._pipe_lock:
+                self._inflight -= 1
+            self._release(fl.group)
+            _bump("drained")
+        items = fl.items
+        if err is not None:
+            if shield.is_oom(err):
+                for it in items:
+                    self._pool.submit(self._serve_degraded, it)
+                return
+            shield.note_batch_failure(items[0].sig)
+            # bisection re-dispatches run SYNCHRONOUSLY on the drainer
+            # (never back into _drainq — the drainer must not block on
+            # the queue it is the only consumer of)
+            self._isolate(items)
+            return
+        if out is None:
+            for it in items:
+                self._pool.submit(self._run_serial, it)
+            return
+        _note_dispatch(items, fl.t_start)
+        for it, b in zip(items, out):
+            self._complete(it, batch=b, out_names=it.planned.output_names)
+
+    def _flight_error(self, items: list, err: BaseException):
+        """Pre-enqueue pipeline failure: mirror the synchronous dispatch
+        error ladder (the slot is already released by the caller)."""
+        if shield.is_oom(err):
+            for it in items:
+                self._pool.submit(self._serve_degraded, it)
+            return
+        shield.note_batch_failure(items[0].sig)
+        self._isolate(items)
+
     def _isolate(self, items: list):
         """Quarantine by bisection: re-dispatch the failed batch in
         halves, so innocents complete batched while the offender bottoms
@@ -779,6 +1104,11 @@ class Scheduler:
                 shield.serial_guard(item.lits)
                 if item.is_write:
                     with self._write_lock:
+                        # may-acquire: storage.store.TableStore._mu
+                        # may-acquire: storage.lockmgr.LockManager._cond
+                        # may-acquire: obs.metrics.Registry._lock
+                        # may-acquire: obs.metrics.metric._lock
+                        # may-acquire: obs.trace._LOCK
                         res = item.session.execute(item.sql)
                 else:
                     res = item.session.execute(item.sql)
@@ -800,3 +1130,7 @@ def serve(node, host: str = "127.0.0.1", port: int = 0,
     srv = CnServer(lambda: Session(node), users_path=users_path,
                    host=host, port=port, scheduler=sched).start()
     return srv, sched
+
+
+from ..obs.metrics import REGISTRY as _METRICS  # noqa: E402
+_METRICS.register_collector("scheduler", _metrics_samples)

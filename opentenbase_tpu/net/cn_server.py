@@ -159,7 +159,10 @@ class CnServer:
                             "password authentication failed"})
             return
         sess = self.make_session()
-        sess.cancel_event = threading.Event()
+        # a waker-capable cancel: scheduler.wait parks on a condition
+        # instead of polling, and this event can still interrupt it
+        from ..exec.scheduler import CancelEvent
+        sess.cancel_event = CancelEvent()
         with self._lock:
             pid = self._next_pid[0]
             self._next_pid[0] += 1

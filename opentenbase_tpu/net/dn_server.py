@@ -501,3 +501,61 @@ class RemoteDataNode:
             return self._call(op="ping") == "pong"
         except (ConnectionError, OSError, RuntimeError):
             return False
+
+
+class StandbyReadNode:
+    """Coordinator-side proxy for READ fragments on a hot standby
+    (storage/replication.py HotStandby behind a DnStandbyServer).  One
+    persistent connection per replica — the router is the only caller
+    and serializes per replica anyway (the replica's own apply/read
+    lock is the scale-out unit, not connection fan-in)."""
+
+    def __init__(self, host: str, port: int, name: str = ""):
+        self.addr = (host, port)
+        self.name = name or f"standby@{host}:{port}"
+        self._sock = None
+        self._lock = locks.Lock("net.dn_server.StandbyReadNode._lock")
+
+    # one conversation per call; the hold is bounded by the socket
+    # deadline, exactly the WalShip contract
+    def _call(self, msg: dict):  # otblint: disable=lock-blocking
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        self.addr, timeout=guard.rpc_deadline())
+                send_msg(self._sock, msg)
+                resp = recv_msg(self._sock, expect_reply=True)
+            except (ConnectionError, OSError, EOFError):
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                finally:
+                    self._sock = None
+                raise
+        if "error" in resp:
+            et = resp.get("etype", "")
+            if et == "StandbyLag":
+                from ..storage.replication import StandbyLag
+                raise StandbyLag(resp["error"],
+                                 hwm=resp.get("hwm", 0))
+            # anything else (cold standby AttributeError, unknown op)
+            # means this standby cannot serve reads at all
+            raise RuntimeError(f"{self.name}: {resp['error']}")
+        return resp
+
+    def hwm(self) -> int:
+        return int(self._call({"op": "hwm"})["hwm"])
+
+    def exec_plan(self, plan, snapshot_ts, txid, params, sources,
+                  min_hwm=0):
+        return self._call({"op": "exec_plan", "plan": plan,
+                           "snapshot_ts": snapshot_ts, "txid": txid,
+                           "params": params, "sources": sources,
+                           "min_hwm": min_hwm})["ok"]
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

@@ -396,6 +396,128 @@ class GtmGuard:
 # in-doubt 2PC resolver (reference: clean2pc launcher + workers)
 # ---------------------------------------------------------------------------
 
+class ReplicaRouter:
+    """Standby read scale-out: route snapshot-covered read fragments to
+    hot standbys, round-robin, with the same breaker ladder as primary
+    RPC (reference: hot_standby=on + a read-balancing pooler).
+
+    Freshness rule: a fragment at snapshot S on dn_i may run on a
+    replica whose GTS high-water mark >= min(S, newest commit ts this
+    coordinator ACKNOWLEDGED on dn_i).  The min matters both ways — a
+    replica need not chase the global GTS clock past the last real
+    commit (read-mostly workloads would otherwise never route), and it
+    must have applied every commit an issued snapshot can observe.
+    Stale cache -> one probe of the replica's hwm; still behind -> next
+    replica, then fall through to the primary.  A replica that answers
+    with a non-lag error (a cold DnStandby has no read surface) drops
+    out of rotation permanently; connection failures feed its breaker,
+    so a dead replica fails fast and re-enters via half-open probes."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._lock = locks.Lock("net.guard.ReplicaRouter._lock")
+        self._replicas = None      # guarded_by: _lock (built lazily)
+        self._rr: dict[int, int] = {}   # guarded_by: _lock
+
+    def invalidate(self) -> None:
+        """Catalog changed (replica registered/removed): rebuild."""
+        with self._lock:
+            self._replicas = None
+
+    def _ensure(self) -> dict:
+        with self._lock:
+            if self._replicas is None:
+                self._replicas = self._build()
+            return self._replicas
+
+    def _build(self) -> dict:
+        from .dn_server import StandbyReadNode
+        reps: dict[int, list] = {}
+        for nd in self.cluster.catalog.datanodes():
+            lst = []
+            for j, sb in enumerate(getattr(nd, "standbys", None) or []):
+                name = f"dn{nd.index}-rr{j}@{sb['host']}:{sb['port']}"
+                lst.append({"name": name, "dead": False, "hwm": -1,
+                            "node": StandbyReadNode(sb["host"],
+                                                    sb["port"], name)})
+            if lst:
+                reps[nd.index] = lst
+        return reps
+
+    def replica_names(self, dn_index: int) -> list:
+        return [r["name"] for r in self._ensure().get(dn_index, [])
+                if not r["dead"]]
+
+    def try_exec(self, dn_index: int, plan, snapshot_ts: int,
+                 txid: int, params: dict, sources: dict):
+        """Run one read fragment on a replica of dn_index.  Returns the
+        fragment's host batch, or None -> caller falls through to the
+        primary (never raises for replica-side trouble)."""
+        from ..storage.replication import StandbyLag
+        reps = self._ensure().get(dn_index)
+        if not reps:
+            return None
+        need = min(int(snapshot_ts),
+                   self.cluster.dn_commit_hwm.get(dn_index, 0))
+        n = len(reps)
+        with self._lock:
+            start = self._rr[dn_index] = \
+                (self._rr.get(dn_index, -1) + 1) % n
+        for k in range(n):
+            r = reps[(start + k) % n]
+            if r["dead"]:
+                continue
+            g = guard_for(r["name"])
+            if r["hwm"] < need:
+                # cached-stale: one cheap hwm probe before giving up on
+                # this replica (it may have caught up since)
+                try:
+                    g.breaker.admit()
+                    r["hwm"] = r["node"].hwm()
+                    g.note_success()
+                except CircuitOpen:
+                    continue
+                except RETRYABLE as e:
+                    g.note_failure(e)
+                    continue
+                except RuntimeError:
+                    r["dead"] = True
+                    continue
+                if r["hwm"] < need:
+                    REGISTRY.counter("otb_replica_skipped_total",
+                                     replica=r["name"],
+                                     reason="lag").inc()
+                    continue
+            try:
+                g.breaker.admit()
+                out = r["node"].exec_plan(plan, snapshot_ts, txid,
+                                          params, sources,
+                                          min_hwm=need)
+                g.note_success()
+            except CircuitOpen:
+                continue
+            except StandbyLag as e:
+                # raced a rebuild that lost ground vs our cache: trust
+                # the replica's own answer, try the next one
+                r["hwm"] = e.hwm
+                REGISTRY.counter("otb_replica_skipped_total",
+                                 replica=r["name"], reason="lag").inc()
+                continue
+            except RETRYABLE as e:
+                g.note_failure(e)
+                continue
+            except RuntimeError:
+                r["dead"] = True
+                continue
+            r["hwm"] = max(r["hwm"], need)
+            REGISTRY.counter("otb_replica_reads_total",
+                             replica=r["name"]).inc()
+            return out
+        REGISTRY.counter("otb_replica_fallthrough_total",
+                         dn=f"dn{dn_index}").inc()
+        return None
+
+
 class IndoubtResolver(threading.Thread):
     """Background sweeper: periodically walks the GTM's prepared_list
     plus each DN's orphaned-prepared set and drives every in-doubt gid

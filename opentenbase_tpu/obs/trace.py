@@ -208,6 +208,14 @@ class QueryTrace:
         }
         for ph in PHASES:
             d[f"{ph}_ms"] = self.phase_ms(ph)
+        # overlap-adjusted staging (otbpipe): wall time the dispatch
+        # path actually WAITED on staging.  Producers mark staging that
+        # ran behind device compute with an `overlapped_ms` attr on the
+        # stage span; without overlap this equals stage_ms, so the new
+        # pipeline doesn't misread as staging going to zero.
+        d["stage_wait_ms"] = max(
+            d["stage_ms"] - self.sum_attr("stage", "overlapped_ms"),
+            0.0)
         return d
 
     def to_dict(self) -> dict:

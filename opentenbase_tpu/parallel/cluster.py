@@ -61,6 +61,9 @@ class DataNode:
         # streaming replication (storage/replication.py WalShip); set via
         # attach_standby BEFORE open_wal
         self._ship = None
+        # GTS high-water mark: newest commit ts applied on this node —
+        # checkpointed to hwm.json so a hot standby seeds caught-up
+        self.last_commit_ts = 0
         if datadir:
             os.makedirs(datadir, exist_ok=True)
 
@@ -68,9 +71,17 @@ class DataNode:
                        sync: bool = True) -> None:
         """Start shipping WAL + checkpoints to a DnStandbyServer
         (reference: walsender registration).  Seeds the standby with the
-        current checkpoint artifacts so it can catch up mid-life."""
-        from ..storage.replication import WalShip
-        self._ship = WalShip(host, port)
+        current checkpoint artifacts so it can catch up mid-life.
+        Called again for another standby, shipping fans out — N hot
+        standby read replicas each receive the full stream."""
+        from ..storage.replication import FanoutShip, WalShip
+        ship = WalShip(host, port)
+        if self._ship is None:
+            self._ship = ship
+        elif isinstance(self._ship, FanoutShip):
+            self._ship.add(ship)
+        else:
+            self._ship = FanoutShip([self._ship, ship])
         self._sync_standby = sync
         if self.datadir:
             # base backup: checkpoint ships its artifacts itself now
@@ -407,6 +418,7 @@ class DataNode:
 
     def commit(self, txid: int, ts: int):
         self.log({"op": "commit", "txid": txid, "ts": int(ts)}, sync=True)
+        self.last_commit_ts = max(self.last_commit_ts, int(ts))
         self._forget_prepared(txid)
         for kind, table, sp in self.txn_spans.pop(txid, []):
             st = self.stores.get(table)
@@ -458,7 +470,10 @@ class DataNode:
             self.wal.append(rec, sync=sync)
 
     # ---- recovery (driven by the cluster, which owns the catalog) ----
-    def recover(self, catalog: Catalog, gtm: GtmCore):
+    def load_checkpoint(self, catalog: Catalog):
+        """Rebuild stores from the catalog's tables + on-disk .ckpt
+        snapshots — the first half of recovery, also the hot standby's
+        base-backup load (storage/replication.py HotStandby)."""
         for name, td in catalog.tables.items():
             st = TableStore(td)
             ckpt = os.path.join(self.datadir, f"{name}.ckpt")
@@ -470,77 +485,101 @@ class DataNode:
                 for c in td.columns:
                     st.alter_add_column(c)
             self.stores[name] = st
+
+    def apply_record(self, rec: dict, pending: dict, gid_of: dict):
+        """Apply ONE replayed WAL record against the live stores.
+        Shared by crash recovery (`recover`) and the hot standby's
+        incremental apply (storage/replication.py HotStandby): a hot
+        standby IS recovery running continuously, one shipped frame at
+        a time, with `pending`/`gid_of` carried across frames instead
+        of resolved at the end."""
+        op = rec.get("op")
+        if op == "create_table":
+            # recover() pre-builds stores from the catalog, so this is
+            # a no-op there; the standby sees DDL only through the WAL
+            td = TableDef.from_json(rec["table"])
+            if td.name not in self.stores:
+                self.stores[td.name] = TableStore(td)
+        elif op == "drop_table":
+            st = self.stores.pop(rec["name"], None)
+            if st is not None:
+                self.cache.invalidate(st)
+        elif op == "insert":
+            st = self.stores.get(rec["table"])
+            if st is None:   # table dropped after this record
+                return
+            enc = {}
+            for cname, v in rec["columns"].items():
+                if not st.td.has_column(cname):
+                    continue   # column dropped after this record
+                arr = np.asarray(v)
+                if arr.dtype.kind == "S":
+                    enc[cname] = st.encode_column(cname, arr)
+                elif arr.dtype.kind in "UO":
+                    enc[cname] = st.encode_column(cname, list(arr))
+                else:
+                    enc[cname] = arr.astype(
+                        st.td.column(cname).type.np_dtype)
+            from ..exec.session import conform_replay_columns
+            enc, rnulls = conform_replay_columns(
+                st, enc, rec["n"], rec.get("nulls"))
+            spans = st.insert(enc, rec["n"], rec["txid"],
+                              shardids=rec.get("shardids"),
+                              nulls=rnulls)
+            pending.setdefault(rec["txid"], []).append(
+                ("ins", st, spans))
+        elif op == "delete":
+            st = self.stores.get(rec["table"])
+            if st is None:
+                return
+            span = st.mark_delete(rec["chunk"], np.asarray(rec["mask"]),
+                                  rec["txid"])
+            pending.setdefault(rec["txid"], []).append(
+                ("del", st, span))
+        elif op == "alter_table":
+            from ..exec.session import replay_alter
+            replay_alter(None, self.stores, rec)
+        elif op == "truncate":
+            st = self.stores.get(rec["table"])
+            if st is not None:
+                st.truncate()
+        elif op == "subabort":
+            lst = pending.get(rec["txid"], [])
+            undo = lst[rec["keep"]:]
+            del lst[rec["keep"]:]
+            for kind, st, sp in undo:
+                if kind == "ins":
+                    st.abort_insert(sp)
+                else:
+                    st.revert_delete([sp])
+        elif op == "prepare":
+            gid_of[rec["txid"]] = rec["gid"]
+        elif op == "commit":
+            ts = np.int64(rec["ts"])
+            self.last_commit_ts = max(self.last_commit_ts,
+                                      int(rec["ts"]))
+            for kind, st, sp in pending.pop(rec["txid"], []):
+                (st.backfill_insert if kind == "ins"
+                 else lambda s, t_: st.backfill_delete([s], t_))(sp, ts)
+            gid_of.pop(rec["txid"], None)
+        elif op == "abort":
+            for kind, st, sp in pending.pop(rec["txid"], []):
+                if kind == "ins":
+                    st.abort_insert(sp)
+                else:
+                    st.revert_delete([sp])
+            gid_of.pop(rec["txid"], None)
+
+    def recover(self, catalog: Catalog, gtm: GtmCore):
+        self.load_checkpoint(catalog)
         pending: dict[int, list] = {}
         gid_of: dict[int, str] = {}
         walpath = os.path.join(self.datadir, "wal.log")
         max_txid = 0
         for rec in Wal.replay(walpath):
-            op = rec.get("op")
             if "txid" in rec:
                 max_txid = max(max_txid, rec["txid"])
-            if op == "insert":
-                st = self.stores.get(rec["table"])
-                if st is None:   # table dropped after this record
-                    continue
-                enc = {}
-                for cname, v in rec["columns"].items():
-                    if not st.td.has_column(cname):
-                        continue   # column dropped after this record
-                    arr = np.asarray(v)
-                    if arr.dtype.kind == "S":
-                        enc[cname] = st.encode_column(cname, arr)
-                    elif arr.dtype.kind in "UO":
-                        enc[cname] = st.encode_column(cname, list(arr))
-                    else:
-                        enc[cname] = arr.astype(
-                            st.td.column(cname).type.np_dtype)
-                from ..exec.session import conform_replay_columns
-                enc, rnulls = conform_replay_columns(
-                    st, enc, rec["n"], rec.get("nulls"))
-                spans = st.insert(enc, rec["n"], rec["txid"],
-                                  shardids=rec.get("shardids"),
-                                  nulls=rnulls)
-                pending.setdefault(rec["txid"], []).append(
-                    ("ins", st, spans))
-            elif op == "delete":
-                st = self.stores.get(rec["table"])
-                if st is None:
-                    continue
-                span = st.mark_delete(rec["chunk"], np.asarray(rec["mask"]),
-                                      rec["txid"])
-                pending.setdefault(rec["txid"], []).append(
-                    ("del", st, span))
-            elif op == "alter_table":
-                from ..exec.session import replay_alter
-                replay_alter(None, self.stores, rec)
-            elif op == "truncate":
-                st = self.stores.get(rec["table"])
-                if st is not None:
-                    st.truncate()
-            elif op == "subabort":
-                lst = pending.get(rec["txid"], [])
-                undo = lst[rec["keep"]:]
-                del lst[rec["keep"]:]
-                for kind, st, sp in undo:
-                    if kind == "ins":
-                        st.abort_insert(sp)
-                    else:
-                        st.revert_delete([sp])
-            elif op == "prepare":
-                gid_of[rec["txid"]] = rec["gid"]
-            elif op == "commit":
-                ts = np.int64(rec["ts"])
-                for kind, st, sp in pending.pop(rec["txid"], []):
-                    (st.backfill_insert if kind == "ins"
-                     else lambda s, t_: st.backfill_delete([s], t_))(sp, ts)
-                gid_of.pop(rec["txid"], None)
-            elif op == "abort":
-                for kind, st, sp in pending.pop(rec["txid"], []):
-                    if kind == "ins":
-                        st.abort_insert(sp)
-                    else:
-                        st.revert_delete([sp])
-                gid_of.pop(rec["txid"], None)
+            self.apply_record(rec, pending, gid_of)
         # in-doubt resolution: prepared but no commit/abort record — ask
         # the GTM for the verdict (reference: clean2pc workers + pg_clean)
         for txid, ops in list(pending.items()):
@@ -572,12 +611,26 @@ class DataNode:
             return
         for name, st in self.stores.items():
             checkpoint_store(st, os.path.join(self.datadir, f"{name}.ckpt"))
+        # hot-standby sidecars: table schemas (a .ckpt has arrays, not a
+        # TableDef) + the GTS high-water mark, so a replica rebuilt from
+        # these artifacts is queryable and knows how fresh it is
+        self._write_sidecar("schema.json", {
+            name: st.td.to_json() for name, st in self.stores.items()})
+        self._write_sidecar("hwm.json",
+                            {"gts_hwm": int(self.last_commit_ts)})
         if self.wal:
             self.wal.truncate()
         if self._ship is not None:
             # the standby mirrors the truncation: snapshot + fresh log
             from ..storage.replication import checkpoint_files
             self._ship.checkpoint(checkpoint_files(self.datadir))
+
+    def _write_sidecar(self, name: str, obj: dict) -> None:
+        import json
+        tmp = os.path.join(self.datadir, name + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, os.path.join(self.datadir, name))
 
     # ---- restorable barriers (reference: the two-phase barrier WAL
     # records of pgxc/barrier/barrier.c:33-40 + PITR restore target) ----
@@ -726,6 +779,13 @@ class Cluster:
         # all hit the same dead DN coalesce into ONE promotion
         self._failover_lock = locks.Lock("parallel.cluster.Cluster._failover_lock")
         self._promoted_at: dict[int, float] = {}
+        # standby read scale-out (net/guard.py ReplicaRouter): per-DN
+        # newest ACKNOWLEDGED commit ts — a replica whose hwm covers
+        # this has applied everything this coordinator committed there,
+        # so any snapshot this coordinator issues is servable on it
+        self.dn_commit_hwm: dict[int, int] = {}
+        from ..net.guard import ReplicaRouter
+        self.read_router = ReplicaRouter(self)
         # restart survival: persisted catalog.jobs resume scheduling as
         # soon as the cluster initializes, not only on CREATE JOB
         from .jobs import resume_jobs
@@ -894,6 +954,31 @@ class Cluster:
                 return
         raise KeyError(f"no datanode {dn_index}")
 
+    def register_read_replica(self, dn_index: int, host: str,
+                              port: int, datadir: str = ""):
+        """Record a HOT standby of dn_index in the catalog as a read
+        replica: the ReplicaRouter routes snapshot-covered read
+        fragments there when GUC replica_reads=on (reference:
+        hot_standby=on + a read-balancing pooler)."""
+        for nd in self.catalog.datanodes():
+            if nd.index == dn_index:
+                if not nd.standbys:
+                    nd.standbys = []
+                nd.standbys.append({"host": host, "port": port,
+                                    "datadir": datadir})
+                self._save_catalog()
+                self.read_router.invalidate()
+                return
+        raise KeyError(f"no datanode {dn_index}")
+
+    def note_dn_commit(self, dn_index: int, ts: int) -> None:
+        """Track the newest commit this coordinator ACKNOWLEDGED per DN
+        — the replica router's freshness floor (a replica at or past it
+        has every commit any snapshot from this coordinator can see)."""
+        hwm = getattr(self, "dn_commit_hwm", None)
+        if hwm is not None:
+            hwm[dn_index] = max(hwm.get(dn_index, 0), int(ts))
+
     def auto_failover(self, dn_index: int):
         """Promote dn_index's registered standby and reroute: crash
         recovery over the standby's shipped directory, a fresh DN
@@ -1060,6 +1145,7 @@ class Cluster:
             ts = int(self.gtm.next_gts())
             for i in dns:
                 self.datanodes[i].commit(txid, ts)
+                self.note_dn_commit(i, ts)
             self.active_txns.discard(txid)
             self.replication_origin_txids.discard(txid)
             return ts
@@ -1087,6 +1173,7 @@ class Cluster:
                 fault_point("REMOTE_COMMIT_PARTIAL")
             try:
                 self.datanodes[i].commit(txid, ts)
+                self.note_dn_commit(i, ts)
             except (ConnectionError, OSError, EOFError):
                 undelivered.append(i)
         fault_point("BEFORE_GTM_FORGET")
@@ -1204,6 +1291,7 @@ class Cluster:
                         continue  # decommissioned node: nothing to deliver
                     try:
                         dn.commit(info["txid"], ts)
+                        self.note_dn_commit(getattr(dn, "index", -1), ts)
                         done.add((gid, name))
                     except (ConnectionError, OSError, EOFError,
                             RuntimeError):

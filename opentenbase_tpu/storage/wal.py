@@ -89,6 +89,19 @@ class Wal:
             yield pickle.loads(blob)
 
 
+def decode_frame(frame: bytes) -> Optional[dict]:
+    """One shipped frame (length+crc+blob) back to its record, or None
+    when torn/corrupt — the hot standby's incremental-apply decoder
+    (storage/replication.py HotStandby) shares the replay framing."""
+    if len(frame) < _HDR.size:
+        return None
+    length, crc = _HDR.unpack_from(frame)
+    blob = frame[_HDR.size:_HDR.size + length]
+    if len(blob) != length or zlib.crc32(blob) != crc:
+        return None
+    return pickle.loads(blob)
+
+
 def checkpoint_store(store, path: str):
     """Write one TableStore as an npz + dictionary sidecar.
 

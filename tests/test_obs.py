@@ -354,6 +354,19 @@ class TestClusterTier:
         assert "# TYPE otb_query_ms histogram" in text
         assert 'le="+Inf"' in text
 
+    def test_scheduler_pipeline_gauges_exposed(self, cluster_env):
+        # importing the scheduler registers its collector; the pipeline
+        # gauges must appear in the exposition even with no scheduler
+        # running (zeros), so dashboards never see a gap
+        import opentenbase_tpu.exec.scheduler  # noqa: F401
+        text = cluster_env.metrics_text()
+        for name in ("otb_sched_pipeline_overlap_ratio",
+                     "otb_sched_drain_queue_depth",
+                     "otb_sched_stage_work_ms",
+                     "otb_sched_pipelined_dispatches",
+                     "otb_sched_drained"):
+            assert name in text, name
+
 
 def test_cn_server_metrics_op():
     from opentenbase_tpu.net.cn_server import CnClient, CnServer

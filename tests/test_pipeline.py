@@ -1,0 +1,253 @@
+"""otbpipe: pipelined dispatch + standby read scale-out.
+
+Four layers:
+- the enable_pipeline GUC switches the scheduler between synchronous
+  and pipelined (drainer-thread) dispatch with BIT-identical results;
+- overlap accounting: pipelined dispatches record staging work and the
+  fraction hidden behind device compute, and the drain queue empties;
+- standby read routing: snapshot-covered point reads route to hot
+  standbys and match the primary exactly; a lagging standby is skipped
+  (fall through to primary, still correct) and re-enters rotation once
+  a checkpoint re-seed catches it up; a cold (non-hot) standby drops
+  out of rotation permanently;
+- the repo lock-order graph stays acyclic with the pipeline ON: this
+  file's scheduler tests re-run in a subprocess under OTB_LOCKCHECK=1
+  and must witness zero violations, every edge already in the static
+  graph (the drainer thread's lock footprint is part of the contract).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from opentenbase_tpu.exec import scheduler as sm
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.obs.metrics import REGISTRY
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    sm.reset_stats()
+    yield
+    sm.reset_stats()
+
+
+def _counter_sum(prefix: str) -> float:
+    """Sum every sample of a (labeled) counter family."""
+    total = 0.0
+    for line in REGISTRY.text().splitlines():
+        if line.startswith(prefix) and not line.startswith("#"):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _mk_node():
+    node = LocalNode()
+    s = Session(node)
+    s.execute("create table t (a bigint, b double precision, g bigint)")
+    s.execute("insert into t values " + ", ".join(
+        f"({i}, {i * 0.5}, {i % 3})" for i in range(200)))
+    s.execute("create table kv (k bigint, v bigint)")
+    s.execute("insert into kv values " + ", ".join(
+        f"({i}, {i * 7})" for i in range(50)))
+    return node
+
+
+AGG_Q = ("select g, sum(b) as sb, count(*) as c from t where a < {} "
+         "group by g order by g")
+
+
+def _run_concurrent(sched, node, sqls):
+    res = [None] * len(sqls)
+    errs = [None] * len(sqls)
+
+    def go(i):
+        try:
+            res[i] = sched.run(Session(node), sqls[i])[-1].rows
+        except Exception as e:   # noqa: BLE001 — re-raised below
+            errs[i] = e
+
+    threads = [threading.Thread(target=go, args=(i,))
+               for i in range(len(sqls))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for e in errs:
+        if e is not None:
+            raise e
+    return res
+
+
+class TestPipelineGuc:
+    def test_pipeline_on_off_bit_identical(self):
+        """The SAME workload through both dispatch paths returns
+        bit-identical rows — the GUC only moves the host sync, never
+        the math."""
+        node = _mk_node()
+        sqls = [AGG_Q.format(n) for n in (50, 80, 120, 199)] + \
+            [f"select v from kv where k = {i}" for i in (3, 11, 29)]
+        ref = [Session(node).execute(q)[-1].rows for q in sqls]
+
+        Session(node).execute("set enable_pipeline = off")
+        with sm.Scheduler(node=node, window_ms=150.0) as sched:
+            got_off = _run_concurrent(sched, node, sqls)
+        assert sm.stats_snapshot()["pipelined_dispatches"] == 0
+
+        sm.reset_stats()
+        Session(node).execute("set enable_pipeline = on")
+        with sm.Scheduler(node=node, window_ms=150.0) as sched:
+            got_on = _run_concurrent(sched, node, sqls)
+        assert sm.stats_snapshot()["pipelined_dispatches"] >= 1
+
+        assert got_off == ref
+        assert got_on == ref
+
+    def test_overlap_accounting_and_drain(self):
+        """Pipelined dispatches record staging work, every flight
+        drains, and the completion queue is empty after close."""
+        node = _mk_node()
+        sqls = [AGG_Q.format(n) for n in (40, 60, 90, 130, 160, 199)]
+        with sm.Scheduler(node=node, window_ms=30.0) as sched:
+            _run_concurrent(sched, node, sqls)
+        st = sm.stats_snapshot()
+        assert st["pipelined_dispatches"] >= 1
+        assert st["drained"] == st["pipelined_dispatches"]
+        assert st["stage_work_ms"] > 0
+        assert 0.0 <= st["pipeline_overlap_ratio"] <= 1.0
+        assert st["drain_queue_depth"] == 0
+
+    def test_slot_balance_across_drainer(self):
+        """The GTM slot handoff to the drainer never leaks: acquired ==
+        released after the scheduler closes."""
+        node = _mk_node()
+        sqls = [AGG_Q.format(n) for n in (50, 100, 150, 199)]
+        with sm.Scheduler(node=node, window_ms=60.0) as sched:
+            _run_concurrent(sched, node, sqls)
+        st = sm.stats_snapshot()
+        assert st["slots_acquired"] == st["slots_released"], st
+
+
+class TestStandbyReplicaReads:
+    def _cluster(self, tmp_path, n=2):
+        from opentenbase_tpu.exec.dist_session import ClusterSession
+        from opentenbase_tpu.parallel.cluster import Cluster
+        cl = Cluster(n_datanodes=n, datadir=str(tmp_path / "cl"))
+        s = ClusterSession(cl)
+        s.execute("create table t (k bigint primary key, v bigint)"
+                  " distribute by shard(k)")
+        s.execute("insert into t values " + ", ".join(
+            f"({i}, {i * 7})" for i in range(60)))
+        return s
+
+    def _attach_hot(self, cl, tmp_path):
+        from opentenbase_tpu.storage.replication import (DnStandbyServer,
+                                                         HotStandby)
+        servers = []
+        for i, dn in enumerate(cl.datanodes):
+            sb = HotStandby(str(tmp_path / f"standby{i}"), index=i)
+            srv = DnStandbyServer(sb).start()
+            dn.attach_standby(srv.host, srv.port)
+            cl.register_read_replica(i, srv.host, srv.port, sb.datadir)
+            servers.append(srv)
+        return servers
+
+    def test_routed_reads_match_primary(self, tmp_path):
+        s = self._cluster(tmp_path)
+        servers = self._attach_hot(s.cluster, tmp_path)
+        try:
+            keys = (3, 17, 42, 55)
+            ref = [s.query(f"select v from t where k = {k}")
+                   for k in keys]
+            s.execute("set replica_reads = on")
+            before = _counter_sum("otb_replica_reads_total")
+            got = [s.query(f"select v from t where k = {k}")
+                   for k in keys]
+            assert got == ref == [[(k * 7,)] for k in keys]
+            assert _counter_sum("otb_replica_reads_total") \
+                >= before + len(keys)
+        finally:
+            for srv in servers:
+                srv.stop()
+
+    def test_lagging_standby_skipped_then_reenters(self, tmp_path):
+        s = self._cluster(tmp_path)
+        cl = s.cluster
+        servers = self._attach_hot(cl, tmp_path)
+        try:
+            s.execute("set replica_reads = on")
+            assert s.query("select v from t where k = 7") == [(49,)]
+
+            # ---- lag: stop shipping, then commit more on the primary
+            saved = [(dn.wal._ship, dn.wal._sync_ship)
+                     for dn in cl.datanodes]
+            for dn in cl.datanodes:
+                dn.wal._ship = None
+            s.execute("insert into t values (100, 700)")
+            fall0 = _counter_sum("otb_replica_fallthrough_total")
+            # the stale replica must be SKIPPED, and the fall-through
+            # read on the primary must equal the primary's truth
+            assert s.query("select v from t where k = 100") == [(700,)]
+            assert _counter_sum("otb_replica_fallthrough_total") > fall0
+
+            # ---- catch up: resume shipping + checkpoint re-seed
+            for dn, (ship, sync) in zip(cl.datanodes, saved):
+                dn.wal._ship = ship
+                dn.wal._sync_ship = sync
+                dn.checkpoint(None)
+            routed0 = _counter_sum("otb_replica_reads_total")
+            assert s.query("select v from t where k = 100") == [(700,)]
+            assert _counter_sum("otb_replica_reads_total") > routed0
+        finally:
+            for srv in servers:
+                srv.stop()
+
+    def test_cold_standby_drops_out_of_rotation(self, tmp_path):
+        from opentenbase_tpu.storage.replication import (DnStandby,
+                                                         DnStandbyServer)
+        s = self._cluster(tmp_path)
+        cl = s.cluster
+        # a pre-otbpipe COLD standby (valid failover target, no read
+        # surface) registered as a read replica must silently drop out
+        sb = DnStandby(str(tmp_path / "cold0"))
+        srv = DnStandbyServer(sb).start()
+        try:
+            cl.datanodes[0].attach_standby(srv.host, srv.port)
+            cl.register_read_replica(0, srv.host, srv.port, sb.datadir)
+            s.execute("set replica_reads = on")
+            for k in (1, 9, 33):
+                assert s.query(f"select v from t where k = {k}") \
+                    == [(k * 7,)]
+            assert cl.read_router.replica_names(0) == []
+        finally:
+            srv.stop()
+
+
+class TestPipelineLockGraph:
+    def test_pipeline_shard_zero_violations(self, tmp_path):
+        """Re-run the pipelined-scheduler tests with the runtime lock
+        sanitizer on: the drainer thread's witnessed lock edges must
+        already be in the static graph, with zero inversions."""
+        report = str(tmp_path / "witnessed.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             "tests/test_pipeline.py::TestPipelineGuc",
+             "-q", "-p", "no:cacheprovider"],
+            cwd=_REPO, capture_output=True, text=True, timeout=420,
+            env={**_ENV, "OTB_LOCKCHECK": "1",
+                 "OTB_LOCKCHECK_REPORT": report,
+                 "OTB_SCHED_PIPELINE": "on"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        data = json.load(open(report))
+        assert data["violations"] == [], data["violations"]
+        from opentenbase_tpu.analysis.concurrency import lock_order_edges
+        static = set(lock_order_edges(_REPO))
+        witnessed = {tuple(e) for e in data["edges"]}
+        assert witnessed <= static, witnessed - static
