@@ -17,7 +17,9 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "ladder"}.
   device buffer pool serves every table resident) vs compute_ms, and
   report the pool hit rate + bytes staged on that repeat
   (storage/bufferpool.py — engine_ms stays the min-of-warm-runs number
-  comparable to earlier rounds)
+  comparable to earlier rounds), plus the compressed-residency block
+  (storage/codec.py): bytes_logical / bytes_resident /
+  effective_cache_ratio of the live pool
 - tpu_unavailable: true when the axon tunnel was down and the run fell
   back to CPU (the numbers are then NOT TPU measurements)
 
@@ -78,7 +80,13 @@ Modes via env:
   per-query GB/s of bytes touched (vs the uncapped in-memory run),
   chunk count, chunk_downshifts, bytes_streamed, bit_identical, and
   warm_programs_compiled (must be 0 — chunk count never reaches a
-  program key), plus the bufferpool pin ledger (must balance).
+  program key), plus the bufferpool pin ledger (must balance).  Each
+  query also reports compressed residency (bytes_logical /
+  bytes_resident / effective_cache_ratio; effective_cache_x =
+  min over queries, acceptance floor 2.5x) and a codec-off control
+  (OTB_CODEC=0: raw_ms, gb_per_s_raw, x_codec_off,
+  bit_identical_codec_off — encoded execution must match raw
+  byte-for-byte).
 """
 
 import json
@@ -231,12 +239,20 @@ def _oob_arm():
     out-of-core figure of merit vs gb_per_s_in_memory), chunk count,
     chunk_downshifts, bytes_streamed, bit_identical, and
     warm_programs_compiled (MUST be 0: chunk count/offsets never reach
-    a program key, so a warm stream recompiles nothing).  Knobs:
+    a program key, so a warm stream recompiles nothing).  Each query
+    also carries the compressed-residency block (storage/codec.py):
+    bytes_logical / bytes_resident / effective_cache_ratio of the live
+    pool after the streamed run, plus a codec-off control arm
+    (OTB_CODEC=0, raw residency, SAME streamed query) reporting
+    raw_ms / gb_per_s_raw / x_codec_off (the GB/s-touched delta the
+    codecs buy) and bit_identical_codec_off (encoded execution must
+    return byte-for-byte the raw arm's rows).  Knobs:
     BENCH_OOB_SF (default 10), BENCH_OOB_CAP_SF (default 1),
     BENCH_REPEAT (default 3) — smoke runs use e.g. BENCH_OOB_SF=0.2
     BENCH_OOB_CAP_SF=0.02."""
     from opentenbase_tpu.exec import morsel as morsel_mod
     from opentenbase_tpu.exec.session import LocalNode, Session
+    from opentenbase_tpu.storage import codec
     from opentenbase_tpu.storage.batch import size_class
     from opentenbase_tpu.storage.bufferpool import POOL
     from opentenbase_tpu.tpch import datagen
@@ -296,6 +312,33 @@ def _oob_arm():
         m1 = morsel_mod.stats_snapshot()
         eng = min(times)
         gb = _gb_touched(qn, data)
+        res = _residency_block()
+        pool_snap = POOL.totals()
+
+        # codec-off control: the SAME streamed query with OTB_CODEC=0
+        # (raw device residency) — encoded execution must be
+        # bit-identical, and the GB/s-touched delta is what compressed
+        # residency buys end to end under the same cap
+        codec_env = os.environ.get("OTB_CODEC")
+        os.environ["OTB_CODEC"] = "0"
+        codec.reset_state()
+        POOL.clear()
+        try:
+            got_raw = s.query(Q[qn])
+            raw_times = []
+            for _ in range(max(1, repeat // 2)):
+                t1 = time.perf_counter()
+                s.query(Q[qn])
+                raw_times.append(time.perf_counter() - t1)
+            eng_raw = min(raw_times)
+        finally:
+            if codec_env is None:
+                os.environ.pop("OTB_CODEC", None)
+            else:
+                os.environ["OTB_CODEC"] = codec_env
+            codec.reset_state()
+            POOL.clear()
+
         entry = {"config": f"Q{qn} oob SF{sf:g}",
                  "engine_ms": eng * 1e3, "cold_ms": cold * 1e3,
                  "in_memory_ms": eng_mem * 1e3,
@@ -309,13 +352,20 @@ def _oob_arm():
                  "bytes_streamed": m1["bytes_streamed"]
                  - m0["bytes_streamed"],
                  "bit_identical": _rows_close(got, ref),
-                 "warm_programs_compiled": c2[0] - c1[0]}
+                 "warm_programs_compiled": c2[0] - c1[0],
+                 **res,
+                 "raw_ms": eng_raw * 1e3,
+                 "gb_per_s_raw": gb / eng_raw,
+                 "x_codec_off": eng_raw / eng,
+                 "bit_identical_codec_off": got == got_raw}
         entry.update(_compile_counters(c0, c1))
         ladder.append(entry)
         s.execute("set morsel = off")
 
     head = ladder[0]
-    pool = POOL.totals()
+    # the codec-off control clears the pool; report the LAST encoded
+    # run's live-pool numbers, not the post-clear zeros
+    pool = pool_snap
     out = {
         "metric": f"out-of-core Q1 SF{sf:g} bytes-touched throughput "
                   f"(SF{cap_sf:g}-sized device cache, {platform})",
@@ -325,6 +375,10 @@ def _oob_arm():
                              / head["gb_per_s_in_memory"], 3)
         if head["gb_per_s_in_memory"] else 0.0,
         "device_cache_bytes": cap,
+        # compressed residency: the effective device-cache multiplier
+        # (min over Q1/Q3/Q5 — the acceptance floor is >= 2.5x)
+        "effective_cache_x": round(
+            min(e["effective_cache_ratio"] for e in ladder), 3),
         "ladder": [{k: (round(v, 3) if isinstance(v, float) else v)
                     for k, v in e.items()} for e in ladder],
         "pin_ledger": POOL.check_pin_ledger(),
@@ -820,6 +874,20 @@ def _compile_snapshot():
 def _compile_counters(c0, c1):
     return {"programs_compiled": c1[0] - c0[0],
             "compile_ms": round(c1[1] - c0[1], 3)}
+
+
+def _residency_block():
+    """Compressed-residency telemetry (storage/codec.py): what the
+    live pool entries would occupy UNENCODED (bytes_logical) vs the
+    actual post-encoding device bytes (bytes_resident) — their ratio
+    is the effective device-cache multiplier the codecs buy."""
+    from opentenbase_tpu.storage.bufferpool import POOL
+    t = POOL.totals()
+    res = t["bytes_live"]
+    return {"bytes_logical": t["bytes_logical"],
+            "bytes_resident": res,
+            "effective_cache_ratio": round(t["bytes_logical"] / res, 3)
+            if res else 0.0}
 
 
 def _save_data(data, path):
@@ -1361,6 +1429,7 @@ def main():
                      "gb_per_s": gb / eng,
                      "tier": s2.last_tier,
                      "phases": phases}
+            entry.update(_residency_block())
             entry.update(_mat_counters(x0, x1))
             entry.update(_compile_counters(c0, c1))
             if s2.last_tier != "mesh":
@@ -1447,7 +1516,8 @@ def main():
     from opentenbase_tpu.storage.bufferpool import POOL
     out["buffercache"] = [
         dict(zip(("table", "hits", "misses", "bytes_live", "evictions",
-                  "invalidations", "pinned", "pins", "unpins"), r))
+                  "invalidations", "pinned", "pins", "unpins",
+                  "bytes_logical", "bytes_resident"), r))
         for r in POOL.stats_rows()]
     if tpu_unavailable:
         out["tpu_unavailable"] = True

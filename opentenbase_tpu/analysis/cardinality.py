@@ -71,7 +71,14 @@ from .passes import ProgramKeyPass, _dotted, _Emitter, _fn_disabled
 
 #: functions that collapse an unbounded int into a bounded class
 _QUANT_FUNCS = frozenset({"size_class", "next_pow2", "_batch_class",
-                          "chunk_class"})
+                          "chunk_class", "lut_capacity", "codec_class",
+                          "codec_classes"})
+#: identifier tokens that smell like a raw encoding descriptor — an
+#: Enc's reference / LUT contents drift with appends, so only the
+#: quantized codec-class token (codec_class/codec_classes) may reach
+#: program-key material (storage/codec.py)
+_ENC_TOKENS = frozenset({"enc", "encs", "encm", "encoding", "encodings",
+                         "codec", "codecs"})
 #: call prefixes whose results have an unbounded / per-process domain
 _UNBOUNDED_PREFIXES = ("time.", "datetime.", "random.", "secrets.",
                        "uuid.", "numpy.random.")
@@ -84,7 +91,9 @@ _HASHABLE_CALLS = frozenset({"tuple", "frozenset", "struct_key",
                              "fingerprint", "hash", "id", "int", "str",
                              "float", "bool", "len", "min", "max",
                              "sum", "repr", "next_pow2", "size_class",
-                             "_batch_class", "chunk_class", "getattr"})
+                             "_batch_class", "chunk_class", "getattr",
+                             "lut_capacity", "codec_class",
+                             "codec_classes"})
 #: constructors of fresh per-call objects — id() of one is ephemeral
 _FRESH_CALLS = frozenset({"dict", "list", "set", "object", "bytearray"})
 
@@ -241,6 +250,11 @@ class ProgramCardinalityPass:
                 tgt = self._callee(_mi, _fi, n)
                 if tgt is None or (tgt.module, tgt.qualname) in seen_fns:
                     continue
+                if tgt.qualname.split(".")[-1] in _QUANT_FUNCS:
+                    # a quantizer's INTERNALS aren't key material — its
+                    # whole point is collapsing the raw domain before
+                    # the key sees it
+                    continue
                 seen_fns.add((tgt.module, tgt.qualname))
                 tmi = self.project.modules[tgt.module]
                 for ret in _return_exprs(tgt):
@@ -298,6 +312,19 @@ class ProgramCardinalityPass:
                         f"window under pressure, so quantize through "
                         f"chunk_class() or one stream mints one "
                         f"compiled program per chunk geometry")
+                return
+            elif isinstance(e, ast.Name) and \
+                    isinstance(e.ctx, ast.Load) and not in_quant and \
+                    any(t in _ENC_TOKENS
+                        for t in e.id.lower().split("_")):
+                em.emit(fi, e.lineno,
+                        f"raw encoding descriptor '{e.id}' in "
+                        f"program-key material — FOR references and "
+                        f"dictionary LUTs drift with appends, so key "
+                        f"on the quantized codec-class token "
+                        f"(codec_class()/codec_classes(); LUT shapes "
+                        f"through lut_capacity()) or every descriptor "
+                        f"drift mints a fresh compiled program")
                 return
             for c in ast.iter_child_nodes(e):
                 if isinstance(e, ast.Call) and c is e.func and \
@@ -704,6 +731,29 @@ def is_ladder_int(v) -> bool:
     return (v >> (bl - 3)) << (bl - 3) == v
 
 
+_CODEC_FAMS = frozenset({"pack8", "pack16", "pack32",
+                         "for8", "for16", "for32"})
+
+
+def _codec_class_ok(tok) -> bool:
+    """A witnessed codec class must be one of the quantized tokens
+    storage/codec.py codec_class() can mint — raw, a family+width from
+    the fixed enum, or dictN with a pow2 LUT capacity.  Anything else
+    in a "codec:" census dimension means a raw encoding descriptor
+    leaked into a program key."""
+    if not isinstance(tok, str):
+        return False
+    if tok == "raw" or tok in _CODEC_FAMS:
+        return True
+    if tok.startswith("dict"):
+        base, _, cap = tok.partition("/")
+        if base not in ("dict8", "dict16") or not cap.isdigit():
+            return False
+        c = int(cap)
+        return c >= 16 and (c & (c - 1)) == 0
+    return False
+
+
 def check_census(data) -> list:
     """Validate a program-census dict against the static ladder
     predictions; returns human-readable violation strings.  Shared by
@@ -724,7 +774,14 @@ def check_census(data) -> list:
                 out.append(f"{tier}/{kfp}: malformed class {cls!r}")
                 continue
             dim, v = cls
-            if not is_ladder_int(v):
+            if str(dim).startswith("codec:"):
+                if not _codec_class_ok(v):
+                    out.append(
+                        f"{tier}/{kfp}: witnessed codec class {v!r} "
+                        f"for {dim} is not a quantized codec-class "
+                        f"token — a raw encoding descriptor (FOR "
+                        f"reference / dict LUT) reached a program key")
+            elif not is_ladder_int(v):
                 out.append(
                     f"{tier}/{kfp}: witnessed {dim} class {v!r} is "
                     f"not ladder-shaped (pow2 or quarter-step) — an "

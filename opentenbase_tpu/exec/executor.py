@@ -34,6 +34,7 @@ from ..ops import kernels as K
 from ..plan import exprs as E
 from ..plan import physical as P
 from ..plan.planner import PlannedStmt, rewrite
+from ..storage import codec
 from ..storage.batch import next_pow2
 from ..storage.store import ABORTED_TS, TableStore
 from ..utils.dtypes import (bits_to_float, dev_dtype, device_float,
@@ -441,21 +442,37 @@ class Executor:
         if staged is not None:
             # fused/mesh path: traced program inputs; n may be a traced
             # per-shard scalar, so the static pad comes from the arrays
+            # (codec.padded_of skips __enc.* aux arrays — their shapes
+            # are (1,)/(cap,), not the padded row geometry)
             arrs, n = staged
-            padded_static = int(next(iter(arrs.values())).shape[0])
+            padded_static = codec.padded_of(arrs)
         else:
             arrs, n = self.ctx.cache.get(store, sorted(needed))
             # quarter-step size classes: the pad is whatever the cache
             # staged (size_class, not next_pow2) — read it off the
             # arrays, never recompute
-            padded_static = int(next(iter(arrs.values())).shape[0]) \
-                if arrs else None
+            padded_static = codec.padded_of(arrs) if arrs else None
+
+        # codec decode (storage/codec.py): staged columns may be
+        # encoded (pack/for/dict codes + traced aux arrays).  Decode is
+        # an elementwise map XLA fuses into the consumers, so payload
+        # columns never materialize decoded outside the final
+        # projection; predicates on encoded columns compare in code
+        # space below and skip even that.
+        encm = codec.enc_names(arrs)
+
+        def _dcol(name):
+            a = arrs[name]
+            k = encm.get(name)
+            if k is None:
+                return a
+            return K.decode_column(a, arrs[k], codec.family_of(k))
 
         qcols, types, dicts, qnulls = {}, {}, {}, {}
         for c in store.td.columns:
             qname = f"{alias}.{c.name}"
             if c.name in arrs:
-                qcols[qname] = arrs[c.name]
+                qcols[qname] = _dcol(c.name)
             if f"__null.{c.name}" in arrs:
                 qnulls[qname] = arrs[f"__null.{c.name}"]
             types[qname] = c.type
@@ -467,13 +484,67 @@ class Executor:
         base = DBatch(qcols, jnp.ones(padded, dtype=bool), types, dicts,
                       qnulls)
         vis = K.visibility_mask(
-            arrs["__xmin_ts"], arrs["__xmax_ts"], arrs["__xmin_txid"],
-            arrs["__xmax_txid"], jnp.int64(self.ctx.snapshot_ts),
+            _dcol("__xmin_ts"), _dcol("__xmax_ts"), _dcol("__xmin_txid"),
+            _dcol("__xmax_txid"), jnp.int64(self.ctx.snapshot_ts),
             jnp.int64(self.ctx.txid), jnp.int64(ABORTED_TS))
         vis = vis & (jnp.arange(padded) < n)
         for f in filters:
-            vis = vis & self._eval_pred(f, base)
+            m = self._pred_on_codes(f, arrs, encm, alias)
+            vis = vis & (m if m is not None
+                         else self._eval_pred(f, base))
         return store, base, vis, arrs, n, padded, outputs, dicts
+
+    def _pred_on_codes(self, f, arrs, encm: dict, alias: str):
+        """Predicate eval in code space: a bare `col <op> literal` over
+        an encoded, null-free column compares shifted codes against the
+        traced literal (ops/kernels.py cmp_on_codes) — no padding
+        select, no decode for filter-only columns.  Live rows compare
+        exactly (code = value - lo + 1 is order-preserving); padding
+        rows are masked by the scan's row-count belt.  Returns None
+        when the shape doesn't qualify and the 3VL path must run."""
+        if not encm or not isinstance(f, E.Cmp) \
+                or f.op not in ("=", "<>", "<", "<=", ">", ">="):
+            return None
+        lhs, rhs, op = f.left, f.right, f.op
+        if isinstance(rhs, E.Col) and isinstance(lhs, E.Lit):
+            lhs, rhs = rhs, lhs
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if not (isinstance(lhs, E.Col) and isinstance(rhs, E.Lit)):
+            return None
+        # storage-representation alignment (expr_compile.py Cmp): a
+        # DECIMAL column stores value * 10**scale, so an int / coarser-
+        # scale literal must rescale UP to the column's scale (exact);
+        # shapes the eval path handles by rescaling the COLUMN fall
+        # back to the 3VL path
+        lt, rt = lhs.type, rhs.type
+        ik = (TypeKind.INT32, TypeKind.INT64, TypeKind.DATE)
+        if lt.kind == TypeKind.DECIMAL:
+            rs = rt.scale if rt.kind == TypeKind.DECIMAL else 0
+            if (rt.kind != TypeKind.DECIMAL and rt.kind not in ik) \
+                    or rs > lt.scale:
+                return None
+            mult = 10 ** (lt.scale - rs)
+        elif lt.kind in ik and rt.kind in ik:
+            mult = 1
+        else:
+            return None
+        cname = lhs.name.split(".", 1)[1] if "." in lhs.name else lhs.name
+        k = encm.get(cname)
+        if k is None or f"__null.{cname}" in arrs:
+            return None
+        v = rhs.value
+        if v is None:
+            return None
+        vdt = getattr(v, "dtype", None)
+        if vdt is not None:
+            if not jnp.issubdtype(vdt, jnp.integer):
+                return None
+        elif not isinstance(v, (int, np.integer)):
+            return None
+        if mult != 1:
+            v = v * mult
+        return K.cmp_on_codes(arrs[cname], arrs[k], codec.family_of(k),
+                              op, v)
 
     def _exec_seqscan(self, node: P.SeqScan) -> DBatch:
         (_store, base, vis, _arrs, _n, _padded, outputs,

@@ -57,6 +57,7 @@ from ..plan import physical as P
 from ..plan.planner import rewrite as rewrite_expr
 from ..obs import trace as obs_trace
 from ..sql.fingerprint import struct_key
+from ..storage import codec
 from . import plancache
 from ..utils import locks
 
@@ -101,6 +102,22 @@ def _mask_refused_add(k):
         _MASK_REFUSED[k] = True
         while len(_MASK_REFUSED) > _MASK_REFUSED_MAX:
             _MASK_REFUSED.pop(next(iter(_MASK_REFUSED)))
+
+
+def _mask_key(base_key):
+    """Codec-free fingerprint of a fragment key.  The batching
+    signature and the _MASK_REFUSED ledger must be STABLE across the
+    staging boundary — codec classes are chosen at stage time, so a
+    signature read at classification (before the table ever staged)
+    would differ from the same fragment's post-stage signature,
+    splitting quarantine accounting and coalescing groups in two.
+    Mask refusal is a property of the plan structure + dtypes, not of
+    the encodings, so stripping the codec component loses nothing.
+    The PROGRAM keys keep the full _table_sig: encodings change traced
+    avals, and key and avals must agree."""
+    plan_key, tsig, baked_key, types_key, lit_types = base_key
+    return struct_key((plan_key, tuple(e[:3] for e in tsig),
+                       baked_key, types_key, lit_types))
 
 
 def _key_of_expr(e) -> tuple:
@@ -308,10 +325,15 @@ def _screen_fragment(ctx, node):
 
 def _table_sig(stores: dict) -> tuple:
     """Per-table signature components: store identity + TEXT dictionary
-    lengths (dictionaries are baked trace constants)."""
+    lengths (dictionaries are baked trace constants) + the staged codec
+    classes (storage/codec.py codec_classes — QUANTIZED family/width
+    tokens; an encoding change alters the traced avals, so it must be
+    key-visible).  Callers must stage before keying: codec_classes
+    reads what staging recorded, so key and avals always agree."""
     return tuple(
         (t, id(st), tuple(sorted((c, len(d.values))
-                                 for c, d in st.dicts.items())))
+                                 for c, d in st.dicts.items())),
+         codec.codec_classes(st))
         for t, st in sorted(stores.items()))
 
 
@@ -340,6 +362,24 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:  # otblint
     if key is None:
         return None
 
+    # stage ONCE outside the trace (device cache, version-keyed) and
+    # BEFORE computing the key: staging chooses/validates the codec
+    # descriptors whose quantized classes are part of _table_sig — a
+    # cold start must mint the same key the warm repeat will see, or
+    # the census sanitizer would count a phantom recompile.  A
+    # self-join's scans share one staged entry per table with the
+    # union of their needed columns.
+    need_by_table: dict = {}
+    for scan in scans:
+        need_by_table.setdefault(scan.table.name, set()).update(
+            _needed_columns(node, scan.alias))
+    staged_arrs: dict = {}
+    staged_ns: dict = {}
+    for t, need in sorted(need_by_table.items()):
+        arrs, n = ctx.cache.get(stores[t], sorted(need))
+        staged_arrs[t] = arrs
+        staged_ns[t] = jnp.int64(n)
+
     table_sig = _table_sig(stores)
     traced_names = tuple(sorted(
         k for k, (v, _t) in ctx.params.items()
@@ -358,7 +398,7 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:  # otblint
         hash(base_key)
     except TypeError:
         return None  # unhashable plan content (e.g. an unrewritten link)
-    if lits and struct_key(base_key) in _MASK_REFUSED:
+    if lits and _mask_key(base_key) in _MASK_REFUSED:
         return _try_fused(executor, node, allow_mask=False)
 
     has_join = _plan_has_join(exec_node_plan)
@@ -369,20 +409,6 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:  # otblint
         # costs microseconds while a fresh XLA compile costs seconds —
         # fusing only pays above a row floor (0 = always fuse)
         return None
-
-    # stage ONCE outside the trace (device cache, version-keyed); a
-    # self-join's scans share one staged entry per table with the union
-    # of their needed columns
-    need_by_table: dict = {}
-    for scan in scans:
-        need_by_table.setdefault(scan.table.name, set()).update(
-            _needed_columns(node, scan.alias))
-    staged_arrs: dict = {}
-    staged_ns: dict = {}
-    for t, need in sorted(need_by_table.items()):
-        arrs, n = ctx.cache.get(stores[t], sorted(need))
-        staged_arrs[t] = arrs
-        staged_ns[t] = jnp.int64(n)
 
     lkey = struct_key(base_key)
     factors: dict = dict(_JOIN_LADDER.get(lkey, {})) if has_join else {}
@@ -424,7 +450,7 @@ def _try_fused(executor, node, allow_mask: bool) -> Optional[object]:  # otblint
                     # a MASKED literal fed a host-sync (value-dependent
                     # program structure): remember and retry with
                     # literals baked
-                    _mask_refused_add(struct_key(base_key))
+                    _mask_refused_add(_mask_key(base_key))
                     plancache.FUSED.pop(full_key)
                     return _try_fused(executor, node, allow_mask=False)
                 # a host-sync slipped through the fusability screen:
@@ -594,8 +620,9 @@ def batch_signature(ctx, node) -> Optional[FragSig]:
         hash(base_key)
     except TypeError:
         return None
+    sig = _mask_key(base_key)   # stable pre/post staging (codec-free)
     with _STATE_LOCK:
-        refused = struct_key(base_key) in _MASK_REFUSED
+        refused = sig in _MASK_REFUSED
     if refused:
         return None  # masked trace host-synced before: literals bake
 
@@ -608,7 +635,7 @@ def batch_signature(ctx, node) -> Optional[FragSig]:
     for scan in scans:
         need_by_table.setdefault(scan.table.name, set()).update(
             _needed_columns(node, scan.alias))
-    return FragSig(sig=struct_key(base_key), plan=masked, lits=lits,
+    return FragSig(sig=sig, plan=masked, lits=lits,
                    stores=stores, cache=ctx.cache,
                    need_by_table=need_by_table, has_join=has_join,
                    plan_key=plan_key, lit_types=lit_types)
@@ -672,7 +699,7 @@ class FragmentProgram:
             hash(base_key)
         except TypeError:
             return False
-        if lits and struct_key(base_key) in _MASK_REFUSED:
+        if lits and _mask_key(base_key) in _MASK_REFUSED:
             return self._prepare(allow_mask=False)
         self.exec_plan = exec_plan
         self.lits = lits
@@ -811,6 +838,16 @@ def stage_fused_batch(info: FragSig, queries: list) \
 
     if not queries:
         return None
+    # stage ONCE for the whole batch (device cache, version-keyed) —
+    # BEFORE the key: staging chooses/validates the codec descriptors
+    # whose quantized classes ride _table_sig (serial-path property)
+    staged_arrs: dict = {}
+    staged_ns: dict = {}
+    for t, need in sorted(info.need_by_table.items()):
+        arrs, n = info.cache.get(info.stores[t], sorted(need))
+        staged_arrs[t] = arrs
+        staged_ns[t] = jnp.int64(n)
+
     # recompute the table signature at dispatch time: DML between
     # classification and dispatch can grow a TEXT dictionary, and the
     # dictionaries are baked trace constants — the key must match what
@@ -818,7 +855,7 @@ def stage_fused_batch(info: FragSig, queries: list) \
     base_key = (info.plan_key, _table_sig(info.stores), (), (),
                 info.lit_types)
     with _STATE_LOCK:
-        refused = struct_key(base_key) in _MASK_REFUSED
+        refused = _mask_key(base_key) in _MASK_REFUSED
     if refused:
         return None
 
@@ -834,14 +871,8 @@ def stage_fused_batch(info: FragSig, queries: list) \
     sb.pvals = tuple(
         jnp.stack([jnp.asarray(q[2][i]) for q in padded])
         for i in range(len(info.lits)))
-
-    # stage ONCE for the whole batch (device cache, version-keyed)
-    sb.staged_arrs = {}
-    sb.staged_ns = {}
-    for t, need in sorted(info.need_by_table.items()):
-        arrs, n = info.cache.get(info.stores[t], sorted(need))
-        sb.staged_arrs[t] = arrs
-        sb.staged_ns[t] = jnp.int64(n)
+    sb.staged_arrs = staged_arrs
+    sb.staged_ns = staged_ns
 
     with _STATE_LOCK:
         sb.factors = dict(_JOIN_LADDER.get(sb.lkey, {})) \

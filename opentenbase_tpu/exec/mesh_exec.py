@@ -60,6 +60,7 @@ from ..obs import trace as obs_trace
 from ..plan import exprs as E
 from ..plan import physical as P
 from ..plan.distribute import BatchSource, DistPlan, ExchangeRef
+from ..storage import codec
 from ..storage.batch import next_pow2
 from ..utils.dtypes import dev_dtype
 from ..utils.hashing import (combine_jax, hash_string, splitmix64_jax)
@@ -360,29 +361,74 @@ class MeshRunner:
             per_dn.append(cols)
 
         from ..storage.batch import size_class
+        from ..utils.dtypes import stage_cast
         padded = size_class(max(max(counts), 1))
         sh = NamedSharding(self.mesh, PS(self.axis))
+
+        # codec: ONE global descriptor per eligible column, proven
+        # against every shard's values at once (storage/codec.py) —
+        # codes stay comparable across the mesh, like the TEXT union
+        # dictionary.  TEXT code columns stay raw here: mesh union
+        # codes live in a different value space than the per-store
+        # codes the single-device ladder entry was proven on.
+        text_names = {c.name for c in td.columns
+                      if c.type.kind == TypeKind.TEXT}
+        encs: dict = {}
+        enc_aux: dict = {}
+        shard_codes: dict = {}
+        for colname in per_dn[0]:
+            if colname in text_names:
+                continue
+            parts = [stage_cast(np.asarray(per_dn[si][colname]))
+                     for si in range(ndn)]
+            r = codec.encode_staged(name, colname,
+                                    np.concatenate(parts)
+                                    if ndn > 1 else parts[0])
+            if r is None:
+                continue
+            codes, enc, aux = r
+            encs[colname] = enc
+            enc_aux[colname] = aux
+            offs = np.cumsum([0] + [len(p) for p in parts])
+            shard_codes[colname] = [codes[offs[i]:offs[i + 1]]
+                                    for i in range(ndn)]
+
         arrs = {}
         nbytes = 0
-        from ..utils.dtypes import stage_cast
         for colname, sample in per_dn[0].items():
             sample = stage_cast(sample)
-            buf = np.zeros((ndn, padded, *sample.shape[1:]),
-                           dtype=sample.dtype)
-            for si in range(ndn):
-                a = per_dn[si][colname]
-                buf[si, :len(a)] = a
+            enc = encs.get(colname)
+            if enc is not None:
+                buf = np.zeros((ndn, padded), dtype=enc.code_dtype)
+                for si in range(ndn):
+                    a = shard_codes[colname][si]
+                    buf[si, :len(a)] = a
+            else:
+                buf = np.zeros((ndn, padded, *sample.shape[1:]),
+                               dtype=sample.dtype)
+                for si in range(ndn):
+                    a = per_dn[si][colname]
+                    buf[si, :len(a)] = a
             arrs[colname] = jax.device_put(
-                buf.reshape(ndn * padded, *sample.shape[1:]), sh)
+                buf.reshape(ndn * padded, *buf.shape[2:]), sh)
             nbytes += buf.nbytes
+        for colname, enc in encs.items():
+            # aux arrays replicate per shard: a (ndn, len) tile sharded
+            # on the mesh axis hands every shard its own (len,) copy
+            aux = enc_aux[colname]
+            rep = np.tile(aux, (ndn, 1))
+            arrs[codec.aux_name(colname, enc)] = jax.device_put(
+                rep.reshape(ndn * aux.shape[0]), sh)
+            nbytes += rep.nbytes
         nrows = jax.device_put(np.asarray(counts, np.int64), sh)
-        staged = _StagedTable(arrs, nrows, padded,
-                              _MeshStoreView(td, union_dicts,
-                                             null_columns), vkey)
+        view = _MeshStoreView(td, union_dicts, null_columns)
+        codec.note_staged(view, encs)
+        staged = _StagedTable(arrs, nrows, padded, view, vkey)
         POOL.note_upload(nbytes)
         POOL.mesh_put(self, name, MeshEntry(
             name, vkey, staged, list(counts), dict_state,
-            set(null_columns), nbytes))
+            set(null_columns), nbytes, encs=encs,
+            bytes_logical=codec.logical_nbytes(arrs)))
         return staged
 
     def _stage_incremental(self, name: str, ent, vkey: tuple):
@@ -447,6 +493,21 @@ class MeshRunner:
                 if len(tc[c.name]):
                     tc[c.name] = state["luts"][i][tc[c.name]]
 
+        # encoded columns: every tail must fit the entry's resident
+        # descriptor (the prefix codes can't be rewritten in place).
+        # Encode BEFORE any device work — a misfit, or a ladder that
+        # moved past this entry, falls back to a full restage.
+        for colname, enc in ent.encs.items():
+            for i in range(ndn):
+                if new_counts[i] <= ent.counts[i]:
+                    continue
+                codes = codec.encode_tail(
+                    name, colname, enc,
+                    stage_cast(np.asarray(tails[i][colname])))
+                if codes is None:
+                    return None
+                tails[i][colname] = codes
+
         new_null = set(ent.null_columns)
         for st in stores:
             new_null |= set(st.null_columns)
@@ -462,7 +523,11 @@ class MeshRunner:
                 t = np.zeros(length, bool)
             return stage_cast(t)
 
+        aux_cols = codec.enc_names(ent.staged.arrs)
+        aux_keys = set(aux_cols.values())
         for colname, devarr in ent.staged.arrs.items():
+            if colname in aux_keys:
+                continue
             new = devarr
             for i in range(ndn):
                 lo, hi = ent.counts[i], new_counts[i]
@@ -472,6 +537,20 @@ class MeshRunner:
                 new = new.at[i * P + lo:i * P + hi].set(jnp.asarray(t))
                 up += t.nbytes
             arrs[colname] = jax.device_put(new, sh)
+        for colname, akey in aux_cols.items():
+            enc = ent.encs[colname]
+            if enc.family != "dict":
+                arrs[akey] = ent.staged.arrs[akey]
+                continue
+            # dictionary tails may have extended the append-only LUT
+            # in place: re-upload the fresh replicated copy (same pow2
+            # capacity, so no program class changes)
+            ah = codec.aux_host(name, colname, enc)
+            if ah is None:
+                return None
+            arrs[akey] = jax.device_put(
+                np.tile(ah, (ndn, 1)).reshape(ndn * ah.shape[0]), sh)
+            up += ah.nbytes * ndn
         for c in sorted(new_null - ent.null_columns):
             # first NULLs arrived in a tail: the prefix mask is zeros
             buf = jnp.zeros(ndn * P, bool)
@@ -488,7 +567,9 @@ class MeshRunner:
         nbytes = sum(int(a.nbytes) for a in arrs.values())
         POOL.note_upload(up, tail_rows=tail_total)
         return MeshEntry(name, vkey, staged, list(new_counts),
-                         ent.dict_state, new_null, nbytes)
+                         ent.dict_state, new_null, nbytes,
+                         encs=ent.encs,
+                         bytes_logical=codec.logical_nbytes(arrs))
 
     # ------------------------------------------------------------------
     # exchange collectives (inside the traced program)
@@ -757,7 +838,8 @@ class MeshRunner:
                        getattr(ex, "limit", None))
                       for ex in dp.exchanges),
                 tuple((t, staged[t].padded,
-                       tuple(sorted(staged[t].arrs)))
+                       tuple(sorted(staged[t].arrs)),
+                       codec.codec_classes(staged[t].view))
                       for t in table_names),
             ))
         except TypeError:
@@ -897,7 +979,11 @@ class MeshRunner:
                    # the staged-array namespace: a null column appearing
                    # after DML adds a __null input, which must recompile
                    # (the flat-arg list and in_specs grow with it)
-                   tuple(sorted(staged[t].arrs)))
+                   tuple(sorted(staged[t].arrs)),
+                   # quantized codec classes (storage/codec.py): an enc
+                   # family/width/LUT-capacity change alters aux avals,
+                   # so the class token must be key-visible
+                   codec.codec_classes(staged[t].view))
                   for t in table_names),
             tuple(sorted(factors.items())),
             tuple(sorted(mults.items())),

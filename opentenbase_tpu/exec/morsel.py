@@ -57,6 +57,7 @@ from ..obs import trace as obs_trace
 from ..plan import exprs as E
 from ..plan import physical as P
 from ..plan.distribute import BatchSource
+from ..storage import codec
 from ..storage.batch import chunk_class, size_class
 from ..utils import locks
 from .spill import (_walk_nodes, _clone_replacing, _needed_cols,
@@ -395,6 +396,12 @@ class MorselDriver:
                         | _needed_cols(shape.per_plan,
                                        big.node.table.name))
         host = staged_host_columns(big.store, needed)
+        # codec descriptors for the streamed table, ensured against the
+        # FULL host columns BEFORE the fragment program is built: every
+        # window provably fits one descriptor (no mid-stream class
+        # fork) and FragmentProgram's _table_sig sees the classes the
+        # chunks will actually carry
+        encs = codec.ensure_classes(big.store, host)
 
         # resident sides: staged whole through the device cache, PINNED
         # for the stream's lifetime — per-chunk pressure relief must
@@ -424,7 +431,8 @@ class MorselDriver:
             floor = min_chunk_rows()
             outs = []
             lo = 0
-            nxt = POOL.get_chunk(big.store, host, 0, self.chunk_rows)
+            nxt = POOL.get_chunk(big.store, host, 0, self.chunk_rows,
+                                 encs)
             with obs_trace.span("execute", tier="morsel") \
                     if obs_trace.ENABLED else obs_trace.NULL_SPAN:
                 while lo < big.rows:
@@ -434,7 +442,7 @@ class MorselDriver:
                         # prefetch: the NEXT window's device_put
                         # enqueues before this window's output blocks
                         nxt = POOL.get_chunk(big.store, host, hi,
-                                             self.chunk_rows)
+                                             self.chunk_rows, encs)
                     staged_arrs = dict(resident_arrs)
                     staged_arrs[bname] = entry.arrs
                     staged_ns = dict(resident_ns)
@@ -471,7 +479,7 @@ class MorselDriver:
                             if not prog.ok():
                                 return None
                             nxt = POOL.get_chunk(big.store, host, lo,
-                                                 self.chunk_rows)
+                                                 self.chunk_rows, encs)
                             continue
                         raise
                     self.chunks += 1

@@ -318,15 +318,31 @@ def _census_classes(tier: str, key):
             else:
                 tail.append(part)
         # table_sig (key[1]) carries store id()s and per-snapshot dict
-        # sizes — execution environment, not fragment identity
+        # sizes — execution environment, not fragment identity.  The
+        # codec classes riding in it ARE witness material though: pull
+        # them out first so the gate can hold encoding drift to the
+        # quantized token enum (codec ladder promotions must mint
+        # class-shaped keys, never raw-descriptor keys).
+        for el in key[1]:
+            if isinstance(el, tuple) and len(el) >= 4 \
+                    and isinstance(el[3], tuple):
+                for it in el[3]:
+                    if isinstance(it, tuple) and len(it) == 2:
+                        classes.append(
+                            (f"codec:{el[0]}.{it[0]}", it[1]))
         frag = (key[0], "*", key[2], key[3], key[4]) + tuple(tail)
         return classes, frag
     if tier == "mesh" and isinstance(key, tuple) and len(key) == 9:
         # (runner_id, frags, exchanges, tables, factors, mults,
         #  gathers, baked, traced-types) — see mesh_exec.prog_key
         classes, tabs = [], []
-        for el in key[3]:     # (table, padded, dicts, arrs)
+        for el in key[3]:     # (table, padded, dicts, arrs, codecs)
             classes.append((f"pad:{el[0]}", el[1]))
+            if len(el) >= 5 and isinstance(el[4], tuple):
+                for it in el[4]:
+                    if isinstance(it, tuple) and len(it) == 2:
+                        classes.append(
+                            (f"codec:{el[0]}.{it[0]}", it[1]))
             tabs.append((el[0], "*", el[2], el[3]))
         for label, part in (("factor", key[4]), ("mult", key[5]),
                             ("gather", key[6])):

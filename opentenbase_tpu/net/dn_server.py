@@ -75,6 +75,9 @@ class DnServer:
                                 # collectors:
                                 # may-acquire: exec.plancache._LOCK
                                 # may-acquire: obs.metrics.Registry._lock
+                                # staging under this lock also chooses/
+                                # validates codec descriptors:
+                                # may-acquire: storage.codec._STATE_LOCK
                                 resp = {"ok": _dispatch(node, msg)}
                     except Exception as e:
                         resp = {"error": f"{type(e).__name__}: {e}",

@@ -46,6 +46,56 @@ def visibility_mask(xmin_ts, xmax_ts, xmin_txid, xmax_txid,
 
 
 # ---------------------------------------------------------------------------
+# codec decode (storage/codec.py): encoded staged column -> original
+# values.  Elementwise affine map / LUT gather — XLA fuses it into the
+# consuming kernel, so a decoded column never materializes unless the
+# final projection needs it.
+# ---------------------------------------------------------------------------
+
+def decode_column(codes, aux, family: str):
+    """Decode one encoded staged column.  `aux` carries the original
+    dtype (pack marker / FOR reference lo-1 / dict LUT); code 0 is the
+    padding sentinel for the for/dict families so zero-padded rows
+    decode to exactly 0 — visibility_mask depends on padded __xmax_ts
+    staying 0."""
+    if family == "pack":
+        return codes.astype(aux.dtype)
+    if family == "for":
+        v = codes.astype(aux.dtype) + aux[0]
+        return jnp.where(codes == 0, jnp.zeros((), aux.dtype), v)
+    return jnp.take(aux, codes.astype(jnp.int32))
+
+
+def cmp_on_codes(codes, aux, family: str, op: str, lit):
+    """Predicate eval on encoded values without the padding select:
+    live rows carry code >= 1 (for) or the exact value (pack), so
+    comparing the shifted codes against the traced literal equals
+    comparing decoded values — padding rows are masked by the scan's
+    row-count belt anyway.  Returns None when the family has no
+    code-space compare (dict ranges)."""
+    if family == "pack":
+        lhs = codes.astype(aux.dtype)
+    elif family == "for":
+        lhs = codes.astype(aux.dtype) + aux[0]
+    else:
+        lhs = jnp.take(aux, codes.astype(jnp.int32))
+    rhs = jnp.asarray(lit, aux.dtype)
+    if op == "=":
+        return lhs == rhs
+    if op == "<>":
+        return lhs != rhs
+    if op == "<":
+        return lhs < rhs
+    if op == "<=":
+        return lhs <= rhs
+    if op == ">":
+        return lhs > rhs
+    if op == ">=":
+        return lhs >= rhs
+    return None
+
+
+# ---------------------------------------------------------------------------
 # compaction: gather selected rows to the front of a padded buffer
 # ---------------------------------------------------------------------------
 

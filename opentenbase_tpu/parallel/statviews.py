@@ -91,7 +91,13 @@ STAT_TABLES = {
         ColumnDef("evictions", T.INT64),
         ColumnDef("invalidations", T.INT64),
         ColumnDef("pinned", T.INT64), ColumnDef("pins", T.INT64),
-        ColumnDef("unpins", T.INT64)],
+        ColumnDef("unpins", T.INT64),
+        # compressed residency (storage/codec.py): bytes_logical is
+        # what the resident arrays would occupy UNENCODED; the ratio
+        # bytes_logical / bytes_resident is the effective-cache
+        # multiplier the codecs bought
+        ColumnDef("bytes_logical", T.INT64),
+        ColumnDef("bytes_resident", T.INT64)],
     # out-of-core streaming telemetry (exec/morsel.py): chunk windows
     # executed, bytes streamed through the pinned chunk cache, and
     # OOM-driven chunk-size downshifts — the observable record of

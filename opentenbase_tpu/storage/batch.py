@@ -145,6 +145,18 @@ def chunk_class(n: int, floor: int = 4096) -> int:
     return p
 
 
+def lut_capacity(n: int, floor: int = 16) -> int:
+    """Dictionary-LUT capacity quantizer (storage/codec.py): pow2 with
+    a floor, so an append-only integer dictionary keeps ONE aux-array
+    shape — and therefore one compiled-program class — until it
+    doubles.  The codec analog of chunk_class: capacity is aval- and
+    key-visible, so it must come from a quantized family."""
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
+
+
 def stage_padded(host_cols, sel):
     """Host column slices -> pow2-padded device arrays for one pass.
     `sel` is a slice (row-range slab), an int index array (hash

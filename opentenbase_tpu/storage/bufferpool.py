@@ -54,6 +54,7 @@ import numpy as np
 
 from ..obs import trace as obs_trace
 from ..utils import locks
+from . import codec
 
 _LOCK = locks.RLock("storage.bufferpool._LOCK")
 _SEQ = itertools.count()
@@ -88,9 +89,12 @@ class DevEntry:
     arrs: dict            # staged name -> device array [padded, ...]
     n: int                # live (staged) row count
     null_at_cache: set    # store.null_columns when staged
-    nbytes: int
+    nbytes: int           # actual device bytes (post-encoding)
     pins: int = 0         # refcount: >0 bars eviction (resident build
     # side of a streaming join, exec/morsel.py); guarded_by: _LOCK
+    encs: dict = dataclasses.field(default_factory=dict)
+    # staged name -> storage/codec.Enc for encoded columns (tail path)
+    bytes_logical: int = 0  # unencoded bytes these arrays represent
 
 
 @dataclasses.dataclass
@@ -107,8 +111,9 @@ class ChunkEntry:
     chunk_rows: int       # padded window shape (chunk_class-quantized)
     live: int             # real rows in [start, start+live)
     arrs: dict            # staged name -> device array [chunk_rows,...]
-    nbytes: int
+    nbytes: int           # actual device bytes (post-encoding)
     pins: int = 0         # guarded_by: _LOCK
+    bytes_logical: int = 0  # unencoded bytes this window represents
 
 
 @dataclasses.dataclass
@@ -120,7 +125,10 @@ class MeshEntry:
     counts: list          # per-DN live row counts
     dict_state: dict      # TEXT col -> {"index", "luts", "dn_lens"}
     null_columns: set     # union null-column set at staging time
-    nbytes: int
+    nbytes: int           # actual device bytes (post-encoding)
+    encs: dict = dataclasses.field(default_factory=dict)
+    # staged name -> storage/codec.Enc (incremental tail path)
+    bytes_logical: int = 0  # unencoded bytes these shards represent
 
 
 class DeviceBufferPool:
@@ -212,21 +220,32 @@ class DeviceBufferPool:
 
     def stats_rows(self) -> list[tuple]:
         """(table, hits, misses, bytes_live, evictions, invalidations,
-        pinned, pins, unpins) rows for the otb_buffercache view (system
-        otb_ tables omitted).  `pinned` is the live pinned-entry count;
-        pins/unpins are the cumulative refcount ledger — columns append
-        so positional consumers of the original six stay valid."""
+        pinned, pins, unpins, bytes_logical, bytes_resident) rows for
+        the otb_buffercache view (system otb_ tables omitted).
+        `pinned` is the live pinned-entry count; pins/unpins are the
+        cumulative refcount ledger; bytes_logical is what the resident
+        entries would occupy UNENCODED vs bytes_resident, the actual
+        post-encoding device bytes (== bytes_live) — their ratio is the
+        effective-cache multiplier the codecs buy.  Columns append so
+        positional consumers of the original six stay valid."""
         with _LOCK:
             live: dict[str, int] = {}
+            logical: dict[str, int] = {}
             pinned: dict[str, int] = {}
-            for _s, e in self._dev.values():
+
+            def acct(e):
                 live[e.table] = live.get(e.table, 0) + e.nbytes
+                logical[e.table] = logical.get(e.table, 0) \
+                    + (e.bytes_logical or e.nbytes)
+
+            for _s, e in self._dev.values():
+                acct(e)
                 if e.pins > 0:
                     pinned[e.table] = pinned.get(e.table, 0) + 1
             for _s, e in self._mesh.values():
-                live[e.table] = live.get(e.table, 0) + e.nbytes
+                acct(e)
             for _s, e in self._chunks.values():
-                live[e.table] = live.get(e.table, 0) + e.nbytes
+                acct(e)
                 if e.pins > 0:
                     pinned[e.table] = pinned.get(e.table, 0) + 1
             rows = []
@@ -236,7 +255,8 @@ class DeviceBufferPool:
                 h, m, ev, inv, pi, up = self._tstats(t) \
                     if t in self._stats else (0, 0, 0, 0, 0, 0)
                 rows.append((t, h, m, live.get(t, 0), ev, inv,
-                             pinned.get(t, 0), pi, up))
+                             pinned.get(t, 0), pi, up,
+                             logical.get(t, 0), live.get(t, 0)))
             return rows
 
     def totals(self) -> dict:
@@ -250,6 +270,10 @@ class DeviceBufferPool:
                                   self._dev.values())
                 + sum(e.nbytes for _s, e in self._mesh.values())
                 + sum(e.nbytes for _s, e in self._chunks.values()),
+                "bytes_logical": sum(
+                    (e.bytes_logical or e.nbytes)
+                    for tier in (self._dev, self._mesh, self._chunks)
+                    for _s, e in tier.values()),
                 "uploaded_bytes": self.uploaded_bytes,
                 "tail_rows": self.tail_rows,
                 "pins": self._pins_total,
@@ -437,28 +461,42 @@ class DeviceBufferPool:
         # last put wins — same policy as the compiled-program caches)
         stage_span = obs_trace.span("stage", table=table, tier="single")
         with stage_span:
+            done = False
             if e is not None and e.version == ver:
                 # same version, new columns: keep the resident buffers,
-                # stage only what is missing
-                padded = int(next(iter(e.arrs.values())).shape[0])
-                add, up = self._stage_columns(store, want - set(e.arrs),
-                                              e.n, padded)
+                # stage only what is missing (padded_of skips __enc.*
+                # aux arrays — their shapes aren't the row geometry)
+                padded = codec.padded_of(e.arrs)
+                add, up, aencs = self._stage_columns(
+                    store, want - set(e.arrs), e.n, padded)
                 arrs = dict(e.arrs)
                 arrs.update(add)
+                encs = dict(e.encs)
+                encs.update(aencs)
                 n, tail = e.n, 0
+                done = True
             elif e is not None \
                     and store.appended_only_since(e.version, e.n):
-                arrs, n, up, tail = self._tail_stage(store, e, want)
-            else:
+                r = self._tail_stage(store, e, want)
+                if r is not None:
+                    arrs, n, up, tail, encs = r
+                    done = True
+            if not done:
+                # full (re)stage — also the fallback when an encoded
+                # column's tail drifted out of its proven range and the
+                # descriptor must re-choose (key-visible, like join-
+                # ladder growth)
                 from .batch import size_class
                 n = store.row_count()
                 padded = size_class(max(n, 1))
-                arrs, up = self._stage_columns(store, want, n, padded)
+                arrs, up, encs = self._stage_columns(store, want, n,
+                                                     padded)
                 tail = 0
         stage_span.set(rows=n, tail_rows=tail)
         if up:
             obs_trace.event("upload", table=table, bytes=int(up))
         nbytes = sum(int(a.nbytes) for a in arrs.values())
+        codec.note_staged(store, encs)
         with _LOCK:
             st = self._tstats(table)
             st[1] += 1
@@ -467,7 +505,8 @@ class DeviceBufferPool:
             self.uploaded_bytes += up
             self.tail_rows += tail
             self._dev[id(store)] = [next(_SEQ), DevEntry(
-                table, ver, arrs, n, set(store.null_columns), nbytes)]
+                table, ver, arrs, n, set(store.null_columns), nbytes,
+                encs=encs, bytes_logical=codec.logical_nbytes(arrs))]
             self._watch_store(store)
         self.trim()
         return arrs, n
@@ -475,36 +514,59 @@ class DeviceBufferPool:
     def _stage_columns(self, store, names, n: int, padded: int):
         """Full staging of rows [0:n] for the given staged-namespace
         names (value columns / __xmin_ts... / __null.c) into padded
-        device arrays.  Returns (arrs, bytes_uploaded)."""
+        device arrays.  Eligible integer columns stage ENCODED
+        (storage/codec.py): the device buffer holds the narrow codes
+        and the column's aux array (__enc.*) rides along as a traced
+        input.  Returns (arrs, bytes_uploaded, encs)."""
         import jax
 
         from ..utils.dtypes import stage_cast
+        table = store.td.name
         plain = sorted({nm for nm in names if not nm.startswith("__")}
                        | {nm[len(_NULL):] for nm in names
                           if nm.startswith(_NULL)})
         host = store.host_live_columns(plain)
         arrs = {}
+        encs = {}
         up = 0
         for name in names:
             h = stage_cast(host[name])
-            buf = np.zeros((padded, *h.shape[1:]), dtype=h.dtype)
-            buf[:n] = h[:n]
-            arrs[name] = jax.device_put(buf)
-            up += buf.nbytes
-        return arrs, up
+            r = codec.encode_staged(table, name, h[:n])
+            if r is not None:
+                code, enc, aux = r
+                encs[name] = enc
+                buf = np.zeros(padded, dtype=code.dtype)
+                buf[:n] = code
+                arrs[name] = jax.device_put(buf)
+                arrs[codec.aux_name(name, enc)] = jax.device_put(aux)
+                up += buf.nbytes + aux.nbytes
+            else:
+                buf = np.zeros((padded, *h.shape[1:]), dtype=h.dtype)
+                buf[:n] = h[:n]
+                arrs[name] = jax.device_put(buf)
+                up += buf.nbytes
+        return arrs, up, encs
 
     def _tail_stage(self, store, e: DevEntry, want):
         """Append-only growth: keep the device prefix, upload only rows
         [e.n:n].  Columns never staged before (or null masks that
         already had prefix NULLs) stage in full; masks whose first NULL
-        arrived in the tail get a zeros prefix for free."""
+        arrived in the tail get a zeros prefix for free.  Encoded
+        columns encode the tail under the entry's EXISTING descriptor
+        (resident codes stay valid); a tail outside the proven range
+        returns None and the caller takes the full-restage path.
+        Dictionary tails may extend the append-only LUT — the aux
+        array re-uploads (tiny), the resident codes don't move."""
+        import jax
         import jax.numpy as jnp
 
         from ..utils.dtypes import stage_cast
         from .batch import size_class
+        table = store.td.name
         n = store.row_count()
         padded = size_class(max(n, 1))
-        all_names = set(e.arrs) | set(want)
+        aux_keys = set(codec.enc_names(e.arrs).values())
+        all_names = (set(e.arrs) - aux_keys) | set(want)
         fresh_nulls = {nm for nm in all_names - set(e.arrs)
                        if nm.startswith(_NULL)
                        and nm[len(_NULL):] not in e.null_at_cache}
@@ -512,17 +574,53 @@ class DeviceBufferPool:
         plain = sorted({nm for nm in e.arrs if not nm.startswith("__")}
                        | {nm[len(_NULL):] for nm in fresh_nulls})
         tail_host = store.host_live_columns(plain, start=e.n)
+        # encode every tail FIRST: a tail outside the proven range
+        # PROMOTES that one column (full re-encode under a widened
+        # descriptor via the _stage_columns path below) while every
+        # other column still takes the tail path — the bounded,
+        # key-visible recompile of join-ladder growth, never a full
+        # restage of the whole table
+        tails = {}
+        promote = set()
+        if n > e.n:
+            for name in e.arrs:
+                if name in aux_keys:
+                    continue
+                t = stage_cast(tail_host[name])
+                enc = e.encs.get(name)
+                if enc is not None:
+                    t = codec.encode_tail(table, name, enc, t)
+                    if t is None:
+                        promote.add(name)
+                        continue
+                tails[name] = t
         arrs = {}
         up = 0
         for name, old in e.arrs.items():
+            if name in aux_keys or name in promote:
+                continue
             if int(old.shape[0]) != padded:
                 buf = jnp.zeros((padded, *old.shape[1:]), old.dtype)
                 old = buf.at[:e.n].set(old[:e.n])
-            if n > e.n:
-                t = stage_cast(tail_host[name])
+            t = tails.get(name)
+            if t is not None:
                 old = old.at[e.n:n].set(jnp.asarray(t))
                 up += t.nbytes
             arrs[name] = old
+        for name, enc in e.encs.items():
+            if name in promote:
+                continue     # fresh aux stages with the new descriptor
+            akey = codec.aux_name(name, enc)
+            if akey not in e.arrs:
+                continue
+            if enc.family == "dict" and n > e.n:
+                aux = codec.aux_host(table, name, enc)
+                if aux is None:
+                    return None   # ladder moved past the entry
+                arrs[akey] = jax.device_put(aux)
+                up += aux.nbytes
+            else:
+                arrs[akey] = e.arrs[akey]
         for name in fresh_nulls:
             buf = jnp.zeros(padded, bool)
             t = tail_host.get(name)
@@ -530,11 +628,14 @@ class DeviceBufferPool:
                 buf = buf.at[e.n:n].set(jnp.asarray(t))
                 up += t.nbytes
             arrs[name] = buf
-        if full_names:
-            add, up2 = self._stage_columns(store, full_names, n, padded)
+        encs = {k: v for k, v in e.encs.items() if k not in promote}
+        if full_names or promote:
+            add, up2, aencs = self._stage_columns(
+                store, sorted(set(full_names) | promote), n, padded)
             arrs.update(add)
+            encs.update(aencs)
             up += up2
-        return arrs, n, up, n - e.n
+        return arrs, n, up, n - e.n, encs
 
     # ------------------------------------------------------------------
     # morsel chunk tier (exec/morsel.py streaming windows)
@@ -556,7 +657,7 @@ class DeviceBufferPool:
             self._note_unpin_locked(entry, entry.table)
 
     def get_chunk(self, store, host_cols: dict, start: int,
-                  chunk_rows: int) -> ChunkEntry:
+                  chunk_rows: int, encs: dict = None) -> ChunkEntry:
         """One fixed-shape streaming window of `host_cols` (the staged
         namespace: value columns + MVCC sys columns + null masks),
         staged to device and returned PINNED — the caller unpins via
@@ -564,14 +665,23 @@ class DeviceBufferPool:
         device_put is async, so fetching chunk i+1 before blocking on
         chunk i's output double-buffers the host→device copy.  Windows
         are version-keyed like every pool entry; a re-requested warm
-        window is a hit (repeat streams over an unchanged table)."""
+        window is a hit (repeat streams over an unchanged table).
+        `encs` (from codec.ensure_classes at stream start) encodes the
+        window's eligible columns — ensured against the FULL host
+        column, so every window of a stream provably shares one
+        program class."""
         import jax
 
         from ..utils.dtypes import stage_cast
         table = store.td.name
         ver = store.version
+        # the quantized codec classes are part of the window key: a
+        # warm raw window must never alias an encoded stream (mixed
+        # avals inside one stream would fork its program class)
         key = (id(store), int(start), int(chunk_rows),
-               tuple(sorted(host_cols)))
+               tuple(sorted(host_cols)),
+               tuple(sorted((c, codec.codec_class(en))
+                            for c, en in (encs or {}).items())))
         with _LOCK:
             ent = self._chunks.get(key)
             if ent is not None and ent[1].version == ver:
@@ -591,13 +701,26 @@ class DeviceBufferPool:
         up = 0
         for name, arr in host_cols.items():
             h = stage_cast(arr)
-            buf = np.zeros((chunk_rows, *h.shape[1:]), dtype=h.dtype)
-            if live:
-                buf[:live] = h[start:start + live]
-            arrs[name] = jax.device_put(buf)
-            up += buf.nbytes
+            r = codec.encode_window(table, name, h[start:start + live]) \
+                if (encs and name in encs) else None
+            if r is not None:
+                code, enc, aux = r
+                buf = np.zeros(chunk_rows, dtype=code.dtype)
+                if live:
+                    buf[:live] = code
+                arrs[name] = jax.device_put(buf)
+                arrs[codec.aux_name(name, enc)] = jax.device_put(aux)
+                up += buf.nbytes + aux.nbytes
+            else:
+                buf = np.zeros((chunk_rows, *h.shape[1:]),
+                               dtype=h.dtype)
+                if live:
+                    buf[:live] = h[start:start + live]
+                arrs[name] = jax.device_put(buf)
+                up += buf.nbytes
         e = ChunkEntry(table, ver, int(start), int(chunk_rows),
-                       int(live), arrs, up)
+                       int(live), arrs, up,
+                       bytes_logical=codec.logical_nbytes(arrs))
         with _LOCK:
             self._tstats(table)[1] += 1
             self.uploaded_bytes += up

@@ -956,6 +956,49 @@ class TestChunkKeyQuantization:
                    for f in report["findings"]), report["findings"]
 
 
+class TestCodecKeyQuantization:
+    """Codec-tier key discipline: an encoding descriptor (FOR
+    reference, dict LUT contents, Enc fields) reaching a program key
+    raw is a finding; the codec_class()-quantized twin is silent
+    (storage/codec.py — references and LUTs drift with appends, so an
+    unquantized descriptor mints one program per drift)."""
+
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/codeckeys.py": """\
+            from opentenbase_tpu.exec.plancache import ProgramCache
+
+            CACHE = ProgramCache("fix", 8)
+
+            def codec_class(enc):
+                return f"{enc.family}{enc.width}"
+
+            def put_raw_descriptor(plan_key, enc, prog):
+                key = (plan_key, ("__codec", enc))        # raw Enc
+                CACHE.put(key, prog)
+
+            def put_raw_classes(plan_key, encs, prog):
+                CACHE.put((plan_key, tuple(sorted(encs))), prog)
+
+            def put_clean(plan_key, enc, prog):
+                key = (plan_key, ("__codec", codec_class(enc)))
+                CACHE.put(key, prog)
+        """,
+    }
+
+    def test_raw_descriptor_flagged_quantized_twin_silent(
+            self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"program-cardinality"})
+        got = sorted(f["symbol"] for f in report["findings"])
+        assert got == ["put_raw_classes", "put_raw_descriptor"], \
+            [(f["symbol"], f["message"]) for f in report["findings"]]
+        assert all("codec_class" in f["message"]
+                   for f in report["findings"]), report["findings"]
+
+
 class TestRetraceRiskPass:
     FILES = {
         "fixpkg/__init__.py": "",
