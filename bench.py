@@ -41,7 +41,12 @@ Modes via env:
   window per arm, default 4), BENCH_QPS_WARM_SECONDS (untimed
   compile-warm phase per arm, default 2), BENCH_QPS_CLIENTS (default
   "8,64,256"), BENCH_QPS_BASELINE_N (serial baseline queries, default
-  60); BENCH_SF defaults to 0.05 in this mode
+  60); BENCH_SF defaults to 0.05 in this mode.  A zipf_cache arm per
+  client count drives zipfian-skewed repeated statements through the
+  GTS-versioned result cache (exec/share.py): device dispatches stay
+  near the distinct-statement count while served queries scale with
+  clients, every response verified (knobs: BENCH_QPS_ZIPF_DISTINCT
+  default 48, BENCH_QPS_ZIPF_SKEW default 1.2)
 - BENCH_OLTP=1: additionally measure the point-op latency path (FQS
   INSERT/SELECT p50) — the reference's execLight.c OLTP story
 - --trace: after each timed arm, dump the full last-query span tree
@@ -1145,6 +1150,104 @@ def _qps_arm(name, node, stream, clients, seconds, warm_s):
             **_compile_counters(c0, c1)}
 
 
+def _qps_zipf_arm(node, clients, seconds, warm_s):
+    """otbshare rung (b) under dashboard-shaped load: a zipfian-skewed
+    pool of repeated statements (rank r drawn with p ~ r^-skew), every
+    response verified against its serially-computed answer.  The
+    sublinearity proof is `dispatches`: device dispatches stay near
+    the DISTINCT statement count while served queries scale with the
+    client count — repeats are CN memory hits that never touch the
+    device."""
+    import threading
+
+    import numpy as np
+    from opentenbase_tpu.exec import scheduler as sched_mod
+    from opentenbase_tpu.exec import share as share_mod
+    from opentenbase_tpu.exec.session import Session
+
+    n_distinct = int(os.environ.get("BENCH_QPS_ZIPF_DISTINCT", "48"))
+    skew = float(os.environ.get("BENCH_QPS_ZIPF_SKEW", "1.2"))
+    pool = [f"select sum(v), count(*) from qps_kv "
+            f"where k < {13 * (r + 1)}" for r in range(n_distinct)]
+    rng = np.random.default_rng(31)
+    w = 1.0 / np.arange(1, n_distinct + 1) ** skew
+    stream = [pool[i] for i in
+              rng.choice(n_distinct, size=4096, p=w / w.sum())]
+    expect = {}
+    s = Session(node)
+    for q in pool:                       # compile once + golden answers
+        expect[q] = s.execute(q)[-1].rows
+
+    lats = [[] for _ in range(clients)]
+    wrong = [0] * clients
+    sheds = [0] * clients
+    stop_at = [0.0]
+
+    def drive(sched, secs):
+        gate = threading.Barrier(clients + 1)
+
+        def client(ci):
+            cs = Session(node)
+            i = ci
+            gate.wait()
+            while time.perf_counter() < stop_at[0]:
+                q = stream[i % len(stream)]
+                t0 = time.perf_counter()
+                try:
+                    rows = sched.run(cs, q)[-1].rows
+                    lats[ci].append(time.perf_counter() - t0)
+                    if rows != expect[q]:
+                        wrong[ci] += 1
+                except Exception:
+                    sheds[ci] += 1
+                i += 1
+
+        threads = [threading.Thread(target=client, args=(ci,),
+                                    daemon=True)
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        stop_at[0] = time.perf_counter() + secs
+        t_begin = time.perf_counter()
+        gate.wait()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t_begin
+
+    sched = sched_mod.Scheduler(node=node,
+                                queue_depth=max(128, 4 * clients))
+    try:
+        if warm_s > 0:
+            drive(sched, warm_s)
+        for per in lats:
+            per.clear()
+        wrong[:] = [0] * clients
+        sheds[:] = [0] * clients
+        s0 = sched_mod.stats_snapshot()
+        w0 = share_mod.stats_snapshot()
+        wall = drive(sched, seconds)
+        s1 = sched_mod.stats_snapshot()
+        w1 = share_mod.stats_snapshot()
+    finally:
+        sched.stop()
+    merged = sorted(x for per in lats for x in per)
+    hits = w1["result_cache_hits"] - w0["result_cache_hits"]
+    misses = w1["result_cache_misses"] - w0["result_cache_misses"]
+    return {"arm": "zipf_cache", "clients": clients, "replicas": 0,
+            "queries": len(merged),
+            "qps": len(merged) / wall if wall > 0 else 0.0,
+            "p50_ms": _qps_pct(merged, 0.50) * 1e3,
+            "p99_ms": _qps_pct(merged, 0.99) * 1e3,
+            "shed": sum(sheds),
+            "wrong": sum(wrong),
+            "distinct": n_distinct, "skew": skew,
+            "dispatches": s1["dispatches"] - s0["dispatches"],
+            "cache_hits": hits,
+            "cache_hit_rate": hits / (hits + misses)
+            if hits + misses else 0.0,
+            "fanin": w1["shared_scan_fanin"] - w0["shared_scan_fanin"]}
+
+
 def _replica_counter(prefix):
     from opentenbase_tpu.obs.metrics import REGISTRY
     total = 0.0
@@ -1257,6 +1360,11 @@ def _qps_mode():
         for clients in clients_list:
             arms.append(_qps_arm(name, node, stream, clients, seconds,
                                  warm_s))
+    # work-sharing axis (otbshare): zipfian repeated statements — the
+    # dispatch count must stay near the distinct-statement count while
+    # served queries scale with clients (result-cache sublinearity)
+    for clients in clients_list:
+        arms.append(_qps_zipf_arm(node, clients, seconds, warm_s))
     # standby read scale-out axis: same point-read stream over a
     # cluster, replicas=0 (primary only) vs replicas=N hot standbys
     replicas_list = [int(r) for r in os.environ.get(
@@ -1285,7 +1393,12 @@ def _qps_mode():
                   "p99_ms, batch_rate = batched/admitted, "
                   "batch_dispatches, batch_hist 'size:count ...', "
                   "shed, overlap_ratio = staged-behind-compute ms / "
-                  "staging ms, pipelined}; replica_point arms: cluster "
+                  "staging ms, pipelined}; zipf_cache arms: zipfian "
+                  "repeated statements through the GTS-versioned "
+                  "result cache {distinct, skew, dispatches (device "
+                  "dispatches — sublinear vs clients), cache_hits, "
+                  "cache_hit_rate, fanin, wrong (asserted 0)}; "
+                  "replica_point arms: cluster "
                   "point reads {replicas = hot standbys per DN, wrong "
                   "(asserted 0), routed_reads, fallthrough}; "
                   "vs_baseline = headline qps / serial point_sig qps",

@@ -343,6 +343,118 @@ class ProgramCardinalityPass:
 
 
 # ===========================================================================
+# result-key
+# ===========================================================================
+class ResultKeyPass:
+    """Result-cache key discipline (exec/share.py, the GTS-versioned
+    result cache).  An entry is servable to ANY later snapshot that
+    covers its GTS, so every ``ResultCache.put`` key component must
+    derive from the literal-masked signature, the literal vector, or
+    the store-version/GTS tuple — the three inputs that exactly
+    determine the result.  Positive-evidence detection (the repo
+    convention): wall-clock / RNG / process-identity reads in the key
+    flow defeat reuse (every put mints a fresh never-matching entry),
+    and a raw row count keys the entry on what the result LOOKED like
+    instead of what produced it — a post-DML table at the same
+    cardinality would wrongly match."""
+
+    rule = "result-key"
+
+    def __init__(self, project: Project):
+        self.project = project
+        # every module-level name bound to a ResultCache() anywhere
+        # (the ProgramKeyPass receiver convention)
+        self.cache_names: set = set()
+        for mi in project.modules.values():
+            for st in mi.src.tree.body:
+                if isinstance(st, ast.Assign) and \
+                        isinstance(st.value, ast.Call):
+                    f = st.value.func
+                    nm = f.id if isinstance(f, ast.Name) else (
+                        f.attr if isinstance(f, ast.Attribute) else None)
+                    if nm == "ResultCache":
+                        for t in st.targets:
+                            if isinstance(t, ast.Name):
+                                self.cache_names.add(t.id)
+
+    def _is_cache_put(self, call) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "put"
+                and len(call.args) >= 2):
+            return False
+        owner = f.value
+        name = owner.id if isinstance(owner, ast.Name) else (
+            owner.attr if isinstance(owner, ast.Attribute) else None)
+        return name in self.cache_names
+
+    def run(self) -> list:
+        em = _Emitter(self.rule)
+        for mi in self.project.modules.values():
+            for fi in mi.functions.values():
+                for call in ast.walk(fi.node):
+                    if isinstance(call, ast.Call) and \
+                            self._is_cache_put(call):
+                        self._check_put(mi, fi, call, em)
+        return em.findings
+
+    # one level into same-project callees feeding the key — the
+    # resolution rules are ProgramCardinalityPass's, shared verbatim
+    _callee = ProgramCardinalityPass._callee
+
+    def _check_put(self, mi, fi: FuncInfo, call, em: _Emitter):
+        key_expr = call.args[0]
+        sites = [(e, fi, mi) for e, _it in _flow_exprs(fi, key_expr)]
+        seen_fns = {(fi.module, fi.qualname)}
+        for e, _fi, _mi in list(sites):
+            for n in ast.walk(e):
+                if not isinstance(n, ast.Call):
+                    continue
+                tgt = self._callee(_mi, _fi, n)
+                if tgt is None or (tgt.module, tgt.qualname) in seen_fns:
+                    continue
+                seen_fns.add((tgt.module, tgt.qualname))
+                tmi = self.project.modules[tgt.module]
+                for ret in _return_exprs(tgt):
+                    sites.extend((x, tgt, tmi)
+                                 for x, _it in _flow_exprs(tgt, ret))
+        for e, efi, emi in sites:
+            self._scan(e, efi, emi, em)
+
+    def _scan(self, expr, fi: FuncInfo, mi, em: _Emitter):
+        for n in ast.walk(expr):
+            if not isinstance(n, ast.Call):
+                continue
+            d = _dotted(n.func, mi) or ""
+            short = d.split(".")[-1]
+            if d.startswith(_UNBOUNDED_PREFIXES) or \
+                    d in _UNBOUNDED_CALLS:
+                em.emit(fi, n.lineno,
+                        f"{d}() in result-cache key material — wall "
+                        f"clock / RNG / process identity never "
+                        f"repeats, so every put mints an entry no "
+                        f"lookup can match; key on the masked "
+                        f"signature, literal vector, and "
+                        f"store-version/GTS tuple only")
+            elif short == "row_count":
+                em.emit(fi, n.lineno,
+                        "raw row count in result-cache key material — "
+                        "it keys the entry on what the result looked "
+                        "like, not what produced it: a post-DML table "
+                        "at the same cardinality would wrongly match; "
+                        "use the store-version tuple for exact "
+                        "invalidation instead")
+            elif short == "len" and n.args and any(
+                    isinstance(x, ast.Name) and "row" in x.id.lower()
+                    for x in ast.walk(n.args[0])):
+                em.emit(fi, n.lineno,
+                        "raw result size in result-cache key material "
+                        "— len(rows) is a property of the answer, not "
+                        "of the (signature, literals, store-version) "
+                        "inputs that determine it; drop it from the "
+                        "key")
+
+
+# ===========================================================================
 # retrace-risk
 # ===========================================================================
 class RetraceRiskPass:

@@ -76,6 +76,9 @@ RULES = {
     "retrace-witness": "runtime program census diverges from the "
                        "static ladder prediction (non-ladder class, "
                        "unexplained recompile, or compile storm)",
+    "result-key": "result-cache key component not derived from the "
+                  "masked signature / literal vector / store-version-"
+                  "GTS tuple (wall clock, RNG, or a raw row count)",
     "hlo-f64": "f64 tensor type in exported StableHLO",
     "hlo-host-transfer": "host transfer / callback op in exported "
                          "StableHLO",

@@ -20,8 +20,8 @@ import sys
 
 from .callgraph import TracedClosure
 from .cardinality import (DeviceResidencyPass, ProgramCardinalityPass,
-                          RetraceRiskPass, RetraceWitnessPass,
-                          TransferDisciplinePass)
+                          ResultKeyPass, RetraceRiskPass,
+                          RetraceWitnessPass, TransferDisciplinePass)
 from .concurrency import (ConcurrencyContext, LockAtomicityPass,
                           LockBlockingPass, LockOrderPass,
                           ThreadDaemonPass)
@@ -52,6 +52,7 @@ def run_passes(project: Project, rules=None) -> list:
         ThreadDaemonPass(project),
         SlotDisciplinePass(project),
         ProgramCardinalityPass(project, closure),
+        ResultKeyPass(project),
         RetraceRiskPass(project, closure),
         DeviceResidencyPass(project),
         TransferDisciplinePass(project, closure),

@@ -592,6 +592,14 @@ class FragSig:
     plan_key: tuple        # _key_of(masked plan)
     lit_types: tuple
 
+    def version_key(self) -> tuple:
+        """Per-table store-version tuple over this fragment's scanned
+        stores — the exact-invalidation component of a result-cache
+        key (exec/share.py): any mutation of any referenced table
+        bumps a version and the tuple stops matching."""
+        from .share import store_versions
+        return store_versions(self.stores)
+
 
 def batch_signature(ctx, node) -> Optional[FragSig]:
     """Classify a plan subtree for same-program batching: the fragment

@@ -60,6 +60,7 @@ from ..plan.distribute import BatchSource
 from ..storage import codec
 from ..storage.batch import chunk_class, size_class
 from ..utils import locks
+from . import share as workshare
 from .spill import (_walk_nodes, _clone_replacing, _needed_cols,
                     _ScanInfo, has_order_sensitive, node_contains,
                     sliced_side_ok, staged_host_columns)
@@ -211,12 +212,18 @@ class _StreamShape:
     resident: list            # [_ScanInfo] staged whole + pinned
 
 
+class _ShareFallback(Exception):
+    """A follower left its shared stream (expelled, or the leader
+    failed) — the query reruns on a private stream."""
+
+
 class MorselDriver:
     """Plan-shape matcher + chunk-streaming executor for one node."""
 
     def __init__(self, stores: dict, cache, snapshot_ts: int,
                  txid: int, chunk_rows: Optional[int] = None,
-                 params: dict = None, forced: bool = False):
+                 params: dict = None, forced: bool = False,
+                 share: Optional[bool] = None):
         self.stores = stores
         self.cache = cache
         self.snapshot_ts = snapshot_ts
@@ -226,6 +233,14 @@ class MorselDriver:
                                       if chunk_rows else
                                       default_chunk_rows())
         self.forced = forced
+        # cross-query shared scans (exec/share.py): on unless the
+        # enable_work_sharing GUC / OTB_WORK_SHARING says otherwise
+        self.share = workshare.enabled(None) if share is None \
+            else bool(share)
+        # per-consumer pin identity: every chunk pin this driver takes
+        # is accounted to this token, so a shared stream's other
+        # consumers can never be released by this one erroring
+        self.token = workshare.new_token()
         # per-stream instrumentation (bench --oob reads these)
         self.chunks = 0
         self.downshifts = 0
@@ -387,9 +402,6 @@ class MorselDriver:
 
     def _run_stream(self, plan, shape: _StreamShape):
         from ..storage.bufferpool import POOL
-        from .dist import _concat_host, _to_device, _to_host
-        from .fused import FragmentProgram
-        from . import shield
 
         big = shape.big
         needed = sorted(_needed_cols(shape.per_plan, big.node.alias)
@@ -403,36 +415,112 @@ class MorselDriver:
         # chunks will actually carry
         encs = codec.ensure_classes(big.store, host)
 
-        # resident sides: staged whole through the device cache, PINNED
-        # for the stream's lifetime — per-chunk pressure relief must
-        # never evict the build side it is streaming against
+        # cross-query sharing: the first stream over (store, version,
+        # chunk shape) leads; compatible concurrent streams follow its
+        # published windows instead of staging their own
+        role, stream, token, join_lo = None, None, self.token, 0
+        if self.share:
+            names = frozenset(host) \
+                | {codec.aux_name(c, en) for c, en in encs.items()}
+            classes = {c: codec.codec_class(en)
+                       for c, en in encs.items()}
+            att = workshare.HUB.attach(big.store, self.chunk_rows,
+                                       names, classes)
+            if att is None:
+                workshare.bump("private_fallbacks")
+            else:
+                role, stream, token, join_lo = att
+
+        if role == "follower":
+            try:
+                out = self._follower_pass(plan, shape, host, encs,
+                                          stream, token, join_lo)
+                POOL.check_pin_ledger()
+                return out
+            except _ShareFallback:
+                workshare.bump("private_fallbacks")
+                return self._stream_pass(plan, shape, host, encs,
+                                         None, self.token)
+        if role == "leader":
+            try:
+                out = self._stream_pass(plan, shape, host, encs,
+                                        stream, token)
+            except Exception:
+                # shared pass must not downshift under live followers
+                # (the chunk shape is the stream's contract): fail the
+                # stream — followers fall back privately — and retry
+                # this query on a private stream with the full
+                # pressure ladder
+                stream.finish(failed=True)
+                workshare.HUB.remove(stream)
+                workshare.bump("private_fallbacks")
+                return self._stream_pass(plan, shape, host, encs,
+                                         None, self.token)
+            fanin = stream.finish()
+            workshare.HUB.remove(stream)
+            if fanin:
+                workshare.bump("shared_streams")
+                POOL.check_pin_ledger()
+            return out
+        return self._stream_pass(plan, shape, host, encs, None,
+                                 self.token)
+
+    def _pin_residents(self, shape: _StreamShape):
+        """Stage + pin the non-streamed sides: per-chunk pressure
+        relief must never evict the build side a stream is probing
+        against.  Returns (arrs by table, counts by table, pin
+        handles)."""
+        from ..storage.bufferpool import POOL
         resident_arrs: dict = {}
         resident_ns: dict = {}
         pins = []
-        try:
-            for info in shape.resident:
-                rneed = sorted(
-                    _needed_cols(shape.per_plan, info.node.alias)
-                    | _needed_cols(shape.per_plan,
-                                   info.node.table.name))
-                arrs, n = self.cache.get(info.store, rneed)
-                resident_arrs[info.node.table.name] = arrs
-                resident_ns[info.node.table.name] = jnp.int64(n)
-                handle = POOL.pin_table(info.store)
-                if handle is not None:
-                    pins.append(handle)
+        for info in shape.resident:
+            rneed = sorted(
+                _needed_cols(shape.per_plan, info.node.alias)
+                | _needed_cols(shape.per_plan, info.node.table.name))
+            arrs, n = self.cache.get(info.store, rneed)
+            resident_arrs[info.node.table.name] = arrs
+            resident_ns[info.node.table.name] = jnp.int64(n)
+            handle = POOL.pin_table(info.store)
+            if handle is not None:
+                pins.append(handle)
+        return resident_arrs, resident_ns, pins
 
+    def _stream_pass(self, plan, shape: _StreamShape, host, encs,
+                     stream, token):
+        """Drive the chunk stream: private when `stream` is None, else
+        as the LEADER — each staged window fans into every follower
+        before this driver consumes it, and run-ahead is throttled so
+        follower backlogs stay bounded."""
+        from ..storage.bufferpool import POOL
+        from .dist import _concat_host, _to_device, _to_host
+        from .fused import FragmentProgram
+        from . import shield
+
+        big = shape.big
+        resident_arrs, resident_ns, pins = {}, {}, []
+        try:
+            resident_arrs, resident_ns, pins = self._pin_residents(shape)
             prog = FragmentProgram(self._exec_ctx(), shape.per_plan,
                                    self.chunk_rows)
             if not prog.ok():
                 return None
 
+            def stage(at):
+                if stream is not None:
+                    stream.throttle()
+                e = POOL.get_chunk(big.store, host, at,
+                                   self.chunk_rows, encs,
+                                   consumer=token)
+                if stream is not None:
+                    stream.publish(e, at, at + self.chunk_rows)
+                return e
+
             bname = big.node.table.name
             floor = min_chunk_rows()
             outs = []
             lo = 0
-            nxt = POOL.get_chunk(big.store, host, 0, self.chunk_rows,
-                                 encs)
+            nxt = stage(0)
             with obs_trace.span("execute", tier="morsel") \
                     if obs_trace.ENABLED else obs_trace.NULL_SPAN:
                 while lo < big.rows:
@@ -441,8 +529,7 @@ class MorselDriver:
                     if hi < big.rows:
                         # prefetch: the NEXT window's device_put
                         # enqueues before this window's output blocks
-                        nxt = POOL.get_chunk(big.store, host, hi,
-                                             self.chunk_rows, encs)
+                        nxt = stage(hi)
                     staged_arrs = dict(resident_arrs)
                     staged_arrs[bname] = entry.arrs
                     staged_ns = dict(resident_ns)
@@ -456,9 +543,22 @@ class MorselDriver:
                             # flight
                             outs.append(_to_host(out))
                     except Exception as e:
-                        POOL.unpin_chunk(entry)
+                        POOL.unpin_chunk(entry, consumer=token)
                         if nxt is not None:
-                            POOL.unpin_chunk(nxt)
+                            POOL.unpin_chunk(nxt, consumer=token)
+                        if stream is not None:
+                            # downshifting would fork the shared chunk
+                            # shape; a lone leader (nobody ever
+                            # joined) closes the stream and takes the
+                            # private ladder in place
+                            with stream.cond:
+                                lone = stream.fanin == 0
+                                if lone:
+                                    stream.accepting = False
+                            if not lone:
+                                raise
+                            workshare.HUB.remove(stream)
+                            stream = None
                         if shield.is_oom(e) \
                                 and self.chunk_rows > floor:
                             # the middle rung of the pressure ladder:
@@ -479,15 +579,16 @@ class MorselDriver:
                             if not prog.ok():
                                 return None
                             nxt = POOL.get_chunk(big.store, host, lo,
-                                                 self.chunk_rows, encs)
+                                                 self.chunk_rows, encs,
+                                                 consumer=token)
                             continue
                         raise
                     self.chunks += 1
                     self.bytes_streamed += entry.nbytes
-                    POOL.unpin_chunk(entry)
+                    POOL.unpin_chunk(entry, consumer=token)
                     if out is None:
                         if nxt is not None:
-                            POOL.unpin_chunk(nxt)
+                            POOL.unpin_chunk(nxt, consumer=token)
                         return None   # fusion refused mid-stream
                     lo = hi
         finally:
@@ -502,6 +603,106 @@ class MorselDriver:
         if not outs:
             return None
         combined = _to_device(_concat_host(outs))
+        return self._finalize(plan, shape, combined)
+
+    def _follower_pass(self, plan, shape: _StreamShape, host, encs,
+                       stream, token, join_lo):
+        """Consume a leader's published windows instead of staging our
+        own: each delivered chunk runs THIS query's compiled fragment
+        under THIS query's snapshot (MVCC system columns ride in the
+        shared window, so visibility is per consumer), then releases
+        only this consumer's pin.  A late joiner re-reads its missed
+        prefix [0, join_lo) privately after the live stream drains —
+        warm chunk-cache hits when the leader staged the same column
+        set.  Raises _ShareFallback when expelled or the stream fails;
+        the caller reruns privately (sharing is never a semantic)."""
+        from ..storage.bufferpool import POOL
+        from .dist import _concat_host, _to_device, _to_host
+        from .fused import FragmentProgram
+
+        big = shape.big
+        bname = big.node.table.name
+        staged_names = list(host) \
+            + [codec.aux_name(c, en) for c, en in encs.items()]
+        resident_arrs, resident_ns, pins = {}, {}, []
+        outs = []   # (lo, host batch) — re-sorted to stream order
+        try:
+            resident_arrs, resident_ns, pins = self._pin_residents(shape)
+            prog = FragmentProgram(self._exec_ctx(), shape.per_plan,
+                                   self.chunk_rows)
+            if not prog.ok():
+                stream.detach(token)
+                return None
+
+            def run_window(lo, entry):
+                staged_arrs = dict(resident_arrs)
+                staged_arrs[bname] = {nm: entry.arrs[nm]
+                                      for nm in staged_names}
+                staged_ns = dict(resident_ns)
+                staged_ns[bname] = jnp.int64(entry.live)
+                out = prog.run(staged_arrs, staged_ns,
+                               self.snapshot_ts, self.txid)
+                if out is not None:
+                    outs.append((lo, _to_host(out)))
+                self.chunks += 1
+                return out is not None
+
+            with obs_trace.span("execute", tier="morsel",
+                                shared=True) \
+                    if obs_trace.ENABLED else obs_trace.NULL_SPAN:
+                while True:
+                    with stream.cond:
+                        f = stream.followers[token]
+                        while not f["deque"] and not stream.done \
+                                and not f["expelled"]:
+                            stream.cond.wait(timeout=0.25)
+                        if f["expelled"] or stream.failed:
+                            raise _ShareFallback()
+                        if f["deque"]:
+                            lo, entry = f["deque"].popleft()
+                        else:
+                            break   # done and fully drained
+                    try:
+                        ok = run_window(lo, entry)
+                    finally:
+                        POOL.unpin_chunk(entry, consumer=token)
+                        with stream.cond:
+                            stream.cond.notify_all()
+                    if not ok:
+                        stream.detach(token)
+                        return None   # fusion refused mid-stream
+                # missed prefix: re-read privately (warm hits when the
+                # leader staged the same columns)
+                lo = 0
+                while lo < join_lo:
+                    entry = POOL.get_chunk(big.store, host, lo,
+                                           self.chunk_rows, encs,
+                                           consumer=token)
+                    try:
+                        ok = run_window(lo, entry)
+                    finally:
+                        POOL.unpin_chunk(entry, consumer=token)
+                    if not ok:
+                        return None
+                    lo += self.chunk_rows
+        except _ShareFallback:
+            raise
+        except Exception:
+            stream.detach(token)
+            raise
+        finally:
+            for handle in pins:
+                POOL.unpin_table(handle)
+
+        bump("streams")
+        bump("chunks", self.chunks)
+        obs_trace.event("morsel_stream", table=bname,
+                        chunks=self.chunks,
+                        chunk_rows=self.chunk_rows, shared=True)
+        if not outs:
+            return None
+        outs.sort(key=lambda p: p[0])
+        combined = _to_device(_concat_host([o for _lo, o in outs]))
         return self._finalize(plan, shape, combined)
 
     def _finalize(self, plan, shape: _StreamShape, combined):

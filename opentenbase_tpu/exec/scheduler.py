@@ -61,6 +61,7 @@ from typing import Optional
 
 from ..sql import ast as A
 from ..sql.parser import parse_sql
+from . import share as workshare
 from . import shield
 from .executor import ExecContext, ExecError, materialize
 from .fused import (batch_signature, finish_fused_batch,
@@ -301,7 +302,8 @@ class _Item:
     __slots__ = ("session", "sql", "planned", "info", "group",
                  "t_submit", "ev", "error", "results", "batch",
                  "out_names", "is_write", "deadline", "cancel_event",
-                 "lk", "cv", "detached", "degraded", "lits")
+                 "lk", "cv", "detached", "degraded", "lits",
+                 "snap", "vkey")
 
     def __init__(self, session, sql):
         self.session = session
@@ -332,6 +334,11 @@ class _Item:
         self.detached = False     # guarded_by: lk
         self.degraded = False     # served by the spill path (shield)
         self.lits = None          # literal bindings (poison fault surface)
+        # result-cache tags (exec/share.py): the snapshot GTS drawn for
+        # this statement and the per-table store-version tuple captured
+        # WITH it — both set at dispatch, consumed at materialization
+        self.snap = None
+        self.vkey = None
 
     @property
     def sig(self):
@@ -470,6 +477,8 @@ class Scheduler:
         self._ensure_started()
         item = _Item(session, sql)
         self._classify(item)
+        if self._serve_cached(item):
+            return item     # result-cache hit: zero device dispatches
         with self._lock:
             depth = self._depth.get(item.group, 0)
             if self.max_queue > 0 and depth >= self.max_queue:
@@ -550,8 +559,52 @@ class Scheduler:
             # per-member materialization fault: isolate and re-run this
             # ONE member serially; batch-mates already hold their views
             return self._recover_member(item, e)
+        self._cache_result(item, names, rows)
         return [Result("SELECT", names=names, rows=rows,
                        rowcount=len(rows))]
+
+    # -- result cache (exec/share.py rung b) ------------------------------
+    def _sharing_on(self, session) -> bool:
+        node = getattr(session, "node", None) or self.node
+        return workshare.enabled(getattr(node, "gucs", None) or {})
+
+    def _serve_cached(self, item: _Item) -> bool:
+        """Serve a batchable SELECT straight from the GTS-versioned
+        result cache: servable iff every referenced table still sits
+        at the entry's captured store version AND this read's snapshot
+        GTS covers the entry's.  A hit completes the item without ever
+        queueing it — no admission slot, no device dispatch."""
+        if item.info is None or not self._sharing_on(item.session):
+            return False
+        node = item.session.node
+        vkey = item.info.version_key()
+        snap = node.gts.next_gts()
+        hit = workshare.RESULT_CACHE.lookup(
+            item.info.sig, [v for _n, v, _t in item.info.lits],
+            vkey, snap)
+        if hit is None:
+            return False
+        names, rows, rowcount = hit
+        return self._complete(item, results=[Result(
+            "SELECT", names=list(names), rows=rows,
+            rowcount=rowcount)])
+
+    def _cache_result(self, item: _Item, names, rows):
+        """Admit one materialized SELECT result, tagged with the
+        snapshot GTS and the store-version tuple captured when that
+        snapshot was drawn (so a DML racing the execution makes the
+        entry unservable instead of stale)."""
+        if item.info is None or item.vkey is None \
+                or item.snap is None or item.degraded \
+                or not self._sharing_on(item.session):
+            return
+        node = item.session.node
+        gucs = getattr(node, "gucs", None) or {}
+        workshare.RESULT_CACHE.put(
+            (item.info.sig,
+             tuple(v for _n, v, _t in item.info.lits), item.vkey),
+            item.snap, names, rows, rowcount=len(rows),
+            budget=workshare.cache_budget(gucs))
 
     # -- completion handshake ---------------------------------------------
     def _complete(self, item: _Item, error=None, results=None,
@@ -824,6 +877,7 @@ class Scheduler:
         t_start = time.monotonic()
         try:
             node = items[0].session.node
+            vkey = items[0].info.version_key()
             queries = []
             for it in items:
                 # per-query MVCC: each batch element carries its own
@@ -831,6 +885,7 @@ class Scheduler:
                 # matching when serial execution would begin)
                 txid = node.gts.next_txid()
                 snap = node.gts.next_gts()
+                it.snap, it.vkey = snap, vkey
                 queries.append(
                     (snap, txid, [v for _n, v, _t in it.info.lits]))
             for attempt in (0, 1):
@@ -915,10 +970,12 @@ class Scheduler:
         flight = sb = None
         try:
             node = items[0].session.node
+            vkey = items[0].info.version_key()
             queries = []
             for it in items:
                 txid = node.gts.next_txid()
                 snap = node.gts.next_gts()
+                it.snap, it.vkey = snap, vkey
                 queries.append(
                     (snap, txid, [v for _n, v, _t in it.info.lits]))
             with self._pipe_lock:
@@ -1111,7 +1168,19 @@ class Scheduler:
                         # may-acquire: obs.trace._LOCK
                         res = item.session.execute(item.sql)
                 else:
+                    if item.info is not None:
+                        # versions BEFORE execution, GTS tag AFTER: a
+                        # DML racing the statement leaves the entry
+                        # keyed at a tuple that no longer matches, and
+                        # the late tag only narrows servability
+                        item.vkey = item.info.version_key()
                     res = item.session.execute(item.sql)
+                    if item.info is not None and len(res) == 1 \
+                            and res[0].command == "SELECT":
+                        node = item.session.node
+                        item.snap = node.gts.next_gts()
+                        self._cache_result(item, res[0].names,
+                                           res[0].rows)
                 self._complete(item, results=res)
             except BaseException as e:
                 self._complete(item, error=e)

@@ -1118,9 +1118,11 @@ class Session:
             raw_cr = self.node.gucs.get("morsel_chunk_rows", "")
             cr = int(raw_cr) if raw_cr.isdigit() and int(raw_cr) > 0 \
                 else default_chunk_rows()
+            from .share import enabled as sharing_enabled
             drv_m = MorselDriver(self.node.stores, self.node.cache,
                                  t.snapshot_ts, t.txid, chunk_rows=cr,
-                                 forced=(raw_morsel == "on"))
+                                 forced=(raw_morsel == "on"),
+                                 share=sharing_enabled(self.node.gucs))
             params_m, planned_m = prerun_init_plans()
             drv_m.params = dict(params_m)
             batch = drv_m.try_run(planned_m)

@@ -1,0 +1,434 @@
+"""Cross-query work sharing: shared morsel scans + a GTS-versioned
+result cache.
+
+Reference analogs: Postgres' synchronized sequential scans
+(src/backend/access/heap/syncscan.c) — concurrent seqscans of one
+relation piggyback on a single pass of the buffer ring instead of each
+driving its own I/O — and pgpool-II's memcached query cache, which
+serves repeated statements from memory but must invalidate by table.
+"Accelerating Presto with GPUs" (PAPERS.md) makes the accelerator
+version of the argument: interactive-concurrency economics on
+device-resident data hinge on amortizing data movement and dispatch
+across concurrent queries, not on per-query kernel speed.
+
+Two rungs, both exact (never a stale row, never a snapshot violation):
+
+- **Shared morsel scans** (`ShareHub`): when concurrent streaming
+  queries' dominant scans hit the same table at the same store version
+  with the same chunk shape, the FIRST one becomes the stream leader
+  and every later arrival attaches as a follower.  The leader drives
+  ONE chunk stream through the bufferpool's pinned chunk cache and
+  fans each staged window into every follower's deque — each follower
+  runs its OWN compiled fragment with its OWN snapshot over the shared
+  device window (MVCC system columns ride in the chunk, so visibility
+  is applied per consumer).  N concurrent analytic queries cost one
+  pass of host→device traffic instead of N.  Per-consumer pin
+  refcounts (storage/bufferpool.py) keep `check_pin_ledger` sound: a
+  follower erroring mid-stream can only release its OWN pins.  A late
+  joiner attaches at the current offset and re-reads just its missed
+  prefix (warm chunk-cache hits when the column sets match); anything
+  incompatible falls back to a private stream — sharing is an
+  optimization, never a semantic.
+
+- **GTS-versioned result cache** (`ResultCache`): a CN-side cache
+  keyed by (literal-masked signature, literal vector, per-table
+  store-version tuple), each entry tagged with the snapshot GTS of the
+  query that produced it.  Store versions are process-globally unique
+  and bump on every mutation, so the version tuple is an exact
+  invalidation key — the same machinery the device buffer pool already
+  trusts for residency.  An entry is servable to a read iff (a) every
+  referenced table still sits at the entry's captured version and (b)
+  the reader's snapshot GTS covers the entry's GTS — a cached result
+  tagged GTS=t is never served to a snapshot older than t.  Repeat
+  dashboard traffic becomes a sub-millisecond CN memory hit that never
+  touches the device.
+
+GUCs: `enable_work_sharing` (default on; env OTB_WORK_SHARING) gates
+both rungs; `result_cache_bytes` (env OTB_RESULT_CACHE_BYTES, default
+64 MiB) bounds the result cache, LRU-evicted.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+
+from ..utils import locks
+
+_LOCK = locks.Lock("exec.share._LOCK")
+_STATS: dict = {                    # guarded_by: _LOCK
+    "shared_streams": 0,            # leader streams that fed >=1 follower
+    "shared_scan_fanin": 0,         # follower attachments (extra consumers)
+    "shared_chunks": 0,             # chunk windows delivered to followers
+    "late_joins": 0,                # followers that attached mid-stream
+    "private_fallbacks": 0,         # expels / incompatibilities -> private
+    "result_cache_hits": 0,
+    "result_cache_misses": 0,
+    "result_cache_invalidations": 0,
+    "result_cache_puts": 0,
+    "result_cache_evictions": 0,
+}
+
+_TOKENS = itertools.count(1)
+
+
+def new_token() -> tuple:
+    """Process-unique consumer token for per-consumer pin accounting."""
+    return ("share", next(_TOKENS))
+
+
+def bump(field: str, n: int = 1):
+    with _LOCK:
+        _STATS[field] += n
+
+
+def stats_snapshot() -> dict:
+    with _LOCK:
+        d = dict(_STATS)
+    d["result_cache_bytes"] = RESULT_CACHE.nbytes()
+    d["result_cache_entries"] = RESULT_CACHE.entries()
+    return d
+
+
+def stats_rows() -> list:
+    """One row for the otb_workshare view."""
+    d = stats_snapshot()
+    return [(d["shared_streams"], d["shared_scan_fanin"],
+             d["shared_chunks"], d["late_joins"],
+             d["private_fallbacks"], d["result_cache_hits"],
+             d["result_cache_misses"], d["result_cache_invalidations"],
+             d["result_cache_puts"], d["result_cache_evictions"],
+             d["result_cache_bytes"], d["result_cache_entries"])]
+
+
+def reset_stats():
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _metrics_samples():
+    for k, v in stats_snapshot().items():
+        yield (f"otb_workshare_{k}", {}, v)
+
+
+# ---------------------------------------------------------------------------
+# GUCs
+# ---------------------------------------------------------------------------
+
+def enabled(gucs: dict = None) -> bool:
+    """`enable_work_sharing` GUC -> OTB_WORK_SHARING env -> on."""
+    raw = (gucs or {}).get("enable_work_sharing")
+    if raw is None:
+        raw = os.environ.get("OTB_WORK_SHARING", "on")
+    return str(raw).strip().lower() not in ("off", "0", "false", "no")
+
+
+def cache_budget(gucs: dict = None) -> int:
+    """`result_cache_bytes` GUC -> OTB_RESULT_CACHE_BYTES -> 64 MiB."""
+    raw = (gucs or {}).get("result_cache_bytes")
+    if raw is None:
+        raw = os.environ.get("OTB_RESULT_CACHE_BYTES", str(64 << 20))
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return 64 << 20
+
+
+def store_versions(stores: dict) -> tuple:
+    """The exact-invalidation version key: every referenced table's
+    store at its CURRENT monotonic version, sorted for a canonical
+    tuple.  Captured at snapshot allocation — a mutation between
+    capture and lookup changes the live tuple, so the entry simply
+    stops matching (lazy exact invalidation)."""
+    return tuple(sorted((t, st.version) for t, st in stores.items()))
+
+
+# ---------------------------------------------------------------------------
+# rung (b): GTS-versioned result cache
+# ---------------------------------------------------------------------------
+
+def _rows_nbytes(names, rows) -> int:
+    """Cheap, slightly pessimistic memory estimate (sampled)."""
+    base = 256 + 64 * len(names)
+    if not rows:
+        return base
+    sample = rows[:32]
+    per = 0
+    for r in sample:
+        per += 56
+        for v in r:
+            per += 24 + (len(v) if isinstance(v, (str, bytes)) else 8)
+    return base + int(per * (len(rows) / len(sample)))
+
+
+class ResultCache:
+    """(masked signature, literal vector) -> one result, valid at one
+    per-table version tuple and servable from one snapshot GTS on."""
+
+    def __init__(self):
+        self._lock = locks.Lock("exec.share.ResultCache._lock")
+        # (sig, lits) -> [seq, vkey, gts, names, rows, rowcount, nbytes]
+        self._map: dict = {}       # guarded_by: _lock
+        self._bytes = 0            # guarded_by: _lock
+        self._seq = itertools.count()
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def clear(self):
+        with self._lock:
+            self._map.clear()
+            self._bytes = 0
+
+    def lookup(self, sig, lits, vkey: tuple, snapshot_gts: int):
+        """(names, rows, rowcount) iff an entry exists whose captured
+        version tuple equals the CURRENT `vkey` and whose producing
+        snapshot GTS is covered by `snapshot_gts`; else None.  A
+        version mismatch drops the entry (exact lazy invalidation); a
+        too-old reader leaves it resident for newer snapshots."""
+        ident = (sig, tuple(lits))
+        with self._lock:
+            ent = self._map.get(ident)
+            if ent is None:
+                pass
+            elif ent[1] != vkey:
+                self._bytes -= ent[6]
+                del self._map[ident]
+                ent = None
+                bump("result_cache_invalidations")
+            elif snapshot_gts < ent[2]:
+                ent = None      # snapshot predates the cached result
+            if ent is None:
+                bump("result_cache_misses")
+                return None
+            ent[0] = next(self._seq)
+            bump("result_cache_hits")
+            return ent[3], list(ent[4]), ent[5]
+
+    def put(self, key, gts: int, names, rows, rowcount: int = None,
+            budget: int = None):
+        """`key` = (sig, lits, vkey) — the ONLY admissible components
+        (analysis/cardinality.py result-key rule): the masked
+        signature, the literal vector, and the per-table store-version
+        tuple.  `gts` tags the producing snapshot."""
+        sig, lits, vkey = key
+        ident = (sig, tuple(lits))
+        budget = cache_budget() if budget is None else int(budget)
+        rows = tuple(rows)
+        nb = _rows_nbytes(names, rows)
+        if nb > budget:
+            return False
+        with self._lock:
+            old = self._map.pop(ident, None)
+            if old is not None:
+                self._bytes -= old[6]
+            while self._map and self._bytes + nb > budget:
+                victim = min(self._map, key=lambda k: self._map[k][0])
+                self._bytes -= self._map.pop(victim)[6]
+                bump("result_cache_evictions")
+            self._map[ident] = [next(self._seq), tuple(vkey), int(gts),
+                                tuple(names), rows,
+                                len(rows) if rowcount is None
+                                else int(rowcount), nb]
+            self._bytes += nb
+        bump("result_cache_puts")
+        return True
+
+    def invalidate_table(self, table: str) -> int:
+        """Eagerly drop every entry whose version key references
+        `table` (DROP/TRUNCATE paths reclaim CN memory immediately;
+        plain DML is caught lazily by the version-tuple mismatch)."""
+        dropped = 0
+        with self._lock:
+            for ident in [k for k, e in self._map.items()
+                          if any(t == table for t, _v in e[1])]:
+                self._bytes -= self._map.pop(ident)[6]
+                dropped += 1
+        if dropped:
+            bump("result_cache_invalidations", dropped)
+        return dropped
+
+
+#: process-global cache — module-level ResultCache binding (the
+#: analysis/cardinality.py result-key pass keys off this spelling)
+RESULT_CACHE = ResultCache()
+
+
+# ---------------------------------------------------------------------------
+# rung (a): shared morsel scan streams
+# ---------------------------------------------------------------------------
+
+def _stall_s() -> float:
+    try:
+        return float(os.environ.get("OTB_SHARE_STALL_S", "30"))
+    except ValueError:
+        return 30.0
+
+
+#: leader run-ahead bound: a follower's undelivered backlog never
+#: exceeds this many pinned windows (bounds HBM wired by sharing)
+MAX_BACKLOG = 4
+
+
+class SharedStream:
+    """One leader-driven chunk stream over a store at a fixed version
+    and chunk shape, fanned into follower deques."""
+
+    def __init__(self, key, table: str, version: int, chunk_rows: int,
+                 names: frozenset, classes: dict):
+        self.key = key
+        self.table = table
+        self.version = version
+        self.chunk_rows = chunk_rows
+        self.names = names          # leader's staged names (incl. aux)
+        self.classes = classes      # column -> codec class key
+        self.cond = locks.Condition(
+            name="exec.share.SharedStream.cond")
+        # token -> {"deque", "join_lo", "expelled"}; guarded_by: cv
+        self.followers: dict = {}
+        self.published = 0          # next unpublished lo; guarded_by: cv
+        self.done = False           # guarded_by: cv
+        self.failed = False         # guarded_by: cv
+        self.accepting = True       # guarded_by: cv
+        self.fanin = 0              # followers ever; guarded_by: cv
+
+    # -- follower side -------------------------------------------------
+    def compatible(self, names: frozenset, classes: dict) -> bool:
+        if not names <= self.names:
+            return False
+        return all(self.classes.get(c) == k for c, k in classes.items())
+
+    def detach(self, token):
+        """Drop a follower and release every pin it still holds on
+        undelivered windows — its OWN pins only (per-consumer
+        refcounts), so the leader and other followers keep theirs."""
+        from ..storage.bufferpool import POOL
+        with self.cond:
+            f = self.followers.get(token)
+            if f is None:
+                return
+            f["expelled"] = True
+            while f["deque"]:
+                _lo, entry = f["deque"].popleft()
+                POOL.unpin_chunk(entry, consumer=token)
+            self.cond.notify_all()
+
+    # -- leader side ---------------------------------------------------
+    def publish(self, entry, lo: int, hi: int):
+        """Fan one staged window into every live follower: pin once
+        per consumer (the leader's own pin came from get_chunk), then
+        enqueue."""
+        from ..storage.bufferpool import POOL
+        nfed = 0
+        with self.cond:
+            for token, f in self.followers.items():
+                if f["expelled"]:
+                    continue
+                POOL.pin_chunk(entry, consumer=token)
+                f["deque"].append((lo, entry))
+                nfed += 1
+            self.published = hi
+            self.cond.notify_all()
+        if nfed:
+            bump("shared_chunks", nfed)
+
+    def throttle(self):
+        """Bound leader run-ahead: wait until every live follower's
+        backlog is under MAX_BACKLOG; a follower stalled past the
+        expel deadline is detached (it falls back to a private
+        stream when it notices)."""
+        deadline_waits = max(1, int(_stall_s() / 0.25))
+
+        def slow_locked():
+            return [t for t, f in self.followers.items()
+                    if not f["expelled"]
+                    and len(f["deque"]) >= MAX_BACKLOG]
+
+        with self.cond:
+            for _ in range(deadline_waits):
+                if not slow_locked():
+                    return
+                self.cond.wait(timeout=0.25)
+            stuck = slow_locked()
+        for token in stuck:
+            self.detach(token)
+            bump("private_fallbacks")
+
+    def finish(self, failed: bool = False):
+        with self.cond:
+            self.accepting = False
+            self.done = True
+            self.failed = failed
+            fanin = self.fanin
+            self.cond.notify_all()
+        if failed:
+            # expel everyone: undelivered pins release, followers fall
+            # back to private streams
+            with self.cond:
+                tokens = list(self.followers)
+            for token in tokens:
+                self.detach(token)
+        return fanin
+
+
+class ShareHub:
+    """Registry of in-flight shareable streams, keyed by (store
+    identity, store version, chunk shape)."""
+
+    def __init__(self):
+        self._lock = locks.Lock("exec.share.ShareHub._lock")
+        self._streams: dict = {}   # key -> SharedStream; guarded_by: _lock
+
+    def live_streams(self) -> int:
+        with self._lock:
+            return len(self._streams)
+
+    def attach(self, store, chunk_rows: int, names: frozenset,
+               classes: dict):
+        """("leader", stream, token) for the first arrival,
+        ("follower", stream, token, join_lo) for a compatible later
+        one, None when an open stream exists but is incompatible (the
+        caller streams privately)."""
+        key = (id(store), store.version, int(chunk_rows))
+        token = new_token()
+        with self._lock:
+            stream = self._streams.get(key)
+            if stream is None:
+                stream = SharedStream(key, store.td.name, store.version,
+                                      int(chunk_rows), names,
+                                      dict(classes))
+                self._streams[key] = stream
+                return "leader", stream, token, 0
+        with stream.cond:
+            if not stream.accepting \
+                    or not stream.compatible(names, classes):
+                return None
+            join_lo = stream.published
+            stream.followers[token] = {
+                "deque": collections.deque(),
+                "join_lo": join_lo, "expelled": False}
+            stream.fanin += 1
+        bump("shared_scan_fanin")
+        if join_lo > 0:
+            bump("late_joins")
+        return "follower", stream, token, join_lo
+
+    def remove(self, stream: SharedStream):
+        with self._lock:
+            if self._streams.get(stream.key) is stream:
+                del self._streams[stream.key]
+
+
+#: process-global hub — one stream per (store, version, shape) at a time
+HUB = ShareHub()
+
+
+from ..obs.metrics import REGISTRY as _METRICS  # noqa: E402
+_METRICS.register_collector("workshare", _metrics_samples)

@@ -270,9 +270,11 @@ def run_degraded(item) -> list:
     if item.planned is not None:
         from ..storage.batch import chunk_class
         from .morsel import MorselDriver
+        from .share import enabled as sharing_enabled
         drv_m = MorselDriver(node.stores, node.cache, snap, txid,
                              chunk_rows=chunk_class(budget),
-                             forced=True)
+                             forced=True,
+                             share=sharing_enabled(node.gucs))
         batch = drv_m.try_run(item.planned)
         if batch is not None:
             bump("streamed")

@@ -195,6 +195,15 @@ class CnServer:
                         send_msg(sock, {"error":
                                         f"{type(e).__name__}: {e}"})
                     continue
+                if msg.get("op") == "workshare":
+                    # cross-query work-sharing counters (otbshare):
+                    # shared-stream fan-in and result-cache hit/miss/
+                    # invalidation totals, queryable out-of-band so a
+                    # load driver can prove sublinearity without a
+                    # full metrics scrape
+                    from ..exec import share as workshare
+                    send_msg(sock, {"ok": workshare.stats_snapshot()})
+                    continue
                 if msg.get("op") != "query":
                     send_msg(sock, {"error":
                                     f"unknown op {msg.get('op')!r}"})
@@ -257,6 +266,14 @@ class CnClient:
     def metrics(self) -> str:
         """Fetch the server's Prometheus text exposition."""
         send_msg(self._sock, {"op": "metrics"})
+        resp = recv_msg(self._sock, expect_reply=True)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["ok"]
+
+    def workshare(self) -> dict:
+        """Fetch cross-query work-sharing counters (otbshare)."""
+        send_msg(self._sock, {"op": "workshare"})
         resp = recv_msg(self._sock, expect_reply=True)
         if "error" in resp:
             raise RuntimeError(resp["error"])

@@ -32,6 +32,11 @@ Views:
   quarantine_hits, oom_dispatches, oom_retries, oom_evicted_bytes,
   degraded, shrunk_batches, streamed) — the serving tier's
   fault-isolation counters (exec/shield.py)
+- otb_workshare(shared_streams, shared_scan_fanin, shared_chunks,
+  late_joins, private_fallbacks, result_cache_hits,
+  result_cache_misses, result_cache_invalidations, result_cache_puts,
+  result_cache_evictions, result_cache_bytes, result_cache_entries) —
+  the cross-query work-sharing counters (exec/share.py)
 """
 
 from __future__ import annotations
@@ -161,6 +166,25 @@ STAT_TABLES = {
         ColumnDef("degraded", T.INT64),
         ColumnDef("shrunk_batches", T.INT64),
         ColumnDef("streamed", T.INT64)],
+    # cross-query work sharing (exec/share.py): shared-scan fan-in and
+    # GTS-versioned result-cache counters — shared_streams = leader
+    # streams that fed >=1 follower; fanin = follower attachments
+    # (extra consumers served by someone else's pass); late_joins =
+    # mid-stream attachments; private_fallbacks = expels and
+    # incompatibilities that reverted to a private stream
+    "otb_workshare": [
+        ColumnDef("shared_streams", T.INT64),
+        ColumnDef("shared_scan_fanin", T.INT64),
+        ColumnDef("shared_chunks", T.INT64),
+        ColumnDef("late_joins", T.INT64),
+        ColumnDef("private_fallbacks", T.INT64),
+        ColumnDef("result_cache_hits", T.INT64),
+        ColumnDef("result_cache_misses", T.INT64),
+        ColumnDef("result_cache_invalidations", T.INT64),
+        ColumnDef("result_cache_puts", T.INT64),
+        ColumnDef("result_cache_evictions", T.INT64),
+        ColumnDef("result_cache_bytes", T.INT64),
+        ColumnDef("result_cache_entries", T.INT64)],
     # recent-query trace ring (obs/trace.py): one row per finished
     # top-level statement, newest last — per-phase wall-time breakdown
     # plus staging/materialization byte counts and buffer-pool hit
@@ -281,6 +305,9 @@ def refresh(cluster, names: list[str]):
         elif name == "otb_morsel":
             from ..exec.morsel import stats_rows as morsel_rows
             rows = list(morsel_rows())
+        elif name == "otb_workshare":
+            from ..exec.share import stats_rows as workshare_rows
+            rows = list(workshare_rows())
         elif name == "otb_stat_query":
             from ..obs import trace as obs_trace
             for qt in obs_trace.recent():

@@ -92,6 +92,8 @@ class DevEntry:
     nbytes: int           # actual device bytes (post-encoding)
     pins: int = 0         # refcount: >0 bars eviction (resident build
     # side of a streaming join, exec/morsel.py); guarded_by: _LOCK
+    pins_by: dict = dataclasses.field(default_factory=dict)
+    # consumer token -> refcount; sums to `pins`; guarded_by: _LOCK
     encs: dict = dataclasses.field(default_factory=dict)
     # staged name -> storage/codec.Enc for encoded columns (tail path)
     bytes_logical: int = 0  # unencoded bytes these arrays represent
@@ -113,6 +115,11 @@ class ChunkEntry:
     arrs: dict            # staged name -> device array [chunk_rows,...]
     nbytes: int           # actual device bytes (post-encoding)
     pins: int = 0         # guarded_by: _LOCK
+    pins_by: dict = dataclasses.field(default_factory=dict)
+    # consumer token -> refcount: a shared morsel stream
+    # (exec/share.py) pins one window once per consumer, and a
+    # consumer erroring mid-stream can only release its OWN pins —
+    # never a pin another fragment is still probing; guarded_by: _LOCK
     bytes_logical: int = 0  # unencoded bytes this window represents
 
 
@@ -301,17 +308,26 @@ class DeviceBufferPool:
                 + sum(e.pins for _s, e in self._chunks.values())
                 + sum(e.pins for e in self._orphans))
 
-    def _note_pin_locked(self, entry, table: str):
+    def _note_pin_locked(self, entry, table: str, consumer=None):
         # caller holds _LOCK
         entry.pins += 1
+        entry.pins_by[consumer] = entry.pins_by.get(consumer, 0) + 1
         self._pins_total += 1
         self._tstats(table)[4] += 1
 
-    def _note_unpin_locked(self, entry, table: str):
+    def _note_unpin_locked(self, entry, table: str, consumer=None):
         # caller holds _LOCK
+        held = entry.pins_by.get(consumer, 0)
+        assert held > 0, (
+            f"bufferpool: unpin for {table} by consumer {consumer!r} "
+            f"holding no pin (holders: {sorted(map(repr, entry.pins_by))})")
         entry.pins -= 1
         assert entry.pins >= 0, \
             f"bufferpool: unbalanced unpin for {table}"
+        if held == 1:
+            del entry.pins_by[consumer]
+        else:
+            entry.pins_by[consumer] = held - 1
         self._unpins_total += 1
         self._tstats(table)[5] += 1
         if entry.pins == 0:
@@ -321,12 +337,26 @@ class DeviceBufferPool:
     def check_pin_ledger(self):
         """Ledger invariant (mirrors the PR-10 slot ledgers): every pin
         is either balanced by an unpin or visible as a live pinned
-        entry — eviction/invalidation can never make a pin disappear."""
+        entry — eviction/invalidation can never make a pin disappear —
+        and every live entry's total refcount equals the sum of its
+        per-consumer counts, all positive (a consumer can never hold a
+        negative balance or release another consumer's pin)."""
         with _LOCK:
             live = self._live_pinned_locked()
             assert self._pins_total == self._unpins_total + live, (
                 f"bufferpool pin ledger broken: pins={self._pins_total} "
                 f"unpins={self._unpins_total} live={live}")
+            entries = ([e for _s, e in self._dev.values()]
+                       + [e for _s, e in self._chunks.values()]
+                       + list(self._orphans))
+            for e in entries:
+                assert e.pins == sum(e.pins_by.values()), (
+                    f"bufferpool pin ledger broken for {e.table}: "
+                    f"pins={e.pins} != per-consumer "
+                    f"{dict(e.pins_by)}")
+                assert all(c > 0 for c in e.pins_by.values()), (
+                    f"bufferpool pin ledger broken for {e.table}: "
+                    f"non-positive consumer count {dict(e.pins_by)}")
             return {"pins": self._pins_total,
                     "unpins": self._unpins_total, "live": live}
 
@@ -430,6 +460,12 @@ class DeviceBufferPool:
                 hit = True
             if hit:
                 self._tstats(table)[3] += 1
+        # cached RESULTS over this table die with its residency (outside
+        # _LOCK: the result cache has its own lock and never calls back
+        # into the pool) — DML is caught lazily by the version-tuple
+        # mismatch, but DROP/TRUNCATE must reclaim CN memory now
+        from ..exec.share import RESULT_CACHE
+        RESULT_CACHE.invalidate_table(table)
 
     # ------------------------------------------------------------------
     # single-device tier (exec/executor.py scans, fused tier, FQS)
@@ -657,7 +693,8 @@ class DeviceBufferPool:
             self._note_unpin_locked(entry, entry.table)
 
     def get_chunk(self, store, host_cols: dict, start: int,
-                  chunk_rows: int, encs: dict = None) -> ChunkEntry:
+                  chunk_rows: int, encs: dict = None,
+                  consumer=None) -> ChunkEntry:
         """One fixed-shape streaming window of `host_cols` (the staged
         namespace: value columns + MVCC sys columns + null masks),
         staged to device and returned PINNED — the caller unpins via
@@ -687,7 +724,7 @@ class DeviceBufferPool:
             if ent is not None and ent[1].version == ver:
                 ent[0] = next(_SEQ)
                 self._tstats(table)[0] += 1
-                self._note_pin_locked(ent[1], table)
+                self._note_pin_locked(ent[1], table, consumer)
                 return ent[1]
             if ent is not None:
                 self._chunks.pop(key, None)
@@ -725,7 +762,7 @@ class DeviceBufferPool:
             self._tstats(table)[1] += 1
             self.uploaded_bytes += up
             self._chunks[key] = [next(_SEQ), e]
-            self._note_pin_locked(e, table)
+            self._note_pin_locked(e, table, consumer)
             self._watch_store(store)
         if obs_trace.ENABLED:
             obs_trace.event("chunk_stage", table=table, start=int(start),
@@ -733,9 +770,17 @@ class DeviceBufferPool:
         self.trim()
         return e
 
-    def unpin_chunk(self, entry: ChunkEntry):
+    def pin_chunk(self, entry: ChunkEntry, consumer=None):
+        """Additional per-consumer pin on an already-staged window — a
+        shared morsel stream (exec/share.py) fans one leader-staged
+        window into every follower, each holding its own refcount."""
         with _LOCK:
-            self._note_unpin_locked(entry, entry.table)
+            self._note_pin_locked(entry, entry.table, consumer)
+        return entry
+
+    def unpin_chunk(self, entry: ChunkEntry, consumer=None):
+        with _LOCK:
+            self._note_unpin_locked(entry, entry.table, consumer)
 
     # ------------------------------------------------------------------
     # mesh tier (exec/mesh_exec.py staging)

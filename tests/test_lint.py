@@ -999,6 +999,50 @@ class TestCodecKeyQuantization:
                    for f in report["findings"]), report["findings"]
 
 
+class TestResultKeyPass:
+    """Result-cache key discipline (otbshare rung b): a wall-clock
+    read or a raw row count reaching a ``ResultCache.put`` key is a
+    finding; the clean twin keyed on (masked signature, literal
+    vector, store-version tuple) is silent — those three inputs
+    exactly determine the result, a timestamp or result size does
+    not."""
+
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/resultkeys.py": """\
+            import time
+            from opentenbase_tpu.exec.share import ResultCache
+
+            RCACHE = ResultCache()
+
+            def put_clock(sig, lits, gts, names, rows):
+                key = (sig, lits, time.time())    # wall clock in key
+                RCACHE.put(key, gts, names, rows)
+
+            def put_rowcount(sig, lits, store, gts, names, rows):
+                n = store.row_count()             # raw row count
+                RCACHE.put((sig, lits, n), gts, names, rows)
+
+            def put_rowlen(sig, lits, gts, names, rows):
+                RCACHE.put((sig, lits, len(rows)), gts, names, rows)
+
+            def put_clean(sig, lits, versions, gts, names, rows):
+                key = (sig, tuple(lits), versions)
+                RCACHE.put(key, gts, names, rows)
+        """,
+    }
+
+    def test_clock_and_rowcount_flagged_clean_twin_silent(
+            self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"result-key"})
+        got = sorted(f["symbol"] for f in report["findings"])
+        assert got == ["put_clock", "put_rowcount", "put_rowlen"], \
+            [(f["symbol"], f["message"]) for f in report["findings"]]
+
+
 class TestRetraceRiskPass:
     FILES = {
         "fixpkg/__init__.py": "",

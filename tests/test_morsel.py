@@ -349,11 +349,13 @@ class TestMidStreamDML:
         state = {"fired": False}
         orig = POOL.get_chunk
 
-        def chunk_with_dml(store, host, start, chunk_rows, encs=None):
+        def chunk_with_dml(store, host, start, chunk_rows, encs=None,
+                           consumer=None):
             if not state["fired"]:
                 state["fired"] = True
                 writer.execute("insert into mid values (777777)")
-            return orig(store, host, start, chunk_rows, encs)
+            return orig(store, host, start, chunk_rows, encs,
+                        consumer=consumer)
 
         monkeypatch.setattr(POOL, "get_chunk", chunk_with_dml)
         sess.execute("set morsel = on")

@@ -368,6 +368,32 @@ class TestClusterTier:
             assert name in text, name
 
 
+    def test_otb_workshare_view(self, cluster_env):
+        s = cluster_env
+        rows = s.query("select shared_streams, shared_scan_fanin, "
+                       "result_cache_hits, result_cache_bytes "
+                       "from otb_workshare")
+        assert len(rows) == 1, rows
+        assert all(v >= 0 for v in rows[0]), rows
+
+    def test_workshare_counters_exposed(self, cluster_env):
+        # importing exec.share registers its collector; the work-
+        # sharing counters must appear even before any sharing happens
+        # (zeros), so dashboards never see a gap
+        import opentenbase_tpu.exec.share  # noqa: F401
+        text = cluster_env.metrics_text()
+        for name in ("otb_workshare_shared_streams",
+                     "otb_workshare_shared_scan_fanin",
+                     "otb_workshare_shared_chunks",
+                     "otb_workshare_late_joins",
+                     "otb_workshare_private_fallbacks",
+                     "otb_workshare_result_cache_hits",
+                     "otb_workshare_result_cache_misses",
+                     "otb_workshare_result_cache_invalidations",
+                     "otb_workshare_result_cache_bytes"):
+            assert name in text, name
+
+
 def test_cn_server_metrics_op():
     from opentenbase_tpu.net.cn_server import CnClient, CnServer
     cluster = Cluster(n_datanodes=2)
@@ -381,6 +407,8 @@ def test_cn_server_metrics_op():
         text = c.metrics()
         assert "otb_queries_total" in text
         assert "# TYPE" in text
+        ws = c.workshare()
+        assert "shared_scan_fanin" in ws and "result_cache_hits" in ws
         c.close()
     finally:
         srv.stop()

@@ -92,6 +92,9 @@ class TestPipelineGuc:
         bit-identical rows — the GUC only moves the host sync, never
         the math."""
         node = _mk_node()
+        # the repeated statements must actually DISPATCH both times —
+        # the result cache would serve the second pass at submit
+        node.gucs["enable_work_sharing"] = "off"
         sqls = [AGG_Q.format(n) for n in (50, 80, 120, 199)] + \
             [f"select v from kv where k = {i}" for i in (3, 11, 29)]
         ref = [Session(node).execute(q)[-1].rows for q in sqls]

@@ -1,0 +1,274 @@
+"""Cross-query work sharing (exec/share.py): shared morsel scans and
+the GTS-versioned result cache.
+
+The contract under test, both rungs exact:
+- N concurrent same-table streaming queries drive ONE chunk stream
+  (host-staged bytes stay ~1x, counter-proven) and every consumer's
+  rows are bit-identical to its private-stream answer, with the pin
+  ledger balanced after the fan-in;
+- a repeated statement is served from the result cache with ZERO
+  additional device dispatches; DML between two lookups invalidates
+  exactly the touched table's entries; a cached result tagged GTS=t is
+  never served to a snapshot older than t;
+- `enable_work_sharing = off` reverts to private streams and an
+  untouched cache, bit-identically.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import opentenbase_tpu.exec.scheduler as sm
+import opentenbase_tpu.exec.share as share
+from opentenbase_tpu.exec.session import LocalNode, Session
+from opentenbase_tpu.storage.bufferpool import POOL
+
+N_ROWS = 60000
+CHUNK = 4096
+
+# every query scans f.v only, so all four are stream-compatible (the
+# follower's staged column set must be a subset of the leader's)
+QUERIES = [
+    "select sum(v) from f",
+    "select min(v), max(v) from f",
+    "select count(*) from f where v > 50",
+    "select sum(v), count(v) from f where v < 30",
+]
+
+
+@pytest.fixture(scope="module")
+def node():
+    node = LocalNode()
+    s = Session(node)
+    s.execute("create table f (k bigint, v decimal(8,2))")
+    rng = np.random.default_rng(11)
+    ks = rng.integers(0, 5000, N_ROWS)
+    s._insert_rows(node.catalog.table("f"), node.stores["f"],
+                   {"k": ks, "v": (ks % 100).astype(float)}, N_ROWS)
+    node.gucs["morsel"] = "on"
+    node.gucs["morsel_chunk_rows"] = str(CHUNK)
+    return node
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    share.reset_stats()
+    share.RESULT_CACHE.clear()
+    yield
+    share.reset_stats()
+    share.RESULT_CACHE.clear()
+
+
+@pytest.fixture(scope="module")
+def baseline(node):
+    """Private-stream answers (sharing off) — also warms every
+    compiled fragment, so the shared runs below measure data movement,
+    not compilation."""
+    node.gucs["enable_work_sharing"] = "off"
+    try:
+        return [Session(node).query(q) for q in QUERIES]
+    finally:
+        node.gucs["enable_work_sharing"] = "on"
+
+
+def _concurrent(node, sqls):
+    res = [None] * len(sqls)
+    errs = [None] * len(sqls)
+    bar = threading.Barrier(len(sqls))
+
+    def go(i):
+        try:
+            bar.wait(timeout=60)
+            res[i] = Session(node).query(sqls[i])
+        except Exception as e:   # noqa: BLE001 — re-raised below
+            errs[i] = e
+
+    threads = [threading.Thread(target=go, args=(i,))
+               for i in range(len(sqls))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert all(e is None for e in errs), errs
+    return res
+
+
+# ---------------------------------------------------------------------------
+# rung (a): shared morsel scans
+# ---------------------------------------------------------------------------
+
+class TestSharedScan:
+    def test_one_stream_bit_identical_ledger_balanced(self, node,
+                                                      baseline):
+        node.gucs["enable_work_sharing"] = "off"
+        POOL.clear()
+        up0 = POOL.totals()["uploaded_bytes"]
+        Session(node).query(QUERIES[0])
+        one_pass = POOL.totals()["uploaded_bytes"] - up0
+        assert one_pass > 0
+
+        node.gucs["enable_work_sharing"] = "on"
+        POOL.clear()
+        share.reset_stats()
+        up1 = POOL.totals()["uploaded_bytes"]
+        got = _concurrent(node, QUERIES)
+        shared_pass = POOL.totals()["uploaded_bytes"] - up1
+
+        for b, g in zip(baseline, got):
+            assert g == b, (b, g)
+        st = share.stats_snapshot()
+        # at least one consumer piggybacked on another's stream (the
+        # barrier makes full 4-way fan-in the overwhelmingly common
+        # case, but the contract is only ever an optimization)
+        assert st["shared_scan_fanin"] >= 1, st
+        assert st["private_fallbacks"] == 0, st
+        assert st["shared_chunks"] >= 1, st
+        # 4 private streams would stage ~4x one pass; sharing keeps the
+        # host->device traffic at ~1x (late joiners may re-read a short
+        # missed prefix from the warm chunk cache: zero re-upload)
+        assert shared_pass < 2.5 * one_pass, (shared_pass, one_pass)
+        led = POOL.check_pin_ledger()
+        assert led["live"] == 0, led
+        assert share.HUB.live_streams() == 0
+
+    def test_off_guc_reverts_to_private_streams(self, node, baseline):
+        node.gucs["enable_work_sharing"] = "off"
+        try:
+            share.reset_stats()
+            got = _concurrent(node, QUERIES)
+        finally:
+            node.gucs["enable_work_sharing"] = "on"
+        for b, g in zip(baseline, got):
+            assert g == b, (b, g)
+        st = share.stats_snapshot()
+        assert st["shared_streams"] == 0, st
+        assert st["shared_scan_fanin"] == 0, st
+        assert st["result_cache_puts"] == 0, st
+        led = POOL.check_pin_ledger()
+        assert led["live"] == 0, led
+
+    def test_incompatible_column_set_falls_back_private(self, node,
+                                                        baseline):
+        """A concurrent query needing a column the leader did not
+        stage must not attach — it streams privately and still
+        answers correctly."""
+        node.gucs["enable_work_sharing"] = "on"
+        k_query = "select count(*) from f where k > 100"
+        expect = Session(node).query(k_query)
+        share.reset_stats()
+        got = _concurrent(node, [QUERIES[0], k_query])
+        assert got[0] == baseline[0]
+        assert got[1] == expect
+        led = POOL.check_pin_ledger()
+        assert led["live"] == 0, led
+
+
+# ---------------------------------------------------------------------------
+# rung (b): GTS-versioned result cache
+# ---------------------------------------------------------------------------
+
+def _mk_sched_node():
+    node = LocalNode()
+    s = Session(node)
+    s.execute("create table a (x bigint)")
+    s.execute("insert into a values (1), (2), (3)")
+    s.execute("create table b (y bigint)")
+    s.execute("insert into b values (10), (20)")
+    return node, s
+
+
+class TestResultCache:
+    def test_repeat_query_zero_additional_dispatches(self):
+        node, s = _mk_sched_node()
+        sm.reset_stats()
+        try:
+            with sm.Scheduler(node=node) as sched:
+                r1 = sched.run(s, "select sum(x) from a")[-1].rows
+                d1 = sm.stats_snapshot()["dispatches"]
+                r2 = sched.run(s, "select sum(x) from a")[-1].rows
+                d2 = sm.stats_snapshot()["dispatches"]
+        finally:
+            sm.reset_stats()
+        assert r1 == r2 == [(6,)]
+        assert d2 == d1, (d1, d2)   # hit: no device dispatch at all
+        st = share.stats_snapshot()
+        assert st["result_cache_hits"] == 1, st
+        assert st["result_cache_puts"] >= 1, st
+
+    def test_dml_invalidates_exactly_the_touched_table(self):
+        node, s = _mk_sched_node()
+        try:
+            with sm.Scheduler(node=node) as sched:
+                sched.run(s, "select sum(x) from a")
+                sched.run(s, "select sum(y) from b")
+                pre_warm = share.stats_snapshot()
+                assert sched.run(
+                    s, "select sum(x) from a")[-1].rows == [(6,)]
+                assert sched.run(
+                    s, "select sum(y) from b")[-1].rows == [(30,)]
+                warm = share.stats_snapshot()
+                assert warm["result_cache_hits"] \
+                    - pre_warm["result_cache_hits"] == 2, warm
+
+                pre = share.stats_snapshot()
+                sched.run(s, "insert into a values (4)")
+                ra = sched.run(s, "select sum(x) from a")[-1].rows
+                rb = sched.run(s, "select sum(y) from b")[-1].rows
+                post = share.stats_snapshot()
+        finally:
+            sm.reset_stats()
+        assert ra == [(10,)], ra     # fresh result, never the stale 6
+        assert rb == [(30,)], rb
+        # exactly ONE entry died (a's); b's entry was untouched and HIT
+        assert post["result_cache_invalidations"] \
+            - pre["result_cache_invalidations"] == 1, (pre, post)
+        assert post["result_cache_hits"] \
+            - pre["result_cache_hits"] == 1, (pre, post)
+        assert post["result_cache_misses"] \
+            - pre["result_cache_misses"] == 1, (pre, post)
+
+    def test_gts_gate_never_serves_an_older_snapshot(self):
+        rc = share.ResultCache()
+        vkey = (("t", 7),)
+        assert rc.put(("sig", ("l",), vkey), 100, ("c",), [(1,)])
+        # snapshot 99 predates the producing snapshot: not servable...
+        assert rc.lookup("sig", ("l",), vkey, 99) is None
+        # ...but the entry stays resident for newer snapshots
+        assert rc.entries() == 1
+        assert rc.lookup("sig", ("l",), vkey, 100) is not None
+        assert rc.lookup("sig", ("l",), vkey, 101) is not None
+        # a store-version mismatch is exact invalidation: drop
+        assert rc.lookup("sig", ("l",), (("t", 8),), 200) is None
+        assert rc.entries() == 0
+
+    def test_budget_bounds_bytes_lru_evicts(self):
+        rc = share.ResultCache()
+        rows = [(i,) for i in range(100)]
+        nb = share._rows_nbytes(("c",), rows)
+        budget = int(nb * 2.5)
+        for i in range(3):
+            assert rc.put((f"s{i}", (), (("t", 1),)), 10, ("c",),
+                          rows, budget=budget)
+        assert rc.entries() == 2
+        assert rc.nbytes() <= budget
+        # oldest evicted, newest resident
+        assert rc.lookup("s0", (), (("t", 1),), 10) is None
+        assert rc.lookup("s2", (), (("t", 1),), 10) is not None
+        # an oversized result is refused outright
+        assert not rc.put(("big", (), (("t", 1),)), 10, ("c",),
+                          rows, budget=nb // 2)
+
+    def test_off_guc_bypasses_the_cache(self):
+        node, s = _mk_sched_node()
+        node.gucs["enable_work_sharing"] = "off"
+        try:
+            with sm.Scheduler(node=node) as sched:
+                r1 = sched.run(s, "select sum(x) from a")[-1].rows
+                r2 = sched.run(s, "select sum(x) from a")[-1].rows
+        finally:
+            sm.reset_stats()
+        assert r1 == r2 == [(6,)]
+        st = share.stats_snapshot()
+        assert st["result_cache_puts"] == 0, st
+        assert st["result_cache_hits"] == 0, st

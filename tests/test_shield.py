@@ -119,6 +119,9 @@ class TestPoisonedBatchMatrix:
 class TestQuarantine:
     def test_repeat_offender_barred_then_serial(self):
         node, _ = _mk_node()
+        # quarantine needs BOTH rounds to dispatch as 2-member batches;
+        # the result cache would serve the innocent at submit in round 2
+        node.gucs["enable_work_sharing"] = "off"
         FI.arm_poison(5)
         with sm.Scheduler(node=node, window_ms=300.0) as sched:
             for _round in range(2):      # threshold: 2 failures
