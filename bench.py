@@ -439,6 +439,7 @@ def _chaos_arm():
             f"({i}, {i * 3})" for i in range(64)))
 
         lat, errors, wrong = [], 0, 0
+        wv0 = _wait_snapshot()
         t_all = time.perf_counter()
         for i in range(n_ops):
             if i and i % flap_every == 0:
@@ -462,6 +463,17 @@ def _chaos_arm():
         for name, labels, kind, value in REGISTRY.samples():
             if kind == "counter" and name.startswith("otb_guard_"):
                 counters[name] = counters.get(name, 0) + int(value)
+
+        # flight-recorder smoke: the flapping DN tripped the breaker,
+        # so at least one postmortem bundle must exist AND round-trip
+        # through JSON — a chaos run that leaves no forensics is a
+        # regression in the recorder, not a quiet success
+        from opentenbase_tpu.obs import xray
+        bundles = xray.flights()
+        assert bundles, "DN flap produced no flight bundle"
+        for b in bundles:
+            json.loads(json.dumps(b))
+
         ms = np.asarray(lat) * 1e3
         out = {
             "metric": "chaos point-read p99 (one DN flapping)",
@@ -474,6 +486,8 @@ def _chaos_arm():
             "error_rate": round(errors / n_ops, 4),
             "wrong_results": wrong,
             "guard_counters": dict(sorted(counters.items())),
+            "flight_bundles": len(bundles),
+            "wait_events": _wait_block(wv0),
         }
         if tpu_unavailable:
             out["tpu_unavailable"] = True
@@ -819,7 +833,9 @@ def _phases(qs):
 
 def _dump_trace(cfg):
     """--trace: full last-query span tree, one JSON line on stderr
-    (stdout stays the single bench JSON line)."""
+    (stdout stays the single bench JSON line).  Cluster runs include
+    the piggy-backed remote DN/GTM subtrees — obs/xray.py grafts them
+    into the CN tree before the trace reaches the ring."""
     if not TRACE_DUMP:
         return
     from opentenbase_tpu.obs import trace as obs_trace
@@ -846,6 +862,34 @@ def _latency_block():
         out.setdefault(lbl, {})[tag] = (
             int(value) if tag == "count" else round(float(value), 3))
     return out
+
+
+def _wait_snapshot():
+    """(event -> (count, total_ms)) snapshot of the cumulative
+    wait-event registry, so arms can report their own deltas."""
+    from opentenbase_tpu.obs import xray
+    return {ev: (cnt, tot) for ev, cnt, tot, _p50, _p95, _p99
+            in xray.wait_rows()}
+
+
+def _wait_block(w0=None):
+    """Where this arm's threads actually blocked: top-5 wait events by
+    total stalled ms (delta against the `w0` snapshot when given) with
+    the cumulative p50/p95/p99 per event — the bench-side twin of the
+    otb_wait_events view."""
+    from opentenbase_tpu.obs import xray
+    w0 = w0 or {}
+    rows = []
+    for ev, cnt, tot, p50, p95, p99 in xray.wait_rows():
+        c0, t0 = w0.get(ev, (0, 0.0))
+        if cnt - c0 <= 0:
+            continue
+        rows.append((tot - t0, ev, cnt - c0, p50, p95, p99))
+    rows.sort(reverse=True)
+    return {ev: {"count": cnt, "total_ms": round(tot, 3),
+                 "p50_ms": round(p50, 3), "p95_ms": round(p95, 3),
+                 "p99_ms": round(p99, 3)}
+            for tot, ev, cnt, p50, p95, p99 in rows[:5]}
 
 
 def _mat_counters(x0, x1):
@@ -1117,6 +1161,7 @@ def _qps_arm(name, node, stream, clients, seconds, warm_s):
             _qps_drive(sched, node, stream, clients, warm_s)
         s0 = sched_mod.stats_snapshot()
         c0 = _compile_snapshot()
+        wv0 = _wait_snapshot()
         lats, shed, wall = _qps_drive(sched, node, stream, clients,
                                       seconds)
         c1 = _compile_snapshot()
@@ -1147,6 +1192,7 @@ def _qps_arm(name, node, stream, clients, seconds, warm_s):
             - s0["batch_dispatches"],
             "batch_hist": " ".join(f"{k}:{v}"
                                    for k, v in sorted(hist.items())),
+            "wait_events": _wait_block(wv0),
             **_compile_counters(c0, c1)}
 
 
@@ -1225,6 +1271,7 @@ def _qps_zipf_arm(node, clients, seconds, warm_s):
         sheds[:] = [0] * clients
         s0 = sched_mod.stats_snapshot()
         w0 = share_mod.stats_snapshot()
+        wv0 = _wait_snapshot()
         wall = drive(sched, seconds)
         s1 = sched_mod.stats_snapshot()
         w1 = share_mod.stats_snapshot()
@@ -1245,7 +1292,8 @@ def _qps_zipf_arm(node, clients, seconds, warm_s):
             "cache_hits": hits,
             "cache_hit_rate": hits / (hits + misses)
             if hits + misses else 0.0,
-            "fanin": w1["shared_scan_fanin"] - w0["shared_scan_fanin"]}
+            "fanin": w1["shared_scan_fanin"] - w0["shared_scan_fanin"],
+            "wait_events": _wait_block(wv0)}
 
 
 def _replica_counter(prefix):
@@ -1295,6 +1343,7 @@ def _qps_replica_arm(n_replicas, clients, seconds, tmpdir):
     cl, servers = _qps_replica_setup(n_replicas, tmpdir)
     routed0 = _replica_counter("otb_replica_reads_total")
     fall0 = _replica_counter("otb_replica_fallthrough_total")
+    wv0 = _wait_snapshot()
     lats = [[] for _ in range(clients)]
     wrong = [0] * clients
     stop_at = [0.0]
@@ -1338,7 +1387,8 @@ def _qps_replica_arm(n_replicas, clients, seconds, tmpdir):
                 _replica_counter("otb_replica_reads_total") - routed0,
             "fallthrough":
                 _replica_counter("otb_replica_fallthrough_total")
-                - fall0}
+                - fall0,
+            "wait_events": _wait_block(wv0)}
 
 
 def _qps_mode():

@@ -49,6 +49,9 @@ RULES = {
                   "traced region",
     "net-deadline": "network conversation without a deadline, or raw "
                     "socket I/O outside the frame codec",
+    "wait-discipline": "blocking wait (Condition.wait, bounded-queue "
+                       "get/put, reply-owed recv) outside a named "
+                       "wait_event(...) context",
     "lock-order": "lock-acquisition-order cycle (potential deadlock) "
                   "or a runtime-witnessed edge the static graph lacks",
     "lock-blocking": "blocking operation (RPC, sleep, subprocess, "

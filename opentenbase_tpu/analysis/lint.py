@@ -29,7 +29,7 @@ from .core import (Baseline, Project, RULES, default_baseline_path,
                    make_report)
 from .passes import (HostSyncPass, LockDisciplinePass, NetDeadlinePass,
                      ObsPurityPass, ProgramKeyPass, SlotDisciplinePass,
-                     TracePurityPass)
+                     TracePurityPass, WaitDisciplinePass)
 
 _CONCURRENCY_RULES = {"lock-order", "lock-blocking", "lock-atomicity"}
 
@@ -49,6 +49,7 @@ def run_passes(project: Project, rules=None) -> list:
         ProgramKeyPass(project),
         LockDisciplinePass(project),
         NetDeadlinePass(project),
+        WaitDisciplinePass(project),
         ThreadDaemonPass(project),
         SlotDisciplinePass(project),
         ProgramCardinalityPass(project, closure),

@@ -1093,6 +1093,170 @@ class NetDeadlinePass:
 
 
 # ===========================================================================
+# wait-discipline
+# ===========================================================================
+class WaitDisciplinePass:
+    """Every blocking wait on the serving path must be attributed to a
+    named wait event.  In scope (``exec/``, ``net/``, ``gtm/``,
+    ``storage/``), these calls must run lexically inside a
+    ``with ...wait_event("..."):`` block (obs/xray.py) or carry a
+    justified ``# otblint: disable=wait-discipline`` pragma:
+
+    - ``<cond-or-event>.wait(...)`` — a Condition/Event park is exactly
+      the stall ``otb_wait_events`` exists to explain; an unnamed one
+      is invisible to the histogram AND to ``otb_stat_activity``.
+    - ``.get(...)`` on a ``queue.Queue`` attribute, and ``.put(...)``
+      when that queue was constructed bounded (a bounded put blocks on
+      backpressure; ``get_nowait``/unbounded puts never park).
+    - ``recv_msg(..., expect_reply=True)`` — the caller is owed a
+      reply, so this recv IS the RPC on-wire wait.
+
+    The frame codecs (``net/wire.py``, ``net/pgwire.py``) are exempt —
+    they are the mechanism under the named waits, not call sites.
+    Method calls on ``self`` named ``wait`` (e.g. ``Scheduler.wait``)
+    are wrappers, not primitives — the primitive they park on is
+    checked at its own site."""
+
+    rule = "wait-discipline"
+
+    def __init__(self, project: Project):
+        self.project = project
+        pkg = project.package
+        self.scope_dirs = (f"{pkg}/exec/", f"{pkg}/net/",
+                          f"{pkg}/gtm/", f"{pkg}/storage/")
+        self.exempt_files = (f"{pkg}/net/wire.py", f"{pkg}/net/pgwire.py")
+
+    def _in_scope(self, norm: str) -> bool:
+        return norm.startswith(self.scope_dirs) \
+            and norm not in self.exempt_files
+
+    def run(self) -> list:
+        import os as _os
+        findings = []
+        for rel, mi in self.project.by_rel.items():
+            norm = rel.replace(_os.sep, "/")
+            if self._in_scope(norm):
+                self._check_module(mi, findings)
+        return findings
+
+    # -- helpers --------------------------------------------------------
+    def _enclosing(self, mi, line: int):
+        best, best_start = None, -1
+        for fi in mi.functions.values():
+            node = fi.node
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end and node.lineno > best_start:
+                best, best_start = fi, node.lineno
+        return best
+
+    def _emit(self, findings, mi, line: int, message: str):
+        src = mi.src
+        if src.disabled(line, self.rule):
+            return
+        fi = self._enclosing(mi, line)
+        if fi is not None and _fn_disabled(fi, self.rule):
+            return
+        findings.append(Finding(self.rule, src.rel, line,
+                                fi.qualname if fi else "", message))
+
+    @staticmethod
+    def _base_name(expr) -> Optional[str]:
+        """Last name segment of a call receiver: `self._q` -> `_q`."""
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return None
+
+    def _check_module(self, mi, findings):
+        tree = mi.src.tree
+        # line intervals of `with ...wait_event(...):` blocks — a wait
+        # lexically inside one is attributed, whatever thread runs it
+        covered = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                call = item.context_expr
+                if isinstance(call, ast.Call):
+                    d = _dotted(call.func, mi) or ""
+                    if d.split(".")[-1] == "wait_event":
+                        covered.append((node.lineno,
+                                        getattr(node, "end_lineno",
+                                                node.lineno)))
+                        break
+
+        def attributed(line: int) -> bool:
+            return any(a <= line <= b for a, b in covered)
+
+        # harvest queue.Queue attribute/name assignments; remember
+        # which were constructed with a capacity (bounded => put blocks)
+        queues, bounded = set(), set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):   # self._q: Queue = ...
+                targets = [node.target]
+            else:
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            d = _dotted(node.value.func, mi) or ""
+            if d.split(".")[-1] != "Queue":
+                continue
+            for t in targets:
+                name = self._base_name(t)
+                if name is None:
+                    continue
+                queues.add(name)
+                if node.value.args or any(kw.arg == "maxsize"
+                                          for kw in node.value.keywords):
+                    bounded.add(name)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            line = node.lineno
+            d = _dotted(node.func, mi) or ""
+            if d.split(".")[-1] == "recv_msg" and any(
+                    kw.arg == "expect_reply"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value for kw in node.keywords):
+                if not attributed(line):
+                    self._emit(findings, mi, line,
+                               "recv_msg(expect_reply=True) outside a "
+                               "wait_event context — this recv is the "
+                               "RPC on-wire wait; name it")
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            base = self._base_name(f.value)
+            if f.attr == "wait":
+                # `self.wait(...)` is a wrapper method, not a primitive
+                if isinstance(f.value, ast.Name) and f.value.id == "self":
+                    continue
+                if not attributed(line):
+                    self._emit(findings, mi, line,
+                               f"blocking .wait() on {base or '?'} "
+                               f"outside a wait_event context — "
+                               f"unnamed stall, invisible to "
+                               f"otb_wait_events")
+            elif f.attr == "get" and base in queues:
+                if not attributed(line):
+                    self._emit(findings, mi, line,
+                               f"queue {base}.get() outside a "
+                               f"wait_event context — an empty queue "
+                               f"parks this thread unnamed")
+            elif f.attr == "put" and base in bounded:
+                if not attributed(line):
+                    self._emit(findings, mi, line,
+                               f"bounded queue {base}.put() outside a "
+                               f"wait_event context — backpressure "
+                               f"parks this thread unnamed")
+
+
+# ===========================================================================
 # slot-discipline
 # ===========================================================================
 class SlotDisciplinePass:

@@ -31,6 +31,7 @@ import numpy as np
 
 from ..catalog.types import SqlType, TypeKind
 from ..obs import trace as obs_trace
+from ..obs import xray as obs_xray
 from ..parallel.cluster import Cluster
 from ..plan import exprs as E
 from ..plan.distribute import (BatchSource, DistPlan, Exchange, ExchangeRef,
@@ -408,11 +409,18 @@ class DistExecutor:
             # max(DN), not sum(DN) (reference: RunRemoteController's
             # parallel connection pump, execDispatchFragment.c:1024)
             from concurrent.futures import ThreadPoolExecutor
+            # the span stack is thread-local, so the workers can't open
+            # spans — but a CAPTURED trace context still rides each RPC
+            # (xray.inject reads it), and the DN-side subtrees it brings
+            # back are grafted into this trace at finish
+            xctx = obs_xray.capture()
+
+            def _on(i):
+                with obs_xray.propagated(xctx):
+                    return self._exec_fragment_on(frag, dp, i, ex_out)
+
             with ThreadPoolExecutor(len(dn_range)) as pool:
-                per_dn: list[HostBatch] = list(pool.map(
-                    lambda i: self._exec_fragment_on(frag, dp, i,
-                                                     ex_out),
-                    dn_range))
+                per_dn: list[HostBatch] = list(pool.map(_on, dn_range))
         else:
             per_dn = [self._exec_fragment_on(frag, dp, dn_idx, ex_out)
                       for dn_idx in dn_range]

@@ -54,6 +54,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from ..obs import trace as obs_trace
+from ..obs import xray as obs_xray
 from ..plan import exprs as E
 from ..plan import physical as P
 from ..plan.distribute import BatchSource
@@ -655,7 +656,8 @@ class MorselDriver:
                         f = stream.followers[token]
                         while not f["deque"] and not stream.done \
                                 and not f["expelled"]:
-                            stream.cond.wait(timeout=0.25)
+                            with obs_xray.wait_event("share-backlog"):
+                                stream.cond.wait(timeout=0.25)
                         if f["expelled"] or stream.failed:
                             raise _ShareFallback()
                         if f["deque"]:

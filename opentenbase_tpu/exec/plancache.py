@@ -550,7 +550,8 @@ _warm_thread: Optional[threading.Thread] = None
 
 def _warm_loop():
     while True:
-        job = _warm_q.get()
+        # warmup daemon idle dequeue, not a query-visible stall
+        job = _warm_q.get()  # otblint: disable=wait-discipline
         try:
             job()
         except Exception:

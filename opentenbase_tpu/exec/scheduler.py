@@ -68,6 +68,7 @@ from .fused import (batch_signature, finish_fused_batch,
                     launch_fused_batch, run_fused_batch,
                     stage_fused_batch)
 from .session import Result
+from ..obs import xray as obs_xray
 from ..utils import locks
 
 # ---------------------------------------------------------------------------
@@ -303,11 +304,12 @@ class _Item:
                  "t_submit", "ev", "error", "results", "batch",
                  "out_names", "is_write", "deadline", "cancel_event",
                  "lk", "cv", "detached", "degraded", "lits",
-                 "snap", "vkey")
+                 "snap", "vkey", "aid")
 
     def __init__(self, session, sql):
         self.session = session
         self.sql = sql
+        self.aid = 0              # otb_stat_activity handle (0 = none)
         self.planned = None
         self.info = None          # FragSig when batchable, else None
         self.group = "default"
@@ -440,8 +442,11 @@ class Scheduler:
             self._thread.join(timeout=30)
             if drainer is not None:
                 # FIFO: every flight the dispatcher enqueued drains
-                # before the sentinel — no result is abandoned
-                self._drainq.put(_STOP)
+                # before the sentinel — no result is abandoned.
+                # Shutdown path, not a query-visible stall: no wait
+                # event (the dispatcher is already stopped, so the
+                # queue only shrinks from here).
+                self._drainq.put(_STOP)  # otblint: disable=wait-discipline
                 drainer.join(timeout=30)
             self._pool.shutdown(wait=True)
         try:
@@ -491,6 +496,10 @@ class Scheduler:
             raise ExecError(
                 f"resource group '{item.group}' queue is full "
                 f"({self.max_queue} queued): query shed")
+        # live-statement registration (otb_stat_activity): born queued,
+        # state advances at dispatch; the waiter unregisters in wait()
+        item.aid = obs_xray.activity_begin(item.sql,
+                                           cancel=item.cancel_event)
         self._q.put(item)
         return item
 
@@ -524,6 +533,8 @@ class Scheduler:
                             if item.deadline is not None \
                                     and now >= item.deadline:
                                 _bump("expired")
+                                obs_xray.flight("statement_timeout",
+                                                sig=item.sql)
                                 raise ExecError(
                                     "canceling statement due to "
                                     "statement timeout")
@@ -540,12 +551,14 @@ class Scheduler:
                                 "canceling statement due to user "
                                 "request")
                         break
-                    item.cv.wait(
-                        rem if (wakeable or cancel is None)
-                        else min(0.05, rem))
+                    with obs_xray.wait_event("sched-result"):
+                        item.cv.wait(
+                            rem if (wakeable or cancel is None)
+                            else min(0.05, rem))
         finally:
             if wakeable:
                 cancel.unregister(item.cv)
+            obs_xray.activity_end(item.aid)
         if item.error is not None:
             raise item.error
         if item.results is not None:
@@ -645,6 +658,7 @@ class Scheduler:
             if self._complete(item, error=ExecError(
                     "canceling statement due to statement timeout")):
                 _bump("expired")
+                obs_xray.flight("statement_timeout", sig=item.sql)
             return True
         cancel = item.cancel_event
         if cancel is not None and cancel.is_set():
@@ -747,8 +761,9 @@ class Scheduler:
             # immediately; the bounded timeout still catches GTM-side
             # frees this condition can't observe (other owners, lease
             # reaping)
-            with self._slot_cv:
-                self._slot_cv.wait(timeout=delay)
+            with obs_xray.wait_event("sched-admission", group=group):
+                with self._slot_cv:
+                    self._slot_cv.wait(timeout=delay)
             delay = min(delay * 2, 0.05)
         _bump("slots_acquired")
 
@@ -780,9 +795,10 @@ class Scheduler:
         if self._deferred:
             return self._deferred.popleft()
         try:
+            # dispatcher idle dequeue, not a query-visible stall
             if timeout is None:
-                return self._q.get()
-            return self._q.get(timeout=timeout)
+                return self._q.get()  # otblint: disable=wait-discipline
+            return self._q.get(timeout=timeout)  # otblint: disable=wait-discipline
         except queue.Empty:
             return None
 
@@ -968,6 +984,8 @@ class Scheduler:
             return
         t_start = time.monotonic()
         flight = sb = None
+        for it in items:
+            obs_xray.activity_state(it.aid, "staging")
         try:
             node = items[0].session.node
             vkey = items[0].info.version_key()
@@ -992,6 +1010,8 @@ class Scheduler:
                         _note_stage((time.perf_counter() - t0) * 1e3,
                                     overlapped)
                     if sb is not None:
+                        for it in items:
+                            obs_xray.activity_state(it.aid, "device")
                         flight = launch_fused_batch(sb)
                     break
                 except BaseException as e:
@@ -1015,9 +1035,12 @@ class Scheduler:
         with self._pipe_lock:
             self._inflight += 1
         _bump("pipelined_dispatches")
+        for it in items:
+            obs_xray.activity_state(it.aid, "draining")
         # bounded queue: a slow drainer back-pressures the dispatcher
         # here, capping how much device work can pile up in flight
-        self._drainq.put(_Flight(items, flight, sb, group, t_start))
+        with obs_xray.wait_event("sched-drain-queue"):
+            self._drainq.put(_Flight(items, flight, sb, group, t_start))
 
     def _drain_loop(self):
         """Drainer thread: the finish-phase host sync (join-ladder
@@ -1032,7 +1055,8 @@ class Scheduler:
         # may-acquire: exec.scheduler.Scheduler._slot_cv
         """
         while True:
-            fl = self._drainq.get()
+            # drainer idle dequeue, not a query-visible stall
+            fl = self._drainq.get()  # otblint: disable=wait-discipline
             if fl is _STOP:
                 return
             self._drain_one(fl)
@@ -1100,6 +1124,9 @@ class Scheduler:
         live = [it for it in items if not self._expire_if_dead(it)]
         if not live:
             return
+        obs_xray.flight("poison_bisect",
+                        sig=str(live[0].sig or live[0].sql),
+                        members=len(live))
         if len(live) == 1:
             shield.bump("isolated")
             self._pool.submit(self._run_serial, live[0])
@@ -1157,6 +1184,11 @@ class Scheduler:
             return
         try:
             _note_dispatch([item], time.monotonic())
+            # slot held: only NOW is the statement on the device path
+            # (marking before _admit would show a slot-starved query
+            # as "device" while it is really still queued)
+            obs_xray.activity_state(item.aid, "device",
+                                    thread=threading.get_ident())
             try:
                 shield.serial_guard(item.lits)
                 if item.is_write:

@@ -391,6 +391,17 @@ def _trace_explain_lines() -> str:
             f"Exchanges: rounds={rounds} "
             f"bytes={int(qt.sum_attr('exchange', 'bytes'))} "
             f"time={qt.phase_ms('exchange'):.2f} ms")
+    # cluster tier over TCP: per-DN phase timings from the span
+    # subtrees each server piggy-backed on its replies — real remote
+    # stage/execute time, not the CN-observed RPC wall total
+    from ..obs import xray as obs_xray
+    for node, a in obs_xray.remote_rows(qt):
+        parts = [f"rpcs={a.get('rpcs', 0)}",
+                 f"server={a.get('server_ms', 0.0):.2f} ms"]
+        for ph in obs_trace.PHASES:
+            if a.get(ph):
+                parts.append(f"{ph}={a[ph]:.2f} ms")
+        lines.append(f"Remote {node}: " + " ".join(parts))
     return "".join("\n" + ln for ln in lines)
 
 
@@ -411,6 +422,8 @@ class Session:
             self.cancel_event.clear()
             raise ExecError("canceling statement due to user request")
         if deadline is not None and time.monotonic() >= deadline:
+            from ..obs import xray as obs_xray
+            obs_xray.flight("statement_timeout")
             raise ExecError(
                 "canceling statement due to statement timeout")
 

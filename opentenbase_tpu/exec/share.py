@@ -54,6 +54,7 @@ import collections
 import itertools
 import os
 
+from ..obs import xray as _xray
 from ..utils import locks
 
 _LOCK = locks.Lock("exec.share._LOCK")
@@ -355,7 +356,8 @@ class SharedStream:
             for _ in range(deadline_waits):
                 if not slow_locked():
                     return
-                self.cond.wait(timeout=0.25)
+                with _xray.wait_event("share-backlog"):
+                    self.cond.wait(timeout=0.25)
             stuck = slow_locked()
         for token in stuck:
             self.detach(token)

@@ -40,6 +40,7 @@ import os
 import time
 
 from ..obs import trace as obs_trace
+from ..obs import xray as obs_xray
 from ..utils import faultinject as FI
 from ..utils import locks
 
@@ -132,7 +133,10 @@ def note_batch_failure(sig) -> bool:
         if len(_QUAR) > 512:        # bounded: drop the stalest entry
             _QUAR.pop(next(iter(_QUAR)))
     if barred:
+        # outside _LOCK: the flight snapshot reads other subsystems
         obs_trace.event("quarantine", sig=str(sig)[:80])
+        obs_xray.guard_event("quarantine", sig=str(sig)[:80])
+        obs_xray.flight("quarantine", sig=str(sig)[:200])
     return barred
 
 
@@ -261,6 +265,9 @@ def run_degraded(item) -> list:
     from .session import Result
     from .spill import SpillDriver
 
+    sig = str(getattr(item, "sig", "") or item.sql)[:200]
+    obs_xray.guard_event("oom_downshift", sig=sig[:80])
+    obs_xray.flight("oom_downshift", sig=sig)
     session = item.session
     node = session.node
     budget = int(_env_f("OTB_SHIELD_DEGRADE_ROWS", 65536))

@@ -18,6 +18,7 @@ import threading
 from typing import Optional
 
 from ..net.wire import recv_msg, send_msg
+from ..obs import xray
 from .server import GtmClient
 
 
@@ -56,7 +57,10 @@ class GtmProxy:
                         return
                     p = _Pending(msg)
                     proxy._q.put(p)
-                    p.event.wait()
+                    # the backend's GTS grant wait: the pump
+                    # answers from one coalesced upstream round
+                    with xray.wait_event("gts-grant"):
+                        p.event.wait()
                     send_msg(self.request, p.resp)
 
         class Server(socketserver.ThreadingTCPServer):
@@ -78,7 +82,8 @@ class GtmProxy:
         answered from ONE gts_batch round trip."""
         while not self._stopping:
             try:
-                first = self._q.get(timeout=0.2)
+                # pump idle dequeue, not a query-visible stall
+                first = self._q.get(timeout=0.2)  # otblint: disable=wait-discipline
             except queue.Empty:
                 continue
             batch = [first]

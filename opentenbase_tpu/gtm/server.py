@@ -27,6 +27,7 @@ import time
 from typing import Optional
 
 from ..net.wire import recv_msg, send_msg
+from ..obs import xray
 from ..utils import locks
 
 RESERVE = 1_000_000  # timestamps reserved ahead per persistence write
@@ -332,6 +333,11 @@ class GtmServer:
                     if msg is None:
                         return
                     op = msg.get("op")
+                    # inbound trace context → handler span; compacted
+                    # subtree rides the reply (manual open/close: resp
+                    # is assembled across the whole if-chain)
+                    sx = xray.server_span(msg, op or "",
+                                          node="gtm").open()
                     try:
                         if op == "gts":
                             resp = {"ts": core_ref.next_gts()}
@@ -410,6 +416,8 @@ class GtmServer:
                             resp = {"error": f"unknown op {op!r}"}
                     except Exception as e:  # serve errors, don't die
                         resp = {"error": str(e)}
+                    sx.close()
+                    sx.attach(resp)
                     send_msg(self.request, resp)
 
             def finish(self):
@@ -456,17 +464,30 @@ class GtmClient:
     # conversation per socket at a time; the hold is bounded by the
     # socket timeout, so the RPC-under-lock here is the design
     def call(self, **msg) -> dict:  # otblint: disable=lock-blocking
+        xray.inject(msg)
+        op = msg.get("op", "")
+        # wait-event attribution: timestamp/slot grants are the two
+        # GTM waits tuners actually chase; everything else is generic
+        ev = "gts-grant" if op in ("gts", "gts_batch", "begin") \
+            else ("gtm-slot" if op == "resq_acquire" else "gtm-rpc")
         with self._lock:
             for attempt in (0, 1):
                 try:
                     s = self._conn()
                     # chaos points: tests arm gtm.send/gtm.recv to
-                    # simulate GTM loss without killing the server
-                    send_msg(s, msg, fault="gtm.send")
-                    # expect_reply: a close while the GTM owes an
-                    # answer is a WireError, never "no message"
-                    resp = recv_msg(s, expect_reply=True,
-                                    fault="gtm.recv")
+                    # simulate GTM loss without killing the server.
+                    # wait_event's enter/exit touch the wait register
+                    # + histograms (opaque to the callgraph):
+                    # may-acquire: obs.xray._WLOCK
+                    # may-acquire: obs.metrics.Registry._lock
+                    # may-acquire: obs.metrics.metric._lock
+                    with xray.wait_event(ev):
+                        send_msg(s, msg, fault="gtm.send")
+                        # expect_reply: a close while the GTM owes an
+                        # answer is a WireError, never "no message"
+                        resp = recv_msg(s, expect_reply=True,
+                                        fault="gtm.recv")
+                    xray.absorb(resp, node="gtm", op=op)
                     if "error" in resp:
                         raise RuntimeError(f"gtm error: {resp['error']}")
                     return resp

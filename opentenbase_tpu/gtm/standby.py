@@ -27,6 +27,7 @@ from typing import Callable, Optional
 
 from ..net.wire import recv_msg, send_msg
 from .server import GtmCore
+from ..obs import xray
 from ..utils import locks
 
 
@@ -161,11 +162,13 @@ def ship_to(host: str, port: int, timeout: float = 5.0) -> Callable:
                 conn[0] = socket.create_connection((host, port),
                                                    timeout=timeout)
             try:
-                send_msg(conn[0], {"op": "replicate", "state": state})
                 # expect_reply: the standby owes an ack — a close here
                 # is a failed ship, not an idle hangup (sync replication
                 # must never report success it didn't get)
-                resp = recv_msg(conn[0], expect_reply=True)
+                with xray.wait_event("wal-ship"):
+                    send_msg(conn[0], {"op": "replicate",
+                                       "state": state})
+                    resp = recv_msg(conn[0], expect_reply=True)
             except (ConnectionError, OSError):
                 try:
                     conn[0].close()

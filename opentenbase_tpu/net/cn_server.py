@@ -33,6 +33,7 @@ import threading
 from typing import Optional
 
 from .wire import recv_msg, send_msg
+from ..obs import xray
 from ..utils import locks
 
 _BANNER = "opentenbase_tpu"
@@ -204,6 +205,14 @@ class CnServer:
                     from ..exec import share as workshare
                     send_msg(sock, {"ok": workshare.stats_snapshot()})
                     continue
+                if msg.get("op") == "flight":
+                    # flight-recorder retrieval: the ringed postmortem
+                    # bundles (quarantine / timeout / breaker / OOM),
+                    # so an operator can pull forensics off a live CN
+                    # without filesystem access
+                    from ..obs import xray
+                    send_msg(sock, {"ok": xray.flights()})
+                    continue
                 if msg.get("op") != "query":
                     send_msg(sock, {"error":
                                     f"unknown op {msg.get('op')!r}"})
@@ -255,7 +264,8 @@ class CnClient:
         send_msg(self._sock, {"op": "query", "sql": sql})
         # expect_reply: the server owes an answer to every query — a
         # close here is a failed conversation, not an idle hangup
-        resp = recv_msg(self._sock, expect_reply=True)
+        with xray.wait_event("rpc-wire", node="cn"):
+            resp = recv_msg(self._sock, expect_reply=True)
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["ok"]
@@ -266,7 +276,8 @@ class CnClient:
     def metrics(self) -> str:
         """Fetch the server's Prometheus text exposition."""
         send_msg(self._sock, {"op": "metrics"})
-        resp = recv_msg(self._sock, expect_reply=True)
+        with xray.wait_event("rpc-wire", node="cn"):
+            resp = recv_msg(self._sock, expect_reply=True)
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["ok"]
@@ -274,7 +285,17 @@ class CnClient:
     def workshare(self) -> dict:
         """Fetch cross-query work-sharing counters (otbshare)."""
         send_msg(self._sock, {"op": "workshare"})
-        resp = recv_msg(self._sock, expect_reply=True)
+        with xray.wait_event("rpc-wire", node="cn"):
+            resp = recv_msg(self._sock, expect_reply=True)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["ok"]
+
+    def flight(self) -> list:
+        """Fetch the server's ringed flight-recorder bundles."""
+        send_msg(self._sock, {"op": "flight"})
+        with xray.wait_event("rpc-wire", node="cn"):
+            resp = recv_msg(self._sock, expect_reply=True)
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["ok"]

@@ -21,6 +21,7 @@ from typing import Optional
 from ..catalog.catalog import Catalog
 from ..catalog.schema import TableDef
 from ..gtm.server import GtmClient
+from ..obs import xray
 from ..parallel.cluster import DataNode
 from . import guard
 from .wire import recv_msg, send_msg
@@ -62,26 +63,41 @@ class DnServer:
                         return
                     if msg is None:
                         return
+                    # inbound trace context (if any) opens a handler
+                    # span; every span the executor opens below nests
+                    # under it, and the compacted subtree rides the
+                    # reply back to the CN
+                    sx = xray.server_span(msg, msg.get("op") or "",
+                                          node=f"dn{node.index}")
                     try:
-                        if msg.get("op") in host_ops:
-                            resp = {"ok": _dispatch(node, msg)}
-                        else:
-                            with lock:
-                                # device execution compiles through
-                                # the plan cache under this lock; in a
-                                # fresh process the first dispatch also
-                                # IMPORTS executor/plancache here, whose
-                                # module bodies register metrics
-                                # collectors:
-                                # may-acquire: exec.plancache._LOCK
-                                # may-acquire: obs.metrics.Registry._lock
-                                # staging under this lock also chooses/
-                                # validates codec descriptors:
-                                # may-acquire: storage.codec._STATE_LOCK
+                        with sx:
+                            if msg.get("op") in host_ops:
                                 resp = {"ok": _dispatch(node, msg)}
+                            else:
+                                with lock:
+                                    # device execution compiles through
+                                    # the plan cache under this lock; in
+                                    # a fresh process the first dispatch
+                                    # also IMPORTS executor/plancache
+                                    # here, whose module bodies register
+                                    # metrics collectors:
+                                    # may-acquire: exec.plancache._LOCK
+                                    # may-acquire: obs.metrics.Registry._lock
+                                    # staging under this lock also
+                                    # chooses/validates codec
+                                    # descriptors:
+                                    # may-acquire: storage.codec._STATE_LOCK
+                                    # execution parks at named wait
+                                    # points (gts-grant, lockmgr, ...)
+                                    # whose enter/exit touch the wait
+                                    # register + histograms:
+                                    # may-acquire: obs.xray._WLOCK
+                                    # may-acquire: obs.metrics.metric._lock
+                                    resp = {"ok": _dispatch(node, msg)}
                     except Exception as e:
                         resp = {"error": f"{type(e).__name__}: {e}",
                                 "etype": type(e).__name__}
+                    sx.attach(resp)
                     send_msg(self.request, resp)
 
         class Server(socketserver.ThreadingTCPServer):
@@ -262,7 +278,8 @@ class DnConnectionPool:
                     self._count += 1
                     g = self.gen
                     break
-                self._cv.wait(1.0)
+                with xray.wait_event("pool-conn"):
+                    self._cv.wait(1.0)
         try:
             s = socket.create_connection(self.addr,
                                          timeout=self.connect_timeout)
@@ -355,15 +372,18 @@ class RemoteDataNode:
                              idempotent=op in IDEMPOTENT_OPS, op=op)
 
     def _call_once(self, msg):
+        xray.inject(msg)
         sock = self.pool.acquire()
         broken = True   # assume the worst; cleared on a clean exchange
         try:
             sock.settimeout(guard.rpc_deadline())
-            send_msg(sock, msg, fault=self._fault_send)
-            # expect_reply: a close here is a broken conversation, never
-            # "no message" (the server owes an answer to every request)
-            resp = recv_msg(sock, expect_reply=True,
-                            fault=self._fault_recv)
+            with xray.wait_event("rpc-wire", node=f"dn{self.index}"):
+                send_msg(sock, msg, fault=self._fault_send)
+                # expect_reply: a close here is a broken conversation,
+                # never "no message" (the server owes an answer to
+                # every request)
+                resp = recv_msg(sock, expect_reply=True,
+                                fault=self._fault_recv)
             broken = False
         except (ConnectionError, OSError, EOFError):
             # a connection-level failure usually means the DN died or
@@ -376,6 +396,7 @@ class RemoteDataNode:
             # (e.g. an unpicklable payload): a desynced socket is never
             # reused, and the slot can never leak
             self.pool.release(sock, broken=broken)
+        xray.absorb(resp, node=f"dn{self.index}", op=msg.get("op", ""))
         if "error" in resp:
             et = resp.get("etype", "")
             # concurrency-control errors keep their type across the
@@ -522,13 +543,15 @@ class StandbyReadNode:
     # one conversation per call; the hold is bounded by the socket
     # deadline, exactly the WalShip contract
     def _call(self, msg: dict):  # otblint: disable=lock-blocking
+        xray.inject(msg)
         with self._lock:
             try:
                 if self._sock is None:
                     self._sock = socket.create_connection(
                         self.addr, timeout=guard.rpc_deadline())
-                send_msg(self._sock, msg)
-                resp = recv_msg(self._sock, expect_reply=True)
+                with xray.wait_event("rpc-wire", node=self.name):
+                    send_msg(self._sock, msg)
+                    resp = recv_msg(self._sock, expect_reply=True)
             except (ConnectionError, OSError, EOFError):
                 try:
                     if self._sock is not None:
@@ -536,6 +559,7 @@ class StandbyReadNode:
                 finally:
                     self._sock = None
                 raise
+        xray.absorb(resp, node=self.name, op=msg.get("op", ""))
         if "error" in resp:
             et = resp.get("etype", "")
             if et == "StandbyLag":

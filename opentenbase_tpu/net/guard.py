@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Optional
 
+from ..obs import xray
 from ..obs.metrics import REGISTRY
 from ..utils import locks
 
@@ -162,6 +163,7 @@ class CircuitBreaker:
             self._state = "closed"
 
     def fail(self) -> None:
+        tripped = False
         with self._lock:
             self._fails += 1
             now = time.monotonic()
@@ -174,8 +176,16 @@ class CircuitBreaker:
                     self._fails >= self.threshold:
                 self._state = "open"
                 self._opened_at = now
+                tripped = True
                 REGISTRY.counter("otb_guard_breaker_trips_total",
                                  node=self.key).inc()
+        if tripped:
+            # outside _lock: the flight snapshot walks other guard and
+            # metrics state — recording must never extend the critical
+            # section (or deadlock against a collector)
+            xray.guard_event("breaker_trip", node=self.key,
+                             fails=self._fails)
+            xray.flight("breaker_trip", sig=self.key)
 
 
 # ---------------------------------------------------------------------------
@@ -268,6 +278,7 @@ def note_shed(group: str) -> None:
     in otb_node_health."""
     REGISTRY.counter("otb_guard_shed_total", group=group).inc()
     guard_for("scheduler").note_shed()
+    xray.guard_event("shed", group=group)
 
 
 def note_degraded(reason: str) -> None:
@@ -277,10 +288,12 @@ def note_degraded(reason: str) -> None:
     otb_node_health — same surface as load shedding, one rung gentler."""
     REGISTRY.counter("otb_guard_degraded_total", reason=reason).inc()
     guard_for("scheduler").note_shed()
+    xray.guard_event("degraded", reason=reason)
 
 
 def note_failover(kind: str) -> None:
     REGISTRY.counter("otb_guard_failovers_total", kind=kind).inc()
+    xray.guard_event("failover", target=kind)
 
 
 # ---------------------------------------------------------------------------
@@ -298,7 +311,14 @@ def guarded(key: str, fn, idempotent: bool = False,
         if idempotent else 0
     attempt = 0
     while True:
-        g.breaker.admit()
+        try:
+            g.breaker.admit()
+        except CircuitOpen:
+            # fail-fast is still a wait the query "spent" on this node:
+            # a zero-ms observation keeps breaker rejections visible in
+            # the wait profile
+            xray.mark("breaker-open", node=key)
+            raise
         try:
             out = fn()
         except RETRYABLE as e:
@@ -473,7 +493,9 @@ class ReplicaRouter:
                 # this replica (it may have caught up since)
                 try:
                     g.breaker.admit()
-                    r["hwm"] = r["node"].hwm()
+                    with xray.wait_event("replica-hwm",
+                                         replica=r["name"]):
+                        r["hwm"] = r["node"].hwm()
                     g.note_success()
                 except CircuitOpen:
                     continue
@@ -536,7 +558,8 @@ class IndoubtResolver(threading.Thread):
         self._stop = threading.Event()
 
     def run(self):
-        while not self._stop.wait(self.period_s):
+        # idle periodic tick, not a query-visible stall
+        while not self._stop.wait(self.period_s):  # otblint: disable=wait-discipline
             try:
                 self.cluster.resolve_indoubt(orphan_grace_s=self.grace_s)
                 self.sweeps += 1

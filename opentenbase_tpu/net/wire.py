@@ -16,6 +16,13 @@ Chaos hooks: call sites may pass a named fault point (``fault=``); when a
 test armed that point via ``utils/faultinject.arm_wire`` the configured
 connection fault (drop/delay/close/garble) fires here, at the exact
 boundary a real network failure would hit.
+
+Trace context: distributed tracing (obs/xray.py) rides inside the message
+dict under the reserved ``"_xray"`` key — requests carry ``{"tid": ...}``
+injected by clients, replies carry ``{"tid", "span"}`` piggy-backed by
+servers.  The frame format itself is unchanged: peers that predate (or
+disable) tracing simply ignore the key, so the protocol stays backward
+and forward compatible with no version negotiation.
 """
 
 from __future__ import annotations

@@ -249,9 +249,16 @@ class Registry:
         return "\n".join(out) + "\n"
 
 
+def _escape_label(v) -> str:
+    """Prometheus text-format label escaping: backslash, double quote,
+    and newline must be escaped inside quoted label values."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
 def _sample_line(name: str, labels: tuple, value) -> str:
     if labels:
-        lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+        lbl = ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels)
         return f"{name}{{{lbl}}} {value:g}"
     return f"{name} {value:g}"
 

@@ -45,6 +45,10 @@ _LOCK = locks.Lock("obs.trace._LOCK")
 _RING: deque = deque(maxlen=RING_CAP)   # guarded_by: _LOCK
 _LAST: list = [None]                    # guarded_by: _LOCK
 _IDS = itertools.count(1)
+# per-process trace-id prefix: qids restart at 1 in every process, so
+# cluster-wide correlation (slow log ↔ flight bundle ↔ shipped span)
+# needs a process-unique component
+_SEED = os.urandom(4).hex()
 
 # canonical phase names summarized per query (otb_stat_query columns)
 PHASES = ("plan", "stage", "execute", "exchange", "finalize")
@@ -141,10 +145,55 @@ def annotate(**kw) -> None:
         st[-1].attrs.update(kw)
 
 
+# ---------------------------------------------------------------------------
+# cross-node helpers (obs/xray.py) — server-side bare roots + grafting
+# ---------------------------------------------------------------------------
+
+def push_root(name: str, **attrs) -> Span:
+    """Open a span on THIS thread even without an active trace — a
+    server handler thread has no QueryTrace; the bare root becomes the
+    piggy-backed subtree's top.  Pair with ``pop_root``."""
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    sp = Span(name, attrs)
+    if st:                           # nested server op: ride the stack
+        st[-1].children.append(sp)
+    st.append(sp)
+    sp._t0 = time.perf_counter()
+    return sp
+
+
+def pop_root(sp: Span) -> Span:
+    sp.ms = (time.perf_counter() - sp._t0) * 1e3
+    st = getattr(_TLS, "stack", None)
+    if st and st[-1] is sp:
+        st.pop()
+    return sp
+
+
+def span_from_dict(d: dict) -> Span:
+    """Rehydrate a shipped span subtree (inverse of Span.to_dict)."""
+    sp = Span(str(d.get("name", "?")), dict(d.get("attrs") or {}))
+    sp.ms = float(d.get("ms") or 0.0)
+    sp.children = [span_from_dict(c) for c in d.get("children") or ()]
+    return sp
+
+
+def graft(d: dict) -> None:
+    """Attach a shipped subtree under the current span (remote phase
+    spans nest INSIDE the CN's RPC span, so ``phase_ms``'s
+    outermost-only rule never double-counts them)."""
+    st = getattr(_TLS, "stack", None)
+    if st:
+        st[-1].children.append(span_from_dict(d))
+
+
 class QueryTrace:
     """One statement's span tree plus identity/summary fields."""
 
-    __slots__ = ("qid", "signature", "root", "tier", "rows", "started")
+    __slots__ = ("qid", "signature", "root", "tier", "rows", "started",
+                 "trace_id")
 
     def __init__(self, signature: str):
         self.qid = next(_IDS)
@@ -153,6 +202,7 @@ class QueryTrace:
         self.tier = ""
         self.rows = 0
         self.started = time.time()
+        self.trace_id = f"{_SEED}-{self.qid:x}"
 
     @property
     def total_ms(self) -> float:
@@ -196,6 +246,7 @@ class QueryTrace:
     def summary(self) -> dict:
         d = {
             "qid": self.qid,
+            "trace_id": self.trace_id,
             "signature": self.signature,
             "tier": self.tier or "single",
             "total_ms": self.total_ms,
@@ -299,6 +350,13 @@ def recent() -> list:
 
 
 def _finish(qt: QueryTrace, failed: bool = False) -> None:
+    try:
+        # graft remote subtrees absorbed on worker threads BEFORE the
+        # trace becomes visible in the ring / metrics / slow log
+        from . import xray
+        xray.on_trace_finish(qt)
+    except Exception:
+        pass                         # observability never fails a query
     with _LOCK:
         _RING.append(qt)
         _LAST[0] = qt

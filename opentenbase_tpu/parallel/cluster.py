@@ -1006,6 +1006,12 @@ class Cluster:
             # may-acquire: gtm.server.GtmClient._lock
             # may-acquire: net.dn_server.DnConnectionPool._lock
             # may-acquire: utils.faultinject._lock
+            # the RPCs park at named wait points and graft/park remote
+            # trace subtrees on reply:
+            # may-acquire: obs.xray._WLOCK
+            # may-acquire: obs.xray._RLOCK
+            # may-acquire: obs.metrics.Registry._lock
+            # may-acquire: obs.metrics.metric._lock
             try:
                 cur.close()
             except Exception:

@@ -211,6 +211,26 @@ STAT_TABLES = {
         ColumnDef("consec_failures", T.INT64),
         ColumnDef("retries", T.INT64),
         ColumnDef("last_error", T.TEXT)],
+    # cumulative wait-event accounting (obs/xray.py): one row per named
+    # wait point (admission queue, GTS grant, bufferpool eviction, RPC
+    # on-wire, ...) with log-bucket latency quantiles — the answer to
+    # "where do queries actually block" (reference: pg_stat_activity
+    # wait_event / wait_event_type, aggregated over time instead of
+    # sampled)
+    "otb_wait_events": [
+        ColumnDef("event", T.TEXT), ColumnDef("count", T.INT64),
+        ColumnDef("total_ms", T.FLOAT64), ColumnDef("p50_ms", T.FLOAT64),
+        ColumnDef("p95_ms", T.FLOAT64), ColumnDef("p99_ms", T.FLOAT64)],
+    # live per-query activity (obs/xray.py): one row per statement
+    # currently inside the serving tier — lifecycle state (queued /
+    # staging / device / draining), the wait event its thread is
+    # blocked on RIGHT NOW, age, and whether a cancel handle exists
+    # (reference: pg_stat_activity + pg_cancel_backend)
+    "otb_stat_activity": [
+        ColumnDef("aid", T.INT64), ColumnDef("state", T.TEXT),
+        ColumnDef("wait_event", T.TEXT), ColumnDef("age_ms", T.FLOAT64),
+        ColumnDef("cancelable", T.BOOL), ColumnDef("trace_id", T.TEXT),
+        ColumnDef("sql", T.TEXT)],
     # the unified metrics registry (obs/metrics.py): every native
     # counter/gauge/histogram sample plus every registered subsystem
     # collector, flattened to (name, labels, kind, value) — the SQL
@@ -322,6 +342,12 @@ def refresh(cluster, names: list[str]):
         elif name == "otb_node_health":
             from ..net.guard import health_rows
             rows = list(health_rows())
+        elif name == "otb_wait_events":
+            from ..obs import xray
+            rows = list(xray.wait_rows())
+        elif name == "otb_stat_activity":
+            from ..obs import xray
+            rows = list(xray.activity_rows())
         elif name == "otb_metrics":
             from ..obs.metrics import REGISTRY
             rows = list(REGISTRY.rows())

@@ -53,6 +53,7 @@ import weakref
 import numpy as np
 
 from ..obs import trace as obs_trace
+from ..obs import xray as obs_xray
 from ..utils import locks
 from . import codec
 
@@ -385,7 +386,7 @@ class DeviceBufferPool:
         active query holds references anyway, so evicting it frees
         nothing."""
         budget = _budget()
-        with _LOCK:
+        with obs_xray.wait_event("bufpool-evict"), _LOCK:
             while True:
                 items = self._evictable_locked()
                 resident = (
@@ -408,7 +409,7 @@ class DeviceBufferPool:
         wired chunk/build side would crash the very stream the relief
         is trying to save."""
         freed = 0
-        with _LOCK:
+        with obs_xray.wait_event("bufpool-evict"), _LOCK:
             resident = (
                 sum(e.nbytes for _s, e in self._dev.values())
                 + sum(e.nbytes for _s, e in self._mesh.values())

@@ -23,6 +23,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
+from ..obs import xray
 from ..utils import locks
 
 
@@ -109,6 +110,7 @@ class LockManager:
                     if remaining <= 0:
                         raise LockTimeout(
                             f"lock wait on txn {holder} timed out")
-                    self._cond.wait(min(remaining, 0.25))
+                    with xray.wait_event("lockmgr"):
+                        self._cond.wait(min(remaining, 0.25))
             finally:
                 self._waits.pop(waiter, None)

@@ -51,6 +51,7 @@ from typing import Optional
 import numpy as np
 
 from ..catalog.types import TypeKind
+from ..obs import xray
 from ..utils import locks
 
 
@@ -180,7 +181,8 @@ class ReplicationSlot:
     def poll(self, max_txns: int = 64, timeout: float = 0.2) -> list:
         with self._cv:
             if not self._q:
-                self._cv.wait(timeout)
+                with xray.wait_event("logical-poll"):
+                    self._cv.wait(timeout)
             out, self._q = self._q[:max_txns], self._q[max_txns:]
             return out
 
@@ -351,7 +353,8 @@ class Subscription:
                         break
                     except Exception as e:       # noqa: BLE001
                         self.last_error = f"{type(e).__name__}: {e}"
-                        self._stop.wait(1.0)
+                        # retry backoff tick, not a query stall
+                        self._stop.wait(1.0)  # otblint: disable=wait-discipline
 
     def _apply_txn(self, txn: dict):
         c = self.cluster

@@ -29,6 +29,7 @@ import threading
 from typing import Callable, Optional
 
 from ..net.wire import recv_msg, send_msg
+from ..obs import xray
 from ..utils import locks
 from .wal import Wal, decode_frame
 
@@ -203,6 +204,10 @@ class DnStandbyServer:
                     if msg is None:
                         return
                     op = msg.get("op")
+                    # standby reads carry CN trace context too: a
+                    # routed read's server time shows up in the trace
+                    sx = xray.server_span(msg, op or "",
+                                          node="standby").open()
                     try:
                         if op == "wal":
                             sb.apply_wal(msg["frame"])
@@ -231,6 +236,8 @@ class DnStandbyServer:
                                 "etype": type(e).__name__}
                         if isinstance(e, StandbyLag):
                             resp["hwm"] = e.hwm
+                    sx.close()
+                    sx.attach(resp)
                     send_msg(self.request, resp)
 
         class Server(socketserver.ThreadingTCPServer):
@@ -272,14 +279,22 @@ class WalShip:
     # standby in write order, so the conversation runs under it by
     # design; the hold is bounded by the socket timeout
     def _call(self, msg: dict) -> None:  # otblint: disable=lock-blocking
+        xray.inject(msg)
         with self._lock:
             try:
                 s = self._conn()
                 # chaos point standby.ship; expect_reply: a standby
                 # that hangs up while it owes an ack is a failed ship
-                # (sync replication must not mistake it for success)
-                send_msg(s, msg, fault="standby.ship")
-                resp = recv_msg(s, expect_reply=True)
+                # (sync replication must not mistake it for success).
+                # wait_event's enter/exit touch the wait register +
+                # histograms (opaque to the callgraph):
+                # may-acquire: obs.xray._WLOCK
+                # may-acquire: obs.metrics.Registry._lock
+                # may-acquire: obs.metrics.metric._lock
+                with xray.wait_event("wal-ship"):
+                    send_msg(s, msg, fault="standby.ship")
+                    resp = recv_msg(s, expect_reply=True)
+                xray.absorb(resp, node="standby", op=msg.get("op", ""))
             except (ConnectionError, OSError):
                 try:
                     if self._sock is not None:

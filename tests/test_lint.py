@@ -357,6 +357,104 @@ class TestNetDeadlinePass:
         assert _scan(tmp_path, "net-deadline") == []
 
 
+class TestWaitDisciplinePass:
+    FILES = {
+        "fixpkg/__init__.py": "",
+        "fixpkg/exec/__init__.py": "",
+        "fixpkg/exec/sched.py": """\
+            import queue
+
+            class Sched:
+                def __init__(self):
+                    self._q = queue.Queue(8)       # bounded
+                    self._logq = queue.Queue()     # unbounded
+
+                def park_bad(self, cv):
+                    cv.wait(1.0)                   # unnamed stall
+
+                def park_good(self, cv, xray):
+                    with xray.wait_event("sched-result"):
+                        cv.wait(1.0)
+
+                def pull_bad(self):
+                    return self._q.get()
+
+                def pull_good(self, xray):
+                    with xray.wait_event("sched-drain-queue"):
+                        return self._q.get()
+
+                def push_bad(self, it):
+                    self._q.put(it)                # bounded: blocks
+
+                def push_free(self, it):
+                    self._logq.put(it)             # unbounded: never
+
+                def peek_free(self):
+                    return self._q.get_nowait()    # never parks
+        """,
+        "fixpkg/net/__init__.py": "",
+        "fixpkg/net/wire.py": """\
+            # frame codec: exempt — it is the mechanism under the waits
+            def recv_msg(sock, expect_reply=False):
+                return sock
+        """,
+        "fixpkg/net/client.py": """\
+            from .wire import recv_msg
+
+            def call_bad(sock):
+                return recv_msg(sock, expect_reply=True)  # owed
+
+            def call_good(sock, xray):
+                with xray.wait_event("rpc-wire"):
+                    return recv_msg(sock, expect_reply=True)
+
+            def drain_free(sock):
+                return recv_msg(sock)              # no reply owed
+        """,
+    }
+
+    def test_violation_and_clean_twin(self, tmp_path):
+        _write_pkg(tmp_path, self.FILES)
+        report = lint(root=str(tmp_path), package="fixpkg",
+                      rules={"wait-discipline"})
+        got = sorted((f["file"], f["symbol"])
+                     for f in report["findings"])
+        assert got == [("fixpkg/exec/sched.py", "Sched.park_bad"),
+                       ("fixpkg/exec/sched.py", "Sched.pull_bad"),
+                       ("fixpkg/exec/sched.py", "Sched.push_bad"),
+                       ("fixpkg/net/client.py", "call_bad")], got
+
+    def test_pragma_suppresses(self, tmp_path):
+        files = dict(self.FILES)
+        files["fixpkg/exec/sched.py"] = files[
+            "fixpkg/exec/sched.py"].replace(
+            "# unnamed stall", "# otblint: disable=wait-discipline"
+        ).replace(
+            "return self._q.get()",
+            "return self._q.get()  # otblint: disable=wait-discipline"
+        ).replace(
+            "# bounded: blocks", "# otblint: disable=wait-discipline")
+        files["fixpkg/net/client.py"] = files[
+            "fixpkg/net/client.py"].replace(
+            "# owed", "# otblint: disable=wait-discipline")
+        _write_pkg(tmp_path, files)
+        assert _scan(tmp_path, "wait-discipline") == []
+
+    def test_out_of_scope_module_silent(self, tmp_path):
+        # a bare Condition.wait outside exec//net//gtm//storage (e.g.
+        # a test helper) is not this rule's business
+        files = {
+            "fixpkg/__init__.py": "",
+            "fixpkg/utils/__init__.py": "",
+            "fixpkg/utils/poll.py": """\
+                def wait_for(cv):
+                    cv.wait(0.5)
+            """,
+        }
+        _write_pkg(tmp_path, files)
+        assert _scan(tmp_path, "wait-discipline") == []
+
+
 class TestSlotDisciplinePass:
     FILES = {
         "fixpkg/__init__.py": "",
