@@ -527,6 +527,26 @@ def _rows_close(got, want):
     return True
 
 
+def _snap_certificate():
+    """Post-hoc otbsnap certificate for the current process: run the
+    Adya G1/G-SI checker (analysis/sicheck.py) over the in-memory
+    snapcheck history, persist the history to $OTB_SNAP_HISTORY, and
+    report the runtime sanitizer's violation count.  The bench gates on
+    si_anomalies == 0 and snapcheck_violations == 0 — the three
+    serving tiers (cache / replica / shared) certified against the
+    commit history they actually raced."""
+    from opentenbase_tpu.analysis import sicheck
+    from opentenbase_tpu.utils import snapcheck
+    res = sicheck.check_history(snapcheck.history_events())
+    if snapcheck.history_on():
+        snapcheck.save_history()
+    return {"si_anomalies": len(res["anomalies"]),
+            "si_reads": res["reads"], "si_writes": res["writes"],
+            "si_by_source": res["by_source"],
+            "snapcheck_violations": len(snapcheck.violations()),
+            "si_detail": res["anomalies"][:5]}
+
+
 def _chaosc_streams(analytics):
     """The mixed chaos workload: point SELECTs (one tiny coalescable
     signature), a small-agg signature, and — unless disabled for smoke
@@ -584,7 +604,22 @@ def _chaosc_flap_cluster(tmp):
               "distribute by shard(k)")
     s.execute("insert into chaos_kv values " + ", ".join(
         f"({i}, {i * 3})" for i in range(64)))
-    return cluster, gtm, servers
+    # one hot standby per DN, registered as a read replica: the chaos
+    # run exercises the replica serving tier (net/guard.py hwm gate)
+    # under live DML + wire flaps, and the otbsnap certificate checks
+    # its reads against the commit history
+    from opentenbase_tpu.storage.replication import (DnStandbyServer,
+                                                     HotStandby)
+    rep_servers = []
+    for i, srv in enumerate(servers):
+        sb = HotStandby(os.path.join(tmp, f"chaos_sb_dn{i}"), index=i)
+        rsrv = DnStandbyServer(sb).start()
+        srv.node.attach_standby(rsrv.host, rsrv.port)
+        cluster.register_read_replica(i, rsrv.host, rsrv.port,
+                                      sb.datadir)
+        rep_servers.append(rsrv)
+    s.execute("set replica_reads = on")
+    return cluster, gtm, servers + rep_servers
 
 
 def _chaos_concurrent_arm():
@@ -629,6 +664,17 @@ def _chaos_concurrent_arm():
 
     n_flap = max(1, min(8, n_clients // 8))
     n_sched = n_clients - n_flap
+
+    # otbsnap: the chaos run doubles as the snapshot-visibility
+    # acceptance shard — sanitizer live on every serve point, bounded
+    # SI history recorded for the post-hoc G1/G-SI checker, and the
+    # committed witness (analysis/visibility_witness.json) refreshed
+    # from what this shard actually served
+    from opentenbase_tpu.utils import snapcheck as snapcheck_mod
+    os.environ.setdefault("OTB_SNAPCHECK", "1")
+    os.environ.setdefault("OTB_SNAP_HISTORY", os.path.join(
+        tempfile.gettempdir(), f"otb-chaosc-history-{os.getpid()}.json"))
+    snapcheck_mod.reset()
 
     node, setup_s, _ = _qps_setup(sf)
     mixed = _chaosc_streams(analytics)
@@ -712,6 +758,27 @@ def _chaos_concurrent_arm():
                     flap["errors"] += 1
             i += 1
 
+    def dml_client():
+        # live write stream on the cluster plane, keys >= 1000 so the
+        # verified point reads (k < 64) never see it — its job is to
+        # move store versions + the replica hwm under the sanitizer
+        # and to populate the SI history's write half
+        dsess = ClusterSession(cluster)
+        j = 0
+        while time.perf_counter() < stop_at[0]:
+            k = 1000 + (j % 50)
+            try:
+                if j % 2 == 0:
+                    dsess.execute(
+                        f"insert into chaos_kv values ({k}, {j})")
+                else:
+                    dsess.execute(
+                        f"delete from chaos_kv where k = {k}")
+            except Exception:  # noqa: BLE001 — flaps hit DML too
+                pass
+            j += 1
+            time.sleep(0.02)
+
     def chaos_driver():
         n = 0
         while time.perf_counter() < stop_at[0]:
@@ -743,7 +810,8 @@ def _chaos_concurrent_arm():
                    + [threading.Thread(target=flap_client, args=(fi,),
                                       daemon=True)
                       for fi in range(n_flap)]
-                   + [threading.Thread(target=chaos_driver,
+                   + [threading.Thread(target=dml_client, daemon=True),
+                      threading.Thread(target=chaos_driver,
                                        daemon=True)])
         t_begin = time.perf_counter()
         for t in threads:
@@ -767,6 +835,13 @@ def _chaos_concurrent_arm():
                 pass
         fgtm.stop()
         shutil.rmtree(tmp, ignore_errors=True)
+
+    # otbsnap certificate: SI-check the recorded history, persist the
+    # witnessed serve-point set into the committed witness file (the
+    # lint gate cross-checks witnessed points against the statically
+    # gated set)
+    cert = _snap_certificate()
+    snapcheck_mod.save_report()
 
     acq, rel = sched_mod.slot_balance()
     lst = sched.gtm.resq_stats()
@@ -807,6 +882,7 @@ def _chaos_concurrent_arm():
                         "leaked": acq - rel},
         "gtm_leases": {**lst, "live_slots": live_slots},
         "flap": dict(flap),
+        "snapshot_soundness": cert,
     }
     if coll_samples:
         out["collateral_samples"] = coll_samples
@@ -815,10 +891,14 @@ def _chaos_concurrent_arm():
     print(json.dumps(out))
     ok = (collateral == 0 and out["wrong_results"] == 0
           and acq == rel and live_slots == 0
-          and lst["acquired"] == lst["released"] + lst["expired"])
+          and lst["acquired"] == lst["released"] + lst["expired"]
+          and cert["si_anomalies"] == 0
+          and cert["snapcheck_violations"] == 0)
     print(f"# chaos-concurrent: {'PASS' if ok else 'FAIL'} "
           f"(collateral={collateral} wrong={out['wrong_results']} "
-          f"slots {acq}/{rel} leases {lst})", file=sys.stderr)
+          f"slots {acq}/{rel} si={cert['si_anomalies']} "
+          f"snapviol={cert['snapcheck_violations']} leases {lst})",
+          file=sys.stderr)
     if not ok:
         sys.exit(1)
 
@@ -1211,6 +1291,18 @@ def _qps_zipf_arm(node, clients, seconds, warm_s):
     from opentenbase_tpu.exec import share as share_mod
     from opentenbase_tpu.exec.session import Session
 
+    # otbsnap: record the SI history for this arm — every cache hit
+    # lands as a read with its exact GTS-versioned key material, every
+    # producing execution as a primary read, so the post-hoc checker
+    # certifies result-cache serving against snapshot isolation
+    from opentenbase_tpu.utils import snapcheck as snapcheck_mod
+    hist_preset = bool(os.environ.get("OTB_SNAP_HISTORY", "").strip())
+    if not hist_preset:
+        os.environ["OTB_SNAP_HISTORY"] = os.path.join(
+            tempfile.gettempdir(),
+            f"otb-zipf-history-{os.getpid()}.json")
+    snapcheck_mod.reset()
+
     n_distinct = int(os.environ.get("BENCH_QPS_ZIPF_DISTINCT", "48"))
     skew = float(os.environ.get("BENCH_QPS_ZIPF_SKEW", "1.2"))
     pool = [f"select sum(v), count(*) from qps_kv "
@@ -1277,10 +1369,15 @@ def _qps_zipf_arm(node, clients, seconds, warm_s):
         w1 = share_mod.stats_snapshot()
     finally:
         sched.stop()
+    cert = _snap_certificate()
+    if not hist_preset:
+        os.environ.pop("OTB_SNAP_HISTORY", None)
     merged = sorted(x for per in lats for x in per)
     hits = w1["result_cache_hits"] - w0["result_cache_hits"]
     misses = w1["result_cache_misses"] - w0["result_cache_misses"]
     return {"arm": "zipf_cache", "clients": clients, "replicas": 0,
+            "si_anomalies": cert["si_anomalies"],
+            "snapshot_soundness": cert,
             "queries": len(merged),
             "qps": len(merged) / wall if wall > 0 else 0.0,
             "p50_ms": _qps_pct(merged, 0.50) * 1e3,

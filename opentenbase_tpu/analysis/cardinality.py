@@ -401,7 +401,34 @@ class ResultKeyPass:
     # resolution rules are ProgramCardinalityPass's, shared verbatim
     _callee = ProgramCardinalityPass._callee
 
+    #: tokens that mark a value as coming from the producing snapshot
+    _SNAP_TOKENS = frozenset({"snap", "snapshot", "gts", "snapshot_ts",
+                              "snapshot_gts", "next_gts"})
+
+    def _check_gts_tag(self, fi: FuncInfo, call, em: _Emitter):
+        """The put's GTS tag (2nd positional arg) bounds which future
+        snapshots the entry may serve — it must flow from the snapshot
+        the result was PRODUCED under (``item.snap`` /
+        ``gts.next_gts()``), not from a constant or an unrelated
+        counter: a fabricated tag lets ``lookup``'s
+        ``snapshot_gts >= tag`` gate hand tomorrow's rows to
+        yesterday's snapshot."""
+        toks: set = set()
+        for e, _it in _flow_exprs(fi, call.args[1]):
+            for n in ast.walk(e):
+                if isinstance(n, ast.Name):
+                    toks.add(n.id)
+                elif isinstance(n, ast.Attribute):
+                    toks.add(n.attr)
+        if not toks & self._SNAP_TOKENS:
+            em.emit(fi, call.lineno,
+                    "result-cache GTS tag does not flow from the "
+                    "producing snapshot (no snap/gts/next_gts "
+                    "material in its flow) — a fabricated tag defeats "
+                    "the lookup staleness gate")
+
     def _check_put(self, mi, fi: FuncInfo, call, em: _Emitter):
+        self._check_gts_tag(fi, call, em)
         key_expr = call.args[0]
         sites = [(e, fi, mi) for e, _it in _flow_exprs(fi, key_expr)]
         seen_fns = {(fi.module, fi.qualname)}

@@ -24,6 +24,17 @@ conventions the passes understand:
 ``# holds: <lock1>[, lock2]``
     on a ``def`` line: callers are required to hold these locks (the
     plancache ``_evict_lru`` convention), so writes inside are covered.
+``# snapshot-gate: <gts-expr>``
+    on or inside a ``def``: this function is a declared SERVE POINT —
+    it can return cached/replicated/shared state to a reader — and the
+    named GTS guard expression (e.g. ``snapshot_gts >= ent[2]``) must
+    be discharged by a comparison that lexically dominates the serve,
+    or by the gate material flowing into a self-gating source call.
+    Checked by the visibility-discipline pass (analysis/visibility.py).
+``# version-gate: <version-expr>``
+    same, for an exact store-version comparison (e.g.
+    ``ent[1].version == ver``) — the entry served must be proven to
+    match the live TableStore version.
 """
 
 from __future__ import annotations
@@ -82,6 +93,16 @@ RULES = {
     "result-key": "result-cache key component not derived from the "
                   "masked signature / literal vector / store-version-"
                   "GTS tuple (wall clock, RNG, or a raw row count)",
+    "snapshot-gate": "serve point (cache/replica/shared-stream/standby "
+                     "read path) without a discharged # snapshot-gate:/"
+                     "# version-gate: contract dominating the serve",
+    "version-key": "content cache whose values derive from TableStore "
+                   "data without store-version material in its key/"
+                   "value flow or an invalidation edge — DML cannot "
+                   "invalidate it",
+    "visibility-witness": "runtime-witnessed serve point (OTB_SNAPCHECK "
+                          "shards) absent from the statically-gated "
+                          "set, or a recorded sanitizer violation",
     "hlo-f64": "f64 tensor type in exported StableHLO",
     "hlo-host-transfer": "host transfer / callback op in exported "
                          "StableHLO",
@@ -91,6 +112,8 @@ RULES = {
 _PRAGMA = re.compile(r"#\s*otblint:\s*([a-z\-]+)(?:=([\w\-,\s]+))?")
 _GUARDED = re.compile(r"#\s*guarded_by:\s*(\w+)")
 _HOLDS = re.compile(r"#\s*holds:\s*([\w,\s]+)")
+_SNAPGATE = re.compile(r"#\s*snapshot-gate:\s*(.+?)\s*$")
+_VERGATE = re.compile(r"#\s*version-gate:\s*(.+?)\s*$")
 
 
 @dataclasses.dataclass
@@ -135,6 +158,8 @@ class SourceFile:
         self.markers: dict[int, set] = {}
         self.guarded_by: dict[int, str] = {}    # line -> lock name
         self.holds: dict[int, tuple] = {}       # line -> lock names
+        self.snapshot_gates: dict[int, str] = {}  # line -> gts expr
+        self.version_gates: dict[int, str] = {}   # line -> ver expr
         for i, ln in enumerate(self.lines, 1):
             if "#" not in ln:
                 continue
@@ -157,6 +182,12 @@ class SourceFile:
                 self.holds[i] = tuple(
                     a.strip() for a in m.group(1).split(",")
                     if a.strip())
+            m = _SNAPGATE.search(ln)
+            if m:
+                self.snapshot_gates[i] = m.group(1)
+            m = _VERGATE.search(ln)
+            if m:
+                self.version_gates[i] = m.group(1)
 
     def disabled(self, line: int, rule: str) -> bool:
         d = self.disables.get(line)

@@ -30,6 +30,8 @@ from .core import (Baseline, Project, RULES, default_baseline_path,
 from .passes import (HostSyncPass, LockDisciplinePass, NetDeadlinePass,
                      ObsPurityPass, ProgramKeyPass, SlotDisciplinePass,
                      TracePurityPass, WaitDisciplinePass)
+from .visibility import (VersionKeyPass, VisibilityDisciplinePass,
+                         VisibilityWitnessPass)
 
 _CONCURRENCY_RULES = {"lock-order", "lock-blocking", "lock-atomicity"}
 
@@ -59,6 +61,11 @@ def run_passes(project: Project, rules=None) -> list:
         TransferDisciplinePass(project, closure),
         RetraceWitnessPass(project),
     ]
+    # the witness cross-check consumes the discipline pass's gated
+    # set, so the pair shares one scan
+    vis = VisibilityDisciplinePass(project)
+    passes += [vis, VersionKeyPass(project),
+               VisibilityWitnessPass(project, vis)]
     if rules is None or rules & _CONCURRENCY_RULES:
         ctx = ConcurrencyContext(project, closure)
         passes += [

@@ -1,0 +1,586 @@
+"""otbsnap static half: snapshot-visibility soundness passes.
+
+The engine serves reads from five version-sensitive fast paths that
+bypass the primary executor — the GTS-versioned result cache and
+shared morsel streams, GTS-high-water replica routing, hot standbys,
+and version-keyed bufferpool/host-snapshot entries — each guarded by
+a hand-written ``snapshot_gts >= tag`` / store-version comparison.
+Nothing used to prove a NEW serve path can't skip the gate.  These
+passes make the guard set a checked, greppable inventory (the
+sync-boundary philosophy), completing the analysis trilogy: otbrace
+proved locks, otbcard proved compile keys, otbsnap proves visibility.
+
+Contract comments (parsed by analysis/core.py):
+
+``# snapshot-gate: <gts-expr>``
+    on or inside a ``def``: declares the function a SERVE POINT whose
+    staleness guard is ``<gts-expr>`` — e.g.
+    ``# snapshot-gate: snapshot_gts >= ent[2]`` on
+    ``ResultCache.lookup``.  The expression must DISCHARGE: either a
+    comparison over exactly its terms appears before a return
+    (lexical-dominance approximation), or every term provably flows
+    into a call argument / return value (the gate material is live —
+    it reaches the self-gating source or the MVCC program run).
+``# version-gate: <version-expr>``
+    same, for exact store-version matching — e.g.
+    ``# version-gate: ent[1].version == ver`` on
+    ``DeviceBufferPool.get_chunk``.
+
+Three rules:
+
+- ``snapshot-gate`` (VisibilityDisciplinePass) — every function in
+  exec/storage/net/parallel that CALLS a serve source
+  (``ResultCache.lookup``, ``ShareHub.attach``, pool
+  ``get_chunk``/``get_device``/``host_snapshot``/
+  ``peek_host_snapshot``, ``ReplicaRouter.try_exec``, any
+  ``exec_plan``/``exec_plan_device`` dispatch) — or IS one of those
+  sources — must carry at least one discharged contract.  Ungated
+  serve point = finding; a contract whose terms no longer appear in
+  the code (stale annotation) = finding.
+- ``version-key`` (VersionKeyPass) — a cache container whose written
+  values derive from TableStore contents must have store-version /
+  GTS material flowing into the write's key or value, or an
+  ``invalidate*`` edge on the owning scope; otherwise DML can never
+  invalidate it.
+- ``visibility-witness`` (VisibilityWitnessPass) — cross-checks the
+  runtime witness (``analysis/visibility_witness.json``, written by
+  ``utils/snapcheck.py`` under OTB_SNAPCHECK=1 shards): every
+  runtime-witnessed serve point must be a member of the
+  statically-gated set, and the witness must carry zero recorded
+  sanitizer violations.  An unannotated runtime serve path fails CI
+  here even if the static detector never saw it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from .cardinality import _assign_exprs, _flow_exprs
+from .core import Finding, FuncInfo, Project
+from .passes import _Emitter
+
+#: package subtrees where reads can reach a client reply
+_SCOPE_DIRS = ("exec", "storage", "net", "parallel")
+
+#: cheap text screen: a module without any of these substrings cannot
+#: contain a serve-source call (keeps the whole-repo gate under budget)
+_PRE_FILTER = ("exec_plan", ".lookup", ".attach", "get_chunk",
+               "get_device", "host_snapshot", "try_exec")
+
+#: serve-source attribute calls that need no receiver check — every
+#: plan dispatch must declare which snapshot it serves under
+_ANY_RECV_ATTRS = frozenset({"try_exec", "exec_plan",
+                             "exec_plan_device"})
+_POOL_ATTRS = frozenset({"get_chunk", "get_device", "host_snapshot",
+                         "peek_host_snapshot"})
+
+#: (class simple name, method) pairs that ARE the gate — the serving
+#: tiers themselves, serve points by definition
+_SELF_GATING = frozenset({
+    ("ResultCache", "lookup"), ("ShareHub", "attach"),
+    ("ReplicaRouter", "try_exec"), ("HotStandby", "exec_plan"),
+    ("DeviceBufferPool", "get_chunk"),
+    ("DeviceBufferPool", "get_device"),
+    ("DeviceBufferPool", "host_snapshot"),
+    ("DeviceBufferPool", "peek_host_snapshot"),
+})
+
+#: identifiers that count as store-version / snapshot material in a
+#: cache write's key+value flow (version-key rule)
+_VERSION_TOKENS = frozenset({
+    "version", "ver", "vkey", "gts", "version_key", "store_versions",
+    "snapshot_ts", "snapshot_gts", "hwm", "commit_ts",
+    "last_commit_ts"})
+
+#: calls that read TableStore CONTENT (what makes a cached value
+#: version-sensitive in the first place)
+_CONTENT_CALLS = frozenset({
+    "host_live_columns", "host_snapshot", "peek_host_snapshot",
+    "get_chunk", "get_device", "row_count", "column", "columns"})
+
+
+def _in_scope(dotted: str) -> bool:
+    parts = dotted.split(".")
+    return len(parts) >= 2 and parts[1] in _SCOPE_DIRS
+
+
+def _canonical(fi: FuncInfo) -> str:
+    """Serve-point name shared with the runtime sanitizer: the dotted
+    module minus the package root, plus the qualname — e.g.
+    ``exec.share.ResultCache.lookup``."""
+    mod = fi.module.split(".", 1)[-1]
+    return f"{mod}.{fi.qualname}"
+
+
+def _own_nodes(fn_node):
+    """The nodes a function OWNS: its subtree minus nested function
+    bodies (those are separate FuncInfos and carry their own gates)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _node_tokens(node) -> set:
+    """Identifier material of an AST subtree: Name ids, Attribute
+    attrs, and constant reprs — the terms a gate expression is made
+    of."""
+    toks: set = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            toks.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            toks.add(n.attr)
+        elif isinstance(n, ast.Constant):
+            toks.add(repr(n.value))
+    return toks
+
+
+def _recv_name(call):
+    owner = call.func.value
+    if isinstance(owner, ast.Name):
+        return owner.id
+    if isinstance(owner, ast.Attribute):
+        return owner.attr
+    return None
+
+
+# ===========================================================================
+# snapshot-gate: visibility discipline
+# ===========================================================================
+class VisibilityDisciplinePass:
+    """Every serve point carries a discharged ``# snapshot-gate:`` /
+    ``# version-gate:`` contract.  ``scan()`` also computes the
+    statically-gated set the witness cross-check consumes."""
+
+    rule = "snapshot-gate"
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._scanned = None
+        # module-level receiver names bound to the serving singletons
+        self.cache_names = {"RESULT_CACHE"}
+        self.hub_names = {"HUB"}
+        self.pool_names = {"POOL", "self"}
+        for mi in project.modules.values():
+            for st in mi.src.tree.body:
+                if not (isinstance(st, ast.Assign)
+                        and isinstance(st.value, ast.Call)):
+                    continue
+                f = st.value.func
+                cls = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else None)
+                names = {t.id for t in st.targets
+                         if isinstance(t, ast.Name)}
+                if cls == "ResultCache":
+                    self.cache_names |= names
+                elif cls == "ShareHub":
+                    self.hub_names |= names
+                elif cls == "DeviceBufferPool":
+                    self.pool_names |= names
+
+    # -- serve-source detection ------------------------------------------
+    def _serve_call(self, call):
+        """The serve-source kind of a Call, or None."""
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        a = f.attr
+        if a in _ANY_RECV_ATTRS:
+            return a
+        recv = _recv_name(call)
+        if a == "lookup" and recv in self.cache_names:
+            return a
+        if a == "attach" and recv in self.hub_names:
+            return a
+        if a in _POOL_ATTRS and recv in self.pool_names:
+            return a
+        return None
+
+    @staticmethod
+    def _self_gating(fi: FuncInfo) -> bool:
+        cls = (fi.class_name or "").rsplit(".", 1)[-1]
+        return (cls, fi.name) in _SELF_GATING
+
+    # -- gate ownership ----------------------------------------------------
+    @staticmethod
+    def _gates_by_func(mi) -> dict:
+        """qualname -> [(kind, expr, line)]; a gate comment belongs to
+        the INNERMOST function whose span covers its line (nested defs
+        carry their own contracts).  A gate written ABOVE a ``def``
+        (decorator position — only blank/comment/decorator lines
+        between) belongs to that def, not the enclosing scope."""
+        fis = list(mi.functions.values())
+        lines = mi.src.lines
+
+        def decorates(line, fi):
+            if not (line < fi.lineno <= line + 8):
+                return False
+            for ln in lines[line:fi.lineno - 1]:
+                t = ln.strip()
+                if t and not t.startswith(("#", "@")):
+                    return False
+            return True
+
+        def owner(line):
+            best = None
+            for fi in fis:
+                if decorates(line, fi):
+                    return fi
+                end = getattr(fi.node, "end_lineno", None) or fi.lineno
+                if fi.lineno <= line <= end and (
+                        best is None or fi.lineno > best.lineno):
+                    best = fi
+            return best
+
+        out: dict = {}
+        for table, kind in ((mi.src.snapshot_gates, "snapshot"),
+                            (mi.src.version_gates, "version")):
+            for line, expr in table.items():
+                fi = owner(line)
+                if fi is not None:
+                    out.setdefault(fi.qualname, []).append(
+                        (kind, expr, line))
+        return out
+
+    # -- discharge ----------------------------------------------------------
+    @staticmethod
+    def _used_tokens(fi: FuncInfo, own: list) -> set:
+        """Tokens of every call argument and return value, expanded
+        through the function's assignment closure — the material that
+        provably reaches a callee or the caller.  A gate expression
+        whose terms all land here is LIVE: it names the snapshot/
+        version operands the function actually serves under."""
+        seeds = []
+        for n in own:
+            if isinstance(n, ast.Call):
+                seeds.extend(n.args)
+                seeds.extend(kw.value for kw in n.keywords)
+            elif isinstance(n, ast.Return) and n.value is not None:
+                seeds.append(n.value)
+        assigns = _assign_exprs(fi.node)
+        toks: set = set()
+        seen_names: set = set()
+        frontier: list = []
+
+        def absorb(e):
+            for x in ast.walk(e):
+                if isinstance(x, ast.Name):
+                    toks.add(x.id)
+                    if x.id not in seen_names:
+                        seen_names.add(x.id)
+                        frontier.append(x.id)
+                elif isinstance(x, ast.Attribute):
+                    toks.add(x.attr)
+                elif isinstance(x, ast.Constant):
+                    toks.add(repr(x.value))
+
+        for e in seeds:
+            absorb(e)
+        while frontier:
+            for rhs, _it in assigns.get(frontier.pop(), ()):
+                absorb(rhs)
+        return toks
+
+    def _check_gate(self, fi, own, used, kind, expr, line, em):
+        try:
+            tree = ast.parse(expr, mode="eval")
+        except SyntaxError:
+            em.emit(fi, line,
+                    f"unparseable # {kind}-gate expression {expr!r}")
+            return
+        want = _node_tokens(tree)
+        returns = [n for n in own if isinstance(n, ast.Return)]
+        last_ret = max((r.lineno for r in returns), default=None)
+        for n in own:
+            # mode (a): a comparison over the contract's terms that
+            # lexically dominates a return
+            if isinstance(n, ast.Compare) and want <= _node_tokens(n) \
+                    and (last_ret is None or n.lineno <= last_ret):
+                return
+        if want <= used:
+            return      # mode (b): gate material flows to a call/return
+        em.emit(fi, line,
+                f"# {kind}-gate: {expr} does not discharge — no "
+                f"dominating comparison over its terms and not all of "
+                f"them reach a call argument or return value (stale "
+                f"contract, or the guard was removed)")
+
+    # -- entry points --------------------------------------------------------
+    def scan(self):
+        """(findings, gated) — gated is the set of canonical
+        serve-point names carrying at least one contract."""
+        if self._scanned is not None:
+            return self._scanned
+        em = _Emitter(self.rule)
+        gated: set = set()
+        for mi in self.project.modules.values():
+            if not _in_scope(mi.dotted):
+                continue
+            if not any(s in mi.src.text for s in _PRE_FILTER) and \
+                    not mi.src.snapshot_gates and \
+                    not mi.src.version_gates:
+                continue
+            gates = self._gates_by_func(mi)
+            for fi in mi.functions.values():
+                own = list(_own_nodes(fi.node))
+                calls = [n for n in own if isinstance(n, ast.Call)
+                         and self._serve_call(n) is not None]
+                declared = gates.get(fi.qualname, [])
+                if declared:
+                    gated.add(_canonical(fi))
+                if not calls and not self._self_gating(fi):
+                    continue
+                if not declared:
+                    kinds = sorted({self._serve_call(c) for c in calls}
+                                   - {None}) or [fi.name]
+                    em.emit(fi, calls[0].lineno if calls else fi.lineno,
+                            f"serve point ({', '.join(kinds)}) without "
+                            f"a # snapshot-gate:/# version-gate: "
+                            f"contract — cached/replicated/shared "
+                            f"state can reach a reader here with no "
+                            f"declared staleness guard")
+                    continue
+                used = self._used_tokens(fi, own)
+                for kind, expr, line in declared:
+                    self._check_gate(fi, own, used, kind, expr,
+                                     line, em)
+        self._scanned = (em.findings, gated)
+        return self._scanned
+
+    def gated(self) -> set:
+        return self.scan()[1]
+
+    def run(self) -> list:
+        return self.scan()[0]
+
+
+# ===========================================================================
+# version-key: content caches DML can actually invalidate
+# ===========================================================================
+class VersionKeyPass:
+    """A cache whose VALUES derive from TableStore contents (column
+    pulls, host snapshots, chunk/device entries, row counts) is stale
+    the moment DML bumps the store version — so store-version/GTS
+    material must flow into the write's key or value (exact-match
+    invalidation, the bufferpool convention), or the owning scope must
+    expose an ``invalidate*`` edge the bump path can call.  A content
+    cache with neither is unreachable by invalidation: flagged."""
+
+    rule = "version-key"
+
+    def __init__(self, project: Project):
+        self.project = project
+
+    def run(self) -> list:
+        em = _Emitter(self.rule)
+        for mi in self.project.modules.values():
+            if not _in_scope(mi.dotted):
+                continue
+            if "store" not in mi.src.text.lower():
+                continue
+            self._scan_module(mi, em)
+        return em.findings
+
+    # -- write-site inventory -------------------------------------------
+    @staticmethod
+    def _write_sites(fi: FuncInfo, recv_names, attr_mode: bool):
+        """(container name, key expr, value expr, line) for every
+        ``C[k] = v`` / ``C.setdefault(k, v)`` in the function, where C
+        is ``self.<name>`` (attr_mode) or a bare module name."""
+        sites = []
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Assign) and \
+                    isinstance(n.targets[0], ast.Subscript):
+                tgt = n.targets[0].value
+                if attr_mode and isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and tgt.attr in recv_names:
+                    sites.append((tgt.attr, n.targets[0].slice,
+                                  n.value, n.lineno))
+                elif not attr_mode and isinstance(tgt, ast.Name) and \
+                        tgt.id in recv_names:
+                    sites.append((tgt.id, n.targets[0].slice,
+                                  n.value, n.lineno))
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "setdefault" and len(n.args) >= 2:
+                tgt = n.func.value
+                if attr_mode and isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and tgt.attr in recv_names:
+                    sites.append((tgt.attr, n.args[0], n.args[1],
+                                  n.lineno))
+                elif not attr_mode and isinstance(tgt, ast.Name) and \
+                        tgt.id in recv_names:
+                    sites.append((tgt.id, n.args[0], n.args[1],
+                                  n.lineno))
+        return sites
+
+    @staticmethod
+    def _flow_tokens(fi: FuncInfo, expr) -> tuple:
+        """(identifier tokens, called attr/function names) over the
+        expression's assignment-closure flow."""
+        toks: set = set()
+        calls: set = set()
+        for e, _it in _flow_exprs(fi, expr):
+            for n in ast.walk(e):
+                if isinstance(n, ast.Name):
+                    toks.add(n.id)
+                elif isinstance(n, ast.Attribute):
+                    toks.add(n.attr)
+                elif isinstance(n, ast.Call):
+                    f = n.func
+                    nm = f.id if isinstance(f, ast.Name) else (
+                        f.attr if isinstance(f, ast.Attribute) else None)
+                    if nm:
+                        calls.add(nm)
+        return toks, calls
+
+    def _scan_module(self, mi, em: _Emitter):
+        # instance-attribute containers, per class
+        by_class: dict = {}
+        for fi in mi.functions.values():
+            if fi.class_name is None:
+                continue
+            ent = by_class.setdefault(
+                fi.class_name, {"attrs": set(), "fns": [],
+                                "inval": []})
+            ent["fns"].append(fi)
+            if "invalidate" in fi.name or fi.name.startswith("_inval"):
+                ent["inval"].append(fi)
+            for n in ast.walk(fi.node):
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self" and \
+                                _is_container(n.value):
+                            ent["attrs"].add(t.attr)
+        for cls, ent in by_class.items():
+            if not ent["attrs"]:
+                continue
+            invalidated = set()
+            for fi in ent["inval"]:
+                for n in ast.walk(fi.node):
+                    if isinstance(n, ast.Attribute) and \
+                            n.attr in ent["attrs"]:
+                        invalidated.add(n.attr)
+            for fi in ent["fns"]:
+                for name, key, val, line in self._write_sites(
+                        fi, ent["attrs"], attr_mode=True):
+                    if name in invalidated:
+                        continue
+                    self._check_site(fi, name, key, val, line, em)
+        # module-level containers written from function scope
+        mod_names = set(mi.containers)
+        if mod_names:
+            invalidated = {
+                name for name in mod_names
+                for fi in mi.functions.values()
+                if "invalidate" in fi.name
+                and any(isinstance(n, ast.Name) and n.id == name
+                        for n in ast.walk(fi.node))}
+            for fi in mi.functions.values():
+                for name, key, val, line in self._write_sites(
+                        fi, mod_names - invalidated, attr_mode=False):
+                    self._check_site(fi, name, key, val, line, em)
+
+    def _check_site(self, fi, name, key, val, line, em: _Emitter):
+        vtoks, vcalls = self._flow_tokens(fi, val)
+        if "TableStore" in vcalls:
+            # the cached value IS a live store object (catalog entry),
+            # not a copy of its contents — it can't go stale
+            return
+        content = bool(vcalls & _CONTENT_CALLS) or any(
+            "store" in t.lower() for t in vtoks | vcalls)
+        if not content:
+            return
+        ktoks, kcalls = self._flow_tokens(fi, key)
+        material = (vtoks | ktoks) & _VERSION_TOKENS or \
+            (vcalls | kcalls) & _VERSION_TOKENS
+        if material:
+            return
+        em.emit(fi, line,
+                f"content cache '{name}' written with TableStore-"
+                f"derived data but no store-version/GTS material in "
+                f"the entry's key or value and no invalidate* edge — "
+                f"DML bumps the store version yet can never invalidate "
+                f"this entry")
+
+
+def _is_container(v) -> bool:
+    if isinstance(v, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                      ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(v, ast.Call):
+        f = v.func
+        nm = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        return nm in ("dict", "list", "set", "defaultdict",
+                      "OrderedDict", "deque", "Counter")
+    return False
+
+
+# ===========================================================================
+# visibility-witness: runtime reality ⊆ static model
+# ===========================================================================
+def check_witness(data, gated) -> list:
+    """Validate a visibility-witness dict against the statically-gated
+    serve-point set; returns human-readable violation strings.  Shared
+    by VisibilityWitnessPass and the tier-1 witness test."""
+    out: list = []
+    points = data.get("serve_points", {})
+    if not isinstance(points, dict):
+        return ["malformed witness: 'serve_points' is not a dict"]
+    for name in sorted(points):
+        if name not in gated:
+            out.append(
+                f"runtime-witnessed serve point '{name}' is not in "
+                f"the statically-gated set — add a # snapshot-gate:/"
+                f"# version-gate: contract on it (or regenerate the "
+                f"witness under OTB_SNAPCHECK=1)")
+    for v in data.get("violations", []) or []:
+        if isinstance(v, dict):
+            out.append(
+                f"recorded sanitizer violation [{v.get('kind', '?')}] "
+                f"at {v.get('point', '?')}: {v.get('message', '')}")
+        else:
+            out.append(f"recorded sanitizer violation: {v!r}")
+    return out
+
+
+class VisibilityWitnessPass:
+    """Cross-check the committed runtime witness
+    (analysis/visibility_witness.json, merged across OTB_SNAPCHECK=1
+    chaos/zipf shards) against the static gate inventory: witnessed
+    serve points ⊆ statically-gated set, zero live violations."""
+
+    rule = "visibility-witness"
+
+    def __init__(self, project: Project,
+                 discipline: VisibilityDisciplinePass):
+        self.project = project
+        self.discipline = discipline
+
+    def run(self) -> list:
+        path = os.path.join(self.project.root, self.project.package,
+                            "analysis", "visibility_witness.json")
+        if not os.path.exists(path):
+            return []
+        rel = os.path.relpath(path, self.project.root).replace(
+            os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            return [Finding(self.rule, rel, 1, "",
+                            f"unreadable visibility witness: {e}")]
+        return [Finding(self.rule, rel, 1, "", msg)
+                for msg in check_witness(data, self.discipline.gated())]

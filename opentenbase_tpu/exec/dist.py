@@ -295,6 +295,9 @@ class DistExecutor:
                     # standby (the next statement rides the mesh again)
                     self.fallback_reason = (
                         f"mesh staging connection failure: {e}")
+        # snapshot-gate: self.snapshot_ts
+        # (every dispatched fragment carries the transaction snapshot;
+        # the datanode filters tuple visibility against it)
         if dp.fqs_node is not None:
             # whole-query shipped to one datanode (FQS).  An in-process
             # datanode returns the device batch directly (no host
@@ -523,6 +526,9 @@ class DistExecutor:
         """Route one read fragment to a hot standby of dn_index, or
         None -> run on the primary as always (router trouble never
         fails a statement)."""
+        # snapshot-gate: self.snapshot_ts
+        # (the router only serves from a replica whose replayed hwm
+        # covers this snapshot; net/guard.py re-checks)
         if not self.replica_reads:
             return None
         router = getattr(self.cluster, "read_router", None)
@@ -554,6 +560,7 @@ class DistExecutor:
         """Run one fragment at `where` ('cn' or dn index).  Returns a
         DBatch for 'cn', a HostBatch from a datanode (the datanode may be
         remote — its exec_plan is the RPC surface)."""
+        # snapshot-gate: self.snapshot_ts
         sources = {ex_idx: hb for (ex_idx, dest), hb in ex_out.items()
                    if dest == where}
         t0 = _time.perf_counter() if self.instrument else 0

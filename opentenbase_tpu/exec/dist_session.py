@@ -1529,6 +1529,7 @@ class ClusterSession:
                 owner = c.locator.route_rows(td, route_cols, n)
                 dns = [c.datanodes[i] for i in sorted(set(owner.tolist()))]
             for dn in dns:
+                # snapshot-gate: t.snapshot_ts
                 hb = dn.exec_plan(plan, t.snapshot_ts, t.txid, {}, {})
                 kcols = [hb.cols[f"{td.name}.{cn}"] for cn in target]
                 for ri in range(hb.nrows):
@@ -2055,6 +2056,7 @@ class ClusterSession:
             raise ExecError("EXECUTE DIRECT does not support subqueries")
         t, _ = self._begin_implicit()
         from .dist import _to_device
+        # snapshot-gate: t.snapshot_ts
         hb = dn.exec_plan(planned.plan, t.snapshot_ts, t.txid, {}, {})
         names, rows = materialize(_to_device(hb), planned.output_names)
         return Result("SELECT", names=names, rows=rows, rowcount=len(rows))

@@ -270,6 +270,9 @@ class DeviceTableCache:
     Kept as a facade so every existing `node.cache` call site works
     unchanged while all nodes share one budget + telemetry."""
 
+    # version-gate: POOL.get_device(store, colnames)
+    # (pure delegate: the pool compares entry.version == store.version
+    # before serving and restages on mismatch)
     def get(self, store: TableStore, colnames: list[str]):
         from ..storage.bufferpool import POOL
         return POOL.get_device(store, colnames)

@@ -245,11 +245,15 @@ class MeshRunner:
     # ------------------------------------------------------------------
     # staging: per-DN host chunks -> sharded device arrays + union dicts
     # ------------------------------------------------------------------
+    # version-gate: cached["version"] == ver
     def _snapshot(self, dn, name: str) -> dict:
         """One DN's live columns + dictionaries at its current version —
         the shared buffer-pool host snapshot for in-process stores, over
         the wire for TCP datanodes (both version-cached, so an unchanged
-        table never re-concatenates or re-ships)."""
+        table never re-concatenates or re-ships).  In-process stores
+        delegate to POOL.host_snapshot (its own version gate); the wire
+        path re-validates the cached snapshot against a fresh
+        dn.table_version probe before reuse."""
         if hasattr(dn, "stores"):
             st = dn.stores.get(name)
             if st is None:

@@ -60,7 +60,7 @@ from ..plan import physical as P
 from ..plan.distribute import BatchSource
 from ..storage import codec
 from ..storage.batch import chunk_class, size_class
-from ..utils import locks
+from ..utils import locks, snapcheck
 from . import share as workshare
 from .spill import (_walk_nodes, _clone_replacing, _needed_cols,
                     _ScanInfo, has_order_sensitive, node_contains,
@@ -419,6 +419,10 @@ class MorselDriver:
         # cross-query sharing: the first stream over (store, version,
         # chunk shape) leads; compatible concurrent streams follow its
         # published windows instead of staging their own
+        # version-gate: (big.store, self.chunk_rows)
+        # (ShareHub.attach keys streams on (id(store), store.version,
+        # chunk_rows) — a follower can only join a stream staged at
+        # the SAME store version it would stage itself)
         role, stream, token, join_lo = None, None, self.token, 0
         if self.share:
             names = frozenset(host) \
@@ -501,12 +505,16 @@ class MorselDriver:
         big = shape.big
         resident_arrs, resident_ns, pins = {}, {}, []
         try:
+            # snapshot-gate: self.snapshot_ts
+            # (every window runs the fragment under this query's
+            # snapshot; MVCC system columns ride in the chunk)
             resident_arrs, resident_ns, pins = self._pin_residents(shape)
             prog = FragmentProgram(self._exec_ctx(), shape.per_plan,
                                    self.chunk_rows)
             if not prog.ok():
                 return None
 
+            # version-gate: (big.store, self.chunk_rows)
             def stage(at):
                 if stream is not None:
                     stream.throttle()
@@ -627,6 +635,12 @@ class MorselDriver:
             + [codec.aux_name(c, en) for c, en in encs.items()]
         resident_arrs, resident_ns, pins = {}, {}, []
         outs = []   # (lo, host batch) — re-sorted to stream order
+        # snapshot-gate: self.snapshot_ts
+        # version-gate: entry.version == stream.version
+        # (every consumed window — published OR the private prefix
+        # re-read — must carry the stream's attach-time store version;
+        # mixing physical versions inside one result would fracture
+        # the read even though each window is MVCC-filtered)
         try:
             resident_arrs, resident_ns, pins = self._pin_residents(shape)
             prog = FragmentProgram(self._exec_ctx(), shape.per_plan,
@@ -664,6 +678,13 @@ class MorselDriver:
                             lo, entry = f["deque"].popleft()
                         else:
                             break   # done and fully drained
+                    if snapcheck.enabled() or snapcheck.history_on():
+                        snapcheck.serve(
+                            "exec.morsel.MorselDriver._follower_pass",
+                            snapshot_gts=self.snapshot_ts,
+                            versions=[(bname, entry.version)],
+                            expect_versions=[(bname, stream.version)],
+                            session=self.txid, source="shared")
                     try:
                         ok = run_window(lo, entry)
                     finally:
@@ -680,6 +701,21 @@ class MorselDriver:
                     entry = POOL.get_chunk(big.store, host, lo,
                                            self.chunk_rows, encs,
                                            consumer=token)
+                    if entry.version != stream.version:
+                        # a DML committed mid-stream: the prefix would
+                        # restage at the NEW store version while the
+                        # consumed windows carry the attach-time one —
+                        # two physical images in one result.  Bail to
+                        # a private stream (consistent by construction)
+                        POOL.unpin_chunk(entry, consumer=token)
+                        raise _ShareFallback()
+                    if snapcheck.enabled() or snapcheck.history_on():
+                        snapcheck.serve(
+                            "exec.morsel.MorselDriver._follower_pass",
+                            snapshot_gts=self.snapshot_ts,
+                            versions=[(bname, entry.version)],
+                            expect_versions=[(bname, stream.version)],
+                            session=self.txid, source="shared")
                     try:
                         ok = run_window(lo, entry)
                     finally:

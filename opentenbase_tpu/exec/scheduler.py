@@ -69,7 +69,7 @@ from .fused import (batch_signature, finish_fused_batch,
                     stage_fused_batch)
 from .session import Result
 from ..obs import xray as obs_xray
-from ..utils import locks
+from ..utils import locks, snapcheck
 
 # ---------------------------------------------------------------------------
 # serving-tier telemetry (surfaced by the otb_scheduler view).  Counters
@@ -581,6 +581,8 @@ class Scheduler:
         node = getattr(session, "node", None) or self.node
         return workshare.enabled(getattr(node, "gucs", None) or {})
 
+    # snapshot-gate: snap
+    # version-gate: vkey
     def _serve_cached(self, item: _Item) -> bool:
         """Serve a batchable SELECT straight from the GTS-versioned
         result cache: servable iff every referenced table still sits
@@ -598,6 +600,10 @@ class Scheduler:
         if hit is None:
             return False
         names, rows, rowcount = hit
+        if snapcheck.enabled() or snapcheck.history_on():
+            snapcheck.serve("exec.scheduler.Scheduler._serve_cached",
+                            snapshot_gts=snap, versions=vkey,
+                            session=id(item.session), source="cache")
         return self._complete(item, results=[Result(
             "SELECT", names=list(names), rows=rows,
             rowcount=rowcount)])
@@ -618,6 +624,12 @@ class Scheduler:
              tuple(v for _n, v, _t in item.info.lits), item.vkey),
             item.snap, names, rows, rowcount=len(rows),
             budget=workshare.cache_budget(gucs))
+        if snapcheck.history_on():
+            # the producing execution is itself a primary read at
+            # item.snap over the captured version tuple — the SI
+            # checker cross-checks cache hits against it
+            snapcheck.note_read(id(item.session), item.snap,
+                                "primary", obs=item.vkey)
 
     # -- completion handshake ---------------------------------------------
     def _complete(self, item: _Item, error=None, results=None,

@@ -536,6 +536,14 @@ class Session:
             st.backfill_delete([span], ts)
         for st, span in t.lock_spans:
             st.clear_locks([span])
+        from ..utils import snapcheck
+        if snapcheck.history_on() and (t.insert_spans or t.delete_spans):
+            # SI history: post-backfill store versions tagged with the
+            # commit GTS — the write half analysis/sicheck.py orders by
+            snapcheck.note_write(
+                t.txid, int(ts),
+                {st.td.name: st.version
+                 for st, _sp in (t.insert_spans + t.delete_spans)})
         self.node.active_txns.discard(t.txid)
         self.node.lockmgr.resolve(t.txid, committed=True)
 

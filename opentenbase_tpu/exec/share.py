@@ -55,7 +55,7 @@ import itertools
 import os
 
 from ..obs import xray as _xray
-from ..utils import locks
+from ..utils import locks, snapcheck
 
 _LOCK = locks.Lock("exec.share._LOCK")
 _STATS: dict = {                    # guarded_by: _LOCK
@@ -188,6 +188,8 @@ class ResultCache:
             self._map.clear()
             self._bytes = 0
 
+    # snapshot-gate: snapshot_gts >= ent[2]
+    # version-gate: ent[1] == vkey
     def lookup(self, sig, lits, vkey: tuple, snapshot_gts: int):
         """(names, rows, rowcount) iff an entry exists whose captured
         version tuple equals the CURRENT `vkey` and whose producing
@@ -211,7 +213,12 @@ class ResultCache:
                 return None
             ent[0] = next(self._seq)
             bump("result_cache_hits")
-            return ent[3], list(ent[4]), ent[5]
+            out = ent[3], list(ent[4]), ent[5]
+        if snapcheck.enabled():
+            snapcheck.serve("exec.share.ResultCache.lookup",
+                            snapshot_gts=snapshot_gts, entry_gts=ent[2],
+                            versions=ent[1], expect_versions=vkey)
+        return out
 
     def put(self, key, gts: int, names, rows, rowcount: int = None,
             budget: int = None):
@@ -392,12 +399,15 @@ class ShareHub:
         with self._lock:
             return len(self._streams)
 
+    # version-gate: store.version
     def attach(self, store, chunk_rows: int, names: frozenset,
                classes: dict):
         """("leader", stream, token) for the first arrival,
         ("follower", stream, token, join_lo) for a compatible later
         one, None when an open stream exists but is incompatible (the
-        caller streams privately)."""
+        caller streams privately).  The store version rides in the
+        stream key AND on the stream object, so a follower can only
+        join a pass over exactly the version its own plan resolved."""
         key = (id(store), store.version, int(chunk_rows))
         token = new_token()
         with self._lock:
@@ -420,6 +430,11 @@ class ShareHub:
         bump("shared_scan_fanin")
         if join_lo > 0:
             bump("late_joins")
+        if snapcheck.enabled():
+            snapcheck.serve("exec.share.ShareHub.attach",
+                            versions=[(stream.table, stream.version)],
+                            expect_versions=[(store.td.name,
+                                              store.version)])
         return "follower", stream, token, join_lo
 
     def remove(self, stream: SharedStream):

@@ -128,6 +128,10 @@ def has_order_sensitive(subtree) -> bool:
                for nd in _walk_nodes(subtree))
 
 
+# version-gate: snap
+# (snap is non-None ONLY when the pool's cached host snapshot matches
+# the live store.version — peek_host_snapshot's own gate; the miss
+# path reads the live columns directly, so no stale image can serve)
 def staged_host_columns(store, needed) -> dict:
     """One store's host columns in the staged namespace (values + MVCC
     sys columns + null masks), reusing the pool's host snapshot when a

@@ -155,6 +155,9 @@ def _dispatch(node: DataNode, msg: dict):
     if op == "alter_table":
         return node.alter_table(msg["rec"])
     if op == "exec_plan":
+        # snapshot-gate: msg["snapshot_ts"]
+        # (the wire carries the CN's transaction snapshot; the DN
+        # filters tuple visibility against it)
         return node.exec_plan(msg["plan"], msg["snapshot_ts"],
                               msg["txid"], msg.get("params", {}),
                               msg.get("sources", {}))
@@ -213,6 +216,10 @@ def _dispatch(node: DataNode, msg: dict):
         if st is None:
             return None
         from ..storage.bufferpool import POOL
+        # version-gate: snap
+        # (the pool rebuilds the snapshot unless its cached image
+        # matches the live store.version; the version ships with the
+        # columns so the mesh owner re-keys its own cache on it)
         snap = POOL.host_snapshot(st)
         return {**snap, "null_columns": sorted(snap["null_columns"])}
     if op == "ping":

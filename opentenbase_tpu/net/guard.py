@@ -44,7 +44,7 @@ from typing import Optional
 
 from ..obs import xray
 from ..obs.metrics import REGISTRY
-from ..utils import locks
+from ..utils import locks, snapcheck
 
 
 class GuardError(ConnectionError):
@@ -468,11 +468,18 @@ class ReplicaRouter:
         return [r["name"] for r in self._ensure().get(dn_index, [])
                 if not r["dead"]]
 
+    # snapshot-gate: r["hwm"] >= need
     def try_exec(self, dn_index: int, plan, snapshot_ts: int,
                  txid: int, params: dict, sources: dict):
         """Run one read fragment on a replica of dn_index.  Returns the
         fragment's host batch, or None -> caller falls through to the
-        primary (never raises for replica-side trouble)."""
+        primary (never raises for replica-side trouble).
+
+        Visibility contract: the fragment may be served by a replica
+        only when its replayed commit high-water mark covers ``need =
+        min(snapshot_ts, primary commit hwm)`` — everything the
+        snapshot can see has been replayed.  The replica re-asserts the
+        same bound server-side (``min_hwm`` -> StandbyLag)."""
         from ..storage.replication import StandbyLag
         reps = self._ensure().get(dn_index)
         if not reps:
@@ -534,6 +541,11 @@ class ReplicaRouter:
             r["hwm"] = max(r["hwm"], need)
             REGISTRY.counter("otb_replica_reads_total",
                              replica=r["name"]).inc()
+            if snapcheck.enabled() or snapcheck.history_on():
+                snapcheck.serve(
+                    "net.guard.ReplicaRouter.try_exec",
+                    snapshot_gts=snapshot_ts, entry_gts=need,
+                    session=txid, source="replica")
             return out
         REGISTRY.counter("otb_replica_fallthrough_total",
                          dn=f"dn{dn_index}").inc()

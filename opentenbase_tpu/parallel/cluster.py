@@ -26,7 +26,7 @@ from ..storage.store import (SerializationConflict, TableStore,
                              WriteConflict)
 from ..storage.wal import Wal, checkpoint_store, restore_store
 from ..utils.faultinject import fault_point
-from ..utils import locks
+from ..utils import locks, snapcheck
 
 
 class DataNode:
@@ -108,6 +108,8 @@ class DataNode:
         st = self.stores.pop(name, None)
         if st is not None:
             self.cache.invalidate(st)
+            from ..storage import codec
+            codec.invalidate_ladder(name)
         if not self._unlogged(name):
             self.log({"op": "drop_table", "name": name})
 
@@ -277,6 +279,9 @@ class DataNode:
         if st is not None:
             self.cache.invalidate(st)
 
+    # snapshot-gate: snapshot_ts
+    # (visibility happens below: the executor filters MVCC system
+    # columns against this snapshot on every scan)
     def exec_plan(self, plan, snapshot_ts: int, txid: int,
                   params: dict, sources: dict):
         """Run a plan fragment against this node's stores; exchange inputs
@@ -420,6 +425,7 @@ class DataNode:
         self.log({"op": "commit", "txid": txid, "ts": int(ts)}, sync=True)
         self.last_commit_ts = max(self.last_commit_ts, int(ts))
         self._forget_prepared(txid)
+        touched: dict = {}
         for kind, table, sp in self.txn_spans.pop(txid, []):
             st = self.stores.get(table)
             if st is None:
@@ -430,6 +436,15 @@ class DataNode:
                 st.clear_locks([sp])
             else:
                 st.backfill_delete([sp], np.int64(ts))
+            if kind != "lock":
+                touched[table] = st
+        if snapcheck.history_on() and touched:
+            # SI history: one write event per DN commit, table names
+            # DN-qualified — same-named stores on different DNs have
+            # independent version sequences and must not alias
+            snapcheck.note_write(
+                txid, ts, {f"dn{self.index}.{t}": st.version
+                           for t, st in touched.items()})
         if self.decoder is not None:
             self.decoder.on_commit(txid, ts)
         # wake lock waiters LAST: they retry against settled state

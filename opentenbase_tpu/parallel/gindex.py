@@ -183,6 +183,9 @@ def _as_route_array(td: TableDef, col: str, keys: list):
     return np.asanyarray(keys)
 
 
+# snapshot-gate: txn.snapshot_ts
+# (uniqueness probes scan the mapping under the inserting
+# transaction's snapshot)
 def maintain_insert(session, td: TableDef, coldata: dict, n: int,
                     sid: Optional[np.ndarray], txn) -> None:
     """Add one mapping row per inserted base row; enforce UNIQUE."""
@@ -222,6 +225,7 @@ def maintain_insert(session, td: TableDef, coldata: dict, n: int,
                              len(rows))
 
 
+# snapshot-gate: txn.snapshot_ts
 def affected_keys(session, td: TableDef, quals: list, txn) -> dict:
     """Distinct key values (storage rep) per indexed column among rows
     the quals select — captured BEFORE the base delete."""
@@ -246,6 +250,7 @@ def affected_keys(session, td: TableDef, quals: list, txn) -> dict:
     return out
 
 
+# snapshot-gate: txn.snapshot_ts
 def _derive_entries(session, td: TableDef, col: str, quals: list,
                     txn) -> tuple:
     """Scan the base table's visible rows matching `quals` and derive
@@ -395,6 +400,9 @@ def _lit_storage(col: ColumnDef, lit):
     return int(v)
 
 
+# snapshot-gate: snapshot_ts
+# (the mapping probe runs under the query's own snapshot, so the
+# node pin can never reflect rows the query cannot see)
 def _pin_by_gindex(session, rte, bq, snapshot_ts, txid):
     c = session.cluster
     reg = indexes_on(c.catalog, rte.table.name)

@@ -54,7 +54,7 @@ import numpy as np
 
 from ..obs import trace as obs_trace
 from ..obs import xray as obs_xray
-from ..utils import locks
+from ..utils import locks, snapcheck
 from . import codec
 
 _LOCK = locks.RLock("storage.bufferpool._LOCK")
@@ -471,6 +471,7 @@ class DeviceBufferPool:
     # ------------------------------------------------------------------
     # single-device tier (exec/executor.py scans, fused tier, FQS)
     # ------------------------------------------------------------------
+    # version-gate: e.version == ver
     def get_device(self, store, colnames):
         """Staged (padded, concatenated) device columns for a store at
         its current version: value columns + MVCC sys columns + null
@@ -492,6 +493,12 @@ class DeviceBufferPool:
                 self._tstats(table)[0] += 1
                 if obs_trace.ENABLED:
                     obs_trace.event("pool", table=table, hit=True)
+                if snapcheck.enabled():
+                    snapcheck.serve(
+                        "storage.bufferpool.DeviceBufferPool"
+                        ".get_device",
+                        versions=[(table, e.version)],
+                        expect_versions=[(table, ver)])
                 return e.arrs, e.n
         obs_trace.event("pool", table=table, hit=False)
         # stage outside the lock (defensive: racing stagers both build,
@@ -693,6 +700,7 @@ class DeviceBufferPool:
         with _LOCK:
             self._note_unpin_locked(entry, entry.table)
 
+    # version-gate: ent[1].version == ver
     def get_chunk(self, store, host_cols: dict, start: int,
                   chunk_rows: int, encs: dict = None,
                   consumer=None) -> ChunkEntry:
@@ -726,6 +734,12 @@ class DeviceBufferPool:
                 ent[0] = next(_SEQ)
                 self._tstats(table)[0] += 1
                 self._note_pin_locked(ent[1], table, consumer)
+                if snapcheck.enabled():
+                    snapcheck.serve(
+                        "storage.bufferpool.DeviceBufferPool"
+                        ".get_chunk",
+                        versions=[(table, ent[1].version)],
+                        expect_versions=[(table, ver)])
                 return ent[1]
             if ent is not None:
                 self._chunks.pop(key, None)
@@ -820,23 +834,38 @@ class DeviceBufferPool:
     # ------------------------------------------------------------------
     # host snapshots (dn_server stage_table wire op, spill passes)
     # ------------------------------------------------------------------
+    # version-gate: store.version == ver
     def host_snapshot(self, store) -> dict:
         """One store's live columns + dictionaries at its current
         version — {"version", "count", "cols", "dicts",
         "null_columns"}.  Version-cached: an unchanged table never
         re-concatenates (the shared staging source for the dn_server
-        stage_table op and the mesh runner's in-process snapshots)."""
+        stage_table op and the mesh runner's in-process snapshots).
+        The build re-reads the store version after materializing and
+        retries on movement: without the stability loop a DML landing
+        mid-concatenation produced a snapshot TAGGED with the old
+        version but containing (some of) the new rows — exactly the
+        torn entry peek_host_snapshot's version gate cannot catch."""
         snap = self.peek_host_snapshot(store)
         if snap is not None:
             return snap
-        ver = store.version
-        cols = store.host_live_columns([c.name for c in
-                                        store.td.columns])
-        n = len(next(iter(cols.values()))) if cols else store.row_count()
-        snap = {"version": ver, "count": n, "cols": cols,
-                "dicts": {c: list(d.values)
-                          for c, d in store.dicts.items()},
-                "null_columns": set(store.null_columns)}
+        while True:
+            ver = store.version
+            cols = store.host_live_columns([c.name for c in
+                                            store.td.columns])
+            n = len(next(iter(cols.values()))) if cols \
+                else store.row_count()
+            snap = {"version": ver, "count": n, "cols": cols,
+                    "dicts": {c: list(d.values)
+                              for c, d in store.dicts.items()},
+                    "null_columns": set(store.null_columns)}
+            if store.version == ver:
+                break
+        if snapcheck.enabled():
+            snapcheck.serve(
+                "storage.bufferpool.DeviceBufferPool.host_snapshot",
+                versions=[(store.td.name, snap["version"])],
+                expect_versions=[(store.td.name, ver)])
         nbytes = sum(int(a.nbytes) for a in cols.values())
         if nbytes <= _host_budget():
             with _LOCK:
@@ -852,13 +881,22 @@ class DeviceBufferPool:
             ent = self._dev.get(id(store))
             return ent is not None and ent[1].version == store.version
 
+    # version-gate: ent[1]["version"] == ver
     def peek_host_snapshot(self, store):
         """The cached host snapshot IF current, else None (never
         builds) — spill passes reuse it instead of re-concatenating."""
         with _LOCK:
             ent = self._host.get(id(store))
-            if ent is not None and ent[1]["version"] == store.version:
+            ver = store.version
+            if ent is not None and ent[1]["version"] == ver:
                 ent[0] = next(_SEQ)
+                if snapcheck.enabled():
+                    snapcheck.serve(
+                        "storage.bufferpool.DeviceBufferPool"
+                        ".peek_host_snapshot",
+                        versions=[(store.td.name,
+                                   ent[1]["version"])],
+                        expect_versions=[(store.td.name, ver)])
                 return ent[1]
         return None
 

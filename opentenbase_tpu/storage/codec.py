@@ -151,6 +151,16 @@ def note_staged(store, encs: dict) -> None:
         pass
 
 
+def invalidate_ladder(table: str) -> None:
+    """Drop a table's ladder entries (the DDL-drop invalidation edge:
+    a re-created table must re-learn its descriptors, not inherit the
+    dead table's value distribution)."""
+    with _STATE_LOCK:
+        for key in [k for k in _LADDER if k[0] == table]:
+            del _LADDER[key]
+        _save_locked()
+
+
 # -- descriptor choice / validation -------------------------------------
 def _range_width(span: int):
     """Narrowest enum width whose code space holds `span` values plus

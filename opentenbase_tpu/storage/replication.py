@@ -167,6 +167,7 @@ class HotStandby(DnStandby):
             self._rebuild()
 
     # -- the read surface (what the CN's replica router calls) ----------
+    # snapshot-gate: hwm >= min_hwm
     def exec_plan(self, plan, snapshot_ts: int, txid: int, params: dict,
                   sources: dict, min_hwm: int = 0):
         """Run a read fragment against the replica image, refusing when
@@ -174,12 +175,18 @@ class HotStandby(DnStandby):
         the primary).  The lock hold spans the execution on purpose:
         apply and reads serialize per replica, and the GIL drops inside
         XLA compute, so N replicas scale N-ways."""
+        from ..utils import snapcheck
         with self._lock:
             node = self._node
             hwm = node.last_commit_ts if node is not None else -1
             if node is None or hwm < min_hwm:
                 raise StandbyLag(
                     f"standby hwm {hwm} < required {min_hwm}", hwm)
+            if snapcheck.enabled() or snapcheck.history_on():
+                snapcheck.serve(
+                    "storage.replication.HotStandby.exec_plan",
+                    snapshot_gts=snapshot_ts, entry_gts=min_hwm,
+                    session=txid, source="standby")
             # may-acquire: exec.plancache._LOCK
             # may-acquire: storage.bufferpool._LOCK
             return node.exec_plan(plan, snapshot_ts, txid, params,
@@ -223,6 +230,9 @@ class DnStandbyServer:
                             # read rotation permanently
                             resp = {"ok": True, "hwm": sb.gts_hwm}
                         elif op == "exec_plan":
+                            # snapshot-gate: msg["snapshot_ts"]
+                            # (delegates: HotStandby.exec_plan
+                            # re-checks hwm >= min_hwm itself)
                             out = sb.exec_plan(
                                 msg["plan"], msg["snapshot_ts"],
                                 msg["txid"], msg.get("params") or {},

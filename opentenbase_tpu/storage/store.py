@@ -641,7 +641,8 @@ class TableStore:
         centroids = kmeans(vecs.astype(np.float32), lists) if n else \
             np.zeros((lists, cd.type.dim), np.float32)
         self.ann_indexes[col] = {"centroids": centroids, "metric": metric,
-                                 "nprobe": nprobe}
+                                 "nprobe": nprobe,
+                                 "version": self.version}
         return lists
 
     def build_hnsw_index(self, col: str, m: int = 16,
